@@ -1,0 +1,15 @@
+"""minio_tpu — a TPU-native object storage framework.
+
+A ground-up rebuild of the capabilities of minio/minio (S3-compatible
+erasure-coded object storage) designed TPU-first:
+
+- The Reed-Solomon GF(2^8) erasure codec and bitrot hashing run as batched
+  JAX/XLA (and Pallas) kernels on TPU, byte-identical with the reference
+  codec (klauspost/reedsolomon as used by /root/reference/cmd/erasure-coding.go).
+- Concurrent PutObject/GetObject/Heal calls batch their 1 MiB stripe blocks
+  into single device dispatches (see minio_tpu/parallel/).
+- The serving plane (S3 HTTP API, auth, storage, quorum) is asyncio +
+  native helpers, mirroring the reference's layer map (SURVEY.md §1).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,1 @@
+"""TPU/compute ops: GF(2^8) arithmetic, Reed-Solomon codec, bitrot hashes."""

@@ -1,0 +1,119 @@
+"""GF(2^8) arithmetic for Reed-Solomon erasure coding.
+
+Field: GF(2^8) with reducing polynomial x^8 + x^4 + x^3 + x^2 + 1 (0x11D)
+and generator 2 — the same field used by the reference codec
+(klauspost/reedsolomon, consumed by /root/reference/cmd/erasure-coding.go:63),
+so that encodings are byte-identical and pass the reference's boot-time
+golden self-test (/root/reference/cmd/erasure-coding.go:149-206).
+
+Everything here is table-driven numpy on uint8; the JAX/TPU kernels in
+rs_jax.py consume the same tables.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_POLY = 0x11D  # x^8 + x^4 + x^3 + x^2 + 1
+
+
+def _build_tables() -> tuple[np.ndarray, np.ndarray]:
+    exp = np.zeros(255, dtype=np.uint8)
+    log = np.zeros(256, dtype=np.int32)
+    x = 1
+    for i in range(255):
+        exp[i] = x
+        log[x] = i
+        x <<= 1
+        if x & 0x100:
+            x ^= _POLY
+    log[0] = -1  # log(0) is undefined; sentinel
+    return exp, log
+
+
+EXP_TABLE, LOG_TABLE = _build_tables()
+
+# Full 256x256 multiplication table (64 KiB) — the workhorse for numpy paths.
+MUL_TABLE = np.zeros((256, 256), dtype=np.uint8)
+_nz = np.arange(1, 256)
+MUL_TABLE[1:, 1:] = EXP_TABLE[(LOG_TABLE[_nz][:, None] + LOG_TABLE[_nz][None, :]) % 255]
+
+INV_TABLE = np.zeros(256, dtype=np.uint8)
+INV_TABLE[1:] = EXP_TABLE[(255 - LOG_TABLE[_nz]) % 255]
+del _nz
+
+
+def gf_mul(a: int, b: int) -> int:
+    return int(MUL_TABLE[a, b])
+
+
+def gf_div(a: int, b: int) -> int:
+    if b == 0:
+        raise ZeroDivisionError("GF(2^8) division by zero")
+    return int(MUL_TABLE[a, INV_TABLE[b]])
+
+
+def gf_exp(a: int, n: int) -> int:
+    """a**n in GF(2^8) — mirrors the reference's galExp used to build the
+    Vandermonde matrix (klauspost/reedsolomon galois.go)."""
+    if n == 0:
+        return 1
+    if a == 0:
+        return 0
+    return int(EXP_TABLE[(int(LOG_TABLE[a]) * n) % 255])
+
+
+def gf_matmul(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Matrix product over GF(2^8). a: [m,k] uint8, b: [k,n] uint8 -> [m,n]."""
+    a = np.asarray(a, dtype=np.uint8)
+    b = np.asarray(b, dtype=np.uint8)
+    # products[m,k,n] then XOR-reduce over k
+    prod = MUL_TABLE[a[:, :, None], b[None, :, :]]
+    return np.bitwise_xor.reduce(prod, axis=1)
+
+
+def gf_matvec_blocks(m: np.ndarray, data: np.ndarray) -> np.ndarray:
+    """Apply an [r,k] GF matrix to k data shards of n bytes each.
+
+    data: [k, n] uint8; returns [r, n] uint8 (out[i] = XOR_j m[i,j]*data[j]).
+    Vectorized over n; loops only over k (<=16 for MinIO stripe widths).
+    """
+    m = np.asarray(m, dtype=np.uint8)
+    data = np.asarray(data, dtype=np.uint8)
+    r, k = m.shape
+    out = np.zeros((r, data.shape[1]), dtype=np.uint8)
+    for j in range(k):
+        out ^= MUL_TABLE[m[:, j][:, None], data[j][None, :]]
+    return out
+
+
+def gf_mat_inv(m: np.ndarray) -> np.ndarray:
+    """Invert a square matrix over GF(2^8) by Gauss-Jordan elimination.
+
+    Raises ValueError if singular. Mirrors the matrix inversion the
+    reference codec performs when building the systematic encoding matrix
+    and when reconstructing from a subset of shards.
+    """
+    m = np.asarray(m, dtype=np.uint8)
+    n = m.shape[0]
+    assert m.shape == (n, n)
+    aug = np.concatenate([m.copy(), np.eye(n, dtype=np.uint8)], axis=1)
+    for col in range(n):
+        # find pivot
+        pivot = -1
+        for r in range(col, n):
+            if aug[r, col] != 0:
+                pivot = r
+                break
+        if pivot < 0:
+            raise ValueError("matrix is singular")
+        if pivot != col:
+            aug[[col, pivot]] = aug[[pivot, col]]
+        # scale pivot row to 1
+        inv = INV_TABLE[aug[col, col]]
+        aug[col] = MUL_TABLE[inv, aug[col]]
+        # eliminate all other rows
+        for r in range(n):
+            if r != col and aug[r, col] != 0:
+                aug[r] ^= MUL_TABLE[aug[r, col], aug[col]]
+    return aug[:, n:].copy()

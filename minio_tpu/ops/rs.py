@@ -1,0 +1,172 @@
+"""Reed-Solomon erasure codec (numpy reference implementation).
+
+Byte-identical with the reference's codec: klauspost/reedsolomon's default
+systematic Vandermonde construction, as wrapped by
+/root/reference/cmd/erasure-coding.go:42-113 (NewErasure/EncodeData/
+DecodeDataBlocks/DecodeDataAndParityBlocks). Verified against the 60 golden
+xxhash64 vectors hard-coded in the reference's boot self-test
+(/root/reference/cmd/erasure-coding.go:160).
+
+This module is the CPU/correctness reference; the TPU path lives in
+rs_jax.py and must agree bit-for-bit with this one.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import gf
+
+
+def build_matrix(data_shards: int, total_shards: int) -> np.ndarray:
+    """Systematic encoding matrix [total, data]: identity on top, parity below.
+
+    Construction (matching the reference dependency's buildMatrix):
+    vandermonde[r, c] = r**c in GF(2^8); multiply by the inverse of the top
+    square so the first `data_shards` rows become the identity.
+    """
+    vm = np.zeros((total_shards, data_shards), dtype=np.uint8)
+    for r in range(total_shards):
+        for c in range(data_shards):
+            vm[r, c] = gf.gf_exp(r, c)
+    top_inv = gf.gf_mat_inv(vm[:data_shards, :data_shards])
+    return gf.gf_matmul(vm, top_inv)
+
+
+class ReedSolomon:
+    """Systematic RS(d+p, d) codec over GF(2^8).
+
+    API mirrors the Erasure wrapper in the reference
+    (/root/reference/cmd/erasure-coding.go:35): encode fills parity shards,
+    reconstruct recovers missing shards from any d survivors.
+    """
+
+    def __init__(self, data_shards: int, parity_shards: int):
+        if data_shards <= 0 or parity_shards < 0:
+            raise ValueError("invalid shard count")
+        if data_shards + parity_shards > 256:
+            raise ValueError("too many shards (max 256)")
+        self.data_shards = data_shards
+        self.parity_shards = parity_shards
+        self.total_shards = data_shards + parity_shards
+        self.matrix = build_matrix(data_shards, self.total_shards)
+        # parity rows only — the part actually multiplied on encode
+        self.parity_matrix = self.matrix[data_shards:, :]
+
+    # -- encoding ----------------------------------------------------------
+
+    def split(self, data: bytes | np.ndarray) -> np.ndarray:
+        """Split a byte buffer into [total, per_shard] with zero padding.
+
+        per_shard = ceil(len/d); parity rows zeroed (filled by encode).
+        Matches the reference's Split + Encode flow
+        (/root/reference/cmd/erasure-coding.go:77-89).
+        """
+        if isinstance(data, np.ndarray):
+            if data.dtype != np.uint8 or data.ndim != 1:
+                raise ValueError("split expects 1-D uint8 array or bytes")
+            buf = data
+        else:
+            buf = np.frombuffer(bytes(data), dtype=np.uint8)
+        if buf.size == 0:
+            raise ValueError("empty data")
+        per_shard = -(-buf.size // self.data_shards)
+        shards = np.zeros((self.total_shards, per_shard), dtype=np.uint8)
+        flat = shards[: self.data_shards].reshape(-1)
+        flat[: buf.size] = buf
+        return shards
+
+    def encode(self, shards: np.ndarray) -> np.ndarray:
+        """Fill parity rows in-place from data rows; returns shards."""
+        shards[self.data_shards :] = gf.gf_matvec_blocks(
+            self.parity_matrix, shards[: self.data_shards]
+        )
+        return shards
+
+    def encode_data(self, data: bytes) -> np.ndarray:
+        """bytes -> fully encoded [total, per_shard] (EncodeData equivalent)."""
+        return self.encode(self.split(data))
+
+    # -- verification / reconstruction ------------------------------------
+
+    def verify(self, shards: np.ndarray) -> bool:
+        expect = gf.gf_matvec_blocks(self.parity_matrix, shards[: self.data_shards])
+        return bool(np.array_equal(expect, shards[self.data_shards :]))
+
+    def decode_matrix_for(self, present: list[int]) -> np.ndarray:
+        """[d, d] matrix mapping d surviving shards -> original data shards.
+
+        `present` lists >=d surviving shard indices (sorted); the first d are
+        used, matching the reference's reconstruct which picks the first d
+        valid shards.
+        """
+        rows = present[: self.data_shards]
+        if len(rows) < self.data_shards:
+            raise ValueError("need at least data_shards surviving shards")
+        sub = self.matrix[rows, :]
+        return gf.gf_mat_inv(sub)
+
+    def reconstruct(
+        self, shards: list[np.ndarray | None], data_only: bool = False
+    ) -> list[np.ndarray | None]:
+        """Recover missing shards (None entries) in place.
+
+        data_only=True mirrors ReconstructData (parity left missing);
+        otherwise mirrors Reconstruct (everything rebuilt).
+        Reference behavior: /root/reference/cmd/erasure-coding.go:94-113.
+        """
+        if len(shards) != self.total_shards:
+            raise ValueError("wrong shard count")
+        present = [i for i, s in enumerate(shards) if s is not None and len(s) > 0]
+        if len(present) == self.total_shards:
+            return [np.asarray(s) for s in shards]  # nothing to do
+        if len(present) < self.data_shards:
+            raise ValueError("too few shards to reconstruct")
+        per_shard = len(shards[present[0]])
+        if any(len(shards[i]) != per_shard for i in present):
+            raise ValueError("surviving shards have mismatched lengths")
+
+        avail = np.stack([np.asarray(shards[i], dtype=np.uint8) for i in present[: self.data_shards]])
+        if present[: self.data_shards] == list(range(self.data_shards)):
+            # all data shards survived (e.g. parity-only loss): no inversion needed
+            data = avail
+        else:
+            dec = self.decode_matrix_for(present)
+            data = gf.gf_matvec_blocks(dec, avail)  # [d, per_shard] original data
+
+        out: list[np.ndarray] = [None] * self.total_shards  # type: ignore[list-item]
+        for i in range(self.total_shards):
+            if shards[i] is not None and len(shards[i]) > 0:
+                out[i] = np.asarray(shards[i], dtype=np.uint8)
+        for i in range(self.data_shards):
+            if out[i] is None:
+                out[i] = data[i]
+        if not data_only:
+            missing_parity = [
+                i for i in range(self.data_shards, self.total_shards) if out[i] is None
+            ]
+            if missing_parity:
+                rows = np.array([i - self.data_shards for i in missing_parity])
+                par = gf.gf_matvec_blocks(self.parity_matrix[rows], data)
+                for j, i in enumerate(missing_parity):
+                    out[i] = par[j]
+        # data_only=True leaves missing parity as None (ReconstructData semantics)
+        return out
+
+    def join(self, shards: list[np.ndarray], size: int) -> bytes:
+        """Concatenate data shards and trim padding to `size` bytes."""
+        flat = np.concatenate([np.asarray(s, dtype=np.uint8) for s in shards[: self.data_shards]])
+        return flat[:size].tobytes()
+
+
+_codec_cache: dict[tuple[int, int], ReedSolomon] = {}
+
+
+def get_codec(data_shards: int, parity_shards: int) -> ReedSolomon:
+    """Cached codec lookup — mirrors the lazy per-(d,p) encoder in the
+    reference (/root/reference/cmd/erasure-coding.go:58-71)."""
+    key = (data_shards, parity_shards)
+    c = _codec_cache.get(key)
+    if c is None:
+        c = _codec_cache[key] = ReedSolomon(data_shards, parity_shards)
+    return c
