@@ -13,6 +13,8 @@ rs_jax.py and must agree bit-for-bit with this one.
 
 from __future__ import annotations
 
+import functools
+
 import numpy as np
 
 from . import gf
@@ -109,9 +111,10 @@ class ReedSolomon:
     def reconstruct(
         self, shards: list[np.ndarray | None], data_only: bool = False
     ) -> list[np.ndarray | None]:
-        """Recover missing shards (None entries) in place.
+        """Recover missing shards (None entries); returns a NEW list.
 
-        data_only=True mirrors ReconstructData (parity left missing);
+        The input list is not mutated. data_only=True mirrors
+        ReconstructData (parity left missing);
         otherwise mirrors Reconstruct (everything rebuilt).
         Reference behavior: /root/reference/cmd/erasure-coding.go:94-113.
         """
@@ -159,14 +162,8 @@ class ReedSolomon:
         return flat[:size].tobytes()
 
 
-_codec_cache: dict[tuple[int, int], ReedSolomon] = {}
-
-
+@functools.lru_cache(maxsize=None)
 def get_codec(data_shards: int, parity_shards: int) -> ReedSolomon:
     """Cached codec lookup — mirrors the lazy per-(d,p) encoder in the
     reference (/root/reference/cmd/erasure-coding.go:58-71)."""
-    key = (data_shards, parity_shards)
-    c = _codec_cache.get(key)
-    if c is None:
-        c = _codec_cache[key] = ReedSolomon(data_shards, parity_shards)
-    return c
+    return ReedSolomon(data_shards, parity_shards)
