@@ -1,0 +1,298 @@
+"""HighwayHash-256 — MinIO's default bitrot hash (HighwayHash256/256S).
+
+Implemented from the HighwayHash specification (google/highwayhash; the
+reference consumes it via minio/highwayhash, see
+/root/reference/cmd/bitrot.go:28,55 and the magic key at :37). Validated
+against the reference's boot-time golden chain checksum
+(/root/reference/cmd/bitrot.go:228-229).
+
+Three tiers:
+- `HighwayHash256`: streaming scalar (pure Python) — correctness reference
+  and small-message path.
+- `hash256_batch_numpy`: vectorized over a batch of equal-length blocks
+  (numpy uint64 lanes) — CPU fallback for the bitrot plane.
+- the JAX/TPU batched variant lives in bitrot_jax.py and must match these.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+M64 = (1 << 64) - 1
+
+INIT0 = (0xDBE6D5D5FE4CCE2F, 0xA4093822299F31D0, 0x13198A2E03707344, 0x243F6A8885A308D3)
+INIT1 = (0x3BD39E10CB0EF593, 0xC0ACF169B5F18A8C, 0xBE5466CF34E90C6C, 0x452821E638D01377)
+
+# HH-256 hash (zero key) of the first 100 decimals of pi — the key MinIO uses
+# for all bitrot hashing (/root/reference/cmd/bitrot.go:37).
+MINIO_KEY = bytes(
+    [0x4B, 0xE7, 0x34, 0xFA, 0x8E, 0x23, 0x8A, 0xCD, 0x26, 0x3E, 0x83, 0xE6,
+     0xBB, 0x96, 0x85, 0x52, 0x04, 0x0F, 0x93, 0x5D, 0xA3, 0x9F, 0x44, 0x14,
+     0x97, 0xE0, 0x9D, 0x13, 0x22, 0xDE, 0x36, 0xA0]
+)
+
+
+def _rot32(x: int) -> int:
+    return ((x >> 32) | (x << 32)) & M64
+
+
+def _zipper_merge_add(v1: int, v0: int, add1: int, add0: int) -> tuple[int, int]:
+    """The byte-shuffle mix of one 128-bit half; returns updated (add1, add0)."""
+    add0 = (add0 + (
+        (((v0 & 0x00000000FF000000) | (v1 & 0x000000FF00000000)) >> 24)
+        | (((v0 & 0x0000FF0000000000) | (v1 & 0x00FF000000000000)) >> 16)
+        | (v0 & 0x0000000000FF0000)
+        | ((v0 & 0x000000000000FF00) << 32)
+        | ((v1 & 0xFF00000000000000) >> 8)
+        | ((v0 << 56) & M64)
+    )) & M64
+    add1 = (add1 + (
+        (((v1 & 0x00000000FF000000) | (v0 & 0x000000FF00000000)) >> 24)
+        | (v1 & 0x0000000000FF0000)
+        | ((v1 & 0x0000FF0000000000) >> 16)
+        | ((v1 & 0x000000000000FF00) << 24)
+        | ((v0 & 0x00FF000000000000) >> 8)
+        | ((v1 & 0x00000000000000FF) << 48)
+        | (v0 & 0xFF00000000000000)
+    )) & M64
+    return add1, add0
+
+
+class HighwayHash256:
+    """Streaming HighwayHash with 256-bit output (hash.Hash-style API)."""
+
+    digest_size = 32
+    block_size = 32
+
+    def __init__(self, key: bytes = MINIO_KEY):
+        if len(key) != 32:
+            raise ValueError("HighwayHash key must be 32 bytes")
+        self._key = tuple(
+            int.from_bytes(key[8 * i : 8 * i + 8], "little") for i in range(4)
+        )
+        self.reset()
+
+    def reset(self) -> None:
+        k = self._key
+        self.v0 = [INIT0[i] ^ k[i] for i in range(4)]
+        self.v1 = [INIT1[i] ^ _rot32(k[i]) for i in range(4)]
+        self.mul0 = list(INIT0)
+        self.mul1 = list(INIT1)
+        self._buf = b""
+
+    # -- core rounds -------------------------------------------------------
+
+    def _update(self, packet: bytes) -> None:
+        v0, v1, mul0, mul1 = self.v0, self.v1, self.mul0, self.mul1
+        for i in range(4):
+            a = int.from_bytes(packet[8 * i : 8 * i + 8], "little")
+            v1[i] = (v1[i] + mul0[i] + a) & M64
+            mul0[i] ^= ((v1[i] & 0xFFFFFFFF) * (v0[i] >> 32)) & M64
+            v0[i] = (v0[i] + mul1[i]) & M64
+            mul1[i] ^= ((v0[i] & 0xFFFFFFFF) * (v1[i] >> 32)) & M64
+        v0[1], v0[0] = _zipper_merge_add(v1[1], v1[0], v0[1], v0[0])
+        v0[3], v0[2] = _zipper_merge_add(v1[3], v1[2], v0[3], v0[2])
+        v1[1], v1[0] = _zipper_merge_add(v0[1], v0[0], v1[1], v1[0])
+        v1[3], v1[2] = _zipper_merge_add(v0[3], v0[2], v1[3], v1[2])
+
+    def _update_remainder(self, rem: bytes) -> None:
+        size = len(rem)  # in (0, 32)
+        size4 = size & 3
+        for i in range(4):
+            self.v0[i] = (self.v0[i] + ((size << 32) + size)) & M64
+        # rotate each 32-bit half of each v1 lane left by `size`
+        for i in range(4):
+            lo = self.v1[i] & 0xFFFFFFFF
+            hi = self.v1[i] >> 32
+            lo = ((lo << size) | (lo >> (32 - size))) & 0xFFFFFFFF if size else lo
+            hi = ((hi << size) | (hi >> (32 - size))) & 0xFFFFFFFF if size else hi
+            self.v1[i] = (hi << 32) | lo
+        packet = bytearray(32)
+        whole = size & ~3
+        packet[:whole] = rem[:whole]
+        if size & 16:
+            packet[28:32] = rem[size - 4 : size]
+        elif size4:
+            tail = rem[whole:]
+            packet[16] = tail[0]
+            packet[17] = tail[size4 >> 1]
+            packet[18] = tail[size4 - 1]
+        self._update(bytes(packet))
+
+    def _permute_and_update(self) -> None:
+        p = (
+            _rot32(self.v0[2]), _rot32(self.v0[3]),
+            _rot32(self.v0[0]), _rot32(self.v0[1]),
+        )
+        self._update(b"".join(x.to_bytes(8, "little") for x in p))
+
+    # -- public API --------------------------------------------------------
+
+    def update(self, data: bytes) -> "HighwayHash256":
+        buf = self._buf + bytes(data)
+        n = len(buf) - (len(buf) % 32)
+        for off in range(0, n, 32):
+            self._update(buf[off : off + 32])
+        self._buf = buf[n:]
+        return self
+
+    # alias matching hashlib naming
+    write = update
+
+    def digest(self) -> bytes:
+        # finalize on a copy so streaming can continue
+        clone = HighwayHash256.__new__(HighwayHash256)
+        clone._key = self._key
+        clone.v0 = list(self.v0)
+        clone.v1 = list(self.v1)
+        clone.mul0 = list(self.mul0)
+        clone.mul1 = list(self.mul1)
+        clone._buf = b""
+        if self._buf:
+            clone._update_remainder(self._buf)
+        for _ in range(10):
+            clone._permute_and_update()
+        out = b""
+        for half in (0, 2):
+            a0 = (clone.v0[half] + clone.mul0[half]) & M64
+            a1 = (clone.v0[half + 1] + clone.mul0[half + 1]) & M64
+            a2 = (clone.v1[half] + clone.mul1[half]) & M64
+            a3 = (clone.v1[half + 1] + clone.mul1[half + 1]) & M64
+            m0, m1 = _modular_reduction(a3, a2, a1, a0)
+            out += m0.to_bytes(8, "little") + m1.to_bytes(8, "little")
+        return out
+
+    def hexdigest(self) -> str:
+        return self.digest().hex()
+
+
+def _modular_reduction(a3_unmasked: int, a2: int, a1: int, a0: int) -> tuple[int, int]:
+    a3 = a3_unmasked & 0x3FFFFFFFFFFFFFFF
+    m1 = a1 ^ (((a3 << 1) | (a2 >> 63)) & M64) ^ (((a3 << 2) | (a2 >> 62)) & M64)
+    m0 = a0 ^ ((a2 << 1) & M64) ^ ((a2 << 2) & M64)
+    return m0, m1
+
+
+def hash256(data: bytes, key: bytes = MINIO_KEY) -> bytes:
+    h = HighwayHash256(key)
+    h.update(data)
+    return h.digest()
+
+
+# -- batched numpy implementation ------------------------------------------
+#
+# Same algorithm vectorized over B equal-length messages with uint64 lanes:
+# state arrays shaped [4, B]. Used as the CPU fallback of the batched bitrot
+# plane (the device path is bitrot_jax.py).
+
+def _np_zipper_merge_add(v1, v0, add1, add0):
+    add0 += (
+        (((v0 & 0x00000000FF000000) | (v1 & 0x000000FF00000000)) >> np.uint64(24))
+        | (((v0 & 0x0000FF0000000000) | (v1 & 0x00FF000000000000)) >> np.uint64(16))
+        | (v0 & np.uint64(0x0000000000FF0000))
+        | ((v0 & np.uint64(0x000000000000FF00)) << np.uint64(32))
+        | ((v1 & np.uint64(0xFF00000000000000)) >> np.uint64(8))
+        | (v0 << np.uint64(56))
+    )
+    add1 += (
+        (((v1 & 0x00000000FF000000) | (v0 & 0x000000FF00000000)) >> np.uint64(24))
+        | (v1 & np.uint64(0x0000000000FF0000))
+        | ((v1 & np.uint64(0x0000FF0000000000)) >> np.uint64(16))
+        | ((v1 & np.uint64(0x000000000000FF00)) << np.uint64(24))
+        | ((v0 & np.uint64(0x00FF000000000000)) >> np.uint64(8))
+        | ((v1 & np.uint64(0x00000000000000FF)) << np.uint64(48))
+        | (v0 & np.uint64(0xFF00000000000000))
+    )
+    return add1, add0
+
+
+class _NpState:
+    __slots__ = ("v0", "v1", "mul0", "mul1")
+
+
+def _np_init(batch: int, key: bytes) -> _NpState:
+    k = np.array(
+        [int.from_bytes(key[8 * i : 8 * i + 8], "little") for i in range(4)],
+        dtype=np.uint64,
+    )
+    s = _NpState()
+    i0 = np.array(INIT0, dtype=np.uint64)
+    i1 = np.array(INIT1, dtype=np.uint64)
+    krot = (k >> np.uint64(32)) | (k << np.uint64(32))
+    s.v0 = np.repeat((i0 ^ k)[:, None], batch, axis=1)
+    s.v1 = np.repeat((i1 ^ krot)[:, None], batch, axis=1)
+    s.mul0 = np.repeat(i0[:, None], batch, axis=1)
+    s.mul1 = np.repeat(i1[:, None], batch, axis=1)
+    return s
+
+
+def _np_update(s: _NpState, a):
+    """a: [4, B] uint64 packet lanes."""
+    m32 = np.uint64(0xFFFFFFFF)
+    s.v1 += s.mul0 + a
+    s.mul0 ^= (s.v1 & m32) * (s.v0 >> np.uint64(32))
+    s.v0 += s.mul1
+    s.mul1 ^= (s.v0 & m32) * (s.v1 >> np.uint64(32))
+    s.v0[1], s.v0[0] = _np_zipper_merge_add(s.v1[1], s.v1[0], s.v0[1], s.v0[0])
+    s.v0[3], s.v0[2] = _np_zipper_merge_add(s.v1[3], s.v1[2], s.v0[3], s.v0[2])
+    s.v1[1], s.v1[0] = _np_zipper_merge_add(s.v0[1], s.v0[0], s.v1[1], s.v1[0])
+    s.v1[3], s.v1[2] = _np_zipper_merge_add(s.v0[3], s.v0[2], s.v1[3], s.v1[2])
+
+
+def hash256_batch_numpy(blocks: np.ndarray, key: bytes = MINIO_KEY) -> np.ndarray:
+    """Hash B equal-length messages: [B, n] uint8 -> [B, 32] uint8 digests."""
+    blocks = np.ascontiguousarray(blocks, dtype=np.uint8)
+    b, n = blocks.shape
+    s = _np_init(b, key)
+    whole = n - (n % 32)
+    if whole:
+        # [B, npackets, 4] uint64 lanes -> iterate packets, vectorize batch
+        lanes = blocks[:, :whole].reshape(b, whole // 32, 4, 8)
+        lanes = lanes.view(np.uint64)[..., 0]  # little-endian host assumed
+        for pi in range(whole // 32):
+            _np_update(s, lanes[:, pi, :].T.copy())
+    rem = n - whole
+    if rem:
+        size = np.uint64(rem)
+        s.v0 += (size << np.uint64(32)) + size
+        sh = np.uint64(rem)
+        m32 = np.uint64(0xFFFFFFFF)
+        lo = s.v1 & m32
+        hi = s.v1 >> np.uint64(32)
+        lo = ((lo << sh) | (lo >> (np.uint64(32) - sh))) & m32
+        hi = ((hi << sh) | (hi >> (np.uint64(32) - sh))) & m32
+        s.v1 = (hi << np.uint64(32)) | lo
+        packet = np.zeros((b, 32), dtype=np.uint8)
+        whole4 = rem & ~3
+        packet[:, :whole4] = blocks[:, whole : whole + whole4]
+        if rem & 16:
+            packet[:, 28:32] = blocks[:, whole + rem - 4 : whole + rem]
+        elif rem & 3:
+            size4 = rem & 3
+            tail = blocks[:, whole + whole4 :]
+            packet[:, 16] = tail[:, 0]
+            packet[:, 17] = tail[:, size4 >> 1]
+            packet[:, 18] = tail[:, size4 - 1]
+        lanes = packet.reshape(b, 4, 8).view(np.uint64)[..., 0]
+        _np_update(s, lanes.T.copy())
+    for _ in range(10):
+        p = np.stack([
+            (s.v0[2] >> np.uint64(32)) | (s.v0[2] << np.uint64(32)),
+            (s.v0[3] >> np.uint64(32)) | (s.v0[3] << np.uint64(32)),
+            (s.v0[0] >> np.uint64(32)) | (s.v0[0] << np.uint64(32)),
+            (s.v0[1] >> np.uint64(32)) | (s.v0[1] << np.uint64(32)),
+        ])
+        _np_update(s, p)
+    out = np.zeros((b, 4), dtype=np.uint64)
+    for oi, half in ((0, 0), (1, 2)):
+        a0 = s.v0[half] + s.mul0[half]
+        a1 = s.v0[half + 1] + s.mul0[half + 1]
+        a2 = s.v1[half] + s.mul1[half]
+        a3 = (s.v1[half + 1] + s.mul1[half + 1]) & np.uint64(0x3FFFFFFFFFFFFFFF)
+        m1 = a1 ^ ((a3 << np.uint64(1)) | (a2 >> np.uint64(63))) ^ (
+            (a3 << np.uint64(2)) | (a2 >> np.uint64(62))
+        )
+        m0 = a0 ^ (a2 << np.uint64(1)) ^ (a2 << np.uint64(2))
+        out[:, 2 * oi] = m0
+        out[:, 2 * oi + 1] = m1
+    return out.view(np.uint8).reshape(b, 32)

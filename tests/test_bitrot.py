@@ -1,0 +1,56 @@
+"""Bitrot hash golden tests — mirrors /root/reference/cmd/bitrot.go:224-255."""
+
+import numpy as np
+import pytest
+
+from minio_tpu.ops import bitrot
+from minio_tpu.ops.highwayhash import (
+    HighwayHash256,
+    MINIO_KEY,
+    hash256,
+    hash256_batch_numpy,
+)
+
+
+def test_bitrot_self_test_passes():
+    bitrot.bitrot_self_test()  # raises on any mismatch
+
+
+@pytest.mark.parametrize("n", [0, 1, 2, 3, 4, 5, 15, 16, 17, 31, 32, 33, 63, 64, 100, 1024, 4097])
+def test_numpy_batch_matches_scalar(n):
+    rng = np.random.default_rng(n)
+    blocks = rng.integers(0, 256, size=(5, n), dtype=np.uint8)
+    batch = hash256_batch_numpy(blocks)
+    for i in range(5):
+        assert batch[i].tobytes() == hash256(blocks[i].tobytes()), f"len={n} row={i}"
+
+
+def test_streaming_split_writes():
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 256, size=1000, dtype=np.uint8).tobytes()
+    whole = hash256(data)
+    for cut in (0, 7, 32, 33, 500, 999, 1000):
+        h = HighwayHash256(MINIO_KEY)
+        h.update(data[:cut]).update(data[cut:])
+        assert h.digest() == whole, f"cut={cut}"
+    # digest() must not disturb streaming state
+    h2 = HighwayHash256(MINIO_KEY)
+    h2.update(data[:500])
+    _ = h2.digest()
+    h2.update(data[500:])
+    assert h2.digest() == whole
+
+
+def test_shard_file_size():
+    algo = bitrot.BitrotAlgorithm.HIGHWAYHASH256S
+    assert bitrot.bitrot_shard_file_size(0, 1024, algo) == 0
+    assert bitrot.bitrot_shard_file_size(1024, 1024, algo) == 1024 + 32
+    assert bitrot.bitrot_shard_file_size(1025, 1024, algo) == 1025 + 64
+    assert bitrot.bitrot_shard_file_size(100, 1024, bitrot.BitrotAlgorithm.SHA256) == 100
+
+
+def test_from_string_roundtrip():
+    for algo in bitrot.BitrotAlgorithm:
+        assert bitrot.algorithm_from_string(algo.string) is algo
+    with pytest.raises(ValueError):
+        bitrot.algorithm_from_string("md5")

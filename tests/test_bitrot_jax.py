@@ -1,0 +1,38 @@
+"""Device HighwayHash must match the scalar/numpy reference exactly."""
+
+import numpy as np
+import pytest
+
+from minio_tpu.ops import rs, rs_jax
+from minio_tpu.ops.bitrot_jax import encode_and_hash, hash256_blocks
+from minio_tpu.ops.highwayhash import hash256, hash256_batch_numpy
+
+
+@pytest.mark.parametrize("n", [32, 64, 1024, 1, 5, 17, 31, 33, 100, 131072 + 22])
+def test_hash_matches_scalar(n):
+    rng = np.random.default_rng(n)
+    blocks = rng.integers(0, 256, size=(3, n), dtype=np.uint8)
+    got = np.asarray(hash256_blocks(blocks))
+    for i in range(3):
+        assert got[i].tobytes() == hash256(blocks[i].tobytes()), f"n={n} i={i}"
+
+
+def test_hash_empty_message():
+    got = np.asarray(hash256_blocks(np.zeros((2, 0), dtype=np.uint8)))
+    assert got[0].tobytes() == hash256(b"")
+    assert got[1].tobytes() == hash256(b"")
+
+
+def test_fused_encode_and_hash():
+    d, p, n = 4, 2, 2048
+    codec = rs_jax.get_tpu_codec(d, p)
+    ref = rs.get_codec(d, p)
+    rng = np.random.default_rng(0)
+    blocks = rng.integers(0, 256, size=(3, d, n), dtype=np.uint8)
+    parity, digests = encode_and_hash(codec, blocks)
+    parity, digests = np.asarray(parity), np.asarray(digests)
+    for b in range(3):
+        full = ref.encode(np.concatenate([blocks[b], np.zeros((p, n), np.uint8)]))
+        np.testing.assert_array_equal(parity[b], full[d:])
+        want = hash256_batch_numpy(full)
+        np.testing.assert_array_equal(digests[b], want)
