@@ -1,0 +1,1 @@
+"""Erasure layer (L2/L3): striping, quorum, object semantics, healing."""

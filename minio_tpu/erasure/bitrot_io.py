@@ -1,0 +1,77 @@
+"""Streaming-bitrot shard file format: digest || block, per shard block.
+
+The on-disk format matches the reference's streaming bitrot writer
+(/root/reference/cmd/bitrot-streaming.go): a shard file holding K shard
+blocks of `shard_size` bytes (last may be short) is stored as
+    hash(block_0) || block_0 || hash(block_1) || block_1 || ...
+with HighwayHash-256 (32-byte digests, MinIO magic key). Verification reads
+recompute each block's digest (/root/reference/cmd/bitrot.go:164-216).
+"""
+
+from __future__ import annotations
+
+import os
+
+from ..ops.bitrot import DEFAULT_BITROT_ALGO, BitrotAlgorithm
+from ..storage import errors
+
+DIGEST_SIZE = 32
+
+
+def block_offset(shard_size: int, block_index: int) -> int:
+    """Shard-file offset of block `block_index` (its digest included)."""
+    return block_index * (DIGEST_SIZE + shard_size)
+
+
+def verify_block(
+    buf: bytes, expect_len: int, algo: BitrotAlgorithm = DEFAULT_BITROT_ALGO
+) -> bytes:
+    """Split one digest||block record and verify it; returns the block.
+
+    Raises FileCorrupt on short reads or digest mismatch — the bitrot
+    detection that triggers healing in the read path. Single source of
+    truth for the record layout (used by reads, inline verify, heal)."""
+    if len(buf) != DIGEST_SIZE + expect_len:
+        raise errors.FileCorrupt("short shard block")
+    digest, block = buf[:DIGEST_SIZE], buf[DIGEST_SIZE:]
+    h = algo.new()
+    h.update(block)
+    if h.digest() != digest:
+        raise errors.FileCorrupt("bitrot detected")
+    return block
+
+
+def bitrot_verify_file(
+    path: str,
+    want_file_size: int,
+    shard_size: int,
+    algo: BitrotAlgorithm = DEFAULT_BITROT_ALGO,
+) -> None:
+    """Whole-file streaming verification (heal/scanner path).
+
+    want_file_size is the *data* size of the shard (without digests); the
+    on-disk file must be exactly want_file_size + n_blocks*32.
+    """
+    n_blocks = -(-want_file_size // shard_size) if want_file_size else 0
+    expect_disk = want_file_size + n_blocks * DIGEST_SIZE
+    try:
+        actual = os.path.getsize(path)
+    except FileNotFoundError:
+        raise errors.FileNotFound(path) from None
+    if actual != expect_disk:
+        raise errors.FileCorrupt(
+            f"shard file size {actual} != expected {expect_disk}"
+        )
+    with open(path, "rb") as f:
+        left = want_file_size
+        while left > 0:
+            n = min(shard_size, left)
+            digest = f.read(DIGEST_SIZE)
+            block = f.read(n)
+            if len(digest) != DIGEST_SIZE or len(block) != n:
+                raise errors.FileCorrupt("short read during verify")
+            h = algo.new()
+            h.update(block)
+            if h.digest() != digest:
+                raise errors.FileCorrupt("bitrot detected")
+            left -= n

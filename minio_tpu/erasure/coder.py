@@ -1,0 +1,157 @@
+"""ErasureCoder — routes stripe blocks to the right codec backend.
+
+TPU-first split: every FULL stripe block of an object has the same shape
+([d, ceil(block_size/d)]), so all full blocks batch into fixed-shape fused
+encode+hash device dispatches (ops/rs_jax.py + ops/bitrot_jax.py — no
+recompilation). Only the object's final partial block has a variable shard
+size; it runs on the numpy codec (ops/rs.py + ops/highwayhash.py), which is
+byte-identical. GetObject/Heal reconstruction follows the same split.
+
+Backend forced with MINIO_TPU_BACKEND=numpy|jax (default: jax when any
+device is available).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..ops import rs
+from ..ops.highwayhash import hash256_batch_numpy
+from . import bitrot_io
+
+# max shards per device dispatch (HBM headroom: see bitrot_jax scan inputs)
+MAX_DEVICE_SHARDS = 4096
+
+BLOCK_SIZE = 1 << 20  # 1 MiB stripe block, reference blockSizeV2
+# (/root/reference/cmd/object-api-common.go:37)
+
+
+def _use_jax() -> bool:
+    mode = os.environ.get("MINIO_TPU_BACKEND", "jax")
+    return mode != "numpy"
+
+
+@dataclass
+class EncodedPart:
+    """One erasure-coded part: per-drive shard file bytes (bitrot
+    interleaved) in erasure-index order [0..d+p)."""
+
+    shard_files: list[bytes]
+    size: int  # input size
+
+
+class ErasureCoder:
+    def __init__(self, data_blocks: int, parity_blocks: int, block_size: int = BLOCK_SIZE):
+        self.d = data_blocks
+        self.p = parity_blocks
+        self.t = data_blocks + parity_blocks
+        self.block_size = block_size
+        self.shard_size = -(-block_size // data_blocks)
+        self._np = rs.get_codec(self.d, self.p)
+        self._jax = None
+        if _use_jax():
+            from ..ops import rs_jax  # deferred: jax import is heavy
+
+            self._jax = rs_jax.get_tpu_codec(self.d, self.p)
+
+    # -- encode ------------------------------------------------------------
+
+    def _encode_block_np(self, block: bytes) -> tuple[np.ndarray, np.ndarray]:
+        shards = self._np.encode_data(block)  # [t, per]
+        digests = hash256_batch_numpy(shards)
+        return shards, digests
+
+    def _encode_full_blocks(self, blocks: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """blocks: [B, d, shard_size] -> (shards [B, t, n], digests [B, t, 32])."""
+        if self._jax is not None:
+            from ..ops.bitrot_jax import encode_and_hash
+
+            parity, digests = encode_and_hash(self._jax, blocks)
+            shards = np.concatenate([blocks, np.asarray(parity)], axis=1)
+            return shards, np.asarray(digests)
+        b = blocks.shape[0]
+        shards = np.zeros((b, self.t, blocks.shape[2]), dtype=np.uint8)
+        shards[:, : self.d] = blocks
+        for i in range(b):
+            shards[i, self.d :] = self._np.encode(shards[i].copy())[self.d :]
+        digests = hash256_batch_numpy(shards.reshape(b * self.t, -1)).reshape(b, self.t, 32)
+        return shards, digests
+
+    def encode_part(self, data: bytes) -> EncodedPart:
+        """Erasure-code one part into per-drive shard files.
+
+        Full stripe blocks go to the device in batches; the partial tail
+        block (if any) uses the numpy codec. Output per drive is the
+        bitrot-interleaved shard file (digest || shard block per stripe).
+        """
+        n = len(data)
+        files = [bytearray() for _ in range(self.t)]
+        if n == 0:
+            return EncodedPart([bytes(f) for f in files], 0)
+        full = n // self.block_size
+        view = memoryview(data)
+
+        if full:
+            per = self.shard_size
+            padded_block = self.d * per  # >= block_size; zero padding at tail
+            arr = np.zeros((full, self.d, per), dtype=np.uint8)
+            flat = np.frombuffer(view[: full * self.block_size], dtype=np.uint8)
+            if padded_block == self.block_size:
+                arr[:] = flat.reshape(full, self.d, per)
+            else:
+                for b in range(full):
+                    blk = flat[b * self.block_size : (b + 1) * self.block_size]
+                    a = arr[b].reshape(-1)
+                    a[: self.block_size] = blk
+            # batch device dispatches under the HBM cap
+            max_blocks = max(1, MAX_DEVICE_SHARDS // self.t)
+            for start in range(0, full, max_blocks):
+                chunk = arr[start : start + max_blocks]
+                shards, digests = self._encode_full_blocks(chunk)
+                for b in range(chunk.shape[0]):
+                    for i in range(self.t):
+                        files[i] += digests[b, i].tobytes()
+                        files[i] += shards[b, i].tobytes()
+
+        tail = n - full * self.block_size
+        if tail:
+            shards, digests = self._encode_block_np(bytes(view[n - tail :]))
+            for i in range(self.t):
+                files[i] += digests[i].tobytes()
+                files[i] += shards[i].tobytes()
+        return EncodedPart([bytes(f) for f in files], n)
+
+    # -- decode ------------------------------------------------------------
+
+    def reconstruct_block(
+        self, present: dict[int, np.ndarray], per_shard: int
+    ) -> dict[int, np.ndarray]:
+        """Rebuild ALL missing shards of one stripe block from >= d present.
+
+        present: {erasure_index: shard bytes [per_shard]}. Returns the full
+        {index: shard} map. numpy path (single block; device batching is for
+        the heal plane)."""
+        idxs = sorted(present.keys())
+        if len(idxs) < self.d:
+            raise ValueError("not enough shards to reconstruct")
+        shards: list[np.ndarray | None] = [None] * self.t
+        for i in idxs:
+            shards[i] = present[i]
+        rec = self._np.reconstruct(shards)
+        return {i: rec[i] for i in range(self.t)}
+
+    # -- geometry ----------------------------------------------------------
+
+    def shard_sizes_for(self, total: int) -> list[tuple[int, int]]:
+        """[(block_data_len, per_shard)] for each stripe block of a part."""
+        out = []
+        full = total // self.block_size
+        for _ in range(full):
+            out.append((self.block_size, self.shard_size))
+        tail = total - full * self.block_size
+        if tail:
+            out.append((tail, -(-tail // self.d)))
+        return out

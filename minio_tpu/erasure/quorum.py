@@ -1,0 +1,143 @@
+"""Quorum reduction over per-drive results.
+
+Mirrors the reference's metadata-quorum machinery
+(/root/reference/cmd/erasure-metadata.go findFileInfoInQuorum,
+/root/reference/cmd/erasure-metadata-utils.go reduceQuorumErrs): N drives
+answer (value | error); the object layer proceeds only when >= quorum drives
+agree on the same logical version.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+
+from ..storage import errors
+from ..storage.datatypes import FileInfo
+
+
+class ErasureError(Exception):
+    pass
+
+
+class QuorumError(ErasureError):
+    """Read/write quorum not met."""
+
+    def __init__(self, msg: str, errs: list[Exception | None] | None = None):
+        super().__init__(msg)
+        self.errs = errs or []
+
+
+class ObjectNotFound(ErasureError):
+    pass
+
+
+class VersionNotFound(ErasureError):
+    pass
+
+
+class BucketNotFound(ErasureError):
+    pass
+
+
+class BucketExists(ErasureError):
+    pass
+
+
+class BucketNotEmpty(ErasureError):
+    pass
+
+
+def count_none(errs: list[Exception | None]) -> int:
+    return sum(1 for e in errs if e is None)
+
+
+def _map_err(e: Exception) -> Exception:
+    if isinstance(e, errors.FileNotFound):
+        return ObjectNotFound(str(e))
+    if isinstance(e, errors.FileVersionNotFound):
+        return VersionNotFound(str(e))
+    if isinstance(e, errors.VolumeNotFound):
+        return BucketNotFound(str(e))
+    return e
+
+
+def reduce_quorum_errs(
+    errs: list[Exception | None], quorum: int, ignored: tuple[type, ...] = ()
+) -> None:
+    """Raise unless >= quorum drives effectively succeeded.
+
+    Mirrors the reference's reduceQuorumErrs
+    (/root/reference/cmd/erasure-metadata-utils.go): `ignored` error types
+    count as success (idempotent ops); otherwise the most common error is
+    surfaced only when IT reaches quorum — a mixed bag of failures below
+    quorum is a retryable QuorumError, never an authoritative error like
+    ObjectNotFound.
+    """
+    ok = sum(1 for e in errs if e is None or isinstance(e, ignored))
+    if ok >= quorum:
+        return
+    real = [e for e in errs if e is not None and not isinstance(e, ignored)]
+    if real:
+        counts = Counter(type(e) for e in real)
+        common_type, common_count = counts.most_common(1)[0]
+        if common_count >= quorum:
+            for e in real:
+                if type(e) is common_type:
+                    raise _map_err(e) from None
+    raise QuorumError(f"quorum {quorum} not met", errs)
+
+
+def _fi_signature(fi: FileInfo) -> tuple:
+    """Fields that must agree for two drives to hold 'the same version'."""
+    return (
+        fi.version_id,
+        fi.mod_time,
+        fi.data_dir,
+        fi.deleted,
+        fi.size,
+        fi.erasure.data_blocks,
+        fi.erasure.parity_blocks,
+        tuple(fi.erasure.distribution),
+    )
+
+
+def find_file_info_in_quorum(
+    parts_metadata: list[FileInfo | None], quorum: int
+) -> FileInfo:
+    """Pick the version >= quorum drives agree on (latest wins on ties).
+
+    Raises QuorumError when no version reaches quorum
+    (/root/reference/cmd/erasure-metadata.go findFileInfoInQuorum).
+    """
+    groups: Counter = Counter()
+    for fi in parts_metadata:
+        if fi is not None and fi.is_valid():
+            groups[_fi_signature(fi)] += 1
+    best: tuple | None = None
+    for sig, cnt in groups.items():
+        if cnt >= quorum and (best is None or sig[1] > best[1]):
+            best = sig
+    if best is None:
+        raise QuorumError(f"no version found in quorum {quorum}")
+    for fi in parts_metadata:
+        if fi is not None and fi.is_valid() and _fi_signature(fi) == best:
+            return fi
+    raise QuorumError(f"no version found in quorum {quorum}")  # pragma: no cover
+
+
+def object_quorum_from_meta(
+    parts_metadata: list[FileInfo | None],
+    errs: list[Exception | None],
+    drive_count: int,
+    default_parity: int,
+) -> tuple[int, int]:
+    """(read_quorum, write_quorum) derived from the stored parity
+    (/root/reference/cmd/erasure-object.go:106)."""
+    parity = default_parity
+    for fi in parts_metadata:
+        if fi is not None and fi.is_valid() and not fi.deleted:
+            parity = fi.erasure.parity_blocks
+            break
+    data = drive_count - parity
+    write_q = data + 1 if data == parity else data
+    return data, write_q

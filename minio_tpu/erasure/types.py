@@ -1,0 +1,42 @@
+"""Object-layer types (ObjectInfo & friends) — the currency between the
+erasure layer and the S3 API layer (mirrors ObjectInfo in
+/root/reference/cmd/object-api-datatypes.go)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class ObjectInfo:
+    bucket: str = ""
+    name: str = ""
+    version_id: str = ""
+    is_latest: bool = True
+    delete_marker: bool = False
+    size: int = 0
+    mod_time: int = 0  # ns
+    etag: str = ""
+    content_type: str = ""
+    user_defined: dict[str, str] = field(default_factory=dict)
+    parts: int = 1
+    is_dir: bool = False
+    storage_class: str = "STANDARD"
+    num_versions: int = 0
+
+
+@dataclass
+class ListObjectsResult:
+    objects: list[ObjectInfo] = field(default_factory=list)
+    prefixes: list[str] = field(default_factory=list)
+    is_truncated: bool = False
+    next_marker: str = ""
+    next_version_marker: str = ""
+
+
+@dataclass
+class BucketInfo:
+    name: str
+    created: int  # ns
+    versioning: bool = False
+    object_locking: bool = False

@@ -1,0 +1,1 @@
+"""Per-drive storage layer (L1): xl.meta metadata, local drive backend."""

@@ -1,0 +1,209 @@
+"""Storage datatypes: FileInfo, ErasureInfo, part/checksum records.
+
+Behavioral mirror of the reference's FileInfo/ErasureInfo
+(/root/reference/cmd/storage-datatypes.go:191, /root/reference/cmd/
+xl-storage-format-v1.go:93) re-expressed as Python dataclasses serialized
+with msgpack (the reference uses msgp codegen for the same purpose).
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from dataclasses import dataclass, field
+
+
+def now_ns() -> int:
+    return time.time_ns()
+
+
+def new_uuid() -> str:
+    return str(uuid.uuid4())
+
+
+NULL_VERSION_ID = "null"
+
+
+@dataclass
+class ChecksumInfo:
+    part_number: int
+    algorithm: str  # bitrot algo string, e.g. "highwayhash256S"
+    hash: bytes = b""  # empty for streaming bitrot (hashes live in shard file)
+
+    def to_dict(self) -> dict:
+        return {"p": self.part_number, "a": self.algorithm, "h": self.hash}
+
+    @staticmethod
+    def from_dict(d: dict) -> "ChecksumInfo":
+        return ChecksumInfo(d["p"], d["a"], d.get("h", b""))
+
+
+@dataclass
+class ErasureInfo:
+    algorithm: str = "reedsolomon"  # on-disk codec id (ErasureAlgo ReedSolomon)
+    data_blocks: int = 0
+    parity_blocks: int = 0
+    block_size: int = 0
+    index: int = 0  # 1-based shard index held by this drive
+    distribution: list[int] = field(default_factory=list)
+    checksums: list[ChecksumInfo] = field(default_factory=list)
+
+    def shard_size(self, block_size: int | None = None) -> int:
+        bs = self.block_size if block_size is None else block_size
+        return -(-bs // self.data_blocks)
+
+    def shard_file_size(self, total_length: int) -> int:
+        """Size of one shard file for an object of total_length bytes
+        (/root/reference/cmd/erasure-coding.go:121)."""
+        if total_length == 0:
+            return 0
+        if total_length < 0:
+            return -1
+        num_blocks = total_length // self.block_size
+        last = total_length % self.block_size
+        last_shard = -(-last // self.data_blocks)
+        return num_blocks * self.shard_size() + last_shard
+
+    def to_dict(self) -> dict:
+        return {
+            "algo": self.algorithm,
+            "data": self.data_blocks,
+            "parity": self.parity_blocks,
+            "bsize": self.block_size,
+            "index": self.index,
+            "dist": self.distribution,
+            "csum": [c.to_dict() for c in self.checksums],
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "ErasureInfo":
+        return ErasureInfo(
+            algorithm=d.get("algo", "reedsolomon"),
+            data_blocks=d.get("data", 0),
+            parity_blocks=d.get("parity", 0),
+            block_size=d.get("bsize", 0),
+            index=d.get("index", 0),
+            distribution=list(d.get("dist", [])),
+            checksums=[ChecksumInfo.from_dict(c) for c in d.get("csum", [])],
+        )
+
+
+@dataclass
+class ObjectPartInfo:
+    number: int
+    size: int  # on-wire part size (after compression/encryption, pre-erasure)
+    actual_size: int  # logical size
+    mod_time: int = 0
+    etag: str = ""
+
+    def to_dict(self) -> dict:
+        return {
+            "n": self.number,
+            "s": self.size,
+            "as": self.actual_size,
+            "mt": self.mod_time,
+            "e": self.etag,
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "ObjectPartInfo":
+        return ObjectPartInfo(d["n"], d["s"], d["as"], d.get("mt", 0), d.get("e", ""))
+
+
+@dataclass
+class FileInfo:
+    """One object version as seen by one drive — the unit the quorum layer
+    reduces over (mirrors /root/reference/cmd/storage-datatypes.go:191)."""
+
+    volume: str = ""
+    name: str = ""
+    version_id: str = ""  # "" == null version
+    is_latest: bool = True
+    deleted: bool = False  # delete marker
+    data_dir: str = ""  # uuid dir holding part files; "" for inline
+    mod_time: int = 0  # ns since epoch
+    size: int = 0
+    metadata: dict[str, str] = field(default_factory=dict)
+    parts: list[ObjectPartInfo] = field(default_factory=list)
+    erasure: ErasureInfo = field(default_factory=ErasureInfo)
+    inline_data: bytes | None = None  # small objects live inside xl.meta
+    fresh: bool = False  # first write of this object
+    num_versions: int = 0
+    successor_mod_time: int = 0
+
+    def is_valid(self) -> bool:
+        if self.deleted:
+            return True
+        d, p = self.erasure.data_blocks, self.erasure.parity_blocks
+        return (
+            d > 0
+            and p >= 0
+            and len(self.erasure.distribution) == d + p
+            and sorted(self.erasure.distribution) == list(range(1, d + p + 1))
+        )
+
+    def write_quorum(self, default_parity: int) -> int:
+        """Write quorum for this layout
+        (/root/reference/cmd/erasure-object.go:1337-1341)."""
+        d = self.erasure.data_blocks or default_parity
+        p = self.erasure.parity_blocks or default_parity
+        if d == p:
+            return d + 1
+        return d
+
+    def read_quorum(self) -> int:
+        return self.erasure.data_blocks
+
+    def to_dict(self) -> dict:
+        d = {
+            "vol": self.volume,
+            "name": self.name,
+            "vid": self.version_id,
+            "del": self.deleted,
+            "ddir": self.data_dir,
+            "mt": self.mod_time,
+            "sz": self.size,
+            "meta": self.metadata,
+            "parts": [p.to_dict() for p in self.parts],
+            "ec": self.erasure.to_dict(),
+        }
+        if self.inline_data is not None:
+            d["inline"] = self.inline_data
+        return d
+
+    @staticmethod
+    def from_dict(d: dict) -> "FileInfo":
+        return FileInfo(
+            volume=d.get("vol", ""),
+            name=d.get("name", ""),
+            version_id=d.get("vid", ""),
+            deleted=d.get("del", False),
+            data_dir=d.get("ddir", ""),
+            mod_time=d.get("mt", 0),
+            size=d.get("sz", 0),
+            metadata=dict(d.get("meta", {})),
+            parts=[ObjectPartInfo.from_dict(p) for p in d.get("parts", [])],
+            erasure=ErasureInfo.from_dict(d.get("ec", {})),
+            inline_data=d.get("inline"),
+        )
+
+
+@dataclass
+class VolInfo:
+    name: str
+    created: int  # ns
+
+
+@dataclass
+class DiskInfo:
+    total: int = 0
+    free: int = 0
+    used: int = 0
+    used_inodes: int = 0
+    fs_type: str = ""
+    root_disk: bool = False
+    healing: bool = False
+    endpoint: str = ""
+    mount_path: str = ""
+    disk_id: str = ""
+    error: str = ""

@@ -1,0 +1,57 @@
+"""Storage error taxonomy.
+
+Mirrors the reference's typed storage errors (cmd/storage-errors.go) — the
+quorum-reduction logic in the erasure layer dispatches on these types.
+"""
+
+
+class StorageError(Exception):
+    pass
+
+
+class DiskNotFound(StorageError):
+    pass
+
+
+class VolumeNotFound(StorageError):
+    pass
+
+
+class VolumeExists(StorageError):
+    pass
+
+
+class VolumeNotEmpty(StorageError):
+    pass
+
+
+class FileNotFound(StorageError):
+    pass
+
+
+class FileVersionNotFound(StorageError):
+    pass
+
+
+class FileAccessDenied(StorageError):
+    pass
+
+
+class FileCorrupt(StorageError):
+    pass
+
+
+class IsNotRegular(StorageError):
+    pass
+
+
+class DiskFull(StorageError):
+    pass
+
+
+class DoneForNow(StorageError):
+    """Sentinel used by walk/scan to stop early."""
+
+
+class MethodNotAllowed(StorageError):
+    pass

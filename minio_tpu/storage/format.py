@@ -1,0 +1,145 @@
+"""xl.meta — per-object versioned metadata file.
+
+Behavioral equivalent of the reference's xl.meta v2
+(/root/reference/cmd/xl-storage-format-v2.go): one file per object holding
+ALL versions (objects + delete markers), newest first, with small-object
+data inlined. Serialization is msgpack behind a magic header (the reference
+uses msgp codegen; the schema here is ours, the semantics match).
+
+Layout: b"XLT2" + u8 format version + msgpack map:
+    {"v": [ {"id": str, "mt": int_ns, "ty": int, "meta": {...}} ],
+     "data": { data_key: bytes }}
+"ty": 1=object, 2=delete marker. "data" holds inline payloads keyed by
+version id (or "null").
+"""
+
+from __future__ import annotations
+
+import msgpack
+
+from . import errors
+from .datatypes import FileInfo
+
+MAGIC = b"XLT2"
+FORMAT_VERSION = 1
+
+TYPE_OBJECT = 1
+TYPE_DELETE_MARKER = 2
+
+# objects <= this are inlined into xl.meta when parity allows
+# (reference: smallFileThreshold 128KiB, cmd/xl-storage.go)
+INLINE_DATA_THRESHOLD = 128 * 1024
+
+
+def _data_key(version_id: str) -> str:
+    return version_id or "null"
+
+
+class XLMeta:
+    """In-memory xl.meta: ordered version list + inline data blobs."""
+
+    def __init__(self) -> None:
+        self.versions: list[dict] = []  # {"id","mt","ty","meta"}
+        self.data: dict[str, bytes] = {}
+
+    # -- serialization -----------------------------------------------------
+
+    def to_bytes(self) -> bytes:
+        payload = msgpack.packb({"v": self.versions, "data": self.data}, use_bin_type=True)
+        return MAGIC + bytes([FORMAT_VERSION]) + payload
+
+    @staticmethod
+    def from_bytes(buf: bytes) -> "XLMeta":
+        if len(buf) < 5 or buf[:4] != MAGIC:
+            raise errors.FileCorrupt("bad xl.meta magic")
+        if buf[4] != FORMAT_VERSION:
+            raise errors.FileCorrupt(f"unknown xl.meta format version {buf[4]}")
+        try:
+            payload = msgpack.unpackb(buf[5:], raw=False, strict_map_key=False)
+        except Exception as e:  # malformed msgpack == corrupt metadata
+            raise errors.FileCorrupt(f"bad xl.meta payload: {e}") from None
+        m = XLMeta()
+        m.versions = list(payload.get("v", []))
+        m.data = dict(payload.get("data", {}))
+        return m
+
+    # -- version operations ------------------------------------------------
+
+    def _sort(self) -> None:
+        # newest first; delete markers sort above objects at equal mod time
+        # (mirrors xlMetaV2VersionHeader sorting, xl-storage-format-v2.go:294)
+        self.versions.sort(key=lambda v: (v["mt"], v["ty"] == TYPE_DELETE_MARKER), reverse=True)
+
+    def find_version(self, version_id: str) -> int:
+        for i, v in enumerate(self.versions):
+            if v["id"] == version_id:
+                return i
+        return -1
+
+    def add_version(self, fi: FileInfo) -> None:
+        """Insert or replace the version `fi.version_id`."""
+        meta = fi.to_dict()
+        inline = meta.pop("inline", None)
+        entry = {
+            "id": fi.version_id,
+            "mt": fi.mod_time,
+            "ty": TYPE_DELETE_MARKER if fi.deleted else TYPE_OBJECT,
+            "meta": meta,
+        }
+        idx = self.find_version(fi.version_id)
+        if idx >= 0:
+            self.versions[idx] = entry
+        else:
+            self.versions.append(entry)
+        if inline is not None:
+            self.data[_data_key(fi.version_id)] = inline
+        else:
+            self.data.pop(_data_key(fi.version_id), None)
+        self._sort()
+
+    def delete_version(self, version_id: str) -> FileInfo:
+        """Remove a version; returns its FileInfo (for data-dir cleanup)."""
+        idx = self.find_version(version_id)
+        if idx < 0:
+            raise errors.FileVersionNotFound(version_id)
+        v = self.versions.pop(idx)
+        self.data.pop(_data_key(version_id), None)
+        return self._to_file_info(v, idx)
+
+    def _to_file_info(self, v: dict, idx: int) -> FileInfo:
+        fi = FileInfo.from_dict(v["meta"])
+        fi.version_id = v["id"]
+        fi.mod_time = v["mt"]
+        fi.deleted = v["ty"] == TYPE_DELETE_MARKER
+        fi.is_latest = idx == 0
+        fi.num_versions = len(self.versions)
+        if idx > 0:
+            fi.successor_mod_time = self.versions[idx - 1]["mt"]
+        key = _data_key(v["id"])
+        if key in self.data:
+            fi.inline_data = self.data[key]
+        return fi
+
+    def file_info(self, version_id: str | None) -> FileInfo:
+        """Resolve a version (None/'' -> latest) to FileInfo.
+
+        Raises FileVersionNotFound for unknown ids; FileNotFound when the
+        latest version is requested but none exist.
+        """
+        if not self.versions:
+            raise errors.FileNotFound("no versions")
+        if version_id:
+            idx = self.find_version(version_id)
+            if idx < 0:
+                raise errors.FileVersionNotFound(version_id)
+        else:
+            idx = 0
+        return self._to_file_info(self.versions[idx], idx)
+
+    def list_versions(self) -> list[FileInfo]:
+        return [self._to_file_info(v, i) for i, v in enumerate(self.versions)]
+
+    def data_dir_refcount(self, data_dir: str) -> int:
+        if not data_dir:
+            return 0
+        return sum(1 for v in self.versions if v["meta"].get("ddir") == data_dir)

@@ -1,0 +1,103 @@
+"""StorageAPI — the drive interface the erasure layer programs against.
+
+Mirrors the reference's 40-method StorageAPI
+(/root/reference/cmd/storage-interface.go:29-114) reduced to the calls the
+framework uses; implemented locally by XLStorage (xlstorage.py) and remotely
+by the storage RPC client (minio_tpu/cluster/storage_client.py).
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import BinaryIO, Iterator
+
+from .datatypes import DiskInfo, FileInfo, VolInfo
+
+
+class StorageAPI(ABC):
+    endpoint: str
+
+    @abstractmethod
+    def disk_info(self) -> DiskInfo: ...
+
+    @abstractmethod
+    def make_vol(self, volume: str) -> None: ...
+
+    @abstractmethod
+    def list_vols(self) -> list[VolInfo]: ...
+
+    @abstractmethod
+    def stat_vol(self, volume: str) -> VolInfo: ...
+
+    @abstractmethod
+    def delete_vol(self, volume: str, force: bool = False) -> None: ...
+
+    # -- metadata ----------------------------------------------------------
+
+    @abstractmethod
+    def write_metadata(self, volume: str, path: str, fi: FileInfo) -> None: ...
+
+    @abstractmethod
+    def update_metadata(self, volume: str, path: str, fi: FileInfo) -> None: ...
+
+    @abstractmethod
+    def read_version(
+        self, volume: str, path: str, version_id: str = "", read_data: bool = False
+    ) -> FileInfo: ...
+
+    @abstractmethod
+    def read_versions(self, volume: str, path: str) -> list[FileInfo]: ...
+
+    @abstractmethod
+    def delete_version(self, volume: str, path: str, fi: FileInfo) -> None: ...
+
+    # -- object data -------------------------------------------------------
+
+    @abstractmethod
+    def rename_data(
+        self, src_volume: str, src_path: str, fi: FileInfo, dst_volume: str, dst_path: str
+    ) -> None: ...
+
+    @abstractmethod
+    def create_file(self, volume: str, path: str, data: bytes | BinaryIO) -> None: ...
+
+    @abstractmethod
+    def append_file(self, volume: str, path: str, data: bytes) -> None: ...
+
+    @abstractmethod
+    def read_file(self, volume: str, path: str, offset: int = 0, length: int = -1) -> bytes: ...
+
+    @abstractmethod
+    def read_file_stream(self, volume: str, path: str, offset: int, length: int) -> BinaryIO: ...
+
+    @abstractmethod
+    def rename_file(
+        self, src_volume: str, src_path: str, dst_volume: str, dst_path: str
+    ) -> None: ...
+
+    @abstractmethod
+    def delete(self, volume: str, path: str, recursive: bool = False) -> None: ...
+
+    @abstractmethod
+    def delete_versions(
+        self, volume: str, path: str, versions: list[FileInfo]
+    ) -> list[Exception | None]: ...
+
+    # -- listing / scanning ------------------------------------------------
+
+    @abstractmethod
+    def list_dir(self, volume: str, path: str, count: int = -1) -> list[str]: ...
+
+    @abstractmethod
+    def walk_dir(self, volume: str, base: str = "") -> Iterator[str]: ...
+
+    @abstractmethod
+    def stat_info_file(self, volume: str, path: str) -> int: ...
+
+    # -- integrity ---------------------------------------------------------
+
+    @abstractmethod
+    def verify_file(self, volume: str, path: str, fi: FileInfo) -> None: ...
+
+    def close(self) -> None:  # pragma: no cover - default no-op
+        pass

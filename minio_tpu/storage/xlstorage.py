@@ -1,0 +1,427 @@
+"""XLStorage — local POSIX drive backend.
+
+Behavioral mirror of the reference's xlStorage (/root/reference/cmd/
+xl-storage.go): one directory per drive; objects live at
+<drive>/<bucket>/<object>/xl.meta with erasure shard files in a
+uuid-named data dir next to it; writes stage in <drive>/.minio.sys/tmp and
+move into place with atomic renames; deletes move to a trash dir that is
+purged asynchronously (moveToTrash, xl-storage.go:1295).
+
+Differences from the reference, by design:
+- No O_DIRECT (Python path; the native IO helper can add it later) — but
+  the write path preserves the same atomicity contract: data dirs and
+  xl.meta never visible half-written.
+- xl.meta is our msgpack schema (storage/format.py), same semantics.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+import uuid
+from typing import BinaryIO, Iterator
+
+from . import errors
+from .datatypes import DiskInfo, FileInfo, VolInfo
+from .format import XLMeta
+from .interface import StorageAPI
+
+SYS_DIR = ".minio.sys"
+TMP_DIR = f"{SYS_DIR}/tmp"
+TRASH_DIR = f"{SYS_DIR}/trash"
+META_FILE = "xl.meta"
+
+_FSYNC = os.environ.get("MINIO_TPU_FSYNC", "0") == "1"
+
+
+def _clean_rel(path: str) -> str:
+    """Reject traversal; normalize an object path to a safe relative path."""
+    if path.startswith("/"):
+        path = path.lstrip("/")
+    norm = os.path.normpath(path) if path else ""
+    if norm.startswith("..") or os.path.isabs(norm):
+        raise errors.FileAccessDenied(path)
+    return "" if norm == "." else norm
+
+
+class XLStorage(StorageAPI):
+    def __init__(self, root: str, endpoint: str = ""):
+        self.root = os.path.abspath(root)
+        self.endpoint = endpoint or self.root
+        self.disk_id = ""
+        self._meta_lock = threading.RLock()
+        os.makedirs(os.path.join(self.root, TMP_DIR), exist_ok=True)
+        os.makedirs(os.path.join(self.root, TRASH_DIR), exist_ok=True)
+
+    # -- path helpers ------------------------------------------------------
+
+    def _vol_path(self, volume: str) -> str:
+        # system volumes may be nested (".minio.sys/tmp"), like the
+        # reference's minioMetaTmpBucket
+        v = _clean_rel(volume)
+        if not v:
+            raise errors.FileAccessDenied(volume)
+        return os.path.join(self.root, v)
+
+    def _file_path(self, volume: str, path: str) -> str:
+        return os.path.join(self._vol_path(volume), _clean_rel(path))
+
+    def _check_vol(self, volume: str) -> str:
+        p = self._vol_path(volume)
+        if not os.path.isdir(p):
+            raise errors.VolumeNotFound(volume)
+        return p
+
+    # -- volumes -----------------------------------------------------------
+
+    def disk_info(self) -> DiskInfo:
+        st = os.statvfs(self.root)
+        total = st.f_blocks * st.f_frsize
+        free = st.f_bavail * st.f_frsize
+        return DiskInfo(
+            total=total,
+            free=free,
+            used=total - free,
+            fs_type="posix",
+            endpoint=self.endpoint,
+            mount_path=self.root,
+            disk_id=self.disk_id,
+        )
+
+    def make_vol(self, volume: str) -> None:
+        p = self._vol_path(volume)
+        if os.path.isdir(p):
+            raise errors.VolumeExists(volume)
+        os.makedirs(p, exist_ok=True)
+
+    def list_vols(self) -> list[VolInfo]:
+        out = []
+        for name in sorted(os.listdir(self.root)):
+            full = os.path.join(self.root, name)
+            if os.path.isdir(full):
+                out.append(VolInfo(name, int(os.stat(full).st_ctime_ns)))
+        return out
+
+    def stat_vol(self, volume: str) -> VolInfo:
+        p = self._check_vol(volume)
+        return VolInfo(_clean_rel(volume), int(os.stat(p).st_ctime_ns))
+
+    def delete_vol(self, volume: str, force: bool = False) -> None:
+        p = self._check_vol(volume)
+        if force:
+            self._to_trash(p)
+            return
+        try:
+            os.rmdir(p)
+        except OSError:
+            raise errors.VolumeNotEmpty(volume) from None
+
+    # -- xl.meta -----------------------------------------------------------
+
+    def _meta_path(self, volume: str, path: str) -> str:
+        return os.path.join(self._file_path(volume, path), META_FILE)
+
+    def _read_meta(self, volume: str, path: str) -> XLMeta:
+        try:
+            with open(self._meta_path(volume, path), "rb") as f:
+                return XLMeta.from_bytes(f.read())
+        except FileNotFoundError:
+            self._check_vol(volume)
+            raise errors.FileNotFound(f"{volume}/{path}") from None
+
+    def _write_meta(self, volume: str, path: str, meta: XLMeta) -> None:
+        dst = self._meta_path(volume, path)
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        tmp = os.path.join(self.root, TMP_DIR, str(uuid.uuid4()))
+        buf = meta.to_bytes()
+        with open(tmp, "wb") as f:
+            f.write(buf)
+            if _FSYNC:
+                f.flush()
+                os.fsync(f.fileno())
+        os.replace(tmp, dst)
+
+    def _trash_replaced_data_dir(self, volume: str, path: str, meta: XLMeta, fi: FileInfo) -> None:
+        """When add_version will replace an existing version, its old data
+        dir must not leak (reference trashes the destination data path on
+        replace, /root/reference/cmd/xl-storage.go RenameData)."""
+        idx = meta.find_version(fi.version_id)
+        if idx < 0:
+            return
+        old_ddir = meta.versions[idx]["meta"].get("ddir", "")
+        if not old_ddir or old_ddir == fi.data_dir:
+            return
+        if meta.data_dir_refcount(old_ddir) > 1:
+            return
+        full = os.path.join(self._file_path(volume, path), old_ddir)
+        if os.path.isdir(full):
+            self._to_trash(full)
+
+    def write_metadata(self, volume: str, path: str, fi: FileInfo) -> None:
+        self._check_vol(volume)
+        with self._meta_lock:
+            try:
+                meta = self._read_meta(volume, path)
+            except errors.FileNotFound:
+                meta = XLMeta()
+            self._trash_replaced_data_dir(volume, path, meta, fi)
+            meta.add_version(fi)
+            self._write_meta(volume, path, meta)
+
+    def update_metadata(self, volume: str, path: str, fi: FileInfo) -> None:
+        with self._meta_lock:
+            meta = self._read_meta(volume, path)
+            if meta.find_version(fi.version_id) < 0:
+                raise errors.FileVersionNotFound(fi.version_id)
+            meta.add_version(fi)
+            self._write_meta(volume, path, meta)
+
+    def read_version(
+        self, volume: str, path: str, version_id: str = "", read_data: bool = False
+    ) -> FileInfo:
+        meta = self._read_meta(volume, path)
+        fi = meta.file_info(version_id)
+        fi.volume = volume
+        fi.name = path
+        if not read_data:
+            # callers that only need metadata shouldn't lug inline payloads
+            # around, but they do need to know data is inline (empty marker)
+            if fi.inline_data is not None:
+                fi.inline_data = b"" if len(fi.inline_data) else fi.inline_data
+        return fi
+
+    def read_versions(self, volume: str, path: str) -> list[FileInfo]:
+        meta = self._read_meta(volume, path)
+        out = meta.list_versions()
+        for fi in out:
+            fi.volume = volume
+            fi.name = path
+        return out
+
+    def delete_version(self, volume: str, path: str, fi: FileInfo) -> None:
+        with self._meta_lock:
+            meta = self._read_meta(volume, path)
+            removed = meta.delete_version(fi.version_id)
+            if removed.data_dir and meta.data_dir_refcount(removed.data_dir) == 0:
+                ddir = os.path.join(self._file_path(volume, path), removed.data_dir)
+                if os.path.isdir(ddir):
+                    self._to_trash(ddir)
+            if meta.versions:
+                self._write_meta(volume, path, meta)
+            else:
+                # last version gone: remove xl.meta and prune empty dirs
+                obj_dir = self._file_path(volume, path)
+                try:
+                    os.remove(os.path.join(obj_dir, META_FILE))
+                except FileNotFoundError:
+                    pass
+                self._prune_empty(obj_dir, self._check_vol(volume))
+
+    def delete_versions(
+        self, volume: str, path: str, versions: list[FileInfo]
+    ) -> list[Exception | None]:
+        out: list[Exception | None] = []
+        for fi in versions:
+            try:
+                self.delete_version(volume, path, fi)
+                out.append(None)
+            except Exception as e:
+                out.append(e)
+        return out
+
+    # -- data --------------------------------------------------------------
+
+    def rename_data(
+        self, src_volume: str, src_path: str, fi: FileInfo, dst_volume: str, dst_path: str
+    ) -> None:
+        """Atomically move a staged data dir into place + commit the version.
+
+        Mirrors the reference's RenameData (/root/reference/cmd/
+        xl-storage.go): shards are written under a tmp uuid dir first; commit
+        is rename(tmp/dataDir -> object/dataDir) then xl.meta update.
+        """
+        self._check_vol(dst_volume)
+        src = self._file_path(src_volume, src_path)
+        dst_dir = self._file_path(dst_volume, dst_path)
+        with self._meta_lock:
+            if fi.data_dir:
+                src_data = os.path.join(src, fi.data_dir)
+                dst_data = os.path.join(dst_dir, fi.data_dir)
+                if not os.path.isdir(src_data):
+                    raise errors.FileNotFound(src_data)
+                os.makedirs(dst_dir, exist_ok=True)
+                if os.path.isdir(dst_data):
+                    self._to_trash(dst_data)
+                os.replace(src_data, dst_data)
+            try:
+                meta = self._read_meta(dst_volume, dst_path)
+            except errors.FileNotFound:
+                meta = XLMeta()
+            self._trash_replaced_data_dir(dst_volume, dst_path, meta, fi)
+            meta.add_version(fi)
+            self._write_meta(dst_volume, dst_path, meta)
+            # clean the now-empty staging dir
+            shutil.rmtree(src, ignore_errors=True)
+
+    def create_file(self, volume: str, path: str, data: bytes | BinaryIO) -> None:
+        full = self._file_path(volume, path)
+        os.makedirs(os.path.dirname(full), exist_ok=True)
+        with open(full, "wb") as f:
+            if isinstance(data, (bytes, bytearray, memoryview)):
+                f.write(data)
+            else:
+                shutil.copyfileobj(data, f, 1 << 20)
+            if _FSYNC:
+                f.flush()
+                os.fsync(f.fileno())
+
+    def append_file(self, volume: str, path: str, data: bytes) -> None:
+        full = self._file_path(volume, path)
+        os.makedirs(os.path.dirname(full), exist_ok=True)
+        with open(full, "ab") as f:
+            f.write(data)
+
+    def read_file(self, volume: str, path: str, offset: int = 0, length: int = -1) -> bytes:
+        full = self._file_path(volume, path)
+        try:
+            with open(full, "rb") as f:
+                if offset:
+                    f.seek(offset)
+                return f.read() if length < 0 else f.read(length)
+        except FileNotFoundError:
+            self._check_vol(volume)
+            raise errors.FileNotFound(f"{volume}/{path}") from None
+        except IsADirectoryError:
+            raise errors.IsNotRegular(path) from None
+
+    def read_file_stream(self, volume: str, path: str, offset: int, length: int) -> BinaryIO:
+        full = self._file_path(volume, path)
+        try:
+            f = open(full, "rb")
+        except FileNotFoundError:
+            self._check_vol(volume)
+            raise errors.FileNotFound(f"{volume}/{path}") from None
+        f.seek(offset)
+        return f
+
+    def rename_file(
+        self, src_volume: str, src_path: str, dst_volume: str, dst_path: str
+    ) -> None:
+        src = self._file_path(src_volume, src_path)
+        dst = self._file_path(dst_volume, dst_path)
+        if not os.path.exists(src):
+            raise errors.FileNotFound(src_path)
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        os.replace(src, dst)
+
+    def delete(self, volume: str, path: str, recursive: bool = False) -> None:
+        full = self._file_path(volume, path)
+        if not os.path.exists(full):
+            self._check_vol(volume)
+            raise errors.FileNotFound(f"{volume}/{path}")
+        if os.path.isdir(full):
+            if recursive:
+                self._to_trash(full)
+            else:
+                try:
+                    os.rmdir(full)
+                except OSError:
+                    raise errors.VolumeNotEmpty(path) from None
+        else:
+            os.remove(full)
+
+    # -- listing -----------------------------------------------------------
+
+    def list_dir(self, volume: str, path: str, count: int = -1) -> list[str]:
+        """Directory entries, dirs suffixed '/' (mirrors ListDir RPC)."""
+        full = self._file_path(volume, path)
+        try:
+            names = sorted(os.listdir(full))
+        except FileNotFoundError:
+            self._check_vol(volume)
+            raise errors.FileNotFound(f"{volume}/{path}") from None
+        out = []
+        for n in names:
+            if os.path.isdir(os.path.join(full, n)):
+                out.append(n + "/")
+            else:
+                out.append(n)
+            if 0 <= count <= len(out):
+                break
+        return out
+
+    def walk_dir(self, volume: str, base: str = "") -> Iterator[str]:
+        """Yield object paths (dirs containing xl.meta) under base, in
+        sorted lexical order — the per-drive feed of distributed listing
+        (/root/reference/cmd/metacache-walk.go:73)."""
+        vol_path = self._check_vol(volume)
+        base_rel = _clean_rel(base)
+        start = os.path.join(vol_path, base_rel) if base_rel else vol_path
+
+        def walk(dir_path: str, rel: str) -> Iterator[str]:
+            try:
+                names = sorted(os.listdir(dir_path))
+            except (FileNotFoundError, NotADirectoryError):
+                return
+            if META_FILE in names and rel:
+                yield rel
+            for n in names:
+                if n == META_FILE:
+                    continue
+                sub = os.path.join(dir_path, n)
+                if os.path.isdir(sub):
+                    yield from walk(sub, f"{rel}/{n}" if rel else n)
+
+        yield from walk(start, base_rel)
+
+    def stat_info_file(self, volume: str, path: str) -> int:
+        full = self._file_path(volume, path)
+        try:
+            return os.stat(full).st_size
+        except FileNotFoundError:
+            raise errors.FileNotFound(f"{volume}/{path}") from None
+
+    # -- integrity ---------------------------------------------------------
+
+    def verify_file(self, volume: str, path: str, fi: FileInfo) -> None:
+        """Streaming-bitrot verify of all parts of a version on this drive
+        (mirrors /root/reference/cmd/bitrot.go:164 bitrotVerify)."""
+        from ..erasure.bitrot_io import bitrot_verify_file  # local import: avoid cycle
+
+        if fi.inline_data is not None:
+            return
+        shard_size = fi.erasure.shard_size()
+        for part in fi.parts:
+            part_path = os.path.join(
+                self._file_path(volume, path), fi.data_dir, f"part.{part.number}"
+            )
+            bitrot_verify_file(
+                part_path,
+                fi.erasure.shard_file_size(part.size),
+                shard_size,
+            )
+
+    # -- trash -------------------------------------------------------------
+
+    def _to_trash(self, full_path: str) -> None:
+        dst = os.path.join(self.root, TRASH_DIR, str(uuid.uuid4()))
+        try:
+            os.replace(full_path, dst)
+        except OSError:
+            shutil.rmtree(full_path, ignore_errors=True)
+
+    def empty_trash(self) -> None:
+        trash = os.path.join(self.root, TRASH_DIR)
+        for name in os.listdir(trash):
+            shutil.rmtree(os.path.join(trash, name), ignore_errors=True)
+
+    def _prune_empty(self, dir_path: str, stop_at: str) -> None:
+        """Remove empty parent dirs up to (not incl.) the volume root."""
+        cur = dir_path
+        while cur != stop_at and cur.startswith(self.root):
+            try:
+                os.rmdir(cur)
+            except OSError:
+                return
+            cur = os.path.dirname(cur)

@@ -1,0 +1,86 @@
+"""Placement hashing: crc32 shard ordering + SipHash-2-4 set placement.
+
+Mirrors the reference's placement functions so object->set and
+object->shard-order mappings are identical:
+- hashOrder: /root/reference/cmd/erasure-metadata-utils.go:178
+- sipHashMod / crcHashMod / hashKey: /root/reference/cmd/erasure-sets.go:655-688
+"""
+
+from __future__ import annotations
+
+import zlib
+
+M64 = (1 << 64) - 1
+
+
+def hash_order(key: str, cardinality: int) -> list[int]:
+    """Consistent 1-based shard ordering for an object key.
+
+    Returns a rotation of [1..cardinality] starting at crc32(key) % n.
+    """
+    if cardinality <= 0:
+        return []
+    crc = zlib.crc32(key.encode())
+    start = crc % cardinality
+    return [1 + ((start + i) % cardinality) for i in range(1, cardinality + 1)]
+
+
+def _rotl(x: int, b: int) -> int:
+    return ((x << b) | (x >> (64 - b))) & M64
+
+
+def _sipround(v0: int, v1: int, v2: int, v3: int) -> tuple[int, int, int, int]:
+    v0 = (v0 + v1) & M64
+    v1 = _rotl(v1, 13) ^ v0
+    v0 = _rotl(v0, 32)
+    v2 = (v2 + v3) & M64
+    v3 = _rotl(v3, 16) ^ v2
+    v0 = (v0 + v3) & M64
+    v3 = _rotl(v3, 21) ^ v0
+    v2 = (v2 + v1) & M64
+    v1 = _rotl(v1, 17) ^ v2
+    v2 = _rotl(v2, 32)
+    return v0, v1, v2, v3
+
+
+def siphash24(k0: int, k1: int, data: bytes) -> int:
+    """SipHash-2-4 with 64-bit output (dchest/siphash semantics)."""
+    v0 = k0 ^ 0x736F6D6570736575
+    v1 = k1 ^ 0x646F72616E646F6D
+    v2 = k0 ^ 0x6C7967656E657261
+    v3 = k1 ^ 0x7465646279746573
+    n = len(data)
+    end = n - (n % 8)
+    for off in range(0, end, 8):
+        m = int.from_bytes(data[off : off + 8], "little")
+        v3 ^= m
+        v0, v1, v2, v3 = _sipround(v0, v1, v2, v3)
+        v0, v1, v2, v3 = _sipround(v0, v1, v2, v3)
+        v0 ^= m
+    b = (n & 0xFF) << 56
+    tail = data[end:]
+    for i, ch in enumerate(tail):
+        b |= ch << (8 * i)
+    v3 ^= b
+    v0, v1, v2, v3 = _sipround(v0, v1, v2, v3)
+    v0, v1, v2, v3 = _sipround(v0, v1, v2, v3)
+    v0 ^= b
+    v2 ^= 0xFF
+    for _ in range(4):
+        v0, v1, v2, v3 = _sipround(v0, v1, v2, v3)
+    return (v0 ^ v1 ^ v2 ^ v3) & M64
+
+
+def sip_hash_mod(key: str, cardinality: int, deployment_id: bytes) -> int:
+    """Object -> erasure-set index (SIPMOD distribution algo)."""
+    if cardinality <= 0:
+        return -1
+    k0 = int.from_bytes(deployment_id[0:8], "little")
+    k1 = int.from_bytes(deployment_id[8:16], "little")
+    return siphash24(k0, k1, key.encode()) % cardinality
+
+
+def crc_hash_mod(key: str, cardinality: int) -> int:
+    if cardinality <= 0:
+        return -1
+    return zlib.crc32(key.encode()) % cardinality

@@ -1,0 +1,115 @@
+"""XLStorage + xl.meta format tests (mirrors the reference's in-process
+tempdir-drive harness approach, /root/reference/cmd/test-utils_test.go:211)."""
+
+import os
+
+import pytest
+
+from minio_tpu.storage import errors
+from minio_tpu.storage.datatypes import ErasureInfo, FileInfo, now_ns
+from minio_tpu.storage.format import XLMeta
+from minio_tpu.storage.xlstorage import XLStorage
+
+
+@pytest.fixture
+def drive(tmp_path):
+    return XLStorage(str(tmp_path / "d0"))
+
+
+def _fi(vid="", deleted=False, size=10, ddir=""):
+    fi = FileInfo(
+        volume="b", name="o", version_id=vid, deleted=deleted,
+        data_dir=ddir, mod_time=now_ns(), size=size,
+        erasure=ErasureInfo(data_blocks=2, parity_blocks=2, block_size=1024,
+                            index=1, distribution=[1, 2, 3, 4]),
+    )
+    return fi
+
+
+def test_volume_lifecycle(drive):
+    drive.make_vol("bucket1")
+    with pytest.raises(errors.VolumeExists):
+        drive.make_vol("bucket1")
+    assert any(v.name == "bucket1" for v in drive.list_vols())
+    drive.delete_vol("bucket1")
+    with pytest.raises(errors.VolumeNotFound):
+        drive.stat_vol("bucket1")
+
+
+def test_metadata_roundtrip(drive):
+    drive.make_vol("b")
+    fi = _fi()
+    fi.metadata["etag"] = "abc"
+    drive.write_metadata("b", "o", fi)
+    got = drive.read_version("b", "o")
+    assert got.size == 10 and got.metadata["etag"] == "abc"
+    assert got.volume == "b" and got.name == "o" and got.is_latest
+
+
+def test_version_ordering_and_delete(drive):
+    drive.make_vol("b")
+    v1, v2 = _fi(vid="v1"), _fi(vid="v2")
+    v2.mod_time = v1.mod_time + 1000
+    drive.write_metadata("b", "o", v1)
+    drive.write_metadata("b", "o", v2)
+    latest = drive.read_version("b", "o")
+    assert latest.version_id == "v2" and latest.num_versions == 2
+    old = drive.read_version("b", "o", "v1")
+    assert not old.is_latest and old.successor_mod_time == v2.mod_time
+    drive.delete_version("b", "o", v2)
+    assert drive.read_version("b", "o").version_id == "v1"
+    drive.delete_version("b", "o", v1)
+    with pytest.raises(errors.FileNotFound):
+        drive.read_version("b", "o")
+
+
+def test_inline_data(drive):
+    drive.make_vol("b")
+    fi = _fi()
+    fi.inline_data = b"payload"
+    drive.write_metadata("b", "o", fi)
+    assert drive.read_version("b", "o", read_data=True).inline_data == b"payload"
+    # metadata-only read masks payload but signals inline presence
+    assert drive.read_version("b", "o").inline_data == b""
+
+
+def test_rename_data_atomic_commit(drive, tmp_path):
+    drive.make_vol("b")
+    fi = _fi(ddir="dd-uuid")
+    drive.create_file(".minio.sys/tmp", "stage1/dd-uuid/part.1", b"shard-bytes")
+    drive.rename_data(".minio.sys/tmp", "stage1", fi, "b", "o")
+    assert drive.read_file("b", "o/dd-uuid/part.1") == b"shard-bytes"
+    assert drive.read_version("b", "o").data_dir == "dd-uuid"
+    # staging dir is gone
+    with pytest.raises(errors.FileNotFound):
+        drive.read_file(".minio.sys/tmp", "stage1/dd-uuid/part.1")
+
+
+def test_walk_dir_sorted(drive):
+    drive.make_vol("b")
+    for name in ("z/obj", "a/obj", "a/b/c", "mid"):
+        drive.write_metadata("b", name, _fi())
+    assert list(drive.walk_dir("b")) == ["a/b/c", "a/obj", "mid", "z/obj"]
+    assert list(drive.walk_dir("b", "a")) == ["a/b/c", "a/obj"]
+
+
+def test_path_traversal_rejected(drive):
+    drive.make_vol("b")
+    with pytest.raises(errors.FileAccessDenied):
+        drive.read_file("b", "../escape")
+    with pytest.raises(errors.FileAccessDenied):
+        drive.read_file("..", "x")
+
+
+def test_xlmeta_corrupt(tmp_path):
+    with pytest.raises(errors.FileCorrupt):
+        XLMeta.from_bytes(b"garbage-not-xlmeta")
+
+
+def test_delete_version_prunes_empty_dirs(drive):
+    drive.make_vol("b")
+    drive.write_metadata("b", "deep/nested/obj", _fi(vid=""))
+    fi = FileInfo(version_id="")
+    drive.delete_version("b", "deep/nested/obj", fi)
+    assert list(drive.walk_dir("b")) == []
+    assert not os.path.exists(os.path.join(drive.root, "b", "deep"))
