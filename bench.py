@@ -1,0 +1,79 @@
+"""North-star benchmark: fused RS encode + bitrot hashing on TPU.
+
+Measures the device-side throughput of the fused EC:8 (8 data + 8 parity)
+encode+HighwayHash dispatch over 1 MiB stripe blocks — the hot loop of
+PutObject (reference: /root/reference/cmd/erasure-encode.go:76-108 +
+cmd/bitrot-streaming.go), and the path BASELINE.md targets at >= 4x the
+reference's AVX512 CPU pipeline.
+
+Baseline: klauspost/reedsolomon AVX512 EC 8+8 encode measures ~10-14 GB/s
+and asm HighwayHash ~10 GB/s per core; pipelined encode+hash(16 shards)
+lands ~5 GiB/s single-core. BASELINE.json fixes the bar at the encode
+benchmark's AVX512 number; we use 10 GiB/s as the reference value so
+vs_baseline is conservative.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Timing note: on this tunnel, block_until_ready returns early — we force
+sync with a device-side scalar checksum fetch and amortize over many
+chained dispatches.
+"""
+
+import json
+import time
+
+BASELINE_GIBPS = 10.0
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from minio_tpu.ops.bitrot_jax import encode_and_hash
+    from minio_tpu.ops.rs_jax import get_tpu_codec
+
+    d, p = 8, 8
+    n = (1 << 20) // d  # 1 MiB stripe block -> 128 KiB shards
+    B = 64  # concurrent stripe blocks per dispatch (64 MiB of data)
+    codec = get_tpu_codec(d, p)
+    data = np.random.default_rng(0).integers(0, 256, size=(B, d, n), dtype=np.uint8)
+    dd = jax.device_put(data)
+
+    fused = jax.jit(lambda x: encode_and_hash(codec, x))
+
+    @jax.jit
+    def checksum(pd):
+        return jnp.sum(pd[0], dtype=jnp.int32) + jnp.sum(pd[1], dtype=jnp.int32)
+
+    # warmup/compile
+    out = fused(dd)
+    _ = int(checksum(out))
+
+    # measure sync overhead, then amortize over chained dispatches
+    t0 = time.perf_counter()
+    _ = int(checksum(out))
+    sync_cost = time.perf_counter() - t0
+
+    iters = 30
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fused(dd)
+    _ = int(checksum(out))
+    elapsed = time.perf_counter() - t0 - sync_cost
+
+    gib = B * d * n / 2**30
+    gibps = gib * iters / elapsed
+    print(
+        json.dumps(
+            {
+                "metric": "rs_encode_bitrot_ec8_1mib_gibps",
+                "value": round(gibps, 2),
+                "unit": "GiB/s",
+                "vs_baseline": round(gibps / BASELINE_GIBPS, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,136 @@
+"""Object listing: merged per-drive walks with quorum resolution.
+
+The distributed analogue in the reference streams sorted per-drive WalkDir
+entries and merges/resolves them across drives
+(/root/reference/cmd/metacache-set.go, metacache-entries.go). Here each
+drive's sorted walk feeds a k-way merge; each candidate key resolves via
+quorum metadata so dangling/partial writes don't surface. Delimiter
+grouping and marker pagination mirror ListObjectsV2 semantics.
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import Iterator
+
+from .quorum import ObjectNotFound, QuorumError, VersionNotFound
+from .types import ListObjectsResult, ObjectInfo
+
+from ..storage.pathutil import (  # noqa: F401 — re-exported API
+    DIR_OBJECT_SUFFIX,
+    decode_dir_object,
+    encode_dir_object,
+)
+
+
+def _safe_walk(disk, bucket: str, base: str) -> Iterator[str]:
+    """walk_dir with drive faults swallowed — the walk is a generator, so
+    errors must be caught inside it, not at construction time."""
+    try:
+        yield from disk.walk_dir(bucket, base)
+    except Exception:  # noqa: BLE001 — dead drives don't break listing
+        return
+
+
+def _merged_keys(es, bucket: str, prefix: str) -> Iterator[str]:
+    """Sorted union of object keys across all drives under a prefix."""
+    # walk from the parent of the last prefix segment so dir-marker
+    # siblings ("photos/" stored as "photos__XLDIR__") are visited too
+    trimmed = prefix[:-1] if prefix.endswith("/") else prefix
+    base = trimmed.rsplit("/", 1)[0] if "/" in trimmed else ""
+    walks = [_safe_walk(disk, bucket, base) for disk in es.disks]
+    last = None
+    for key in heapq.merge(*walks, key=decode_dir_object):
+        if key == last:
+            continue
+        last = key
+        dec = decode_dir_object(key)
+        if dec.startswith(prefix):
+            yield key
+        elif not key.startswith(trimmed) and key > trimmed:
+            # every encoded key that can decode into the prefix range
+            # starts with `trimmed`; the sorted walk is past all of them
+            return
+
+
+def list_objects(
+    es,
+    bucket: str,
+    prefix: str = "",
+    marker: str = "",
+    delimiter: str = "",
+    max_keys: int = 1000,
+    include_versions: bool = False,
+    version_marker: str = "",
+) -> ListObjectsResult:
+    """ListObjects(V1/V2/Versions) over one erasure set."""
+    if not es.bucket_exists(bucket):
+        from .quorum import BucketNotFound
+
+        raise BucketNotFound(bucket)
+    out = ListObjectsResult()
+    seen_prefixes: set[str] = set()
+    max_keys = max(0, min(max_keys, 100000))
+    last_emitted = ""  # next_marker must point at the LAST RETURNED entry
+    last_vid = ""
+
+    def full() -> bool:
+        return len(out.objects) + len(out.prefixes) >= max_keys
+
+    for raw_key in _merged_keys(es, bucket, prefix):
+        key = decode_dir_object(raw_key)
+        if delimiter:
+            rest = key[len(prefix) :]
+            di = rest.find(delimiter)
+            if di >= 0:
+                cp = prefix + rest[: di + len(delimiter)]
+                if cp in seen_prefixes or cp <= marker:
+                    continue
+                if full():
+                    out.is_truncated = True
+                    out.next_marker = last_emitted
+                    return out
+                seen_prefixes.add(cp)
+                out.prefixes.append(cp)
+                last_emitted = cp
+                continue
+        if include_versions:
+            if key < marker:
+                continue
+            try:
+                versions = es.list_object_versions(bucket, key)
+            except (ObjectNotFound, QuorumError, VersionNotFound):
+                continue
+            resume_skip = key == marker and bool(version_marker)
+            for oi in versions:
+                if resume_skip:
+                    # resume strictly after the version-id marker
+                    if oi.version_id == version_marker:
+                        resume_skip = False
+                    continue
+                if key == marker and not version_marker:
+                    continue  # whole key already returned on a prior page
+                oi.name = key
+                if len(out.objects) >= max_keys:
+                    out.is_truncated = True
+                    out.next_marker = last_emitted
+                    out.next_version_marker = last_vid
+                    return out
+                out.objects.append(oi)
+                last_emitted = key
+                last_vid = oi.version_id
+            continue
+        if key <= marker:
+            continue
+        try:
+            oi = es.get_object_info(bucket, raw_key)
+        except (ObjectNotFound, QuorumError, VersionNotFound):
+            continue  # dangling or delete-marked
+        if full():
+            out.is_truncated = True
+            out.next_marker = last_emitted
+            return out
+        oi.name = key
+        out.objects.append(oi)
+        last_emitted = key
+    return out

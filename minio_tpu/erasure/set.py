@@ -167,7 +167,12 @@ class ErasureSet:
         version_id: str | None = None,
         versioned: bool = False,
         parity: int | None = None,
+        distribution: list[int] | None = None,
+        allow_inline: bool = True,
     ) -> ObjectInfo:
+        """distribution/allow_inline overrides serve the multipart plane:
+        all parts of an upload must share the final object's shard layout
+        and be rename-able files (never inline)."""
         if not self.bucket_exists(bucket) and not bucket.startswith(".minio.sys"):
             raise BucketNotFound(bucket)
         p = self.default_parity if parity is None else parity
@@ -188,13 +193,13 @@ class ErasureSet:
             data_blocks=d,
             parity_blocks=p,
             block_size=BLOCK_SIZE,
-            distribution=hash_order(f"{bucket}/{obj}", self.n),
+            distribution=distribution or hash_order(f"{bucket}/{obj}", self.n),
             checksums=[ChecksumInfo(1, DEFAULT_BITROT_ALGO.string)],
         )
         fi.parts = [ObjectPartInfo(1, len(data), len(data), fi.mod_time, etag)]
 
         encoded = self.coder(d, p).encode_part(data)
-        inline = len(data) <= INLINE_DATA_THRESHOLD
+        inline = allow_inline and len(data) <= INLINE_DATA_THRESHOLD
         if not inline:
             fi.data_dir = str(uuid.uuid4())
 
@@ -248,6 +253,31 @@ class ErasureSet:
             return self._to_object_info(bucket, obj, fi)
         return self._to_object_info(bucket, obj, fi)
 
+    def open_object(
+        self, bucket: str, obj: str, version_id: str = ""
+    ) -> tuple[ObjectInfo, FileInfo, list[FileInfo | None]]:
+        """One quorum metadata read; reuse the handles for ranged reads so
+        Range requests don't pay the quorum read twice."""
+        fi, metas, _, _ = self._quorum_fileinfo(bucket, obj, version_id, read_data=True)
+        if fi.deleted:
+            raise ObjectNotFound(f"{bucket}/{obj}")
+        return self._to_object_info(bucket, obj, fi), fi, metas
+
+    def read_object(
+        self,
+        bucket: str,
+        obj: str,
+        fi: FileInfo,
+        metas: list[FileInfo | None],
+        offset: int = 0,
+        length: int = -1,
+    ) -> Iterator[bytes]:
+        if length < 0:
+            length = fi.size - offset
+        if offset < 0 or offset + length > fi.size:
+            raise ValueError("invalid range")
+        return self._read_range(bucket, obj, fi, metas, offset, length)
+
     def get_object(
         self,
         bucket: str,
@@ -256,15 +286,8 @@ class ErasureSet:
         offset: int = 0,
         length: int = -1,
     ) -> tuple[ObjectInfo, Iterator[bytes]]:
-        fi, metas, read_q, _ = self._quorum_fileinfo(bucket, obj, version_id, read_data=True)
-        if fi.deleted:
-            raise ObjectNotFound(f"{bucket}/{obj}")
-        oi = self._to_object_info(bucket, obj, fi)
-        if length < 0:
-            length = fi.size - offset
-        if offset < 0 or offset + length > fi.size:
-            raise ValueError("invalid range")
-        return oi, self._read_range(bucket, obj, fi, metas, offset, length)
+        oi, fi, metas = self.open_object(bucket, obj, version_id)
+        return oi, self.read_object(bucket, obj, fi, metas, offset, length)
 
     def _shard_sources(
         self, fi: FileInfo, metas: list[FileInfo | None]
@@ -291,72 +314,78 @@ class ErasureSet:
         length: int,
     ) -> Iterator[bytes]:
         """Greedy striped read with per-block verification + reconstruction
-        (mirrors /root/reference/cmd/erasure-decode.go parallelReader)."""
+        (mirrors /root/reference/cmd/erasure-decode.go parallelReader).
+        Spans multiple parts (multipart objects: each part is its own
+        erasure stream, stitched by metadata only)."""
         if length == 0:
             return
         d = fi.erasure.data_blocks
         coder = self.coder(d, fi.erasure.parity_blocks)
         sources = self._shard_sources(fi, metas)
-        part = fi.parts[0]
-        geometry = coder.shard_sizes_for(part.size)
         bad: set[int] = set()
 
-        def read_shard_block(idx: int, block_i: int, per: int, f_off: int) -> bytes:
+        def read_shard_block(part_num: int, idx: int, per: int, f_off: int) -> bytes:
             disk, m = sources[idx]
             if m.inline_data:
                 buf = m.inline_data[f_off : f_off + DIGEST + per]
             else:
                 buf = disk.read_file(
-                    bucket, f"{obj}/{fi.data_dir}/part.{part.number}", f_off, DIGEST + per
+                    bucket, f"{obj}/{fi.data_dir}/part.{part_num}", f_off, DIGEST + per
                 )
             return bitrot_io.verify_block(buf, per)
 
-        block_start = offset // coder.block_size
-        pos = block_start * coder.block_size
-        # per-shard running file offset for this block index
-        for block_i in range(block_start, len(geometry)):
+        pos = 0  # logical offset of the current part
+        for part in fi.parts:
             if length <= 0:
-                break
-            data_len, per = geometry[block_i]
-            # file offset of this block in every shard file: all previous
-            # blocks are full (shard_size) except none before tail
-            f_off = bitrot_io.block_offset(coder.shard_size, block_i)
-            want = list(range(d))  # prefer data shards: no matrix math
-            got: dict[int, bytes] = {}
-            for idx in want:
-                if idx in sources and idx not in bad:
-                    try:
-                        got[idx] = read_shard_block(idx, block_i, per, f_off)
-                        continue
-                    except (errors.FileCorrupt, errors.FileNotFound, OSError):
-                        bad.add(idx)
-            if len(got) < d:
-                for idx in range(d, self.n):
-                    if len(got) >= d:
-                        break
+                return
+            if pos + part.size <= offset:
+                pos += part.size
+                continue
+            geometry = coder.shard_sizes_for(part.size)
+            bpos = pos  # logical offset of current block within the object
+            for block_i, (data_len, per) in enumerate(geometry):
+                if length <= 0:
+                    return
+                if bpos + data_len <= offset:
+                    bpos += data_len
+                    continue
+                f_off = bitrot_io.block_offset(coder.shard_size, block_i)
+                got: dict[int, bytes] = {}
+                for idx in range(d):  # prefer data shards: no matrix math
                     if idx in sources and idx not in bad:
                         try:
-                            got[idx] = read_shard_block(idx, block_i, per, f_off)
+                            got[idx] = read_shard_block(part.number, idx, per, f_off)
                         except (errors.FileCorrupt, errors.FileNotFound, OSError):
                             bad.add(idx)
                 if len(got) < d:
-                    raise QuorumError(
-                        f"cannot read block {block_i}: only {len(got)} of {d} shards"
+                    for idx in range(d, self.n):
+                        if len(got) >= d:
+                            break
+                        if idx in sources and idx not in bad:
+                            try:
+                                got[idx] = read_shard_block(part.number, idx, per, f_off)
+                            except (errors.FileCorrupt, errors.FileNotFound, OSError):
+                                bad.add(idx)
+                    if len(got) < d:
+                        raise QuorumError(
+                            f"cannot read part {part.number} block {block_i}: "
+                            f"only {len(got)} of {d} shards"
+                        )
+                if all(i in got for i in range(d)):
+                    block = b"".join(got[i] for i in range(d))[:data_len]
+                else:
+                    rec = coder.reconstruct_block(
+                        {i: np.frombuffer(v, dtype=np.uint8) for i, v in got.items()}, per
                     )
-            if all(i in got for i in range(d)):
-                block = b"".join(got[i] for i in range(d))[:data_len]
-            else:
-                rec = coder.reconstruct_block(
-                    {i: np.frombuffer(v, dtype=np.uint8) for i, v in got.items()}, per
-                )
-                block = b"".join(rec[i].tobytes() for i in range(d))[:data_len]
-            lo = max(offset - pos, 0)
-            hi = min(lo + length, data_len)
-            if hi > lo:
-                chunk = block[lo:hi]
-                length -= len(chunk)
-                yield chunk
-            pos += data_len
+                    block = b"".join(rec[i].tobytes() for i in range(d))[:data_len]
+                lo = max(offset - bpos, 0)
+                hi = min(lo + length, data_len)
+                if hi > lo:
+                    chunk = block[lo:hi]
+                    length -= len(chunk)
+                    yield chunk
+                bpos += data_len
+            pos += part.size
 
     # -- delete ------------------------------------------------------------
 
@@ -458,29 +487,34 @@ class ErasureSet:
         if not stale:
             return {"healed": [], "type": "object"}
 
-        # rebuild the full shard files for stale drives, block by block
-        part = fi.parts[0]
-        geometry = coder.shard_sizes_for(part.size)
-        rebuilt: dict[int, bytearray] = {idx: bytearray() for idx, _ in stale}
-        for block_i, (data_len, per) in enumerate(geometry):
-            f_off = bitrot_io.block_offset(coder.shard_size, block_i)
-            got: dict[int, np.ndarray] = {}
-            for idx, (disk, m) in good.items():
-                if len(got) >= d:
-                    break
-                if m.inline_data:
-                    buf = m.inline_data[f_off : f_off + DIGEST + per]
-                else:
-                    buf = disk.read_file(
-                        bucket, f"{obj}/{fi.data_dir}/part.{part.number}", f_off, DIGEST + per
-                    )
-                block = bitrot_io.verify_block(buf, per)
-                got[idx] = np.frombuffer(block, dtype=np.uint8)
-            rec = coder.reconstruct_block(got, per)
-            for idx, _ in stale:
-                blk = rec[idx].tobytes()
-                rebuilt[idx] += hash256(blk)
-                rebuilt[idx] += blk
+        # rebuild the full shard files for stale drives, part by part
+        per_part_rebuilt: dict[int, dict[int, bytearray]] = {}
+        for part in fi.parts:
+            geometry = coder.shard_sizes_for(part.size)
+            rebuilt: dict[int, bytearray] = {idx: bytearray() for idx, _ in stale}
+            for block_i, (data_len, per) in enumerate(geometry):
+                f_off = bitrot_io.block_offset(coder.shard_size, block_i)
+                got: dict[int, np.ndarray] = {}
+                for idx, (disk, m) in good.items():
+                    if len(got) >= d:
+                        break
+                    if m.inline_data:
+                        buf = m.inline_data[f_off : f_off + DIGEST + per]
+                    else:
+                        buf = disk.read_file(
+                            bucket,
+                            f"{obj}/{fi.data_dir}/part.{part.number}",
+                            f_off,
+                            DIGEST + per,
+                        )
+                    block = bitrot_io.verify_block(buf, per)
+                    got[idx] = np.frombuffer(block, dtype=np.uint8)
+                rec = coder.reconstruct_block(got, per)
+                for idx, _ in stale:
+                    blk = rec[idx].tobytes()
+                    rebuilt[idx] += hash256(blk)
+                    rebuilt[idx] += blk
+            per_part_rebuilt[part.number] = rebuilt
         healed = []
         tmp_id = str(uuid.uuid4())
         for shard_idx, disk in stale:
@@ -489,11 +523,14 @@ class ErasureSet:
             dfi.erasure.index = shard_idx + 1
             try:
                 if fi.inline_data is not None or not fi.data_dir:
-                    dfi.inline_data = bytes(rebuilt[shard_idx])
+                    dfi.inline_data = bytes(per_part_rebuilt[fi.parts[0].number][shard_idx])
                     disk.write_metadata(bucket, obj, dfi)
                 else:
-                    stage = f"{tmp_id}/{fi.data_dir}/part.{part.number}"
-                    disk.create_file(TMP_VOLUME, stage, bytes(rebuilt[shard_idx]))
+                    for part in fi.parts:
+                        stage = f"{tmp_id}/{fi.data_dir}/part.{part.number}"
+                        disk.create_file(
+                            TMP_VOLUME, stage, bytes(per_part_rebuilt[part.number][shard_idx])
+                        )
                     disk.rename_data(TMP_VOLUME, tmp_id, dfi, bucket, obj)
                 healed.append(disk.endpoint)
             except Exception:  # noqa: BLE001

@@ -203,7 +203,10 @@ def hash256_blocks(blocks: jax.Array, key: bytes = MINIO_KEY) -> jax.Array:
             xhi, xlo = x
             return _update(_St.of(carry), xhi, xlo).tup(), ()
 
-        carry, _ = jax.lax.scan(step, s.tup(), (hi, lo), unroll=8)
+        # unrolling amortizes loop overhead on TPU; on CPU it only slows
+        # compilation of the (n/32)-step chain
+        unroll = 8 if jax.default_backend() == "tpu" else 1
+        carry, _ = jax.lax.scan(step, s.tup(), (hi, lo), unroll=unroll)
         s = _St.of(carry)
     rem = n - whole
     if rem:
@@ -230,8 +233,14 @@ def hash256_blocks(blocks: jax.Array, key: bytes = MINIO_KEY) -> jax.Array:
             packet = packet.at[:, 18].set(tail[:, size4 - 1])
         hi, lo = _load_packets(packet)
         s = _update(s, [h[0] for h in hi], [l[0] for l in lo])
-    for _ in range(10):
-        s = _permute_and_update(s)
+
+    # 10 finalization rounds as a scan: one compiled body instead of a
+    # 10x-unrolled graph (XLA CPU compile time explodes on the unroll)
+    def _fin(carry, _):
+        return _permute_and_update(_St.of(carry)).tup(), ()
+
+    carry, _ = jax.lax.scan(_fin, s.tup(), None, length=10)
+    s = _St.of(carry)
     # modular reduction per 128-bit half -> 4 x uint64 out, little-endian
     outs = []
     for half in (0, 2):

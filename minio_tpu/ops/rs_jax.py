@@ -152,7 +152,8 @@ class TpuRSCodec:
                 )[0]
                 rows.append(pr)
         m = np.stack(rows)
-        w = jnp.asarray(gf_matrix_to_bitplanes(m))
+        # cache host-side: device placement/sharding is the caller's concern
+        w = gf_matrix_to_bitplanes(m)
         self._decode_w_cache[key] = w
         if len(self._decode_w_cache) > self._decode_w_cache_max:
             self._decode_w_cache.popitem(last=False)
@@ -171,7 +172,7 @@ class TpuRSCodec:
         by HealObject (the reference's erasure.Heal decode-all path,
         /root/reference/cmd/erasure-decode.go:317).
         """
-        w = self._reconstruct_w(tuple(present), tuple(missing))
+        w = jnp.asarray(self._reconstruct_w(tuple(present), tuple(missing)))
         data = jnp.asarray(survivors, dtype=jnp.uint8)
         return gf_apply_bits(w, data, len(missing))
 

@@ -1,0 +1,887 @@
+"""The S3 API server: routing + handlers over the object layer.
+
+Path-style S3 API (the reference's registerAPIRouter,
+/root/reference/cmd/api-router.go:255) on aiohttp. Handlers validate auth
+(SigV4 header/presigned, streaming payloads), then call the erasure object
+layer in worker threads; responses are S3-wire XML/headers.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import os
+import re
+import urllib.parse
+import xml.etree.ElementTree as ET
+from datetime import datetime, timezone
+from email.utils import format_datetime, parsedate_to_datetime
+from xml.sax.saxutils import escape
+
+from aiohttp import web
+
+from ..erasure import listing, quorum
+from ..erasure.set import ErasureSet
+from ..erasure.types import ObjectInfo
+from ..storage.xlstorage import XLStorage
+from . import s3err, signature, streaming
+from .buckets import BucketMetadataSys
+
+BUCKET_NAME_RE = re.compile(r"^[a-z0-9][a-z0-9.\-]{1,61}[a-z0-9]$")
+
+
+def _iso8601(ns: int) -> str:
+    return datetime.fromtimestamp(ns / 1e9, tz=timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%S.%f"
+    )[:-3] + "Z"
+
+
+def _http_date(ns: int) -> str:
+    return format_datetime(
+        datetime.fromtimestamp(ns / 1e9, tz=timezone.utc), usegmt=True
+    )
+
+
+class S3Server:
+    def __init__(self, store: ErasureSet, region: str = "us-east-1"):
+        from ..erasure.multipart import MultipartManager
+
+        self.store = store
+        self.region = region
+        self.buckets = BucketMetadataSys(store)
+        self.mp = MultipartManager(store)
+        root_user = os.environ.get("MINIO_ROOT_USER", "minioadmin")
+        root_pass = os.environ.get("MINIO_ROOT_PASSWORD", "minioadmin")
+        self._credentials = {root_user: root_pass}
+        self.verifier = signature.SigV4Verifier(self._credentials.get, region)
+        self.app = web.Application(client_max_size=1 << 30)
+        self.app.router.add_route("*", "/", self._entry)
+        self.app.router.add_route("*", "/{bucket}", self._entry)
+        self.app.router.add_route("*", "/{bucket}/{key:.*}", self._entry)
+
+    # -- plumbing ------------------------------------------------------------
+
+    async def _run(self, fn, *args, **kw):
+        return await asyncio.get_running_loop().run_in_executor(
+            None, lambda: fn(*args, **kw)
+        )
+
+    def _err_response(self, request, err: s3err.APIError) -> web.Response:
+        return web.Response(
+            status=err.http_status,
+            body=err.to_xml(resource=request.path),
+            content_type="application/xml",
+        )
+
+    async def _entry(self, request: web.Request) -> web.StreamResponse:
+        try:
+            return await self._dispatch(request)
+        except s3err.APIError as e:
+            return self._err_response(request, e)
+        except quorum.BucketNotFound:
+            return self._err_response(request, s3err.NoSuchBucket)
+        except quorum.BucketExists:
+            return self._err_response(request, s3err.BucketAlreadyOwnedByYou)
+        except quorum.BucketNotEmpty:
+            return self._err_response(request, s3err.BucketNotEmpty)
+        except (quorum.ObjectNotFound,):
+            return self._err_response(request, s3err.NoSuchKey)
+        except quorum.VersionNotFound:
+            return self._err_response(request, s3err.NoSuchVersion)
+        except quorum.QuorumError:
+            return self._err_response(request, s3err.InternalError)
+        except Exception:  # noqa: BLE001
+            import traceback
+
+            traceback.print_exc()
+            return self._err_response(request, s3err.InternalError)
+
+    async def _authenticate(self, request: web.Request) -> tuple[str, bytes]:
+        """Verify request auth; returns (access_key, payload bytes)."""
+        headers = {k.lower(): v for k, v in request.headers.items()}
+        raw_path = request.rel_url.raw_path
+        query = urllib.parse.parse_qsl(
+            request.rel_url.raw_query_string, keep_blank_values=True
+        )
+        body = await request.read() if request.body_exists else b""
+
+        if "X-Amz-Signature" in dict(query):
+            ak = self.verifier.verify_presigned("GET" if request.method == "GET" else request.method, raw_path, query, headers)
+            return ak, body
+        if "authorization" not in headers:
+            raise s3err.AccessDenied
+
+        content_sha = headers.get("x-amz-content-sha256", signature.UNSIGNED_PAYLOAD)
+        ak = self.verifier.verify_header_auth(
+            request.method, raw_path, query, headers, content_sha
+        )
+        if content_sha == signature.STREAMING_UNSIGNED_TRAILER:
+            body = streaming.decode_unsigned_chunked(body)
+        elif content_sha in (
+            signature.STREAMING_PAYLOAD,
+            signature.STREAMING_PAYLOAD_TRAILER,
+        ):
+            auth = signature.parse_auth_header(headers["authorization"])
+            body = streaming.decode_signed_chunked(
+                body,
+                auth.signature,
+                headers.get("x-amz-date", ""),
+                auth.scope,
+                self._credentials.get(ak, ""),
+            )
+        elif content_sha not in (signature.UNSIGNED_PAYLOAD,):
+            if hashlib.sha256(body).hexdigest() != content_sha:
+                raise s3err.XAmzContentSHA256Mismatch
+        return ak, body
+
+    # -- dispatch ------------------------------------------------------------
+
+    async def _dispatch(self, request: web.Request) -> web.StreamResponse:
+        _, body = await self._authenticate(request)
+        bucket = request.match_info.get("bucket", "")
+        key = urllib.parse.unquote(request.match_info.get("key", ""))
+        q = request.rel_url.query
+        m = request.method
+
+        if not bucket:
+            if m == "GET":
+                return await self.list_buckets(request)
+            raise s3err.MethodNotAllowed
+        if bucket.startswith(".minio.sys"):
+            raise s3err.AccessDenied
+
+        if not key:
+            if m == "PUT":
+                if "versioning" in q:
+                    return await self.put_bucket_versioning(request, bucket, body)
+                if "policy" in q:
+                    return await self.put_bucket_simple(request, bucket, "policy", body)
+                if "lifecycle" in q:
+                    return await self.put_bucket_simple(request, bucket, "lifecycle", body)
+                if "tagging" in q:
+                    return await self.put_bucket_simple(request, bucket, "tags", body)
+                if "notification" in q:
+                    return await self.put_bucket_simple(request, bucket, "notification", body)
+                if "encryption" in q:
+                    return await self.put_bucket_simple(request, bucket, "encryption", body)
+                if "object-lock" in q:
+                    return await self.put_bucket_simple(request, bucket, "object_lock", body)
+                if "cors" in q:
+                    return await self.put_bucket_simple(request, bucket, "cors", body)
+                if "replication" in q:
+                    return await self.put_bucket_simple(request, bucket, "replication", body)
+                return await self.put_bucket(request, bucket)
+            if m == "DELETE":
+                for sub in ("policy", "lifecycle", "tagging", "notification",
+                            "encryption", "cors", "replication"):
+                    if sub in q:
+                        return await self.delete_bucket_simple(request, bucket, sub)
+                return await self.delete_bucket(request, bucket)
+            if m == "HEAD":
+                return await self.head_bucket(request, bucket)
+            if m == "GET":
+                if "location" in q:
+                    return await self.get_bucket_location(request, bucket)
+                if "versioning" in q:
+                    return await self.get_bucket_versioning(request, bucket)
+                if "versions" in q:
+                    return await self.list_object_versions(request, bucket)
+                for sub, attr, missing in (
+                    ("policy", "policy", s3err.NoSuchBucketPolicy),
+                    ("lifecycle", "lifecycle", s3err.NoSuchLifecycleConfiguration),
+                    ("tagging", "tags", s3err.NoSuchTagSet),
+                    ("notification", "notification", None),
+                    ("encryption", "encryption", s3err.ServerSideEncryptionConfigurationNotFoundError),
+                    ("object-lock", "object_lock", s3err.ObjectLockConfigurationNotFoundError),
+                    ("cors", "cors", s3err.NoSuchCORSConfiguration),
+                    ("replication", "replication", s3err.ReplicationConfigurationNotFoundError),
+                ):
+                    if sub in q:
+                        return await self.get_bucket_simple(request, bucket, attr, missing)
+                if "uploads" in q:
+                    return await self.list_multipart_uploads(request, bucket)
+                return await self.list_objects(request, bucket)
+            if m == "POST":
+                if "delete" in q:
+                    return await self.delete_multiple(request, bucket, body)
+            raise s3err.MethodNotAllowed
+
+        # object-level
+        if m == "PUT":
+            if "x-amz-copy-source" in request.headers and "partNumber" not in q:
+                return await self.copy_object(request, bucket, key)
+            if "partNumber" in q and "uploadId" in q:
+                return await self.put_object_part(request, bucket, key, body)
+            return await self.put_object(request, bucket, key, body)
+        if m == "GET":
+            if "uploadId" in q:
+                return await self.list_parts(request, bucket, key)
+            return await self.get_object(request, bucket, key)
+        if m == "HEAD":
+            return await self.head_object(request, bucket, key)
+        if m == "DELETE":
+            if "uploadId" in q:
+                return await self.abort_multipart(request, bucket, key)
+            return await self.delete_object(request, bucket, key)
+        if m == "POST":
+            if "uploads" in q:
+                return await self.new_multipart(request, bucket, key)
+            if "uploadId" in q:
+                return await self.complete_multipart(request, bucket, key, body)
+        raise s3err.MethodNotAllowed
+
+    # -- service -------------------------------------------------------------
+
+    async def list_buckets(self, request) -> web.Response:
+        buckets = await self._run(self.store.list_buckets)
+        items = "".join(
+            f"<Bucket><Name>{escape(b.name)}</Name>"
+            f"<CreationDate>{_iso8601(b.created)}</CreationDate></Bucket>"
+            for b in buckets
+        )
+        xml = (
+            '<?xml version="1.0" encoding="UTF-8"?>'
+            '<ListAllMyBucketsResult xmlns="http://s3.amazonaws.com/doc/2006-03-01/">'
+            "<Owner><ID>minio-tpu</ID><DisplayName>minio-tpu</DisplayName></Owner>"
+            f"<Buckets>{items}</Buckets></ListAllMyBucketsResult>"
+        )
+        return web.Response(body=xml.encode(), content_type="application/xml")
+
+    # -- bucket --------------------------------------------------------------
+
+    async def put_bucket(self, request, bucket: str) -> web.Response:
+        if not BUCKET_NAME_RE.match(bucket) or ".." in bucket:
+            raise s3err.InvalidBucketName
+        await self._run(self.store.make_bucket, bucket)
+        lock_enabled = request.headers.get("x-amz-bucket-object-lock-enabled", "") == "true"
+        if lock_enabled:
+            bm = self.buckets.get(bucket)
+            bm.versioning = True
+            bm.object_lock = "<ObjectLockConfiguration><ObjectLockEnabled>Enabled</ObjectLockEnabled></ObjectLockConfiguration>"
+            await self._run(self.buckets.set, bucket, bm)
+        return web.Response(status=200, headers={"Location": f"/{bucket}"})
+
+    async def head_bucket(self, request, bucket: str) -> web.Response:
+        if not await self._run(self.store.bucket_exists, bucket):
+            return web.Response(status=404)
+        return web.Response(status=200)
+
+    async def delete_bucket(self, request, bucket: str) -> web.Response:
+        force = request.headers.get("x-minio-force-delete", "") == "true"
+        # refuse non-empty buckets (cheap check: any object at all)
+        res = await self._run(
+            listing.list_objects, self.store, bucket, "", "", "", 1, True
+        )
+        if (res.objects or res.prefixes) and not force:
+            raise s3err.BucketNotEmpty
+        await self._run(self.store.delete_bucket, bucket, force or bool(res.objects))
+        self.buckets.drop(bucket)
+        return web.Response(status=204)
+
+    async def get_bucket_location(self, request, bucket: str) -> web.Response:
+        if not await self._run(self.store.bucket_exists, bucket):
+            raise s3err.NoSuchBucket
+        xml = (
+            '<?xml version="1.0" encoding="UTF-8"?>'
+            f'<LocationConstraint xmlns="http://s3.amazonaws.com/doc/2006-03-01/">{self.region}</LocationConstraint>'
+        )
+        return web.Response(body=xml.encode(), content_type="application/xml")
+
+    async def get_bucket_versioning(self, request, bucket: str) -> web.Response:
+        if not await self._run(self.store.bucket_exists, bucket):
+            raise s3err.NoSuchBucket
+        bm = self.buckets.get(bucket)
+        inner = ""
+        if bm.versioning:
+            inner = "<Status>Enabled</Status>"
+        elif bm.versioning_suspended:
+            inner = "<Status>Suspended</Status>"
+        xml = (
+            '<?xml version="1.0" encoding="UTF-8"?>'
+            f'<VersioningConfiguration xmlns="http://s3.amazonaws.com/doc/2006-03-01/">{inner}</VersioningConfiguration>'
+        )
+        return web.Response(body=xml.encode(), content_type="application/xml")
+
+    async def put_bucket_versioning(self, request, bucket: str, body: bytes) -> web.Response:
+        if not await self._run(self.store.bucket_exists, bucket):
+            raise s3err.NoSuchBucket
+        try:
+            root = ET.fromstring(body)
+            status = ""
+            for el in root.iter():
+                if el.tag.endswith("Status"):
+                    status = el.text or ""
+        except ET.ParseError:
+            raise s3err.MalformedXML from None
+        bm = self.buckets.get(bucket)
+        bm.versioning = status == "Enabled"
+        bm.versioning_suspended = status == "Suspended"
+        await self._run(self.buckets.set, bucket, bm)
+        return web.Response(status=200)
+
+    async def get_bucket_simple(self, request, bucket, attr, missing_err) -> web.Response:
+        if not await self._run(self.store.bucket_exists, bucket):
+            raise s3err.NoSuchBucket
+        bm = self.buckets.get(bucket)
+        val = getattr(bm, attr)
+        if not val:
+            if missing_err is None:
+                val = '<?xml version="1.0" encoding="UTF-8"?><NotificationConfiguration/>'
+            else:
+                raise missing_err
+        if isinstance(val, dict):
+            import json
+
+            return web.Response(body=json.dumps(val).encode(), content_type="application/json")
+        return web.Response(body=val.encode() if isinstance(val, str) else val,
+                            content_type="application/xml")
+
+    async def put_bucket_simple(self, request, bucket, attr, body: bytes) -> web.Response:
+        if not await self._run(self.store.bucket_exists, bucket):
+            raise s3err.NoSuchBucket
+        bm = self.buckets.get(bucket)
+        if attr == "policy":
+            import json
+
+            try:
+                setattr(bm, attr, json.loads(body))
+            except ValueError:
+                raise s3err.MalformedXML from None
+        else:
+            setattr(bm, attr, body.decode())
+        await self._run(self.buckets.set, bucket, bm)
+        return web.Response(status=200 if attr != "policy" else 204)
+
+    async def delete_bucket_simple(self, request, bucket, sub) -> web.Response:
+        attr = {"tagging": "tags"}.get(sub, sub)
+        bm = self.buckets.get(bucket)
+        setattr(bm, attr, None if attr != "tags" else {})
+        await self._run(self.buckets.set, bucket, bm)
+        return web.Response(status=204)
+
+    # -- listing ---------------------------------------------------------------
+
+    async def list_objects(self, request, bucket: str) -> web.Response:
+        q = request.rel_url.query
+        v2 = q.get("list-type") == "2"
+        prefix = q.get("prefix", "")
+        delimiter = q.get("delimiter", "")
+        try:
+            max_keys = int(q.get("max-keys", "1000"))
+        except ValueError:
+            raise s3err.InvalidMaxKeys from None
+        if v2:
+            marker = q.get("continuation-token", "") or q.get("start-after", "")
+        else:
+            marker = q.get("marker", "")
+        res = await self._run(
+            listing.list_objects, self.store, bucket, prefix, marker, delimiter, max_keys
+        )
+        contents = "".join(
+            f"<Contents><Key>{escape(o.name)}</Key>"
+            f"<LastModified>{_iso8601(o.mod_time)}</LastModified>"
+            f'<ETag>"{o.etag}"</ETag><Size>{o.size}</Size>'
+            f"<StorageClass>STANDARD</StorageClass></Contents>"
+            for o in res.objects
+        )
+        prefixes = "".join(
+            f"<CommonPrefixes><Prefix>{escape(p)}</Prefix></CommonPrefixes>"
+            for p in res.prefixes
+        )
+        common = (
+            f"<Name>{escape(bucket)}</Name><Prefix>{escape(prefix)}</Prefix>"
+            f"<MaxKeys>{max_keys}</MaxKeys>"
+            f"<Delimiter>{escape(delimiter)}</Delimiter>"
+            f"<IsTruncated>{'true' if res.is_truncated else 'false'}</IsTruncated>"
+        )
+        if v2:
+            extra = f"<KeyCount>{len(res.objects) + len(res.prefixes)}</KeyCount>"
+            if res.is_truncated:
+                extra += f"<NextContinuationToken>{escape(res.next_marker)}</NextContinuationToken>"
+            xml = (
+                '<?xml version="1.0" encoding="UTF-8"?>'
+                '<ListBucketResult xmlns="http://s3.amazonaws.com/doc/2006-03-01/">'
+                f"{common}{extra}{contents}{prefixes}</ListBucketResult>"
+            )
+        else:
+            extra = ""
+            if res.is_truncated:
+                extra = f"<NextMarker>{escape(res.next_marker)}</NextMarker>"
+            xml = (
+                '<?xml version="1.0" encoding="UTF-8"?>'
+                '<ListBucketResult xmlns="http://s3.amazonaws.com/doc/2006-03-01/">'
+                f"{common}{extra}{contents}{prefixes}</ListBucketResult>"
+            )
+        return web.Response(body=xml.encode(), content_type="application/xml")
+
+    async def list_object_versions(self, request, bucket: str) -> web.Response:
+        q = request.rel_url.query
+        prefix = q.get("prefix", "")
+        delimiter = q.get("delimiter", "")
+        max_keys = int(q.get("max-keys", "1000"))
+        marker = q.get("key-marker", "")
+        vmarker = q.get("version-id-marker", "")
+        res = await self._run(
+            listing.list_objects,
+            self.store,
+            bucket,
+            prefix,
+            marker,
+            delimiter,
+            max_keys,
+            True,
+            vmarker,
+        )
+        body = []
+        for o in res.objects:
+            vid = o.version_id or "null"
+            tag = "DeleteMarker" if o.delete_marker else "Version"
+            entry = (
+                f"<{tag}><Key>{escape(o.name)}</Key><VersionId>{vid}</VersionId>"
+                f"<IsLatest>{'true' if o.is_latest else 'false'}</IsLatest>"
+                f"<LastModified>{_iso8601(o.mod_time)}</LastModified>"
+            )
+            if not o.delete_marker:
+                entry += f'<ETag>"{o.etag}"</ETag><Size>{o.size}</Size><StorageClass>STANDARD</StorageClass>'
+            entry += f"</{tag}>"
+            body.append(entry)
+        prefixes = "".join(
+            f"<CommonPrefixes><Prefix>{escape(p)}</Prefix></CommonPrefixes>"
+            for p in res.prefixes
+        )
+        xml = (
+            '<?xml version="1.0" encoding="UTF-8"?>'
+            '<ListVersionsResult xmlns="http://s3.amazonaws.com/doc/2006-03-01/">'
+            f"<Name>{escape(bucket)}</Name><Prefix>{escape(prefix)}</Prefix>"
+            f"<MaxKeys>{max_keys}</MaxKeys>"
+            f"<IsTruncated>{'true' if res.is_truncated else 'false'}</IsTruncated>"
+            f"{''.join(body)}{prefixes}</ListVersionsResult>"
+        )
+        return web.Response(body=xml.encode(), content_type="application/xml")
+
+    # -- objects ---------------------------------------------------------------
+
+    def _obj_headers(self, oi: ObjectInfo) -> dict[str, str]:
+        h = {
+            "ETag": f'"{oi.etag}"',
+            "Last-Modified": _http_date(oi.mod_time),
+            "Accept-Ranges": "bytes",
+            "Content-Type": oi.content_type or "application/octet-stream",
+        }
+        if oi.version_id:
+            h["x-amz-version-id"] = oi.version_id
+        for k, v in oi.user_defined.items():
+            if k.startswith("x-amz-meta-") or k in ("cache-control", "content-disposition", "content-encoding", "content-language", "expires"):
+                h[k] = v
+        return h
+
+    def _check_preconditions(self, request, oi: ObjectInfo) -> None:
+        inm = request.headers.get("If-None-Match")
+        im = request.headers.get("If-Match")
+        ims = request.headers.get("If-Modified-Since")
+        ius = request.headers.get("If-Unmodified-Since")
+        etag = f'"{oi.etag}"'
+        if im and im.strip() not in (etag, "*", oi.etag):
+            raise s3err.PreconditionFailed
+        if ius:
+            try:
+                t = parsedate_to_datetime(ius)
+                if oi.mod_time / 1e9 > t.timestamp():
+                    raise s3err.PreconditionFailed
+            except (ValueError, TypeError):
+                pass
+        if inm and inm.strip() in (etag, "*", oi.etag):
+            raise s3err.NotModified
+        if ims:
+            try:
+                t = parsedate_to_datetime(ims)
+                if oi.mod_time / 1e9 <= t.timestamp():
+                    raise s3err.NotModified
+            except (ValueError, TypeError):
+                pass
+
+    async def put_object(self, request, bucket: str, key: str, body: bytes) -> web.Response:
+        key = listing.encode_dir_object(key)
+        md5_hdr = request.headers.get("Content-MD5")
+        if md5_hdr:
+            import base64
+
+            if base64.b64encode(hashlib.md5(body).digest()).decode() != md5_hdr:
+                raise s3err.BadDigest
+        user_defined = {}
+        ct = request.headers.get("Content-Type")
+        if ct:
+            user_defined["content-type"] = ct
+        for k, v in request.headers.items():
+            lk = k.lower()
+            if lk.startswith("x-amz-meta-") or lk in (
+                "cache-control", "content-disposition", "content-encoding",
+                "content-language", "expires", "x-amz-storage-class",
+            ):
+                user_defined[lk] = v
+        bm = self.buckets.get(bucket)
+        oi = await self._run(
+            self.store.put_object,
+            bucket,
+            key,
+            body,
+            user_defined,
+            None,
+            bm.versioning,
+        )
+        headers = {"ETag": f'"{oi.etag}"'}
+        if oi.version_id:
+            headers["x-amz-version-id"] = oi.version_id
+        return web.Response(status=200, headers=headers)
+
+    async def copy_object(self, request, bucket: str, key: str) -> web.Response:
+        src = urllib.parse.unquote(request.headers["x-amz-copy-source"])
+        if src.startswith("/"):
+            src = src[1:]
+        src_vid = ""
+        if "?versionId=" in src:
+            src, src_vid = src.split("?versionId=", 1)
+        if "/" not in src:
+            raise s3err.InvalidArgument
+        src_bucket, src_key = src.split("/", 1)
+        src_key = listing.encode_dir_object(src_key)
+        oi, it = await self._run(
+            self.store.get_object, src_bucket, src_key, src_vid
+        )
+        data = b"".join(it)
+        directive = request.headers.get("x-amz-metadata-directive", "COPY")
+        user_defined = dict(oi.user_defined)
+        user_defined["content-type"] = oi.content_type
+        if directive == "REPLACE":
+            user_defined = {
+                k.lower(): v
+                for k, v in request.headers.items()
+                if k.lower().startswith("x-amz-meta-")
+            }
+            if request.headers.get("Content-Type"):
+                user_defined["content-type"] = request.headers["Content-Type"]
+        bm = self.buckets.get(bucket)
+        new_oi = await self._run(
+            self.store.put_object,
+            bucket,
+            listing.encode_dir_object(key),
+            data,
+            user_defined,
+            None,
+            bm.versioning,
+        )
+        xml = (
+            '<?xml version="1.0" encoding="UTF-8"?>'
+            f'<CopyObjectResult><ETag>"{new_oi.etag}"</ETag>'
+            f"<LastModified>{_iso8601(new_oi.mod_time)}</LastModified></CopyObjectResult>"
+        )
+        headers = {}
+        if new_oi.version_id:
+            headers["x-amz-version-id"] = new_oi.version_id
+        return web.Response(body=xml.encode(), content_type="application/xml", headers=headers)
+
+    def _parse_range(self, request, size: int) -> tuple[int, int] | None:
+        rng = request.headers.get("Range")
+        if not rng or not rng.startswith("bytes="):
+            return None
+        spec = rng[len("bytes=") :]
+        if "," in spec:
+            raise s3err.NotImplemented_
+        start_s, _, end_s = spec.partition("-")
+        try:
+            if start_s == "":
+                n = int(end_s)
+                if n == 0:
+                    raise s3err.InvalidRange
+                start = max(size - n, 0)
+                end = size - 1
+            else:
+                start = int(start_s)
+                end = int(end_s) if end_s else size - 1
+        except ValueError:
+            return None  # malformed range is ignored per RFC
+        if start >= size or start > end:
+            raise s3err.InvalidRange
+        return start, min(end, size - 1)
+
+    async def get_object(self, request, bucket: str, key: str) -> web.StreamResponse:
+        key = listing.encode_dir_object(key)
+        vid = request.rel_url.query.get("versionId", "")
+        if vid == "null":
+            vid = ""
+        oi, fi, metas = await self._run(self.store.open_object, bucket, key, vid)
+        self._check_preconditions(request, oi)
+        rng = self._parse_range(request, oi.size) if oi.size else None
+        headers = self._obj_headers(oi)
+        if rng:
+            start, end = rng
+            it = self.store.read_object(bucket, key, fi, metas, start, end - start + 1)
+            headers["Content-Range"] = f"bytes {start}-{end}/{oi.size}"
+            resp = web.StreamResponse(status=206, headers=headers)
+            resp.content_length = end - start + 1
+        else:
+            it = self.store.read_object(bucket, key, fi, metas)
+            resp = web.StreamResponse(status=200, headers=headers)
+            resp.content_length = oi.size
+        await resp.prepare(request)
+        loop = asyncio.get_running_loop()
+        sentinel = object()
+        nxt = lambda: next(it, sentinel)  # noqa: E731
+        while True:
+            chunk = await loop.run_in_executor(None, nxt)
+            if chunk is sentinel:
+                break
+            await resp.write(chunk)
+        await resp.write_eof()
+        return resp
+
+    async def head_object(self, request, bucket: str, key: str) -> web.Response:
+        key = listing.encode_dir_object(key)
+        vid = request.rel_url.query.get("versionId", "")
+        if vid == "null":
+            vid = ""
+        oi = await self._run(self.store.get_object_info, bucket, key, vid)
+        if oi.delete_marker:
+            return web.Response(status=405, headers={"x-amz-delete-marker": "true"})
+        self._check_preconditions(request, oi)
+        headers = self._obj_headers(oi)
+        headers["Content-Length"] = str(oi.size)
+        return web.Response(status=200, headers=headers)
+
+    async def delete_object(self, request, bucket: str, key: str) -> web.Response:
+        key = listing.encode_dir_object(key)
+        vid = request.rel_url.query.get("versionId", "")
+        if vid == "null":
+            vid = ""
+        bm = self.buckets.get(bucket)
+        headers = {}
+        try:
+            oi = await self._run(
+                self.store.delete_object, bucket, key, vid, bm.versioning
+            )
+            if oi.delete_marker:
+                headers["x-amz-delete-marker"] = "true"
+            if oi.version_id:
+                headers["x-amz-version-id"] = oi.version_id
+        except (quorum.ObjectNotFound, quorum.VersionNotFound):
+            pass  # S3 deletes are idempotent
+        return web.Response(status=204, headers=headers)
+
+    async def delete_multiple(self, request, bucket: str, body: bytes) -> web.Response:
+        try:
+            root = ET.fromstring(body)
+        except ET.ParseError:
+            raise s3err.MalformedXML from None
+        quiet = False
+        targets = []
+        for el in root:
+            tag = el.tag.split("}")[-1]
+            if tag == "Quiet":
+                quiet = (el.text or "").lower() == "true"
+            elif tag == "Object":
+                k, v = "", ""
+                for sub in el:
+                    stag = sub.tag.split("}")[-1]
+                    if stag == "Key":
+                        k = sub.text or ""
+                    elif stag == "VersionId":
+                        v = sub.text or ""
+                targets.append((k, v))
+        bm = self.buckets.get(bucket)
+        results = []
+        for k, v in targets[:1000]:
+            try:
+                oi = await self._run(
+                    self.store.delete_object,
+                    bucket,
+                    listing.encode_dir_object(k),
+                    "" if v == "null" else v,
+                    bm.versioning,
+                )
+                results.append((k, v, None, oi))
+            except (quorum.ObjectNotFound, quorum.VersionNotFound):
+                results.append((k, v, None, None))
+            except Exception:  # noqa: BLE001
+                results.append((k, v, s3err.InternalError, None))
+        parts = []
+        for k, v, err, oi in results:
+            if err is None:
+                if not quiet:
+                    e = f"<Deleted><Key>{escape(k)}</Key>"
+                    if v:
+                        e += f"<VersionId>{escape(v)}</VersionId>"
+                    if oi is not None and oi.delete_marker and oi.version_id:
+                        e += f"<DeleteMarker>true</DeleteMarker><DeleteMarkerVersionId>{oi.version_id}</DeleteMarkerVersionId>"
+                    parts.append(e + "</Deleted>")
+            else:
+                parts.append(
+                    f"<Error><Key>{escape(k)}</Key><Code>{err.code}</Code>"
+                    f"<Message>{escape(err.description)}</Message></Error>"
+                )
+        xml = (
+            '<?xml version="1.0" encoding="UTF-8"?>'
+            '<DeleteResult xmlns="http://s3.amazonaws.com/doc/2006-03-01/">'
+            f"{''.join(parts)}</DeleteResult>"
+        )
+        return web.Response(body=xml.encode(), content_type="application/xml")
+
+    # -- multipart -------------------------------------------------------------
+
+    async def new_multipart(self, request, bucket, key) -> web.Response:
+        key = listing.encode_dir_object(key)
+        user_defined = {}
+        if request.headers.get("Content-Type"):
+            user_defined["content-type"] = request.headers["Content-Type"]
+        for k, v in request.headers.items():
+            if k.lower().startswith("x-amz-meta-"):
+                user_defined[k.lower()] = v
+        upload_id = await self._run(
+            self.mp.new_upload, bucket, key, user_defined
+        )
+        xml = (
+            '<?xml version="1.0" encoding="UTF-8"?>'
+            '<InitiateMultipartUploadResult xmlns="http://s3.amazonaws.com/doc/2006-03-01/">'
+            f"<Bucket>{escape(bucket)}</Bucket><Key>{escape(key)}</Key>"
+            f"<UploadId>{upload_id}</UploadId></InitiateMultipartUploadResult>"
+        )
+        return web.Response(body=xml.encode(), content_type="application/xml")
+
+    async def put_object_part(self, request, bucket, key, body) -> web.Response:
+        from ..erasure import multipart as mp_mod
+
+        key = listing.encode_dir_object(key)
+        q = request.rel_url.query
+        try:
+            part_number = int(q["partNumber"])
+        except (KeyError, ValueError):
+            raise s3err.InvalidArgument from None
+        upload_id = q.get("uploadId", "")
+        try:
+            etag = await self._run(
+                self.mp.put_part, bucket, key, upload_id, part_number, body
+            )
+        except mp_mod.UploadNotFound:
+            raise s3err.NoSuchUpload from None
+        except mp_mod.InvalidPart:
+            raise s3err.InvalidPart from None
+        return web.Response(status=200, headers={"ETag": f'"{etag}"'})
+
+    async def complete_multipart(self, request, bucket, key, body) -> web.Response:
+        from ..erasure import multipart as mp_mod
+
+        key = listing.encode_dir_object(key)
+        upload_id = request.rel_url.query.get("uploadId", "")
+        try:
+            root = ET.fromstring(body)
+        except ET.ParseError:
+            raise s3err.MalformedXML from None
+        parts = []
+        for el in root:
+            if el.tag.split("}")[-1] == "Part":
+                n, etag = 0, ""
+                for sub in el:
+                    t = sub.tag.split("}")[-1]
+                    if t == "PartNumber":
+                        n = int(sub.text or "0")
+                    elif t == "ETag":
+                        etag = (sub.text or "").strip()
+                parts.append((n, etag))
+        bm = self.buckets.get(bucket)
+        try:
+            oi = await self._run(
+                self.mp.complete, bucket, key, upload_id, parts, bm.versioning
+            )
+        except mp_mod.UploadNotFound:
+            raise s3err.NoSuchUpload from None
+        except mp_mod.InvalidPartOrder:
+            raise s3err.InvalidPartOrder from None
+        except mp_mod.InvalidPart:
+            raise s3err.InvalidPart from None
+        xml = (
+            '<?xml version="1.0" encoding="UTF-8"?>'
+            '<CompleteMultipartUploadResult xmlns="http://s3.amazonaws.com/doc/2006-03-01/">'
+            f"<Location>/{escape(bucket)}/{escape(key)}</Location>"
+            f"<Bucket>{escape(bucket)}</Bucket><Key>{escape(key)}</Key>"
+            f'<ETag>"{oi.etag}"</ETag></CompleteMultipartUploadResult>'
+        )
+        headers = {}
+        if oi.version_id:
+            headers["x-amz-version-id"] = oi.version_id
+        return web.Response(body=xml.encode(), content_type="application/xml", headers=headers)
+
+    async def abort_multipart(self, request, bucket, key) -> web.Response:
+        from ..erasure import multipart as mp_mod
+
+        key = listing.encode_dir_object(key)
+        upload_id = request.rel_url.query.get("uploadId", "")
+        try:
+            await self._run(self.mp.abort, bucket, key, upload_id)
+        except mp_mod.UploadNotFound:
+            raise s3err.NoSuchUpload from None
+        return web.Response(status=204)
+
+    async def list_parts(self, request, bucket, key) -> web.Response:
+        from ..erasure import multipart as mp_mod
+
+        key = listing.encode_dir_object(key)
+        q = request.rel_url.query
+        upload_id = q.get("uploadId", "")
+        max_parts = int(q.get("max-parts", "1000"))
+        marker = int(q.get("part-number-marker", "0"))
+        try:
+            parts = await self._run(
+                self.mp.list_parts, bucket, key, upload_id, max_parts, marker
+            )
+        except mp_mod.UploadNotFound:
+            raise s3err.NoSuchUpload from None
+        items = "".join(
+            f"<Part><PartNumber>{p.number}</PartNumber>"
+            f'<ETag>"{p.etag}"</ETag><Size>{p.size}</Size>'
+            f"<LastModified>{_iso8601(p.mod_time)}</LastModified></Part>"
+            for p in parts
+        )
+        xml = (
+            '<?xml version="1.0" encoding="UTF-8"?>'
+            '<ListPartsResult xmlns="http://s3.amazonaws.com/doc/2006-03-01/">'
+            f"<Bucket>{escape(bucket)}</Bucket><Key>{escape(key)}</Key>"
+            f"<UploadId>{upload_id}</UploadId><MaxParts>{max_parts}</MaxParts>"
+            f"<IsTruncated>false</IsTruncated>{items}</ListPartsResult>"
+        )
+        return web.Response(body=xml.encode(), content_type="application/xml")
+
+    async def list_multipart_uploads(self, request, bucket) -> web.Response:
+        prefix = request.rel_url.query.get("prefix", "")
+        uploads = await self._run(self.mp.list_uploads, bucket, prefix)
+        items = "".join(
+            f"<Upload><Key>{escape(k)}</Key><UploadId>{uid}</UploadId></Upload>"
+            for k, uid in uploads
+        )
+        xml = (
+            '<?xml version="1.0" encoding="UTF-8"?>'
+            '<ListMultipartUploadsResult xmlns="http://s3.amazonaws.com/doc/2006-03-01/">'
+            f"<Bucket>{escape(bucket)}</Bucket><Prefix>{escape(prefix)}</Prefix>"
+            f"<IsTruncated>false</IsTruncated>{items}</ListMultipartUploadsResult>"
+        )
+        return web.Response(body=xml.encode(), content_type="application/xml")
+
+
+def make_server(drive_paths: list[str], region: str = "us-east-1") -> S3Server:
+    disks = [XLStorage(p) for p in drive_paths]
+    store = ErasureSet(disks)
+    return S3Server(store, region)
+
+
+def main(argv: list[str] | None = None) -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser(description="minio_tpu S3 server")
+    ap.add_argument("drives", nargs="+", help="drive directories")
+    ap.add_argument("--address", default="0.0.0.0:9000")
+    args = ap.parse_args(argv)
+    host, _, port = args.address.rpartition(":")
+    srv = make_server(args.drives)
+    web.run_app(srv.app, host=host or "0.0.0.0", port=int(port), print=None)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,84 @@
+"""BucketMetadataSys — per-bucket config persisted in the object store.
+
+Mirrors the reference's BucketMetadataSys (/root/reference/cmd/
+bucket-metadata-sys.go): bucket metadata (creation time, versioning config,
+policy, tags, ...) lives as objects under the system volume and is cached
+in memory; every node recovers it from the backend at boot.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+from ..erasure.quorum import ObjectNotFound
+
+SYSTEM_BUCKET = ".minio.sys"
+CONFIG_PREFIX = "buckets"
+
+
+class BucketMetadata:
+    def __init__(self, name: str, created_ns: int = 0):
+        self.name = name
+        self.created_ns = created_ns
+        self.versioning = False
+        self.versioning_suspended = False
+        self.policy: dict | None = None
+        self.tags: dict[str, str] = {}
+        self.quota: int = 0
+        self.lifecycle: str | None = None  # raw XML, served back as stored
+        self.notification: str | None = None
+        self.encryption: str | None = None
+        self.object_lock: str | None = None
+        self.cors: str | None = None
+        self.replication: str | None = None
+
+    def to_json(self) -> bytes:
+        return json.dumps(self.__dict__).encode()
+
+    @staticmethod
+    def from_json(name: str, buf: bytes) -> "BucketMetadata":
+        bm = BucketMetadata(name)
+        try:
+            bm.__dict__.update(json.loads(buf))
+        except (ValueError, TypeError):
+            pass
+        bm.name = name
+        return bm
+
+
+class BucketMetadataSys:
+    def __init__(self, store):
+        self.store = store  # object layer (ErasureSet / pools)
+        self._cache: dict[str, BucketMetadata] = {}
+        self._lock = threading.Lock()
+
+    def _key(self, bucket: str) -> str:
+        return f"{CONFIG_PREFIX}/{bucket}/.metadata.json"
+
+    def get(self, bucket: str) -> BucketMetadata:
+        with self._lock:
+            bm = self._cache.get(bucket)
+        if bm is not None:
+            return bm
+        try:
+            _, it = self.store.get_object(SYSTEM_BUCKET, self._key(bucket))
+            bm = BucketMetadata.from_json(bucket, b"".join(it))
+        except (ObjectNotFound, Exception):  # noqa: BLE001 — default config
+            bm = BucketMetadata(bucket)
+        with self._lock:
+            self._cache[bucket] = bm
+        return bm
+
+    def set(self, bucket: str, bm: BucketMetadata) -> None:
+        self.store.put_object(SYSTEM_BUCKET, self._key(bucket), bm.to_json())
+        with self._lock:
+            self._cache[bucket] = bm
+
+    def drop(self, bucket: str) -> None:
+        with self._lock:
+            self._cache.pop(bucket, None)
+        try:
+            self.store.delete_object(SYSTEM_BUCKET, self._key(bucket))
+        except Exception:  # noqa: BLE001
+            pass

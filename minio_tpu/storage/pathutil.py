@@ -1,0 +1,26 @@
+"""Object-key path conventions shared by storage and listing layers."""
+
+# objects with trailing slash ("directory markers") are stored with this
+# suffix (reference's encodeDirObject, cmd/object-api-utils.go)
+DIR_OBJECT_SUFFIX = "__XLDIR__"
+
+
+def encode_dir_object(key: str) -> str:
+    return key[:-1] + DIR_OBJECT_SUFFIX if key.endswith("/") else key
+
+
+def decode_dir_object(key: str) -> str:
+    return key[: -len(DIR_OBJECT_SUFFIX)] + "/" if key.endswith(DIR_OBJECT_SUFFIX) else key
+
+
+def walk_sort_key(name: str, is_dir: bool) -> tuple[str, int]:
+    """Sort siblings so emitted object keys come out in DECODED order.
+
+    A subdir 'photos' emits keys 'photos/...'; the dir-marker object
+    'photos__XLDIR__' emits exactly 'photos/', which sorts first.
+    """
+    if name.endswith(DIR_OBJECT_SUFFIX):
+        return (decode_dir_object(name), 0)
+    if is_dir:
+        return (name + "/", 1)
+    return (name, 1)

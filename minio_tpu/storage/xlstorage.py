@@ -30,6 +30,8 @@ from .interface import StorageAPI
 SYS_DIR = ".minio.sys"
 TMP_DIR = f"{SYS_DIR}/tmp"
 TRASH_DIR = f"{SYS_DIR}/trash"
+MULTIPART_DIR = f"{SYS_DIR}/multipart"
+BUCKETS_META_DIR = f"{SYS_DIR}/buckets"
 META_FILE = "xl.meta"
 
 _FSYNC = os.environ.get("MINIO_TPU_FSYNC", "0") == "1"
@@ -51,8 +53,8 @@ class XLStorage(StorageAPI):
         self.endpoint = endpoint or self.root
         self.disk_id = ""
         self._meta_lock = threading.RLock()
-        os.makedirs(os.path.join(self.root, TMP_DIR), exist_ok=True)
-        os.makedirs(os.path.join(self.root, TRASH_DIR), exist_ok=True)
+        for sysdir in (TMP_DIR, TRASH_DIR, MULTIPART_DIR, BUCKETS_META_DIR):
+            os.makedirs(os.path.join(self.root, sysdir), exist_ok=True)
 
     # -- path helpers ------------------------------------------------------
 
@@ -352,26 +354,35 @@ class XLStorage(StorageAPI):
         return out
 
     def walk_dir(self, volume: str, base: str = "") -> Iterator[str]:
-        """Yield object paths (dirs containing xl.meta) under base, in
-        sorted lexical order — the per-drive feed of distributed listing
+        """Yield object paths (dirs containing xl.meta) under base, sorted
+        so DECODED keys come out in order (dir markers before their subtree)
+        — the per-drive feed of distributed listing
         (/root/reference/cmd/metacache-walk.go:73)."""
+        from .pathutil import walk_sort_key
+
         vol_path = self._check_vol(volume)
         base_rel = _clean_rel(base)
         start = os.path.join(vol_path, base_rel) if base_rel else vol_path
 
         def walk(dir_path: str, rel: str) -> Iterator[str]:
             try:
-                names = sorted(os.listdir(dir_path))
+                names = os.listdir(dir_path)
             except (FileNotFoundError, NotADirectoryError):
                 return
             if META_FILE in names and rel:
                 yield rel
+            entries = []
             for n in names:
                 if n == META_FILE:
                     continue
-                sub = os.path.join(dir_path, n)
-                if os.path.isdir(sub):
-                    yield from walk(sub, f"{rel}/{n}" if rel else n)
+                is_dir = os.path.isdir(os.path.join(dir_path, n))
+                entries.append((walk_sort_key(n, is_dir), n, is_dir))
+            entries.sort()
+            for _, n, is_dir in entries:
+                if is_dir:
+                    yield from walk(
+                        os.path.join(dir_path, n), f"{rel}/{n}" if rel else n
+                    )
 
         yield from walk(start, base_rel)
 

@@ -1,15 +1,17 @@
 """Test configuration: force JAX onto a virtual 8-device CPU mesh.
 
-Real TPU hardware is single-chip in this environment; multi-chip sharding is
-validated on virtual CPU devices (same XLA partitioner, no ICI).
-Must run before the first `import jax` anywhere in the test session.
+Real TPU hardware here is a single tunneled chip (JAX_PLATFORMS=axon pinned
+in the environment by a sitecustomize hook); multi-chip sharding is
+validated on virtual CPU devices instead (same XLA partitioner, no ICI).
+The sitecustomize wins over plain env vars, so the platform is forced via
+jax.config before any backend is created.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
