@@ -22,6 +22,8 @@ from .set import ErasureSet
 from .types import ObjectInfo
 
 MP_VOLUME = ".minio.sys/multipart"
+POOL_SEP = "~"  # upload ids are "<pool_idx>~<uuid>" so every part/complete
+# call resolves to the pool (and thus set) that started the upload
 
 
 class UploadNotFound(Exception):
@@ -247,3 +249,77 @@ class MultipartManager:
         oi = self.es._to_object_info(bucket, obj, fi)
         oi.parts = len(parts)
         return oi
+
+
+class MultipartRouter:
+    """Routes multipart calls through pools -> hashed set.
+
+    The reference routes by getHashedSet(object)
+    (/root/reference/cmd/erasure-sets.go NewMultipartUpload); across pools
+    the pool index rides inside the upload id so an upload stays pinned to
+    the pool that started it (the reference tracks this server-side).
+    """
+
+    def __init__(self, store):
+        self.store = store  # ServerPools or anything with .pools/.get_hashed_set
+
+    def _pools(self):
+        return getattr(self.store, "pools", [self.store])
+
+    def _mgr(self, obj: str, pool_idx: int) -> MultipartManager:
+        pools = self._pools()
+        if not 0 <= pool_idx < len(pools):
+            raise UploadNotFound(f"bad pool index {pool_idx}")
+        pool = pools[pool_idx]
+        # plain ErasureSet stores have no set routing
+        es = pool.get_hashed_set(obj) if hasattr(pool, "get_hashed_set") else pool
+        return MultipartManager(es)
+
+    @staticmethod
+    def _split(upload_id: str) -> tuple[int, str]:
+        if POOL_SEP in upload_id:
+            head, raw = upload_id.split(POOL_SEP, 1)
+            try:
+                return int(head), raw
+            except ValueError:
+                pass
+        return 0, upload_id
+
+    def new_upload(self, bucket, obj, user_defined=None, parity=None) -> str:
+        pools = self._pools()
+        pool_idx = 0
+        if len(pools) > 1:
+            # a multipart overwrite must land in the pool already holding
+            # the object, like put_object does — otherwise reads keep
+            # serving the stale copy from the earlier pool
+            try:
+                pool_idx = pools.index(self.store._pool_holding(bucket, obj))
+            except Exception:  # noqa: BLE001 — new object: place by space
+                pool_idx = pools.index(self.store._pool_with_most_free())
+        raw = self._mgr(obj, pool_idx).new_upload(bucket, obj, user_defined, parity)
+        return f"{pool_idx}{POOL_SEP}{raw}"
+
+    def put_part(self, bucket, obj, upload_id, part_number, data) -> str:
+        pidx, raw = self._split(upload_id)
+        return self._mgr(obj, pidx).put_part(bucket, obj, raw, part_number, data)
+
+    def list_parts(self, bucket, obj, upload_id, max_parts=1000, part_marker=0):
+        pidx, raw = self._split(upload_id)
+        return self._mgr(obj, pidx).list_parts(bucket, obj, raw, max_parts, part_marker)
+
+    def abort(self, bucket, obj, upload_id) -> None:
+        pidx, raw = self._split(upload_id)
+        self._mgr(obj, pidx).abort(bucket, obj, raw)
+
+    def complete(self, bucket, obj, upload_id, parts, versioned=False):
+        pidx, raw = self._split(upload_id)
+        return self._mgr(obj, pidx).complete(bucket, obj, raw, parts, versioned)
+
+    def list_uploads(self, bucket, prefix="") -> list[tuple[str, str]]:
+        out = []
+        for pidx, pool in enumerate(self._pools()):
+            sets = getattr(pool, "sets", [pool])
+            for s in sets:
+                for key, raw in MultipartManager(s).list_uploads(bucket, prefix):
+                    out.append((key, f"{pidx}{POOL_SEP}{raw}"))
+        return sorted(set(out))

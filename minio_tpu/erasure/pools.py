@@ -1,0 +1,148 @@
+"""ServerPools — the top-level ObjectLayer over one or more pools.
+
+Mirrors /root/reference/cmd/erasure-server-pool.go: new objects land in
+the pool with the most free space; reads/deletes fan out to find the pool
+that holds the object; buckets exist on every pool. Each pool is an
+ErasureSets. This is the object the S3 server programs against.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from ..storage.datatypes import FileInfo
+from ..storage.interface import StorageAPI
+from .quorum import ObjectNotFound, VersionNotFound
+from .sets import ErasureSets
+from .types import BucketInfo, ObjectInfo
+
+
+class ServerPools:
+    def __init__(self, pools: list[ErasureSets]):
+        if not pools:
+            raise ValueError("need at least one pool")
+        self.pools = pools
+
+    # facade plumbing for listing/multipart
+    @property
+    def disks(self) -> list[StorageAPI]:
+        return [d for p in self.pools for d in p.disks]
+
+    @property
+    def n(self) -> int:
+        return self.pools[0].n
+
+    @property
+    def default_parity(self) -> int:
+        return self.pools[0].default_parity
+
+    # -- placement ---------------------------------------------------------
+
+    def _pool_with_most_free(self) -> ErasureSets:
+        if len(self.pools) == 1:
+            return self.pools[0]
+        best, best_free = self.pools[0], -1
+        for p in self.pools:
+            free = 0
+            for d in p.disks:
+                try:
+                    free += d.disk_info().free
+                except Exception:  # noqa: BLE001
+                    pass
+            if free > best_free:
+                best, best_free = p, free
+        return best
+
+    def _pool_holding(self, bucket: str, obj: str, version_id: str = "") -> ErasureSets:
+        """Pool that already has the object (parallel lookup in the
+        reference, getPoolInfoExistingWithOpts); raises ObjectNotFound."""
+        last: Exception = ObjectNotFound(f"{bucket}/{obj}")
+        for p in self.pools:
+            try:
+                p.get_object_info(bucket, obj, version_id)
+                return p
+            except (ObjectNotFound, VersionNotFound) as e:
+                last = e
+        raise last
+
+    # -- buckets -----------------------------------------------------------
+
+    def make_bucket(self, bucket: str) -> None:
+        for p in self.pools:
+            p.make_bucket(bucket)
+
+    def delete_bucket(self, bucket: str, force: bool = False) -> None:
+        for p in self.pools:
+            p.delete_bucket(bucket, force=force)
+
+    def bucket_exists(self, bucket: str) -> bool:
+        return any(p.bucket_exists(bucket) for p in self.pools)
+
+    def list_buckets(self) -> list[BucketInfo]:
+        return self.pools[0].list_buckets()
+
+    # -- objects -----------------------------------------------------------
+
+    def put_object(self, bucket: str, obj: str, data: bytes, *a, **kw) -> ObjectInfo:
+        # overwrite in place if some pool already holds the object
+        if len(self.pools) > 1:
+            try:
+                pool = self._pool_holding(bucket, obj)
+            except (ObjectNotFound, VersionNotFound):
+                pool = self._pool_with_most_free()
+        else:
+            pool = self.pools[0]
+        return pool.put_object(bucket, obj, data, *a, **kw)
+
+    def get_object(self, bucket: str, obj: str, version_id: str = "", *a, **kw):
+        return self._pool_holding(bucket, obj, version_id).get_object(
+            bucket, obj, version_id, *a, **kw
+        )
+
+    def open_object(self, bucket: str, obj: str, version_id: str = ""):
+        # the returned handle is bound to the concrete set that holds the
+        # object — later reads never re-resolve pools
+        return self._pool_holding(bucket, obj, version_id).open_object(
+            bucket, obj, version_id
+        )
+
+    def get_object_info(self, bucket: str, obj: str, version_id: str = "") -> ObjectInfo:
+        return self._pool_holding(bucket, obj, version_id).get_object_info(
+            bucket, obj, version_id
+        )
+
+    def delete_object(
+        self, bucket: str, obj: str, version_id: str = "", versioned: bool = False, **kw
+    ) -> ObjectInfo:
+        try:
+            pool = self._pool_holding(bucket, obj, version_id)
+        except (ObjectNotFound, VersionNotFound):
+            if versioned:
+                # delete marker still gets written somewhere deterministic
+                pool = self.pools[0]
+            else:
+                raise
+        return pool.delete_object(bucket, obj, version_id, versioned=versioned, **kw)
+
+    def list_object_versions(self, bucket: str, obj: str) -> list[ObjectInfo]:
+        out: list[ObjectInfo] = []
+        for p in self.pools:
+            try:
+                out.extend(p.list_object_versions(bucket, obj))
+            except Exception:  # noqa: BLE001
+                pass
+        out.sort(key=lambda o: o.mod_time, reverse=True)
+        return out
+
+    def heal_object(self, bucket: str, obj: str, version_id: str = "") -> dict:
+        return self._pool_holding(bucket, obj, version_id).heal_object(
+            bucket, obj, version_id
+        )
+
+    def get_hashed_set(self, key: str):
+        # single-pool fast path used by the multipart router
+        return self._pool_with_most_free().get_hashed_set(key)
+
+    def walk_objects(self, bucket: str, prefix: str = "") -> Iterator[str]:
+        for p in self.pools:
+            yield from p.walk_objects(bucket, prefix)

@@ -255,28 +255,14 @@ class ErasureSet:
 
     def open_object(
         self, bucket: str, obj: str, version_id: str = ""
-    ) -> tuple[ObjectInfo, FileInfo, list[FileInfo | None]]:
-        """One quorum metadata read; reuse the handles for ranged reads so
-        Range requests don't pay the quorum read twice."""
+    ) -> tuple[ObjectInfo, "ObjectHandle"]:
+        """One quorum metadata read; the returned handle serves any number
+        of ranged reads without re-reading quorum metadata."""
         fi, metas, _, _ = self._quorum_fileinfo(bucket, obj, version_id, read_data=True)
         if fi.deleted:
             raise ObjectNotFound(f"{bucket}/{obj}")
-        return self._to_object_info(bucket, obj, fi), fi, metas
-
-    def read_object(
-        self,
-        bucket: str,
-        obj: str,
-        fi: FileInfo,
-        metas: list[FileInfo | None],
-        offset: int = 0,
-        length: int = -1,
-    ) -> Iterator[bytes]:
-        if length < 0:
-            length = fi.size - offset
-        if offset < 0 or offset + length > fi.size:
-            raise ValueError("invalid range")
-        return self._read_range(bucket, obj, fi, metas, offset, length)
+        oi = self._to_object_info(bucket, obj, fi)
+        return oi, ObjectHandle(self, bucket, obj, fi, metas)
 
     def get_object(
         self,
@@ -286,8 +272,8 @@ class ErasureSet:
         offset: int = 0,
         length: int = -1,
     ) -> tuple[ObjectInfo, Iterator[bytes]]:
-        oi, fi, metas = self.open_object(bucket, obj, version_id)
-        return oi, self.read_object(bucket, obj, fi, metas, offset, length)
+        oi, h = self.open_object(bucket, obj, version_id)
+        return oi, h.read(offset, length)
 
     def _shard_sources(
         self, fi: FileInfo, metas: list[FileInfo | None]
@@ -561,4 +547,25 @@ class ErasureSet:
                 k: v for k, v in fi.metadata.items() if k not in ("etag", "content-type")
             },
             num_versions=fi.num_versions,
+        )
+
+
+class ObjectHandle:
+    """Resolved read handle: concrete set + quorum-picked version + per-drive
+    metadata. Constructing reads is free; all I/O happens during iteration."""
+
+    def __init__(self, es: ErasureSet, bucket: str, obj: str, fi: FileInfo, metas):
+        self.es = es
+        self.bucket = bucket
+        self.obj = obj
+        self.fi = fi
+        self.metas = metas
+
+    def read(self, offset: int = 0, length: int = -1) -> Iterator[bytes]:
+        if length < 0:
+            length = self.fi.size - offset
+        if offset < 0 or offset + length > self.fi.size:
+            raise ValueError("invalid range")
+        return self.es._read_range(
+            self.bucket, self.obj, self.fi, self.metas, offset, length
         )

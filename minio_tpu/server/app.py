@@ -44,12 +44,12 @@ def _http_date(ns: int) -> str:
 
 class S3Server:
     def __init__(self, store: ErasureSet, region: str = "us-east-1"):
-        from ..erasure.multipart import MultipartManager
+        from ..erasure.multipart import MultipartRouter
 
         self.store = store
         self.region = region
         self.buckets = BucketMetadataSys(store)
-        self.mp = MultipartManager(store)
+        self.mp = MultipartRouter(store)
         root_user = os.environ.get("MINIO_ROOT_USER", "minioadmin")
         root_pass = os.environ.get("MINIO_ROOT_PASSWORD", "minioadmin")
         self._credentials = {root_user: root_pass}
@@ -208,10 +208,12 @@ class S3Server:
 
         # object-level
         if m == "PUT":
-            if "x-amz-copy-source" in request.headers and "partNumber" not in q:
-                return await self.copy_object(request, bucket, key)
             if "partNumber" in q and "uploadId" in q:
+                if "x-amz-copy-source" in request.headers:
+                    return await self.upload_part_copy(request, bucket, key)
                 return await self.put_object_part(request, bucket, key, body)
+            if "x-amz-copy-source" in request.headers:
+                return await self.copy_object(request, bucket, key)
             return await self.put_object(request, bucket, key, body)
         if m == "GET":
             if "uploadId" in q:
@@ -609,18 +611,18 @@ class S3Server:
         vid = request.rel_url.query.get("versionId", "")
         if vid == "null":
             vid = ""
-        oi, fi, metas = await self._run(self.store.open_object, bucket, key, vid)
+        oi, handle = await self._run(self.store.open_object, bucket, key, vid)
         self._check_preconditions(request, oi)
         rng = self._parse_range(request, oi.size) if oi.size else None
         headers = self._obj_headers(oi)
         if rng:
             start, end = rng
-            it = self.store.read_object(bucket, key, fi, metas, start, end - start + 1)
+            it = handle.read(start, end - start + 1)
             headers["Content-Range"] = f"bytes {start}-{end}/{oi.size}"
             resp = web.StreamResponse(status=206, headers=headers)
             resp.content_length = end - start + 1
         else:
-            it = self.store.read_object(bucket, key, fi, metas)
+            it = handle.read()
             resp = web.StreamResponse(status=200, headers=headers)
             resp.content_length = oi.size
         await resp.prepare(request)
@@ -766,6 +768,54 @@ class S3Server:
             raise s3err.InvalidPart from None
         return web.Response(status=200, headers={"ETag": f'"{etag}"'})
 
+    async def upload_part_copy(self, request, bucket, key) -> web.Response:
+        from ..erasure import multipart as mp_mod
+
+        key = listing.encode_dir_object(key)
+        q = request.rel_url.query
+        try:
+            part_number = int(q["partNumber"])
+        except (KeyError, ValueError):
+            raise s3err.InvalidArgument from None
+        upload_id = q.get("uploadId", "")
+        src = urllib.parse.unquote(request.headers["x-amz-copy-source"])
+        if src.startswith("/"):
+            src = src[1:]
+        src_vid = ""
+        if "?versionId=" in src:
+            src, src_vid = src.split("?versionId=", 1)
+        if "/" not in src:
+            raise s3err.InvalidArgument
+        src_bucket, src_key = src.split("/", 1)
+        src_key = listing.encode_dir_object(src_key)
+        oi, handle = await self._run(
+            self.store.open_object, src_bucket, src_key, src_vid
+        )
+        offset, length = 0, oi.size
+        crange = request.headers.get("x-amz-copy-source-range", "")
+        if crange.startswith("bytes="):
+            try:
+                a, _, b = crange[len("bytes=") :].partition("-")
+                offset = int(a)
+                length = int(b) - offset + 1
+            except ValueError:
+                raise s3err.InvalidArgument from None
+            if offset < 0 or length <= 0 or offset + length > oi.size:
+                raise s3err.InvalidRange
+        data = await self._run(lambda: b"".join(handle.read(offset, length)))
+        try:
+            etag = await self._run(
+                self.mp.put_part, bucket, key, upload_id, part_number, data
+            )
+        except mp_mod.UploadNotFound:
+            raise s3err.NoSuchUpload from None
+        xml = (
+            '<?xml version="1.0" encoding="UTF-8"?>'
+            f'<CopyPartResult><ETag>"{etag}"</ETag>'
+            f"<LastModified>{_iso8601(oi.mod_time)}</LastModified></CopyPartResult>"
+        )
+        return web.Response(body=xml.encode(), content_type="application/xml")
+
     async def complete_multipart(self, request, bucket, key, body) -> web.Response:
         from ..erasure import multipart as mp_mod
 
@@ -865,21 +915,64 @@ class S3Server:
         return web.Response(body=xml.encode(), content_type="application/xml")
 
 
-def make_server(drive_paths: list[str], region: str = "us-east-1") -> S3Server:
-    disks = [XLStorage(p) for p in drive_paths]
-    store = ErasureSet(disks)
-    return S3Server(store, region)
+def make_object_layer(
+    drive_specs: list[str], set_size: int = 0
+):
+    """Build the full L3 topology from drive specs (ellipses expanded):
+    format.json bootstrap -> ErasureSets per pool -> ServerPools.
+
+    Each spec is one pool (reference: each `minio server` arg group is a
+    pool); 'path{0...15}' patterns expand to drives.
+    """
+    from ..erasure.pools import ServerPools
+    from ..erasure.sets import ErasureSets
+    from ..storage.format_erasure import init_or_load_formats
+    from ..storage.offline import OfflineDisk
+    from ..utils import ellipses
+
+    # args with ellipses each form a pool; bare dirs combine into one pool
+    # (reference: each ellipses arg group is a serverPool)
+    pool_specs: list[list[str]] = []
+    bare: list[str] = []
+    for spec in drive_specs:
+        if ellipses.has_ellipses(spec):
+            pool_specs.append(ellipses.expand(spec))
+        else:
+            bare.append(spec)
+    if bare:
+        pool_specs.insert(0, bare)
+
+    pools = []
+    for pool_idx, paths in enumerate(pool_specs):
+        disks = [XLStorage(p) for p in paths]
+        size = ellipses.choose_set_size(len(disks), set_size)
+        dep_id, grouped = init_or_load_formats(disks, size)
+        grouped = [
+            [d if d is not None else OfflineDisk() for d in row] for row in grouped
+        ]
+        pools.append(ErasureSets(grouped, dep_id, pool_index=pool_idx))
+    return ServerPools(pools)
+
+
+def make_server(
+    drive_paths: list[str], region: str = "us-east-1", set_size: int = 0
+) -> S3Server:
+    return S3Server(make_object_layer(drive_paths, set_size), region)
 
 
 def main(argv: list[str] | None = None) -> None:
     import argparse
 
     ap = argparse.ArgumentParser(description="minio_tpu S3 server")
-    ap.add_argument("drives", nargs="+", help="drive directories")
+    ap.add_argument(
+        "drives", nargs="+",
+        help="drive dirs or ellipses patterns; each arg is one pool",
+    )
     ap.add_argument("--address", default="0.0.0.0:9000")
+    ap.add_argument("--set-size", type=int, default=0, help="drives per erasure set")
     args = ap.parse_args(argv)
     host, _, port = args.address.rpartition(":")
-    srv = make_server(args.drives)
+    srv = make_server(args.drives, set_size=args.set_size)
     web.run_app(srv.app, host=host or "0.0.0.0", port=int(port), print=None)
 
 

@@ -64,8 +64,10 @@ class BucketMetadataSys:
         try:
             _, it = self.store.get_object(SYSTEM_BUCKET, self._key(bucket))
             bm = BucketMetadata.from_json(bucket, b"".join(it))
-        except (ObjectNotFound, Exception):  # noqa: BLE001 — default config
-            bm = BucketMetadata(bucket)
+        except ObjectNotFound:
+            bm = BucketMetadata(bucket)  # never configured: defaults
+        # any OTHER failure (quorum loss, IO) must propagate — silently
+        # defaulting would run a versioned bucket unversioned
         with self._lock:
             self._cache[bucket] = bm
         return bm

@@ -188,6 +188,8 @@ class SigV4Verifier:
             signature = q["X-Amz-Signature"]
         except KeyError:
             raise s3err.MissingFields from None
+        except ValueError:
+            raise s3err.InvalidArgument from None
         if len(cred) < 5 or cred[-1] != "aws4_request":
             raise s3err.AuthorizationHeaderMalformed
         access_key = "/".join(cred[:-4])
@@ -195,7 +197,10 @@ class SigV4Verifier:
         secret = self.lookup_secret(access_key)
         if secret is None:
             raise s3err.InvalidAccessKeyId
-        t = datetime.strptime(amz_date, "%Y%m%dT%H%M%SZ").replace(tzinfo=timezone.utc)
+        try:
+            t = datetime.strptime(amz_date, "%Y%m%dT%H%M%SZ").replace(tzinfo=timezone.utc)
+        except ValueError:
+            raise s3err.AccessDenied from None
         if datetime.now(timezone.utc) > t + timedelta(seconds=expires):
             raise s3err.ExpiredPresignRequest
         payload_hash = q.get("X-Amz-Content-Sha256", UNSIGNED_PAYLOAD)

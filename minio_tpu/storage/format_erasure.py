@@ -1,0 +1,151 @@
+"""format.json — per-drive cluster identity and set layout.
+
+Mirrors the reference's formatErasureV3 (/root/reference/cmd/
+format-erasure.go:112): every drive stores the deployment id, the full
+set layout (drive UUIDs per set), its own UUID, and the distribution
+algorithm. At boot, formats are loaded from all drives, quorum-verified,
+and fresh drives are healed by writing them a format that fills a hole.
+"""
+
+from __future__ import annotations
+
+import json
+import uuid
+from dataclasses import dataclass, field
+
+from . import errors
+from .xlstorage import SYS_DIR, XLStorage
+
+FORMAT_FILE = "format.json"
+DISTRIBUTION_ALGO = "SIPMOD+PARITY"  # reference formatErasureVersionV3DistributionAlgoV3
+
+
+@dataclass
+class FormatErasure:
+    version: str = "1"
+    format: str = "xl"
+    id: str = ""  # deployment id
+    this: str = ""  # this drive's uuid
+    sets: list[list[str]] = field(default_factory=list)
+    distribution_algo: str = DISTRIBUTION_ALGO
+
+    def to_json(self) -> bytes:
+        return json.dumps(
+            {
+                "version": self.version,
+                "format": self.format,
+                "id": self.id,
+                "xl": {
+                    "version": "3",
+                    "this": self.this,
+                    "sets": self.sets,
+                    "distributionAlgo": self.distribution_algo,
+                },
+            }
+        ).encode()
+
+    @staticmethod
+    def from_json(buf: bytes) -> "FormatErasure":
+        d = json.loads(buf)
+        xl = d.get("xl", {})
+        return FormatErasure(
+            version=d.get("version", "1"),
+            format=d.get("format", "xl"),
+            id=d.get("id", ""),
+            this=xl.get("this", ""),
+            sets=xl.get("sets", []),
+            distribution_algo=xl.get("distributionAlgo", DISTRIBUTION_ALGO),
+        )
+
+    def drive_position(self) -> tuple[int, int]:
+        """(set_index, drive_index) of this drive in the layout."""
+        for si, s in enumerate(self.sets):
+            for di, u in enumerate(s):
+                if u == self.this:
+                    return si, di
+        raise errors.FileCorrupt(f"drive uuid {self.this} not in format layout")
+
+
+def read_format(disk: XLStorage) -> FormatErasure:
+    buf = disk.read_file(SYS_DIR, FORMAT_FILE)
+    return FormatErasure.from_json(buf)
+
+
+def write_format(disk: XLStorage, fmt: FormatErasure) -> None:
+    disk.create_file(SYS_DIR, FORMAT_FILE, fmt.to_json())
+
+
+def init_or_load_formats(
+    disks: list[XLStorage], set_drive_count: int
+) -> tuple[str, list[list[XLStorage]]]:
+    """Bootstrap: load formats where present, initialize fresh drives,
+    and return (deployment_id, drives grouped into sets, format-ordered).
+
+    First boot (no formats anywhere) writes a fresh layout. Mixed state
+    heals fresh drives into holes left by wiped ones, keyed by position.
+    """
+    if len(disks) % set_drive_count:
+        raise ValueError("drive count not divisible by set size")
+    n_sets = len(disks) // set_drive_count
+
+    formats: list[FormatErasure | None] = []
+    for disk in disks:
+        try:
+            formats.append(read_format(disk))
+        except (errors.FileNotFound, errors.VolumeNotFound, ValueError):
+            formats.append(None)
+
+    live = [f for f in formats if f is not None]
+    if not live:
+        # fresh cluster: mint everything
+        deployment_id = str(uuid.uuid4())
+        sets = [
+            [str(uuid.uuid4()) for _ in range(set_drive_count)]
+            for _ in range(n_sets)
+        ]
+        for i, disk in enumerate(disks):
+            fmt = FormatErasure(
+                id=deployment_id, this=sets[i // set_drive_count][i % set_drive_count],
+                sets=sets,
+            )
+            write_format(disk, fmt)
+        grouped = [
+            disks[s * set_drive_count : (s + 1) * set_drive_count]
+            for s in range(n_sets)
+        ]
+        for disk, f in zip(disks, (read_format(d) for d in disks)):
+            disk.disk_id = f.this
+        return deployment_id, grouped
+
+    # existing cluster: verify agreement, heal fresh drives into holes
+    ref = live[0]
+    for f in live[1:]:
+        if f.id != ref.id or f.sets != ref.sets:
+            raise errors.FileCorrupt("format.json mismatch across drives")
+    if len(ref.sets) != n_sets or any(len(s) != set_drive_count for s in ref.sets):
+        raise errors.FileCorrupt("format.json layout does not match endpoints")
+
+    # map uuid -> disk for present drives; fresh drives fill the holes in
+    # command-line order (the reference heals by endpoint position)
+    by_uuid: dict[str, XLStorage] = {}
+    for disk, f in zip(disks, formats):
+        if f is not None:
+            by_uuid[f.this] = disk
+            disk.disk_id = f.this
+    fresh = [disk for disk, f in zip(disks, formats) if f is None]
+    grouped: list[list[XLStorage]] = []
+    for s in ref.sets:
+        row: list[XLStorage] = []
+        for u in s:
+            if u in by_uuid:
+                row.append(by_uuid[u])
+            elif fresh:
+                disk = fresh.pop(0)
+                fmt = FormatErasure(id=ref.id, this=u, sets=ref.sets)
+                write_format(disk, fmt)
+                disk.disk_id = u
+                row.append(disk)
+            else:
+                row.append(None)  # type: ignore[arg-type] — offline drive
+        grouped.append(row)
+    return ref.id, grouped

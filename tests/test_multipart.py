@@ -121,3 +121,25 @@ def test_list_multipart_uploads(cli):
     r = cli.request("GET", "/mpb", query={"uploads": ""})
     assert r.status == 200
     assert uid.encode() in r.body and b"inflight/a" in r.body
+
+
+def test_upload_part_copy(cli):
+    src = os.urandom(100_000)
+    cli.put_object("mpb", "copy-src", src)
+    uid = _initiate(cli, "copy-dst")
+    r = cli.request(
+        "PUT", "/mpb/copy-dst", query={"partNumber": "1", "uploadId": uid},
+        headers={"x-amz-copy-source": "/mpb/copy-src"},
+    )
+    assert r.status == 200 and b"CopyPartResult" in r.body
+    e1 = r.body.split(b"<ETag>")[1].split(b"</ETag>")[0].decode().strip('"')
+    r = cli.request(
+        "PUT", "/mpb/copy-dst", query={"partNumber": "2", "uploadId": uid},
+        headers={"x-amz-copy-source": "/mpb/copy-src",
+                 "x-amz-copy-source-range": "bytes=0-9999"},
+    )
+    assert r.status == 200
+    e2 = r.body.split(b"<ETag>")[1].split(b"</ETag>")[0].decode().strip('"')
+    r = _complete(cli, "copy-dst", uid, [(1, e1), (2, e2)])
+    assert r.status == 200, r.body
+    assert cli.get_object("mpb", "copy-dst").body == src + src[:10000]
