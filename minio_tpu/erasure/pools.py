@@ -139,10 +139,6 @@ class ServerPools:
             bucket, obj, version_id
         )
 
-    def get_hashed_set(self, key: str):
-        # single-pool fast path used by the multipart router
-        return self._pool_with_most_free().get_hashed_set(key)
-
     def walk_objects(self, bucket: str, prefix: str = "") -> Iterator[str]:
         for p in self.pools:
             yield from p.walk_objects(bucket, prefix)

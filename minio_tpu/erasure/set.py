@@ -532,6 +532,11 @@ class ErasureSet:
 
     # -- misc --------------------------------------------------------------
 
+    def walk_objects(self, bucket: str, prefix: str = ""):
+        from . import listing
+
+        yield from listing._merged_keys(self, bucket, prefix)
+
     def _to_object_info(self, bucket: str, obj: str, fi: FileInfo) -> ObjectInfo:
         return ObjectInfo(
             bucket=bucket,

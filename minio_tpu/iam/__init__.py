@@ -1,0 +1,1 @@
+"""IAM: identities, policy documents, STS temporary credentials."""

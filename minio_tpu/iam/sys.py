@@ -1,0 +1,349 @@
+"""IAMSys — users, groups, policies, service accounts, temp credentials.
+
+Mirrors the reference's IAM system (/root/reference/cmd/iam.go,
+cmd/iam-store.go): an in-memory cache over persistent records stored as
+objects under .minio.sys/config/iam/, with root credentials from the
+environment. Temp (STS) and service-account credentials carry a session
+token: an HMAC-signed claims blob keyed by the root secret (the reference
+uses JWT with the same trust root).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import secrets as pysecrets
+import threading
+import time
+from dataclasses import dataclass, field
+
+from .policy import CANNED_POLICIES, Policy
+
+IAM_PREFIX = "config/iam"
+SYSTEM_BUCKET = ".minio.sys"
+
+
+class IAMError(Exception):
+    pass
+
+
+class NoSuchUser(IAMError):
+    pass
+
+
+class NoSuchPolicy(IAMError):
+    pass
+
+
+class NoSuchGroup(IAMError):
+    pass
+
+
+@dataclass
+class UserIdentity:
+    access_key: str
+    secret_key: str
+    status: str = "enabled"  # enabled | disabled
+    policies: list[str] = field(default_factory=list)
+    groups: list[str] = field(default_factory=list)
+    # service accounts / temp creds
+    parent: str = ""
+    session_policy: dict | None = None
+    expiration: float = 0.0  # unix secs; 0 = none
+    is_service_account: bool = False
+    is_temp: bool = False
+
+    def to_dict(self) -> dict:
+        return dict(self.__dict__)
+
+    @staticmethod
+    def from_dict(d: dict) -> "UserIdentity":
+        u = UserIdentity(d["access_key"], d["secret_key"])
+        u.__dict__.update(d)
+        return u
+
+
+class IAMSys:
+    def __init__(self, store, root_user: str, root_password: str):
+        self.store = store
+        self.root_user = root_user
+        self.root_password = root_password
+        self._lock = threading.RLock()
+        self.users: dict[str, UserIdentity] = {}
+        self.groups: dict[str, dict] = {}  # name -> {"members": [...], "policies": [...], "status": ...}
+        self.policies: dict[str, Policy] = dict(CANNED_POLICIES)
+        self._loaded = False
+
+    # -- persistence -------------------------------------------------------
+
+    def _save(self, name: str, payload: dict) -> None:
+        self.store.put_object(
+            SYSTEM_BUCKET, f"{IAM_PREFIX}/{name}.json", json.dumps(payload).encode()
+        )
+
+    def _load_doc(self, name: str) -> dict:
+        from ..erasure.quorum import BucketNotFound, ObjectNotFound, VersionNotFound
+
+        try:
+            _, it = self.store.get_object(SYSTEM_BUCKET, f"{IAM_PREFIX}/{name}.json")
+            return json.loads(b"".join(it))
+        except (ObjectNotFound, VersionNotFound, BucketNotFound):
+            return {}  # never configured — any OTHER error propagates
+
+    def load(self) -> None:
+        with self._lock:
+            users = self._load_doc("users")
+            self.users = {k: UserIdentity.from_dict(v) for k, v in users.items()}
+            self.groups = self._load_doc("groups")
+            pol = self._load_doc("policies")
+            self.policies = dict(CANNED_POLICIES)
+            for k, v in pol.items():
+                self.policies[k] = Policy.from_dict(v)
+            self._loaded = True
+
+    def _persist_users(self) -> None:
+        self._save("users", {k: u.to_dict() for k, u in self.users.items()})
+
+    def _persist_groups(self) -> None:
+        self._save("groups", self.groups)
+
+    def _persist_policies(self) -> None:
+        self._save(
+            "policies",
+            {
+                k: p.to_dict()
+                for k, p in self.policies.items()
+                if k not in CANNED_POLICIES
+            },
+        )
+
+    # -- users -------------------------------------------------------------
+
+    def add_user(self, access_key: str, secret_key: str, status: str = "enabled") -> None:
+        with self._lock:
+            u = self.users.get(access_key)
+            if u is None:
+                u = UserIdentity(access_key, secret_key, status)
+            else:
+                u.secret_key, u.status = secret_key, status
+            self.users[access_key] = u
+            self._persist_users()
+
+    def remove_user(self, access_key: str) -> None:
+        with self._lock:
+            if access_key not in self.users:
+                raise NoSuchUser(access_key)
+            del self.users[access_key]
+            # drop dependents (service accounts / temp creds of this user)
+            for k in [k for k, u in self.users.items() if u.parent == access_key]:
+                del self.users[k]
+            self._persist_users()
+
+    def set_user_status(self, access_key: str, status: str) -> None:
+        with self._lock:
+            u = self.users.get(access_key)
+            if u is None:
+                raise NoSuchUser(access_key)
+            u.status = status
+            self._persist_users()
+
+    def list_users(self) -> dict[str, UserIdentity]:
+        with self._lock:
+            return {
+                k: u for k, u in self.users.items()
+                if not u.is_service_account and not u.is_temp
+            }
+
+    # -- groups ------------------------------------------------------------
+
+    def update_group_members(self, group: str, members: list[str], remove: bool = False) -> None:
+        with self._lock:
+            g = self.groups.setdefault(
+                group, {"members": [], "policies": [], "status": "enabled"}
+            )
+            if remove:
+                g["members"] = [m for m in g["members"] if m not in members]
+                if not members:  # empty remove request deletes the group
+                    del self.groups[group]
+            else:
+                g["members"] = sorted(set(g["members"]) | set(members))
+            self._persist_groups()
+
+    def list_groups(self) -> list[str]:
+        with self._lock:
+            return sorted(self.groups)
+
+    # -- policies ----------------------------------------------------------
+
+    def set_policy(self, name: str, policy: Policy) -> None:
+        with self._lock:
+            self.policies[name] = policy
+            self._persist_policies()
+
+    def delete_policy(self, name: str) -> None:
+        with self._lock:
+            if name not in self.policies or name in CANNED_POLICIES:
+                raise NoSuchPolicy(name)
+            del self.policies[name]
+            self._persist_policies()
+
+    def attach_policy(self, names: list[str], user: str = "", group: str = "") -> None:
+        with self._lock:
+            for n in names:
+                if n not in self.policies:
+                    raise NoSuchPolicy(n)
+            if user:
+                u = self.users.get(user)
+                if u is None:
+                    raise NoSuchUser(user)
+                u.policies = names
+                self._persist_users()
+            elif group:
+                g = self.groups.setdefault(
+                    group, {"members": [], "policies": [], "status": "enabled"}
+                )
+                g["policies"] = names
+                self._persist_groups()
+
+    # -- service accounts / temp creds --------------------------------------
+
+    def _sign_token(self, claims: dict) -> str:
+        body = base64.urlsafe_b64encode(json.dumps(claims).encode()).decode()
+        sig = hmac.new(
+            self.root_password.encode(), body.encode(), hashlib.sha256
+        ).hexdigest()
+        return f"{body}.{sig}"
+
+    def verify_token(self, token: str) -> dict | None:
+        try:
+            body, sig = token.rsplit(".", 1)
+            want = hmac.new(
+                self.root_password.encode(), body.encode(), hashlib.sha256
+            ).hexdigest()
+            if not hmac.compare_digest(want, sig):
+                return None
+            return json.loads(base64.urlsafe_b64decode(body))
+        except Exception:  # noqa: BLE001
+            return None
+
+    def new_service_account(
+        self, parent: str, policy: dict | None = None,
+        access_key: str = "", secret_key: str = "",
+    ) -> UserIdentity:
+        with self._lock:
+            ak = access_key or ("SA" + pysecrets.token_hex(8).upper())
+            sk = secret_key or pysecrets.token_urlsafe(24)
+            u = UserIdentity(
+                ak, sk, parent=parent, session_policy=policy,
+                is_service_account=True,
+            )
+            self.users[ak] = u
+            self._persist_users()
+            return u
+
+    def assume_role(
+        self, parent: str, duration_secs: int = 3600, policy: dict | None = None
+    ) -> tuple[UserIdentity, str]:
+        """STS AssumeRole: mint temp credentials under the caller's identity
+        (/root/reference/cmd/sts-handlers.go AssumeRole)."""
+        with self._lock:
+            ak = "STS" + pysecrets.token_hex(8).upper()
+            sk = pysecrets.token_urlsafe(24)
+            exp = time.time() + max(900, min(duration_secs, 7 * 24 * 3600))
+            u = UserIdentity(
+                ak, sk, parent=parent, session_policy=policy,
+                expiration=exp, is_temp=True,
+            )
+            token = self._sign_token(
+                {"accessKey": ak, "parent": parent, "exp": exp}
+            )
+            self.users[ak] = u
+            self._persist_users()
+            return u, token
+
+    # -- auth --------------------------------------------------------------
+
+    def lookup_secret(self, access_key: str) -> str | None:
+        """Credential lookup for SigV4 verification."""
+        if access_key == self.root_user:
+            return self.root_password
+        with self._lock:
+            u = self.users.get(access_key)
+        if u is None or u.status != "enabled":
+            return None
+        if u.expiration and time.time() > u.expiration:
+            return None
+        return u.secret_key
+
+    def is_owner(self, access_key: str) -> bool:
+        if access_key == self.root_user:
+            return True
+        with self._lock:
+            u = self.users.get(access_key)
+        # service accounts / temp creds of root inherit ownership
+        return bool(u and u.parent == self.root_user and u.session_policy is None)
+
+    def _policies_for(self, access_key: str) -> tuple[list[Policy], dict | None]:
+        """(identity policies, optional session policy restriction)."""
+        with self._lock:
+            u = self.users.get(access_key)
+            if u is None:
+                return [], None
+            names: list[str] = []
+            session = None
+            target = u
+            if u.parent:
+                session = u.session_policy
+                parent = self.users.get(u.parent)
+                if u.parent == self.root_user:
+                    return [CANNED_POLICIES["consoleAdmin"]], session
+                if parent is None:
+                    return [], session
+                target = parent
+            names.extend(target.policies)
+            for gname in target.groups:
+                g = self.groups.get(gname)
+                if g and g.get("status") != "disabled":
+                    names.extend(g.get("policies", []))
+            for gname, g in self.groups.items():
+                if target.access_key in g.get("members", []) and g.get("status") != "disabled":
+                    names.extend(g.get("policies", []))
+            pols = [self.policies[n] for n in dict.fromkeys(names) if n in self.policies]
+            return pols, session
+
+    def is_allowed(
+        self,
+        access_key: str,
+        action: str,
+        resource: str,
+        conditions: dict[str, str] | None = None,
+        bucket_policy: Policy | None = None,
+    ) -> bool:
+        """Full authorization decision for one request."""
+        if self.is_owner(access_key):
+            return True
+        pols, session = self._policies_for(access_key)
+        # explicit deny anywhere wins; session policy (if any) must ALSO allow
+        allowed = False
+        for p in pols:
+            v = p.is_allowed(action, resource, access_key, conditions)
+            if v is False:
+                return False
+            if v is True:
+                allowed = True
+        if bucket_policy is not None:
+            v = bucket_policy.is_allowed(
+                action, resource, access_key, conditions, require_principal=True
+            )
+            if v is False:
+                return False
+            if v is True:
+                allowed = True
+        if allowed and session is not None:
+            v = Policy.from_dict(session).is_allowed(
+                action, resource, access_key, conditions
+            )
+            return v is True
+        return allowed

@@ -1,0 +1,217 @@
+"""Admin API — the `mc admin` surface subset.
+
+Mirrors the reference's admin router (/root/reference/cmd/admin-router.go,
+admin-handlers*.go) under /minio/admin/v3/: user/group/policy management,
+service accounts, server info, storage info, heal triggering. Bodies are
+plain JSON (the reference's madmin client encrypts bodies with the admin
+credential; our wire format is documented JSON with the same semantics).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+from aiohttp import web
+
+from ..iam.policy import Policy
+from ..iam.sys import NoSuchPolicy, NoSuchUser
+from . import s3err
+
+
+def _json(data, status=200) -> web.Response:
+    return web.Response(
+        status=status, body=json.dumps(data).encode(), content_type="application/json"
+    )
+
+
+async def handle_admin(server, request: web.Request, access_key: str, subpath: str, body: bytes):
+    """Dispatch /minio/admin/v3/<op> requests."""
+    op = subpath.split("?")[0]
+    q = request.rel_url.query
+    m = request.method
+    iam = server.iam
+
+    def authz(action: str):
+        if not iam.is_allowed(access_key, action, ""):
+            raise s3err.AccessDenied
+
+    # -- users ------------------------------------------------------------
+    if op == "add-user" and m == "PUT":
+        authz("admin:CreateUser")
+        try:
+            d = json.loads(body)
+            ak = q.get("accessKey", "")
+            if not ak or not d.get("secretKey"):
+                raise s3err.InvalidArgument
+        except ValueError:
+            raise s3err.InvalidArgument from None
+        await server._run(iam.add_user, ak, d["secretKey"], d.get("status", "enabled"))
+        return web.Response(status=200)
+    if op == "remove-user" and m == "DELETE":
+        authz("admin:DeleteUser")
+        try:
+            await server._run(iam.remove_user, q.get("accessKey", ""))
+        except NoSuchUser:
+            return _json({"error": "user not found"}, 404)
+        return web.Response(status=200)
+    if op == "list-users" and m == "GET":
+        authz("admin:ListUsers")
+        users = await server._run(iam.list_users)
+        return _json(
+            {
+                k: {"status": u.status, "policyName": ",".join(u.policies), "memberOf": u.groups}
+                for k, u in users.items()
+            }
+        )
+    if op == "set-user-status" and m == "PUT":
+        authz("admin:EnableUser")
+        try:
+            await server._run(iam.set_user_status, q.get("accessKey", ""), q.get("status", "enabled"))
+        except NoSuchUser:
+            return _json({"error": "user not found"}, 404)
+        return web.Response(status=200)
+
+    # -- groups -----------------------------------------------------------
+    if op == "update-group-members" and m == "PUT":
+        authz("admin:AddUserToGroup")
+        try:
+            d = json.loads(body)
+        except ValueError:
+            raise s3err.InvalidArgument from None
+        await server._run(
+            iam.update_group_members,
+            d.get("group", ""),
+            d.get("members", []),
+            d.get("isRemove", False),
+        )
+        return web.Response(status=200)
+    if op == "groups" and m == "GET":
+        authz("admin:ListGroups")
+        return _json(await server._run(iam.list_groups))
+    if op == "group" and m == "GET":
+        authz("admin:GetGroup")
+        g = iam.groups.get(q.get("group", ""))
+        if g is None:
+            return _json({"error": "group not found"}, 404)
+        return _json({"name": q.get("group"), **g})
+
+    # -- policies ---------------------------------------------------------
+    if op == "add-canned-policy" and m == "PUT":
+        authz("admin:CreatePolicy")
+        try:
+            pol = Policy.from_json(body)
+        except (ValueError, KeyError):
+            raise s3err.InvalidArgument from None
+        await server._run(iam.set_policy, q.get("name", ""), pol)
+        return web.Response(status=200)
+    if op == "remove-canned-policy" and m == "DELETE":
+        authz("admin:DeletePolicy")
+        try:
+            await server._run(iam.delete_policy, q.get("name", ""))
+        except NoSuchPolicy:
+            return _json({"error": "policy not found"}, 404)
+        return web.Response(status=200)
+    if op == "list-canned-policies" and m == "GET":
+        authz("admin:ListUserPolicies")
+        return _json({k: p.to_dict() for k, p in iam.policies.items()})
+    if op == "info-canned-policy" and m == "GET":
+        authz("admin:GetPolicy")
+        p = iam.policies.get(q.get("name", ""))
+        if p is None:
+            return _json({"error": "policy not found"}, 404)
+        return _json(p.to_dict())
+    if op == "set-user-or-group-policy" and m == "PUT":
+        authz("admin:AttachUserOrGroupPolicy")
+        names = [n for n in q.get("policyName", "").split(",") if n]
+        try:
+            if q.get("isGroup") == "true":
+                await server._run(iam.attach_policy, names, "", q.get("userOrGroup", ""))
+            else:
+                await server._run(iam.attach_policy, names, q.get("userOrGroup", ""), "")
+        except (NoSuchUser, NoSuchPolicy) as e:
+            return _json({"error": str(e)}, 404)
+        return web.Response(status=200)
+
+    # -- service accounts -------------------------------------------------
+    if op == "add-service-account" and m == "PUT":
+        authz("admin:CreateServiceAccount")
+        try:
+            d = json.loads(body) if body else {}
+        except ValueError:
+            raise s3err.InvalidArgument from None
+        parent = d.get("targetUser") or access_key
+        u = await server._run(
+            iam.new_service_account,
+            parent,
+            d.get("policy"),
+            d.get("accessKey", ""),
+            d.get("secretKey", ""),
+        )
+        return _json(
+            {"credentials": {"accessKey": u.access_key, "secretKey": u.secret_key}}
+        )
+
+    # -- info / heal ------------------------------------------------------
+    if op == "info" and m == "GET":
+        authz("admin:ServerInfo")
+        return _json(await server._run(server.server_info))
+    if op == "storageinfo" and m == "GET":
+        authz("admin:StorageInfo")
+        return _json(await server._run(server.storage_info))
+    if op.startswith("heal/") or op == "heal":
+        authz("admin:Heal")
+        parts = op.split("/", 2)
+        bucket = parts[1] if len(parts) > 1 else ""
+        prefix = parts[2] if len(parts) > 2 else ""
+        result = await server._run(server.heal_sweep, bucket, prefix)
+        return _json(result)
+
+    raise s3err.NotImplemented_
+
+
+def server_info_payload(server) -> dict:
+    pools = getattr(server.store, "pools", [server.store])
+    info = {
+        "mode": "online",
+        "deploymentID": getattr(pools[0], "deployment_id", ""),
+        "region": server.region,
+        "pools": [],
+        "uptime": int(time.time() - server.started_at),
+        "version": "minio-tpu/0.1.0",
+        "backendType": "Erasure",
+    }
+    for p in pools:
+        sets = getattr(p, "sets", [p])
+        info["pools"].append(
+            {
+                "sets": [
+                    {
+                        "id": s.set_index,
+                        "drives": [d.endpoint for d in s.disks],
+                        "parity": s.default_parity,
+                    }
+                    for s in sets
+                ]
+            }
+        )
+    return info
+
+
+def storage_info_payload(server) -> dict:
+    out = {"disks": []}
+    for d in server.store.disks:
+        try:
+            di = d.disk_info()
+            out["disks"].append(
+                {
+                    "endpoint": di.endpoint,
+                    "total": di.total,
+                    "free": di.free,
+                    "used": di.used,
+                    "state": "ok" if not di.error else di.error,
+                }
+            )
+        except Exception as e:  # noqa: BLE001
+            out["disks"].append({"endpoint": d.endpoint, "state": str(e)})
+    return out

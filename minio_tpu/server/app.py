@@ -29,6 +29,68 @@ from .buckets import BucketMetadataSys
 
 BUCKET_NAME_RE = re.compile(r"^[a-z0-9][a-z0-9.\-]{1,61}[a-z0-9]$")
 
+# bucket subresource -> (GET action, PUT action)
+_SUBRESOURCE_ACTIONS = {
+    "policy": ("s3:GetBucketPolicy", "s3:PutBucketPolicy"),
+    "lifecycle": ("s3:GetLifecycleConfiguration", "s3:PutLifecycleConfiguration"),
+    "tagging": ("s3:GetBucketTagging", "s3:PutBucketTagging"),
+    "notification": ("s3:GetBucketNotification", "s3:PutBucketNotification"),
+    "encryption": ("s3:GetEncryptionConfiguration", "s3:PutEncryptionConfiguration"),
+    "object-lock": (
+        "s3:GetBucketObjectLockConfiguration",
+        "s3:PutBucketObjectLockConfiguration",
+    ),
+    "cors": ("s3:GetBucketCORS", "s3:PutBucketCORS"),
+    "replication": ("s3:GetReplicationConfiguration", "s3:PutReplicationConfiguration"),
+    "versioning": ("s3:GetBucketVersioning", "s3:PutBucketVersioning"),
+}
+
+
+def _route_action(m: str, bucket: str, key: str, q, headers) -> tuple[str, str, str]:
+    """(action, bucket, key) for authorization — the request->policy-action
+    mapping the reference does per-handler via checkRequestAuthType."""
+    if key:
+        if m in ("GET", "HEAD"):
+            if "uploadId" in q:
+                return "s3:ListMultipartUploadParts", bucket, key
+            if "versionId" in q:
+                return "s3:GetObjectVersion", bucket, key
+            return "s3:GetObject", bucket, key
+        if m == "PUT":
+            return "s3:PutObject", bucket, key
+        if m == "DELETE":
+            if "uploadId" in q:
+                return "s3:AbortMultipartUpload", bucket, key
+            if "versionId" in q:
+                return "s3:DeleteObjectVersion", bucket, key
+            return "s3:DeleteObject", bucket, key
+        if m == "POST":
+            return "s3:PutObject", bucket, key
+        return "s3:*", bucket, key
+    # bucket level
+    for sub, (get_a, put_a) in _SUBRESOURCE_ACTIONS.items():
+        if sub in q:
+            if m in ("GET", "HEAD"):
+                return get_a, bucket, ""
+            return put_a, bucket, ""
+    if m == "PUT":
+        return "s3:CreateBucket", bucket, ""
+    if m == "DELETE":
+        return "s3:DeleteBucket", bucket, ""
+    if m == "POST":
+        return "s3:DeleteObject", bucket, ""  # multi-delete
+    if "versions" in q:
+        return "s3:ListBucketVersions", bucket, ""
+    if "location" in q:
+        return "s3:GetBucketLocation", bucket, ""
+    if "uploads" in q:
+        return "s3:ListBucketMultipartUploads", bucket, ""
+    return "s3:ListBucket", bucket, ""
+
+
+def _route_conditions(q) -> dict[str, str]:
+    return {"s3:prefix": q.get("prefix", ""), "s3:delimiter": q.get("delimiter", "")}
+
 
 def _iso8601(ns: int) -> str:
     return datetime.fromtimestamp(ns / 1e9, tz=timezone.utc).strftime(
@@ -44,16 +106,24 @@ def _http_date(ns: int) -> str:
 
 class S3Server:
     def __init__(self, store: ErasureSet, region: str = "us-east-1"):
+        import time as _time
+
         from ..erasure.multipart import MultipartRouter
+        from ..iam.sys import IAMSys
 
         self.store = store
         self.region = region
         self.buckets = BucketMetadataSys(store)
         self.mp = MultipartRouter(store)
+        self.started_at = _time.time()
         root_user = os.environ.get("MINIO_ROOT_USER", "minioadmin")
         root_pass = os.environ.get("MINIO_ROOT_PASSWORD", "minioadmin")
-        self._credentials = {root_user: root_pass}
-        self.verifier = signature.SigV4Verifier(self._credentials.get, region)
+        self.iam = IAMSys(store, root_user, root_pass)
+        # a real load error must abort boot: running with silently-empty IAM
+        # would wipe stored identities on the next persist (first boot is
+        # fine — missing documents load as empty)
+        self.iam.load()
+        self.verifier = signature.SigV4Verifier(self.iam.lookup_secret, region)
         self.app = web.Application(client_max_size=1 << 30)
         self.app.router.add_route("*", "/", self._entry)
         self.app.router.add_route("*", "/{bucket}", self._entry)
@@ -106,10 +176,12 @@ class S3Server:
         body = await request.read() if request.body_exists else b""
 
         if "X-Amz-Signature" in dict(query):
-            ak = self.verifier.verify_presigned("GET" if request.method == "GET" else request.method, raw_path, query, headers)
+            ak = self.verifier.verify_presigned(request.method, raw_path, query, headers)
+            self._check_session_token(ak, headers, dict(query))
             return ak, body
         if "authorization" not in headers:
-            raise s3err.AccessDenied
+            # anonymous: only bucket policies can authorize it downstream
+            return "", body
 
         content_sha = headers.get("x-amz-content-sha256", signature.UNSIGNED_PAYLOAD)
         ak = self.verifier.verify_header_auth(
@@ -127,28 +199,78 @@ class S3Server:
                 auth.signature,
                 headers.get("x-amz-date", ""),
                 auth.scope,
-                self._credentials.get(ak, ""),
+                self.iam.lookup_secret(ak) or "",
             )
         elif content_sha not in (signature.UNSIGNED_PAYLOAD,):
             if hashlib.sha256(body).hexdigest() != content_sha:
                 raise s3err.XAmzContentSHA256Mismatch
+        self._check_session_token(ak, headers, {})
         return ak, body
+
+    def _check_session_token(self, access_key: str, headers, query) -> None:
+        """Temp (STS) credentials must present a valid session token whose
+        claims match the signing key (reference: checkClaimsFromToken)."""
+        u = self.iam.users.get(access_key)
+        if u is None or not u.is_temp:
+            return
+        token = headers.get("x-amz-security-token", "") or query.get(
+            "X-Amz-Security-Token", ""
+        )
+        claims = self.iam.verify_token(token) if token else None
+        if not claims or claims.get("accessKey") != access_key:
+            raise s3err.AccessDenied
 
     # -- dispatch ------------------------------------------------------------
 
+    def _authorize(
+        self, access_key: str, action: str, bucket: str, key: str = "",
+        conditions: dict[str, str] | None = None,
+    ) -> None:
+        resource = f"{bucket}/{key}" if key else bucket
+        bucket_policy = None
+        if bucket:
+            raw = self.buckets.get(bucket).policy
+            if raw:
+                from ..iam.policy import Policy
+
+                bucket_policy = Policy.from_dict(raw)
+        if not self.iam.is_allowed(
+            access_key, action, resource, conditions, bucket_policy
+        ):
+            raise s3err.AccessDenied
+
     async def _dispatch(self, request: web.Request) -> web.StreamResponse:
-        _, body = await self._authenticate(request)
+        ak, body = await self._authenticate(request)
+        request["access_key"] = ak
         bucket = request.match_info.get("bucket", "")
         key = urllib.parse.unquote(request.match_info.get("key", ""))
         q = request.rel_url.query
         m = request.method
 
+        # admin + STS planes
+        if bucket == "minio" and key.startswith("admin/"):
+            from .admin import handle_admin
+
+            if not ak:
+                raise s3err.AccessDenied
+            sub = key[len("admin/") :]
+            sub = sub.split("/", 1)[1] if "/" in sub else ""  # strip version
+            return await handle_admin(self, request, ak, sub, body)
+        if not bucket and m == "POST":
+            from .sts import handle_sts
+
+            return await handle_sts(self, request, ak, body)
+
         if not bucket:
             if m == "GET":
+                self._authorize(ak, "s3:ListAllMyBuckets", "")
                 return await self.list_buckets(request)
             raise s3err.MethodNotAllowed
         if bucket.startswith(".minio.sys"):
             raise s3err.AccessDenied
+
+        self._authorize(ak, *_route_action(m, bucket, key, q, request.headers),
+                        conditions=_route_conditions(q))
 
         if not key:
             if m == "PUT":
@@ -536,7 +658,10 @@ class S3Server:
             headers["x-amz-version-id"] = oi.version_id
         return web.Response(status=200, headers=headers)
 
-    async def copy_object(self, request, bucket: str, key: str) -> web.Response:
+    def _parse_copy_source(self, request, access_key: str) -> tuple[str, str, str]:
+        """Parse x-amz-copy-source and AUTHORIZE the read on it — the
+        destination PutObject grant must not leak other buckets (or IAM
+        records under .minio.sys) through the copy path."""
         src = urllib.parse.unquote(request.headers["x-amz-copy-source"])
         if src.startswith("/"):
             src = src[1:]
@@ -546,7 +671,17 @@ class S3Server:
         if "/" not in src:
             raise s3err.InvalidArgument
         src_bucket, src_key = src.split("/", 1)
+        if src_bucket.startswith(".minio.sys") or not src_key:
+            raise s3err.AccessDenied
         src_key = listing.encode_dir_object(src_key)
+        action = "s3:GetObjectVersion" if src_vid else "s3:GetObject"
+        self._authorize(access_key, action, src_bucket, src_key)
+        return src_bucket, src_key, src_vid
+
+    async def copy_object(self, request, bucket: str, key: str) -> web.Response:
+        src_bucket, src_key, src_vid = self._parse_copy_source(
+            request, request.get("access_key", "")
+        )
         oi, it = await self._run(
             self.store.get_object, src_bucket, src_key, src_vid
         )
@@ -778,16 +913,9 @@ class S3Server:
         except (KeyError, ValueError):
             raise s3err.InvalidArgument from None
         upload_id = q.get("uploadId", "")
-        src = urllib.parse.unquote(request.headers["x-amz-copy-source"])
-        if src.startswith("/"):
-            src = src[1:]
-        src_vid = ""
-        if "?versionId=" in src:
-            src, src_vid = src.split("?versionId=", 1)
-        if "/" not in src:
-            raise s3err.InvalidArgument
-        src_bucket, src_key = src.split("/", 1)
-        src_key = listing.encode_dir_object(src_key)
+        src_bucket, src_key, src_vid = self._parse_copy_source(
+            request, request.get("access_key", "")
+        )
         oi, handle = await self._run(
             self.store.open_object, src_bucket, src_key, src_vid
         )
@@ -898,6 +1026,34 @@ class S3Server:
             f"<IsTruncated>false</IsTruncated>{items}</ListPartsResult>"
         )
         return web.Response(body=xml.encode(), content_type="application/xml")
+
+    # -- admin helpers ---------------------------------------------------------
+
+    def server_info(self) -> dict:
+        from .admin import server_info_payload
+
+        return server_info_payload(self)
+
+    def storage_info(self) -> dict:
+        from .admin import storage_info_payload
+
+        return storage_info_payload(self)
+
+    def heal_sweep(self, bucket: str = "", prefix: str = "") -> dict:
+        """Synchronous heal sweep over bucket/prefix (admin heal trigger;
+        the background scanner drives the same per-object heal)."""
+        healed, scanned, failed = [], 0, 0
+        buckets = [bucket] if bucket else [b.name for b in self.store.list_buckets()]
+        for b in buckets:
+            for raw in self.store.walk_objects(b, prefix):
+                scanned += 1
+                try:
+                    res = self.store.heal_object(b, raw)
+                    for ep in res.get("healed", []):
+                        healed.append(f"{b}/{raw}@{ep}")
+                except Exception:  # noqa: BLE001
+                    failed += 1
+        return {"scanned": scanned, "healed": healed, "failed": failed}
 
     async def list_multipart_uploads(self, request, bucket) -> web.Response:
         prefix = request.rel_url.query.get("prefix", "")

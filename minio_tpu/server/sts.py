@@ -1,0 +1,53 @@
+"""STS handlers: AssumeRole — temporary credentials over the S3 endpoint.
+
+Mirrors /root/reference/cmd/sts-handlers.go: POST / with form-encoded
+Action=AssumeRole issued by a SigV4-authenticated user mints expiring
+credentials + a signed session token carrying the parent identity.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.parse
+from datetime import datetime, timezone
+from xml.sax.saxutils import escape
+
+from aiohttp import web
+
+from . import s3err
+
+
+async def handle_sts(server, request: web.Request, access_key: str, body: bytes):
+    form = dict(urllib.parse.parse_qsl(body.decode("utf-8", "replace")))
+    action = form.get("Action", "")
+    if action != "AssumeRole":
+        raise s3err.NotImplemented_
+    if not access_key:
+        raise s3err.AccessDenied
+    try:
+        duration = int(form.get("DurationSeconds", "3600") or "3600")
+    except ValueError:
+        raise s3err.InvalidArgument from None
+    policy = None
+    if form.get("Policy"):
+        try:
+            policy = json.loads(form["Policy"])
+        except ValueError:
+            raise s3err.MalformedXML from None
+    user, token = await server._run(
+        server.iam.assume_role, access_key, duration, policy
+    )
+    exp = datetime.fromtimestamp(user.expiration, tz=timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%SZ"
+    )
+    xml = (
+        '<?xml version="1.0" encoding="UTF-8"?>'
+        '<AssumeRoleResponse xmlns="https://sts.amazonaws.com/doc/2011-06-15/">'
+        "<AssumeRoleResult><Credentials>"
+        f"<AccessKeyId>{escape(user.access_key)}</AccessKeyId>"
+        f"<SecretAccessKey>{escape(user.secret_key)}</SecretAccessKey>"
+        f"<SessionToken>{escape(token)}</SessionToken>"
+        f"<Expiration>{exp}</Expiration>"
+        "</Credentials></AssumeRoleResult></AssumeRoleResponse>"
+    )
+    return web.Response(body=xml.encode(), content_type="application/xml")

@@ -39,7 +39,11 @@ SET_SIZES = [16, 15, 14, 13, 12, 11, 10, 9, 8, 7, 6, 5, 4, 3, 2, 1]
 
 
 def possible_set_counts(count: int) -> list[int]:
-    return [s for s in SET_SIZES if count % s == 0]
+    # single-drive standalone is a special mode; otherwise sets are >= 2
+    # drives (the reference rejects layouts it can't stripe, setSizes {2..16})
+    if count == 1:
+        return [1]
+    return [s for s in SET_SIZES if s >= 2 and count % s == 0]
 
 
 def choose_set_size(drive_count: int, requested: int = 0) -> int:

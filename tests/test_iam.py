@@ -1,0 +1,240 @@
+"""IAM: policy evaluation, users/groups/service accounts, STS, and
+request authorization through the live server (reference surfaces:
+cmd/iam.go, cmd/sts-handlers.go, cmd/admin-handlers-users.go)."""
+
+import os
+
+os.environ.setdefault("MINIO_TPU_BACKEND", "numpy")
+
+import json
+
+import pytest
+
+from minio_tpu.client import S3Client
+from minio_tpu.iam.policy import CANNED_POLICIES, Policy
+from tests.test_s3_api import ServerThread
+
+
+# -- pure policy evaluation -------------------------------------------------
+
+def test_policy_wildcards_and_deny():
+    p = Policy.from_json(json.dumps({
+        "Version": "2012-10-17",
+        "Statement": [
+            {"Effect": "Allow", "Action": "s3:Get*", "Resource": "arn:aws:s3:::photos/*"},
+            {"Effect": "Deny", "Action": "s3:GetObject", "Resource": "arn:aws:s3:::photos/private/*"},
+        ],
+    }))
+    assert p.is_allowed("s3:GetObject", "photos/cat.jpg") is True
+    assert p.is_allowed("s3:GetObject", "photos/private/x") is False
+    assert p.is_allowed("s3:PutObject", "photos/cat.jpg") is None
+    assert p.is_allowed("s3:GetBucketLocation", "photos/anything") is True
+
+
+def test_policy_conditions():
+    p = Policy.from_json(json.dumps({
+        "Statement": [{
+            "Effect": "Allow", "Action": "s3:ListBucket",
+            "Resource": "arn:aws:s3:::b",
+            "Condition": {"StringLike": {"s3:prefix": ["public/*"]}},
+        }],
+    }))
+    assert p.is_allowed("s3:ListBucket", "b", conditions={"s3:prefix": "public/docs"}) is True
+    assert p.is_allowed("s3:ListBucket", "b", conditions={"s3:prefix": "secret"}) is None
+
+
+def test_canned_policies():
+    ro = CANNED_POLICIES["readonly"]
+    assert ro.is_allowed("s3:GetObject", "any/obj") is True
+    assert ro.is_allowed("s3:PutObject", "any/obj") is None
+    rw = CANNED_POLICIES["readwrite"]
+    assert rw.is_allowed("s3:DeleteObject", "b/k") is True
+
+
+# -- server-level ------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    base = tmp_path_factory.mktemp("iam-drives")
+    st = ServerThread([str(base / f"d{i}") for i in range(4)])
+    yield st
+    st.stop()
+
+
+@pytest.fixture(scope="module")
+def admin(server):
+    c = S3Client(f"127.0.0.1:{server.port}")
+    c.make_bucket("pub")
+    c.make_bucket("priv")
+    return c
+
+
+def test_admin_user_lifecycle_and_enforcement(admin, server):
+    # create a user with readonly policy
+    r = admin.request(
+        "PUT", "/minio/admin/v3/add-user", query={"accessKey": "alice"},
+        body=json.dumps({"secretKey": "alicesecret"}).encode(),
+    )
+    assert r.status == 200, r.body
+    r = admin.request(
+        "PUT", "/minio/admin/v3/set-user-or-group-policy",
+        query={"policyName": "readonly", "userOrGroup": "alice"},
+    )
+    assert r.status == 200, r.body
+    admin.put_object("pub", "doc.txt", b"readable")
+
+    alice = S3Client(f"127.0.0.1:{server.port}", "alice", "alicesecret")
+    assert alice.get_object("pub", "doc.txt").body == b"readable"
+    assert alice.put_object("pub", "nope", b"x").status == 403
+    assert alice.delete_object("pub", "doc.txt").status == 403
+    # list users
+    r = admin.request("GET", "/minio/admin/v3/list-users")
+    assert r.status == 200 and b"alice" in r.body
+    # disable
+    assert admin.request(
+        "PUT", "/minio/admin/v3/set-user-status",
+        query={"accessKey": "alice", "status": "disabled"},
+    ).status == 200
+    assert alice.get_object("pub", "doc.txt").status == 403
+    admin.request("PUT", "/minio/admin/v3/set-user-status",
+                  query={"accessKey": "alice", "status": "enabled"})
+
+
+def test_custom_policy_and_groups(admin, server):
+    pol = {
+        "Version": "2012-10-17",
+        "Statement": [
+            {"Effect": "Allow", "Action": ["s3:GetObject", "s3:PutObject", "s3:ListBucket"],
+             "Resource": ["arn:aws:s3:::pub", "arn:aws:s3:::pub/*"]},
+        ],
+    }
+    assert admin.request(
+        "PUT", "/minio/admin/v3/add-canned-policy", query={"name": "pub-rw"},
+        body=json.dumps(pol).encode(),
+    ).status == 200
+    admin.request(
+        "PUT", "/minio/admin/v3/add-user", query={"accessKey": "bob"},
+        body=json.dumps({"secretKey": "bobsecret0"}).encode(),
+    )
+    assert admin.request(
+        "PUT", "/minio/admin/v3/update-group-members",
+        body=json.dumps({"group": "writers", "members": ["bob"]}).encode(),
+    ).status == 200
+    assert admin.request(
+        "PUT", "/minio/admin/v3/set-user-or-group-policy",
+        query={"policyName": "pub-rw", "userOrGroup": "writers", "isGroup": "true"},
+    ).status == 200
+    bob = S3Client(f"127.0.0.1:{server.port}", "bob", "bobsecret0")
+    assert bob.put_object("pub", "from-bob", b"hi").status == 200
+    assert bob.get_object("pub", "from-bob").body == b"hi"
+    assert bob.put_object("priv", "x", b"no").status == 403
+
+
+def test_service_account(admin, server):
+    r = admin.request("PUT", "/minio/admin/v3/add-service-account", body=b"{}")
+    assert r.status == 200
+    creds = json.loads(r.body)["credentials"]
+    sa = S3Client(f"127.0.0.1:{server.port}", creds["accessKey"], creds["secretKey"])
+    # root's service account inherits full access
+    assert sa.make_bucket("sa-made").status == 200
+    assert sa.put_object("sa-made", "k", b"v").status == 200
+
+
+def test_sts_assume_role(admin, server):
+    import urllib.parse
+
+    body = urllib.parse.urlencode({
+        "Action": "AssumeRole", "Version": "2011-06-15", "DurationSeconds": "900",
+    }).encode()
+    r = admin.request("POST", "/", body=body)
+    assert r.status == 200, r.body
+    x = r.body.decode()
+    ak = x.split("<AccessKeyId>")[1].split("<")[0]
+    sk = x.split("<SecretAccessKey>")[1].split("<")[0]
+    token = x.split("<SessionToken>")[1].split("<")[0]
+    tmp = S3Client(f"127.0.0.1:{server.port}", ak, sk)
+    # without the session token the temp cred is refused
+    assert tmp.request("GET", "/").status == 403
+    r = tmp.request("GET", "/", headers={"x-amz-security-token": token})
+    assert r.status == 200
+
+
+def test_anonymous_with_bucket_policy(admin, server):
+    pol = {
+        "Version": "2012-10-17",
+        "Statement": [{
+            "Effect": "Allow", "Principal": "*",
+            "Action": ["s3:GetObject"], "Resource": ["arn:aws:s3:::pub/*"],
+        }],
+    }
+    assert admin.request(
+        "PUT", "/pub", query={"policy": ""}, body=json.dumps(pol).encode()
+    ).status == 204
+    admin.put_object("pub", "open.txt", b"public!")
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", server.port)
+    conn.request("GET", "/pub/open.txt")
+    resp = conn.getresponse()
+    assert resp.status == 200 and resp.read() == b"public!"
+    # anonymous writes still denied
+    conn = http.client.HTTPConnection("127.0.0.1", server.port)
+    conn.request("PUT", "/pub/evil", body=b"x")
+    assert conn.getresponse().status == 403
+
+
+def test_admin_requires_privileges(admin, server):
+    admin.request(
+        "PUT", "/minio/admin/v3/add-user", query={"accessKey": "weak"},
+        body=json.dumps({"secretKey": "weaksecret"}).encode(),
+    )
+    weak = S3Client(f"127.0.0.1:{server.port}", "weak", "weaksecret")
+    assert weak.request("GET", "/minio/admin/v3/list-users").status == 403
+    r = admin.request("GET", "/minio/admin/v3/info")
+    assert r.status == 200 and b"deploymentID" in r.body
+
+
+def test_copy_source_requires_read_access(admin, server):
+    # user with PutObject-only on pub must not exfiltrate via copy-source
+    pol = {"Version": "2012-10-17", "Statement": [
+        {"Effect": "Allow", "Action": ["s3:PutObject"],
+         "Resource": ["arn:aws:s3:::pub/*"]}]}
+    admin.request("PUT", "/minio/admin/v3/add-canned-policy",
+                  query={"name": "put-only"}, body=json.dumps(pol).encode())
+    admin.request("PUT", "/minio/admin/v3/add-user", query={"accessKey": "dave"},
+                  body=json.dumps({"secretKey": "davesecret"}).encode())
+    admin.request("PUT", "/minio/admin/v3/set-user-or-group-policy",
+                  query={"policyName": "put-only", "userOrGroup": "dave"})
+    admin.put_object("priv", "secret", b"hidden")
+    dave = S3Client(f"127.0.0.1:{server.port}", "dave", "davesecret")
+    r = dave.request("PUT", "/pub/stolen",
+                     headers={"x-amz-copy-source": "/priv/secret"})
+    assert r.status == 403, r.body
+    # .minio.sys can never be a copy source, even for root
+    r = admin.request("PUT", "/pub/iamdump",
+                      headers={"x-amz-copy-source": "/.minio.sys/config/iam/users.json"})
+    assert r.status == 403
+
+
+def test_bucket_policy_requires_principal(admin, server):
+    # identity-style policy (no Principal) uploaded as bucket policy must
+    # not open the bucket to anonymous callers
+    pol = {"Version": "2012-10-17", "Statement": [
+        {"Effect": "Allow", "Action": ["s3:GetObject"],
+         "Resource": ["arn:aws:s3:::priv/*"]}]}
+    admin.request("PUT", "/priv", query={"policy": ""}, body=json.dumps(pol).encode())
+    admin.put_object("priv", "p.txt", b"still-private")
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", server.port)
+    conn.request("GET", "/priv/p.txt")
+    assert conn.getresponse().status == 403
+
+
+def test_policy_bracket_literal():
+    p = Policy.from_json(json.dumps({
+        "Statement": [{"Effect": "Deny", "Action": "s3:GetObject",
+                       "Resource": "arn:aws:s3:::b/report[1].pdf"}],
+    }))
+    assert p.is_allowed("s3:GetObject", "b/report[1].pdf") is False
+    assert p.is_allowed("s3:GetObject", "b/report1.pdf") is None
