@@ -1,0 +1,1 @@
+"""Cluster substrate (L0): endpoints, storage RPC, distributed locks."""

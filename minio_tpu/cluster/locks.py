@@ -1,0 +1,233 @@
+"""Distributed namespace locks (dsync).
+
+Mirrors /root/reference/internal/dsync/drwmutex.go + cmd/local-locker.go:
+read/write locks on object names, acquired by broadcasting to all nodes'
+lockers and succeeding when a quorum grants (write: n/2+1, read: n/2);
+losers release whatever they got and retry. Each node serves its own
+in-memory lock table over HTTP (the reference runs a dedicated lock grid
+so locks never queue behind data traffic).
+"""
+
+from __future__ import annotations
+
+import http.client
+import random
+import threading
+import time
+import uuid as uuidlib
+
+import msgpack
+from aiohttp import web
+
+LOCK_PREFIX = "/minio/lock/v1"
+
+
+LOCK_TTL = 120.0  # seconds; a crashed holder's locks expire lazily
+# (the reference refreshes held locks and expires stale ones —
+# internal/dsync/drwmutex.go:340 refreshLock / cmd/local-locker.go expiry)
+
+
+class LocalLocker:
+    """In-memory lock table for one node (reference cmd/local-locker.go).
+
+    Entries carry expiry timestamps so a SIGKILLed holder can't wedge a
+    resource forever: expired writers/readers are purged on next access.
+    """
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        # resource -> {"writer": uid|None, "wexp": t, "readers": {uid: (count, exp)}}
+        self._locks: dict[str, dict] = {}
+
+    def _purge(self, e: dict) -> None:
+        now = time.monotonic()
+        if e["writer"] and e["wexp"] < now:
+            e["writer"] = None
+        e["readers"] = {
+            u: (c, exp) for u, (c, exp) in e["readers"].items() if exp >= now
+        }
+
+    def lock(self, resource: str, uid: str) -> bool:
+        with self._mu:
+            e = self._locks.setdefault(
+                resource, {"writer": None, "wexp": 0.0, "readers": {}}
+            )
+            self._purge(e)
+            if e["writer"] or e["readers"]:
+                return False
+            e["writer"] = uid
+            e["wexp"] = time.monotonic() + LOCK_TTL
+            return True
+
+    def unlock(self, resource: str, uid: str) -> bool:
+        with self._mu:
+            e = self._locks.get(resource)
+            if not e or e["writer"] != uid:
+                return False
+            del self._locks[resource]
+            return True
+
+    def rlock(self, resource: str, uid: str) -> bool:
+        with self._mu:
+            e = self._locks.setdefault(
+                resource, {"writer": None, "wexp": 0.0, "readers": {}}
+            )
+            self._purge(e)
+            if e["writer"]:
+                return False
+            c, _ = e["readers"].get(uid, (0, 0.0))
+            e["readers"][uid] = (c + 1, time.monotonic() + LOCK_TTL)
+            return True
+
+    def runlock(self, resource: str, uid: str) -> bool:
+        with self._mu:
+            e = self._locks.get(resource)
+            if not e or uid not in e["readers"]:
+                return False
+            c, exp = e["readers"][uid]
+            if c <= 1:
+                del e["readers"][uid]
+            else:
+                e["readers"][uid] = (c - 1, exp)
+            if not e["readers"] and not e["writer"]:
+                del self._locks[resource]
+            return True
+
+    def force_unlock(self, resource: str) -> bool:
+        with self._mu:
+            return self._locks.pop(resource, None) is not None
+
+    def stats(self) -> dict:
+        with self._mu:
+            return {
+                r: {"writer": bool(e["writer"]), "readers": len(e["readers"])}
+                for r, e in self._locks.items()
+            }
+
+
+class LockRESTServer:
+    def __init__(self, locker: LocalLocker, token: str):
+        self.locker = locker
+        self.token = token
+
+    def register(self, app: web.Application) -> None:
+        app.router.add_route("POST", LOCK_PREFIX + "/{op}", self.handle)
+
+    async def handle(self, request: web.Request) -> web.Response:
+        if request.headers.get("x-minio-token") != self.token:
+            return web.Response(status=403)
+        op = request.match_info["op"]
+        args = msgpack.unpackb(await request.read(), raw=False)
+        if op == "stats":
+            ok = self.locker.stats()
+        elif op == "force_unlock":
+            ok = self.locker.force_unlock(args["resource"])
+        elif op in ("lock", "unlock", "rlock", "runlock"):
+            ok = getattr(self.locker, op)(args["resource"], args.get("uid", ""))
+        else:
+            return web.Response(status=404)
+        return web.Response(body=msgpack.packb(ok))
+
+
+class _RemoteLocker:
+    def __init__(self, host: str, port: int, token: str):
+        self.host, self.port, self.token = host, port, token
+        self._local = threading.local()
+
+    def _call(self, op: str, resource: str, uid: str) -> bool:
+        conn = getattr(self._local, "conn", None)
+        try:
+            if conn is None:
+                conn = http.client.HTTPConnection(self.host, self.port, timeout=5)
+                self._local.conn = conn
+            conn.request(
+                "POST", f"{LOCK_PREFIX}/{op}",
+                body=msgpack.packb({"resource": resource, "uid": uid}),
+                headers={"x-minio-token": self.token},
+            )
+            resp = conn.getresponse()
+            data = resp.read()
+            if resp.status != 200:
+                return False
+            return bool(msgpack.unpackb(data, raw=False))
+        except (http.client.HTTPException, OSError):
+            self._local.conn = None
+            return False
+
+    def lock(self, r, u):
+        return self._call("lock", r, u)
+
+    def unlock(self, r, u):
+        return self._call("unlock", r, u)
+
+    def rlock(self, r, u):
+        return self._call("rlock", r, u)
+
+    def runlock(self, r, u):
+        return self._call("runlock", r, u)
+
+
+class DRWMutex:
+    """Distributed RW mutex over a set of lockers with quorum
+    (reference internal/dsync/drwmutex.go:113)."""
+
+    def __init__(self, lockers: list, resource: str):
+        self.lockers = lockers
+        self.resource = resource
+        self.uid = str(uuidlib.uuid4())
+
+    def _quorum(self, write: bool) -> int:
+        n = len(self.lockers)
+        q = n // 2 + 1 if write else n // 2
+        return max(q, 1)
+
+    def _acquire(self, write: bool, timeout: float) -> bool:
+        op_lock = "lock" if write else "rlock"
+        op_unlock = "unlock" if write else "runlock"
+        deadline = time.monotonic() + timeout
+        quorum = self._quorum(write)
+        backoff = 0.002
+        while True:
+            granted = []
+            for lk in self.lockers:
+                if getattr(lk, op_lock)(self.resource, self.uid):
+                    granted.append(lk)
+            if len(granted) >= quorum:
+                return True
+            for lk in granted:
+                getattr(lk, op_unlock)(self.resource, self.uid)
+            if time.monotonic() > deadline:
+                return False
+            # jitter breaks the lockstep livelock of two symmetric
+            # contenders (the reference randomizes dsync retry timing)
+            time.sleep(backoff * (0.5 + random.random()))
+            backoff = min(backoff * 2, 0.25)
+
+    def lock(self, timeout: float = 10.0) -> bool:
+        return self._acquire(True, timeout)
+
+    def rlock(self, timeout: float = 10.0) -> bool:
+        return self._acquire(False, timeout)
+
+    def unlock(self) -> None:
+        for lk in self.lockers:
+            lk.unlock(self.resource, self.uid)
+
+    def runlock(self) -> None:
+        for lk in self.lockers:
+            lk.runlock(self.resource, self.uid)
+
+
+class NamespaceLock:
+    """Per-object lock facade used by the object layer
+    (reference cmd/namespace-lock.go)."""
+
+    def __init__(self, lockers: list | None = None):
+        self.lockers = lockers or [LocalLocker()]
+
+    def new(self, bucket: str, obj: str) -> DRWMutex:
+        return DRWMutex(self.lockers, f"{bucket}/{obj}")
+
+
+class LockTimeout(Exception):
+    pass

@@ -215,6 +215,12 @@ class MultipartManager:
             for (n, _), pfi in zip(parts, part_fis)
         ]
 
+        # the final commit must exclude concurrent put/delete of the same
+        # object (same namespace write lock put_object takes)
+        mtx = self.es.ns.new(bucket, obj)
+        if not mtx.lock(30.0):
+            raise InvalidPart("namespace lock timeout during complete")
+
         def commit(i: int, disk) -> None:
             shard_idx = dist[i] - 1
             # move each part's shard file into the final object layout
@@ -231,20 +237,23 @@ class MultipartManager:
             dfi.erasure.index = shard_idx + 1
             disk.write_metadata(bucket, obj, dfi)
 
-        futs = [
-            self.es._pool.submit(commit, i, disk)
-            for i, disk in enumerate(self.es.disks)
-        ]
-        errs: list[Exception | None] = []
-        for f in futs:
-            try:
-                f.result()
-                errs.append(None)
-            except Exception as e:  # noqa: BLE001
-                errs.append(e)
-        d = self.es.n - parity
-        write_q = d + 1 if d == parity else d
-        reduce_quorum_errs(errs, write_q)
+        try:
+            futs = [
+                self.es._pool.submit(commit, i, disk)
+                for i, disk in enumerate(self.es.disks)
+            ]
+            errs: list[Exception | None] = []
+            for f in futs:
+                try:
+                    f.result()
+                    errs.append(None)
+                except Exception as e:  # noqa: BLE001
+                    errs.append(e)
+            d = self.es.n - parity
+            write_q = d + 1 if d == parity else d
+            reduce_quorum_errs(errs, write_q)
+        finally:
+            mtx.unlock()
         self._cleanup(bucket, obj, upload_id)
         oi = self.es._to_object_info(bucket, obj, fi)
         oi.parts = len(parts)

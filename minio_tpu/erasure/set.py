@@ -69,7 +69,10 @@ class ErasureSet:
         default_parity: int | None = None,
         set_index: int = 0,
         pool_index: int = 0,
+        ns_lock=None,
     ):
+        from ..cluster.locks import NamespaceLock
+
         if len(disks) < 1:
             raise ValueError("need at least one drive")
         self.disks = list(disks)
@@ -79,6 +82,7 @@ class ErasureSet:
         self.default_parity = (
             default_parity if default_parity is not None else default_parity_count(self.n)
         )
+        self.ns = ns_lock if ns_lock is not None else NamespaceLock()
         self._pool = ThreadPoolExecutor(max_workers=max(4, self.n))
         self._coders: dict[tuple[int, int], ErasureCoder] = {}
 
@@ -124,8 +128,10 @@ class ErasureSet:
         reduce_quorum_errs(errs, self.n // 2 + 1, ignored=(errors.VolumeNotFound,))
 
     def bucket_exists(self, bucket: str) -> bool:
+        # read-quorum semantics: half the drives answering is enough to
+        # know the bucket exists (writes still enforce write quorum)
         res = self._parallel(lambda d: d.stat_vol(bucket))
-        return count_none([e for _, e in res]) >= self.n // 2 + 1
+        return count_none([e for _, e in res]) >= max(self.n // 2, 1)
 
     def list_buckets(self) -> list[BucketInfo]:
         for disk, (vols, err) in zip(self.disks, self._parallel(lambda d: d.list_vols())):
@@ -175,6 +181,29 @@ class ErasureSet:
         and be rename-able files (never inline)."""
         if not self.bucket_exists(bucket) and not bucket.startswith(".minio.sys"):
             raise BucketNotFound(bucket)
+        mtx = self.ns.new(bucket, obj)
+        if not mtx.lock(30.0):
+            raise QuorumError(f"namespace write lock timeout on {bucket}/{obj}")
+        try:
+            return self._put_object_locked(
+                bucket, obj, data, user_defined, version_id, versioned,
+                parity, distribution, allow_inline,
+            )
+        finally:
+            mtx.unlock()
+
+    def _put_object_locked(
+        self,
+        bucket: str,
+        obj: str,
+        data: bytes,
+        user_defined: dict[str, str] | None,
+        version_id: str | None,
+        versioned: bool,
+        parity: int | None,
+        distribution: list[int] | None,
+        allow_inline: bool,
+    ) -> ObjectInfo:
         p = self.default_parity if parity is None else parity
         d = self.n - p
         write_q = d + 1 if d == p else d
@@ -256,13 +285,25 @@ class ErasureSet:
     def open_object(
         self, bucket: str, obj: str, version_id: str = ""
     ) -> tuple[ObjectInfo, "ObjectHandle"]:
-        """One quorum metadata read; the returned handle serves any number
-        of ranged reads without re-reading quorum metadata."""
-        fi, metas, _, _ = self._quorum_fileinfo(bucket, obj, version_id, read_data=True)
-        if fi.deleted:
-            raise ObjectNotFound(f"{bucket}/{obj}")
+        """One quorum metadata read under a namespace read lock; the handle
+        serves any number of ranged reads without re-reading metadata."""
+        mtx = self.ns.new(bucket, obj)
+        if not mtx.rlock(30.0):
+            raise QuorumError(f"namespace read lock timeout on {bucket}/{obj}")
+        try:
+            fi, metas, _, _ = self._quorum_fileinfo(
+                bucket, obj, version_id, read_data=True
+            )
+            if fi.deleted:
+                raise ObjectNotFound(f"{bucket}/{obj}")
+        except BaseException:
+            mtx.runlock()
+            raise
         oi = self._to_object_info(bucket, obj, fi)
-        return oi, ObjectHandle(self, bucket, obj, fi, metas)
+        # the read lock stays held while the handle streams (the reference
+        # holds GetObject's lock until the reader closes); the TTL backstops
+        # abandoned handles
+        return oi, ObjectHandle(self, bucket, obj, fi, metas, release=mtx.runlock)
 
     def get_object(
         self,
@@ -388,6 +429,17 @@ class ErasureSet:
         - version id given -> remove exactly that version
         - unversioned -> remove the null version entirely
         """
+        mtx = self.ns.new(bucket, obj)
+        if not mtx.lock(30.0):
+            raise QuorumError(f"namespace write lock timeout on {bucket}/{obj}")
+        try:
+            return self._delete_object_locked(bucket, obj, version_id, versioned)
+        finally:
+            mtx.unlock()
+
+    def _delete_object_locked(
+        self, bucket: str, obj: str, version_id: str, versioned: bool
+    ) -> ObjectInfo:
         write_q = self.n // 2 + 1
         if versioned and not version_id:
             fi = FileInfo(volume=bucket, name=obj)
@@ -430,7 +482,18 @@ class ErasureSet:
         quorum-pick the authoritative version, classify each drive as ok or
         stale (missing version, bad metadata, or failing bitrot verify),
         reconstruct stale shards from healthy ones, rename into place.
+        Holds the namespace write lock: healing must not interleave with a
+        concurrent overwrite of the same object.
         """
+        mtx = self.ns.new(bucket, obj)
+        if not mtx.lock(30.0):
+            raise QuorumError(f"namespace lock timeout healing {bucket}/{obj}")
+        try:
+            return self._heal_object_locked(bucket, obj, version_id)
+        finally:
+            mtx.unlock()
+
+    def _heal_object_locked(self, bucket: str, obj: str, version_id: str) -> dict:
         fi, metas, read_q, write_q = self._quorum_fileinfo(
             bucket, obj, version_id, read_data=True
         )
@@ -557,20 +620,38 @@ class ErasureSet:
 
 class ObjectHandle:
     """Resolved read handle: concrete set + quorum-picked version + per-drive
-    metadata. Constructing reads is free; all I/O happens during iteration."""
+    metadata, holding the namespace read lock until closed. Constructing
+    reads is free; all I/O happens during iteration; the lock releases when
+    the last read() iterator finishes (or close() is called)."""
 
-    def __init__(self, es: ErasureSet, bucket: str, obj: str, fi: FileInfo, metas):
+    def __init__(
+        self, es: ErasureSet, bucket: str, obj: str, fi: FileInfo, metas, release=None
+    ):
         self.es = es
         self.bucket = bucket
         self.obj = obj
         self.fi = fi
         self.metas = metas
+        self._release = release
+
+    def close(self) -> None:
+        rel, self._release = self._release, None
+        if rel is not None:
+            rel()
 
     def read(self, offset: int = 0, length: int = -1) -> Iterator[bytes]:
         if length < 0:
             length = self.fi.size - offset
         if offset < 0 or offset + length > self.fi.size:
+            self.close()
             raise ValueError("invalid range")
-        return self.es._read_range(
-            self.bucket, self.obj, self.fi, self.metas, offset, length
-        )
+
+        def gen():
+            try:
+                yield from self.es._read_range(
+                    self.bucket, self.obj, self.fi, self.metas, offset, length
+                )
+            finally:
+                self.close()
+
+        return gen()

@@ -27,11 +27,15 @@ class ErasureSets:
         deployment_id: str,
         default_parity: int | None = None,
         pool_index: int = 0,
+        ns_lock=None,
     ):
         self.deployment_id = deployment_id
         self._dep_id_bytes = _dep_bytes(deployment_id)
         self.sets = [
-            ErasureSet(disks, default_parity, set_index=i, pool_index=pool_index)
+            ErasureSet(
+                disks, default_parity, set_index=i, pool_index=pool_index,
+                ns_lock=ns_lock,
+            )
             for i, disks in enumerate(sets_disks)
         ]
         self.pool_index = pool_index

@@ -105,29 +105,37 @@ def _http_date(ns: int) -> str:
 
 
 class S3Server:
-    def __init__(self, store: ErasureSet, region: str = "us-east-1"):
+    def __init__(self, store=None, region: str = "us-east-1"):
         import time as _time
 
-        from ..erasure.multipart import MultipartRouter
-        from ..iam.sys import IAMSys
-
-        self.store = store
+        self.store = None
         self.region = region
-        self.buckets = BucketMetadataSys(store)
-        self.mp = MultipartRouter(store)
         self.started_at = _time.time()
-        root_user = os.environ.get("MINIO_ROOT_USER", "minioadmin")
-        root_pass = os.environ.get("MINIO_ROOT_PASSWORD", "minioadmin")
-        self.iam = IAMSys(store, root_user, root_pass)
-        # a real load error must abort boot: running with silently-empty IAM
-        # would wipe stored identities on the next persist (first boot is
-        # fine — missing documents load as empty)
-        self.iam.load()
-        self.verifier = signature.SigV4Verifier(self.iam.lookup_secret, region)
+        self.root_user = os.environ.get("MINIO_ROOT_USER", "minioadmin")
+        self.root_pass = os.environ.get("MINIO_ROOT_PASSWORD", "minioadmin")
         self.app = web.Application(client_max_size=1 << 30)
         self.app.router.add_route("*", "/", self._entry)
         self.app.router.add_route("*", "/{bucket}", self._entry)
         self.app.router.add_route("*", "/{bucket}/{key:.*}", self._entry)
+        if store is not None:
+            self.set_store(store)
+
+    def set_store(self, store) -> None:
+        """Attach the object layer once bootstrap completes; until then S3
+        requests answer 503 (the reference gates on newObjectLayer the
+        same way)."""
+        from ..erasure.multipart import MultipartRouter
+        from ..iam.sys import IAMSys
+
+        self.buckets = BucketMetadataSys(store)
+        self.mp = MultipartRouter(store)
+        self.iam = IAMSys(store, self.root_user, self.root_pass)
+        # a real load error must abort boot: running with silently-empty IAM
+        # would wipe stored identities on the next persist (first boot is
+        # fine — missing documents load as empty)
+        self.iam.load()
+        self.verifier = signature.SigV4Verifier(self.iam.lookup_secret, self.region)
+        self.store = store
 
     # -- plumbing ------------------------------------------------------------
 
@@ -145,6 +153,11 @@ class S3Server:
 
     async def _entry(self, request: web.Request) -> web.StreamResponse:
         try:
+            if self.store is None:
+                return web.Response(
+                    status=503, headers={"Retry-After": "1"},
+                    body=b"server initializing",
+                )
             return await self._dispatch(request)
         except s3err.APIError as e:
             return self._err_response(request, e)
@@ -764,11 +777,14 @@ class S3Server:
         loop = asyncio.get_running_loop()
         sentinel = object()
         nxt = lambda: next(it, sentinel)  # noqa: E731
-        while True:
-            chunk = await loop.run_in_executor(None, nxt)
-            if chunk is sentinel:
-                break
-            await resp.write(chunk)
+        try:
+            while True:
+                chunk = await loop.run_in_executor(None, nxt)
+                if chunk is sentinel:
+                    break
+                await resp.write(chunk)
+        finally:
+            handle.close()  # release the namespace read lock promptly
         await resp.write_eof()
         return resp
 
@@ -1072,14 +1088,25 @@ class S3Server:
 
 
 def make_object_layer(
-    drive_specs: list[str], set_size: int = 0
+    drive_specs: list[str],
+    set_size: int = 0,
+    my_port: int = 0,
+    internode_token_value: str = "",
+    local_drive_registry: dict[int, XLStorage] | None = None,
+    ns_lock=None,
 ):
     """Build the full L3 topology from drive specs (ellipses expanded):
-    format.json bootstrap -> ErasureSets per pool -> ServerPools.
+    endpoints -> local XLStorage / remote StorageRESTClient -> format.json
+    bootstrap -> ErasureSets per pool -> ServerPools.
 
     Each spec is one pool (reference: each `minio server` arg group is a
-    pool); 'path{0...15}' patterns expand to drives.
+    pool); 'path{0...15}' and 'http://host{1...2}:9000/d{1...4}' patterns
+    expand to drives. All nodes pass identical specs; global drive indexes
+    address remote drives (filled into local_drive_registry for the node's
+    own storage RPC server).
     """
+    from ..cluster.endpoint import parse_endpoint
+    from ..cluster.storage_rest import StorageRESTClient
     from ..erasure.pools import ServerPools
     from ..erasure.sets import ErasureSets
     from ..storage.format_erasure import init_or_load_formats
@@ -1098,15 +1125,39 @@ def make_object_layer(
     if bare:
         pool_specs.insert(0, bare)
 
+    # bootstrap-leader rule: only the node owning the very first endpoint
+    # may mint a fresh cluster layout
+    leader = parse_endpoint(pool_specs[0][0], my_port).is_local
+    allow_mint = leader if local_drive_registry is not None else True
+
     pools = []
+    global_idx = 0
     for pool_idx, paths in enumerate(pool_specs):
-        disks = [XLStorage(p) for p in paths]
+        disks = []
+        any_local = False
+        for p in paths:
+            ep = parse_endpoint(p, my_port)
+            if ep.is_local:
+                d = XLStorage(ep.path, endpoint=p)
+                if local_drive_registry is not None:
+                    local_drive_registry[global_idx] = d
+                any_local = True
+            else:
+                d = StorageRESTClient(
+                    ep.host, ep.port, global_idx, internode_token_value, endpoint=p
+                )
+            disks.append(d)
+            global_idx += 1
+        if not any_local and local_drive_registry is not None:
+            raise ValueError(f"pool {pool_idx}: no local drives for this node")
         size = ellipses.choose_set_size(len(disks), set_size)
-        dep_id, grouped = init_or_load_formats(disks, size)
+        dep_id, grouped = init_or_load_formats(disks, size, allow_mint=allow_mint)
         grouped = [
             [d if d is not None else OfflineDisk() for d in row] for row in grouped
         ]
-        pools.append(ErasureSets(grouped, dep_id, pool_index=pool_idx))
+        pools.append(
+            ErasureSets(grouped, dep_id, pool_index=pool_idx, ns_lock=ns_lock)
+        )
     return ServerPools(pools)
 
 
@@ -1119,17 +1170,83 @@ def make_server(
 def main(argv: list[str] | None = None) -> None:
     import argparse
 
+    from ..cluster.endpoint import parse_endpoints, remote_nodes
+    from ..cluster.locks import LocalLocker, LockRESTServer, NamespaceLock, _RemoteLocker
+    from ..cluster.storage_rest import StorageRESTServer, internode_token
+    from ..utils import ellipses
+
     ap = argparse.ArgumentParser(description="minio_tpu S3 server")
     ap.add_argument(
         "drives", nargs="+",
-        help="drive dirs or ellipses patterns; each arg is one pool",
+        help="drive dirs, ellipses patterns, or http://host:port/path "
+        "endpoints; each ellipses arg is one pool",
     )
     ap.add_argument("--address", default="0.0.0.0:9000")
     ap.add_argument("--set-size", type=int, default=0, help="drives per erasure set")
     args = ap.parse_args(argv)
     host, _, port = args.address.rpartition(":")
-    srv = make_server(args.drives, set_size=args.set_size)
-    web.run_app(srv.app, host=host or "0.0.0.0", port=int(port), print=None)
+    my_port = int(port)
+
+    root_user = os.environ.get("MINIO_ROOT_USER", "minioadmin")
+    root_pass = os.environ.get("MINIO_ROOT_PASSWORD", "minioadmin")
+    token = internode_token(root_user, root_pass)
+
+    all_eps = parse_endpoints(
+        [p for spec in args.drives for p in ellipses.expand(spec)], my_port
+    )
+    peers = remote_nodes(all_eps)
+    distributed = bool(peers)
+
+    registry: dict[int, XLStorage] = {}
+    local_locker = LocalLocker()
+    lockers = [local_locker] + [
+        _RemoteLocker(n.split(":")[0], int(n.split(":")[1]), token) for n in peers
+    ]
+    ns_lock = NamespaceLock(lockers)
+
+    srv = S3Server(None)
+    StorageRESTServer(registry, token).register(srv.app)
+    LockRESTServer(local_locker, token).register(srv.app)
+
+    async def bootstrap():
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+
+        def build():
+            return make_object_layer(
+                args.drives, args.set_size, my_port, token, registry, ns_lock
+            )
+
+        last = None
+        for _ in range(180):
+            try:
+                store = await loop.run_in_executor(None, build)
+                # set_store does storage IO (IAM/bucket-config loads, incl.
+                # remote RPC) — keep it off the event loop, which must stay
+                # responsive for peers' storage/lock RPCs
+                await loop.run_in_executor(None, srv.set_store, store)
+                print(
+                    f"object layer online: {len(store.pools)} pool(s), "
+                    f"{len(store.disks)} drives, distributed={distributed}",
+                    flush=True,
+                )
+                return
+            except Exception as e:  # noqa: BLE001 — peers may still be booting
+                last = e
+                await asyncio.sleep(1)
+        print(f"bootstrap failed: {last}", flush=True)
+        os._exit(1)  # a task-level SystemExit would leave run_app serving 503s
+
+    async def on_start(app):
+        # background task: peers bootstrap against each other's storage
+        # RPC, so the listener must come up FIRST (on_startup blocks it)
+        import asyncio
+
+        app["bootstrap"] = asyncio.create_task(bootstrap())
+
+    srv.app.on_startup.append(on_start)
+    web.run_app(srv.app, host=host or "0.0.0.0", port=my_port, print=None)
 
 
 if __name__ == "__main__":

@@ -76,27 +76,45 @@ def write_format(disk: XLStorage, fmt: FormatErasure) -> None:
 
 
 def init_or_load_formats(
-    disks: list[XLStorage], set_drive_count: int
+    disks: list[XLStorage], set_drive_count: int, allow_mint: bool = True
 ) -> tuple[str, list[list[XLStorage]]]:
     """Bootstrap: load formats where present, initialize fresh drives,
     and return (deployment_id, drives grouped into sets, format-ordered).
 
-    First boot (no formats anywhere) writes a fresh layout. Mixed state
-    heals fresh drives into holes left by wiped ones, keyed by position.
+    First boot (no formats anywhere) writes a fresh layout — but only when
+    `allow_mint` (the cluster leader: the node owning the first endpoint)
+    and every drive is reachable, so two nodes can't mint rival layouts
+    (reference: waitForFormatErasure in cmd/prepare-storage.go).
+    Mixed state heals fresh drives into holes left by wiped ones.
+    Unreachable drives stay as None placeholders.
     """
     if len(disks) % set_drive_count:
         raise ValueError("drive count not divisible by set size")
     n_sets = len(disks) // set_drive_count
 
     formats: list[FormatErasure | None] = []
+    offline: list[bool] = []
     for disk in disks:
         try:
             formats.append(read_format(disk))
+            offline.append(False)
         except (errors.FileNotFound, errors.VolumeNotFound, ValueError):
             formats.append(None)
+            offline.append(False)  # reachable but fresh
+        except errors.StorageError:
+            formats.append(None)
+            offline.append(True)  # peer down / unreachable
 
     live = [f for f in formats if f is not None]
     if not live:
+        if not allow_mint:
+            raise errors.DiskNotFound(
+                "no formats found and this node is not the bootstrap leader"
+            )
+        if any(offline):
+            raise errors.DiskNotFound(
+                "cannot mint a fresh cluster while drives are unreachable"
+            )
         # fresh cluster: mint everything
         deployment_id = str(uuid.uuid4())
         sets = [
@@ -132,7 +150,10 @@ def init_or_load_formats(
         if f is not None:
             by_uuid[f.this] = disk
             disk.disk_id = f.this
-    fresh = [disk for disk, f in zip(disks, formats) if f is None]
+    # only reachable format-less drives can be healed into holes
+    fresh = [
+        disk for disk, f, off in zip(disks, formats, offline) if f is None and not off
+    ]
     grouped: list[list[XLStorage]] = []
     for s in ref.sets:
         row: list[XLStorage] = []
