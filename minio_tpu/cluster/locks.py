@@ -21,6 +21,17 @@ from aiohttp import web
 
 LOCK_PREFIX = "/minio/lock/v1"
 
+from concurrent.futures import ThreadPoolExecutor  # noqa: E402
+
+_LOCK_POOL = ThreadPoolExecutor(max_workers=16, thread_name_prefix="dsync")
+
+
+def _safe_result(fut) -> bool:
+    try:
+        return bool(fut.result(timeout=10))
+    except Exception:  # noqa: BLE001 — unreachable locker == not granted
+        return False
+
 
 LOCK_TTL = 120.0  # seconds; a crashed holder's locks expire lazily
 # (the reference refreshes held locks and expires stale ones —
@@ -188,10 +199,21 @@ class DRWMutex:
         quorum = self._quorum(write)
         backoff = 0.002
         while True:
-            granted = []
-            for lk in self.lockers:
-                if getattr(lk, op_lock)(self.resource, self.uid):
-                    granted.append(lk)
+            # broadcast concurrently: one slow/blackholed peer must not add
+            # its full timeout to every round (the reference fans out too)
+            if len(self.lockers) > 1:
+                futs = [
+                    _LOCK_POOL.submit(getattr(lk, op_lock), self.resource, self.uid)
+                    for lk in self.lockers
+                ]
+                granted = [
+                    lk for lk, f in zip(self.lockers, futs) if _safe_result(f)
+                ]
+            else:
+                granted = [
+                    lk for lk in self.lockers
+                    if getattr(lk, op_lock)(self.resource, self.uid)
+                ]
             if len(granted) >= quorum:
                 return True
             for lk in granted:

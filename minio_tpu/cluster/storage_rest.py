@@ -182,8 +182,8 @@ class StorageRESTServer:
 
 
 def _fi_wire(fi: FileInfo) -> dict:
+    # to_dict/from_dict carry everything except the read-side annotations
     d = fi.to_dict()
-    d["_vid"] = fi.version_id
     d["_latest"] = fi.is_latest
     d["_nv"] = fi.num_versions
     d["_smt"] = fi.successor_mod_time
@@ -192,7 +192,6 @@ def _fi_wire(fi: FileInfo) -> dict:
 
 def _fi_unwire(d: dict) -> FileInfo:
     fi = FileInfo.from_dict(d)
-    fi.version_id = d.get("_vid", fi.version_id)
     fi.is_latest = d.get("_latest", True)
     fi.num_versions = d.get("_nv", 0)
     fi.successor_mod_time = d.get("_smt", 0)
@@ -217,10 +216,21 @@ class StorageRESTClient(StorageAPI):
             self._local.conn = c
         return c
 
+    # ops safe to resend after a dropped connection; replays of renames,
+    # appends, and version deletes change outcomes (double-append, rename
+    # of a now-missing source counted as a write error) and must not retry
+    _RETRYABLE = frozenset(
+        {"diskinfo", "makevol", "listvols", "statvol", "deletevol",
+         "writemetadata", "updatemetadata", "readversion", "readversions",
+         "createfile", "readfile", "delete", "listdir", "walkdir",
+         "statinfofile", "verifyfile"}
+    )
+
     def _rpc(self, op: str, args: dict | None = None) -> bytes:
         body = msgpack.packb(args or {})
         path = f"{STORAGE_PREFIX}/{self.drive_index}/{op}"
-        for attempt in (0, 1):
+        attempts = (0, 1) if op in self._RETRYABLE else (1,)
+        for attempt in attempts:
             conn = self._conn()
             try:
                 conn.request(
@@ -300,9 +310,7 @@ class StorageRESTClient(StorageAPI):
         return fis
 
     def delete_version(self, volume: str, path: str, fi: FileInfo) -> None:
-        d = fi.to_dict()
-        d["vid"] = fi.version_id
-        self._rpc("deleteversion", {"volume": volume, "path": path, "fi": d})
+        self._rpc("deleteversion", {"volume": volume, "path": path, "fi": fi.to_dict()})
 
     def delete_versions(self, volume, path, versions):
         out = []

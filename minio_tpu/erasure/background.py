@@ -1,0 +1,201 @@
+"""Background durability plane: data scanner, MRF heal queue, heal workers.
+
+Mirrors the reference's background subsystems:
+- data scanner (/root/reference/cmd/data-scanner.go): continuous namespace
+  crawl with adaptive pacing; verifies objects, queues heals, feeds the
+  data-usage cache.
+- MRF — most-recent-failures (/root/reference/cmd/mrf.go): read-path
+  degradation immediately requeues the object for heal instead of waiting
+  for the next scanner cycle.
+- heal workers (/root/reference/cmd/background-heal-ops.go): a bounded
+  worker pool draining the heal queue.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class DataUsage:
+    buckets: dict[str, dict] = field(default_factory=dict)  # name -> {objects, size}
+    last_update: float = 0.0
+    cycles: int = 0
+
+    def snapshot(self) -> dict:
+        return {
+            "bucketsCount": len(self.buckets),
+            "objectsCount": sum(b["objects"] for b in self.buckets.values()),
+            "objectsTotalSize": sum(b["size"] for b in self.buckets.values()),
+            "lastUpdate": self.last_update,
+            "cycles": self.cycles,
+            "bucketsUsage": self.buckets,
+        }
+
+
+class MRFQueue:
+    """Most-recent-failures: bounded dedup queue of objects needing heal."""
+
+    def __init__(self, maxsize: int = 10000):
+        self._q: queue.Queue = queue.Queue(maxsize=maxsize)
+        self._pending: set[tuple[str, str]] = set()
+        self._mu = threading.Lock()
+
+    def add(self, bucket: str, obj: str) -> None:
+        key = (bucket, obj)
+        with self._mu:
+            if key in self._pending:
+                return
+            self._pending.add(key)
+        try:
+            self._q.put_nowait(key)
+        except queue.Full:
+            with self._mu:
+                self._pending.discard(key)
+
+    def get(self, timeout: float) -> tuple[str, str] | None:
+        try:
+            key = self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+        with self._mu:
+            self._pending.discard(key)
+        return key
+
+    def __len__(self) -> int:
+        return self._q.qsize()
+
+
+class BackgroundOps:
+    """Scanner + heal workers for one object layer (all pools/sets)."""
+
+    def __init__(
+        self,
+        store,
+        scan_interval: float = 60.0,
+        object_sleep: float = 0.005,
+        heal_workers: int = 2,
+        deep_verify: bool = False,
+    ):
+        self.store = store
+        self.scan_interval = scan_interval
+        self.object_sleep = object_sleep
+        self.deep_verify = deep_verify
+        self.mrf = MRFQueue()
+        self.usage = DataUsage()
+        self.stats = {
+            "scans": 0, "objects_scanned": 0, "heals_queued": 0,
+            "heals_done": 0, "heals_failed": 0,
+        }
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._heal_workers = heal_workers
+        # read paths report degradation here
+        self.on_degraded = self.mrf.add
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        t = threading.Thread(target=self._scan_loop, daemon=True, name="scanner")
+        t.start()
+        self._threads.append(t)
+        for i in range(self._heal_workers):
+            t = threading.Thread(
+                target=self._heal_loop, daemon=True, name=f"heal-{i}"
+            )
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    # -- scanner -----------------------------------------------------------
+
+    def _scan_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.scan_once()
+            except Exception:  # noqa: BLE001 — scanner must never die
+                pass
+            self._stop.wait(self.scan_interval)
+
+    def scan_once(self) -> DataUsage:
+        """One full namespace crawl: usage accounting + heal detection.
+
+        Mirrors scanDataFolder (/root/reference/cmd/data-scanner.go:307);
+        deep_verify additionally runs bitrot verification (the reference
+        deep-scans each object every N cycles)."""
+        usage: dict[str, dict] = {}
+        for b in self.store.list_buckets():
+            bucket_usage = {"objects": 0, "size": 0, "versions": 0}
+            for raw in self.store.walk_objects(b.name):
+                if self._stop.is_set():
+                    return self.usage
+                self.stats["objects_scanned"] += 1
+                try:
+                    needs_heal = self._inspect(b.name, raw, bucket_usage)
+                    if needs_heal:
+                        self.mrf.add(b.name, raw)
+                        self.stats["heals_queued"] += 1
+                except Exception:  # noqa: BLE001 — damaged object: queue heal
+                    self.mrf.add(b.name, raw)
+                    self.stats["heals_queued"] += 1
+                if self.object_sleep:
+                    time.sleep(self.object_sleep)  # adaptive pacing analogue
+            usage[b.name] = bucket_usage
+        self.usage.buckets = usage
+        self.usage.last_update = time.time()
+        self.usage.cycles += 1
+        self.stats["scans"] += 1
+        return self.usage
+
+    def _inspect(self, bucket: str, obj: str, acc: dict) -> bool:
+        """Account usage; return True when the object needs healing."""
+        metas, errs, sets = [], [], None
+        for cand in self._candidate_sets(obj):
+            metas, errs = cand._read_all_fileinfo(bucket, obj, "", False)
+            if any(m is not None and m.is_valid() for m in metas):
+                sets = cand
+                break
+        ok = [m for m in metas if m is not None and m.is_valid()]
+        if not ok or sets is None:
+            return False  # dangling; GC is the scanner's later job
+        fi = max(ok, key=lambda m: m.mod_time)
+        if fi.deleted:
+            return any(e is not None for e in errs)
+        acc["objects"] += 1
+        acc["size"] += fi.size
+        acc["versions"] += fi.num_versions or 1
+        if any(e is not None for e in errs):
+            return True  # missing on some drive
+        if self.deep_verify:
+            try:
+                res = sets.heal_object(bucket, obj)
+                return bool(res.get("healed"))
+            except Exception:  # noqa: BLE001
+                return True
+        return False
+
+    def _candidate_sets(self, obj: str):
+        """The set that would hold obj in EACH pool (multi-pool objects
+        live in exactly one pool; probe like ServerPools._pool_holding)."""
+        store = self.store
+        for p in getattr(store, "pools", [store]):
+            yield p.get_hashed_set(obj) if hasattr(p, "get_hashed_set") else p
+
+    # -- heal workers ------------------------------------------------------
+
+    def _heal_loop(self) -> None:
+        while not self._stop.is_set():
+            item = self.mrf.get(timeout=1.0)
+            if item is None:
+                continue
+            bucket, obj = item
+            try:
+                self.store.heal_object(bucket, obj)
+                self.stats["heals_done"] += 1
+            except Exception:  # noqa: BLE001
+                self.stats["heals_failed"] += 1

@@ -17,7 +17,7 @@ from dataclasses import dataclass
 from ..storage import errors
 from ..storage.datatypes import FileInfo, ObjectPartInfo, now_ns
 from ..utils.hashing import hash_order
-from .quorum import ObjectNotFound, reduce_quorum_errs
+from .quorum import ObjectNotFound, QuorumError, reduce_quorum_errs
 from .set import ErasureSet
 from .types import ObjectInfo
 
@@ -219,7 +219,8 @@ class MultipartManager:
         # object (same namespace write lock put_object takes)
         mtx = self.es.ns.new(bucket, obj)
         if not mtx.lock(30.0):
-            raise InvalidPart("namespace lock timeout during complete")
+            # server-side contention is retryable, not a client error
+            raise QuorumError(f"namespace lock timeout completing {bucket}/{obj}")
 
         def commit(i: int, disk) -> None:
             shard_idx = dist[i] - 1

@@ -85,6 +85,8 @@ class ErasureSet:
         self.ns = ns_lock if ns_lock is not None else NamespaceLock()
         self._pool = ThreadPoolExecutor(max_workers=max(4, self.n))
         self._coders: dict[tuple[int, int], ErasureCoder] = {}
+        # read-path degradation hook (MRF heal-on-read, reference cmd/mrf.go)
+        self.on_degraded = None
 
     # -- helpers -----------------------------------------------------------
 
@@ -350,6 +352,19 @@ class ErasureSet:
         coder = self.coder(d, fi.erasure.parity_blocks)
         sources = self._shard_sources(fi, metas)
         bad: set[int] = set()
+        degraded_reported = False
+
+        def report_degraded():
+            nonlocal degraded_reported
+            if not degraded_reported and self.on_degraded is not None:
+                degraded_reported = True
+                try:
+                    self.on_degraded(bucket, obj)
+                except Exception:  # noqa: BLE001
+                    pass
+
+        if len(sources) < self.n:
+            report_degraded()  # some drive lacks this version entirely
 
         def read_shard_block(part_num: int, idx: int, per: int, f_off: int) -> bytes:
             disk, m = sources[idx]
@@ -384,6 +399,7 @@ class ErasureSet:
                             got[idx] = read_shard_block(part.number, idx, per, f_off)
                         except (errors.FileCorrupt, errors.FileNotFound, OSError):
                             bad.add(idx)
+                            report_degraded()
                 if len(got) < d:
                     for idx in range(d, self.n):
                         if len(got) >= d:
@@ -393,6 +409,7 @@ class ErasureSet:
                                 got[idx] = read_shard_block(part.number, idx, per, f_off)
                             except (errors.FileCorrupt, errors.FileNotFound, OSError):
                                 bad.add(idx)
+                                report_degraded()
                     if len(got) < d:
                         raise QuorumError(
                             f"cannot read part {part.number} block {block_i}: "

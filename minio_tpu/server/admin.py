@@ -152,6 +152,41 @@ async def handle_admin(server, request: web.Request, access_key: str, subpath: s
             {"credentials": {"accessKey": u.access_key, "secretKey": u.secret_key}}
         )
 
+    # -- observability ----------------------------------------------------
+    if op == "trace" and m == "GET":
+        authz("admin:ServerTrace")
+        return await _stream_trace(server, request)
+    if op == "datausageinfo" and m == "GET":
+        authz("admin:DataUsageInfo")
+        bg = server.background
+        return _json(bg.usage.snapshot() if bg else {})
+    if op == "background-heal/status" and m == "GET":
+        authz("admin:Heal")
+        bg = server.background
+        return _json(
+            {
+                "mrfQueued": len(bg.mrf) if bg else 0,
+                **(bg.stats if bg else {}),
+            }
+        )
+    if op == "scanner/status" and m == "GET":
+        authz("admin:OBDInfo")
+        bg = server.background
+        return _json(bg.stats if bg else {})
+    if op == "top/locks" and m == "GET":
+        authz("admin:TopLocksInfo")
+        # aggregate lock tables reachable from this node
+        from ..cluster.locks import LocalLocker
+
+        stats = {}
+        first = server.store
+        sets = getattr(getattr(first, "pools", [first])[0], "sets", [])
+        if sets:
+            for lk in sets[0].ns.lockers:
+                if isinstance(lk, LocalLocker):
+                    stats.update(lk.stats())
+        return _json(stats)
+
     # -- info / heal ------------------------------------------------------
     if op == "info" and m == "GET":
         authz("admin:ServerInfo")
@@ -215,3 +250,26 @@ def storage_info_payload(server) -> dict:
         except Exception as e:  # noqa: BLE001
             out["disks"].append({"endpoint": d.endpoint, "state": str(e)})
     return out
+
+
+async def _stream_trace(server, request: web.Request) -> web.StreamResponse:
+    """Long-lived JSON-lines trace stream (`mc admin trace` analogue)."""
+    import asyncio
+    import queue as _queue
+
+    q = server.trace.subscribe()
+    resp = web.StreamResponse(headers={"Content-Type": "application/json"})
+    await resp.prepare(request)
+    loop = asyncio.get_running_loop()
+    try:
+        while True:
+            try:
+                rec = await loop.run_in_executor(None, q.get, True, 1.0)
+            except _queue.Empty:
+                continue
+            await resp.write(json.dumps(rec).encode() + b"\n")
+    except (ConnectionResetError, asyncio.CancelledError):
+        pass
+    finally:
+        server.trace.unsubscribe(q)
+    return resp

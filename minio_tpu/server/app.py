@@ -108,9 +108,14 @@ class S3Server:
     def __init__(self, store=None, region: str = "us-east-1"):
         import time as _time
 
+        from .metrics import Metrics, TracePubSub
+
         self.store = None
         self.region = region
         self.started_at = _time.time()
+        self.metrics = Metrics()
+        self.trace = TracePubSub()
+        self.background = None
         self.root_user = os.environ.get("MINIO_ROOT_USER", "minioadmin")
         self.root_pass = os.environ.get("MINIO_ROOT_PASSWORD", "minioadmin")
         self.app = web.Application(client_max_size=1 << 30)
@@ -136,6 +141,16 @@ class S3Server:
         self.iam.load()
         self.verifier = signature.SigV4Verifier(self.iam.lookup_secret, self.region)
         self.store = store
+        # background durability plane: scanner + MRF heal workers
+        from ..erasure.background import BackgroundOps
+
+        interval = float(os.environ.get("MINIO_TPU_SCAN_INTERVAL", "300"))
+        self.background = BackgroundOps(store, scan_interval=interval)
+        for p in getattr(store, "pools", [store]):
+            for s in getattr(p, "sets", [p]):
+                s.on_degraded = self.background.mrf.add
+        if interval > 0:
+            self.background.start()
 
     # -- plumbing ------------------------------------------------------------
 
@@ -152,6 +167,47 @@ class S3Server:
         )
 
     async def _entry(self, request: web.Request) -> web.StreamResponse:
+        import time as _time
+
+        from .metrics import classify_api, trace_record
+
+        t0 = _time.perf_counter()
+        resp: web.StreamResponse | None = None
+        try:
+            resp = await self._entry_inner(request)
+            return resp
+        finally:
+            dur = _time.perf_counter() - t0
+            status = resp.status if resp is not None else 500
+            api = classify_api(
+                request.method,
+                request.match_info.get("bucket", ""),
+                request.match_info.get("key", ""),
+                request.rel_url.query,
+            )
+            rx = int(request.headers.get("Content-Length") or 0)
+            tx = getattr(resp, "content_length", None) or 0 if resp else 0
+            self.metrics.observe(api, status, dur, rx, tx)
+            if self.trace.active:
+                self.trace.publish(trace_record(request, status, dur, rx, tx))
+
+    async def _entry_inner(self, request: web.Request) -> web.StreamResponse:
+        # unauthenticated planes: health + metrics
+        bucket = request.match_info.get("bucket", "")
+        key = request.match_info.get("key", "")
+        if bucket == "minio":
+            if key.startswith("health/"):
+                # disk probes may hit remote drives: stay off the event loop
+                return await self._run(self._health, request, key)
+            if key in ("v2/metrics/cluster", "v2/metrics/node", "metrics/v3"):
+                if self.store is None:
+                    return web.Response(status=503)
+                if os.environ.get("MINIO_PROMETHEUS_AUTH_TYPE", "jwt") != "public":
+                    ak, _ = await self._authenticate(request)
+                    if not ak or not self.iam.is_allowed(ak, "admin:Prometheus", ""):
+                        raise s3err.AccessDenied
+                text = await self._run(self.metrics.render, self)
+                return web.Response(body=text.encode(), content_type="text/plain")
         try:
             if self.store is None:
                 return web.Response(
@@ -760,19 +816,23 @@ class S3Server:
         if vid == "null":
             vid = ""
         oi, handle = await self._run(self.store.open_object, bucket, key, vid)
-        self._check_preconditions(request, oi)
-        rng = self._parse_range(request, oi.size) if oi.size else None
-        headers = self._obj_headers(oi)
-        if rng:
-            start, end = rng
-            it = handle.read(start, end - start + 1)
-            headers["Content-Range"] = f"bytes {start}-{end}/{oi.size}"
-            resp = web.StreamResponse(status=206, headers=headers)
-            resp.content_length = end - start + 1
-        else:
-            it = handle.read()
-            resp = web.StreamResponse(status=200, headers=headers)
-            resp.content_length = oi.size
+        try:
+            self._check_preconditions(request, oi)
+            rng = self._parse_range(request, oi.size) if oi.size else None
+            headers = self._obj_headers(oi)
+            if rng:
+                start, end = rng
+                it = handle.read(start, end - start + 1)
+                headers["Content-Range"] = f"bytes {start}-{end}/{oi.size}"
+                resp = web.StreamResponse(status=206, headers=headers)
+                resp.content_length = end - start + 1
+            else:
+                it = handle.read()
+                resp = web.StreamResponse(status=200, headers=headers)
+                resp.content_length = oi.size
+        except BaseException:
+            handle.close()  # preconditions/range failures must not leak the rlock
+            raise
         await resp.prepare(request)
         loop = asyncio.get_running_loop()
         sentinel = object()
@@ -935,18 +995,21 @@ class S3Server:
         oi, handle = await self._run(
             self.store.open_object, src_bucket, src_key, src_vid
         )
-        offset, length = 0, oi.size
-        crange = request.headers.get("x-amz-copy-source-range", "")
-        if crange.startswith("bytes="):
-            try:
-                a, _, b = crange[len("bytes=") :].partition("-")
-                offset = int(a)
-                length = int(b) - offset + 1
-            except ValueError:
-                raise s3err.InvalidArgument from None
-            if offset < 0 or length <= 0 or offset + length > oi.size:
-                raise s3err.InvalidRange
-        data = await self._run(lambda: b"".join(handle.read(offset, length)))
+        try:
+            offset, length = 0, oi.size
+            crange = request.headers.get("x-amz-copy-source-range", "")
+            if crange.startswith("bytes="):
+                try:
+                    a, _, b = crange[len("bytes=") :].partition("-")
+                    offset = int(a)
+                    length = int(b) - offset + 1
+                except ValueError:
+                    raise s3err.InvalidArgument from None
+                if offset < 0 or length <= 0 or offset + length > oi.size:
+                    raise s3err.InvalidRange
+            data = await self._run(lambda: b"".join(handle.read(offset, length)))
+        finally:
+            handle.close()
         try:
             etag = await self._run(
                 self.mp.put_part, bucket, key, upload_id, part_number, data
@@ -1042,6 +1105,30 @@ class S3Server:
             f"<IsTruncated>false</IsTruncated>{items}</ListPartsResult>"
         )
         return web.Response(body=xml.encode(), content_type="application/xml")
+
+    def _health(self, request, key: str) -> web.Response:
+        """Liveness/readiness/cluster health
+        (reference cmd/healthcheck-handler.go)."""
+        if key == "health/live":
+            return web.Response(status=200)
+        if key in ("health/ready", "health/cluster"):
+            if self.store is None:
+                return web.Response(status=503)
+            if key == "health/cluster":
+                online = 0
+                for d in self.store.disks:
+                    try:
+                        d.disk_info()
+                        online += 1
+                    except Exception:  # noqa: BLE001
+                        pass
+                quorum = len(self.store.disks) // 2 + 1
+                if online < quorum:
+                    return web.Response(
+                        status=503, headers={"X-Minio-Write-Quorum": str(quorum)}
+                    )
+            return web.Response(status=200)
+        return web.Response(status=404)
 
     # -- admin helpers ---------------------------------------------------------
 

@@ -1,0 +1,190 @@
+"""Prometheus metrics + request tracing pubsub.
+
+Mirrors the reference's observability plane: metrics v2/v3 endpoints
+(/root/reference/cmd/metrics-v2.go, metrics-v3*.go) exposing request,
+storage, heal, and usage series in Prometheus text format; and the
+zero-cost-when-idle trace pubsub behind `mc admin trace`
+(/root/reference/cmd/http-tracer.go + internal/pubsub).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import defaultdict
+
+
+class Metrics:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.requests_total: dict[str, int] = defaultdict(int)  # by api
+        self.errors_total: dict[str, int] = defaultdict(int)  # by api
+        self.errors_4xx: int = 0
+        self.errors_5xx: int = 0
+        self.rx_bytes = 0
+        self.tx_bytes = 0
+        self.request_seconds: dict[str, float] = defaultdict(float)
+        self.inflight = 0
+
+    def observe(self, api: str, status: int, dur: float, rx: int, tx: int) -> None:
+        with self._mu:
+            self.requests_total[api] += 1
+            self.request_seconds[api] += dur
+            self.rx_bytes += rx
+            self.tx_bytes += tx
+            if status >= 500:
+                self.errors_5xx += 1
+                self.errors_total[api] += 1
+            elif status >= 400:
+                self.errors_4xx += 1
+                self.errors_total[api] += 1
+
+    def render(self, server) -> str:
+        """Prometheus text exposition for the cluster endpoint."""
+        lines = [
+            "# HELP minio_s3_requests_total Total S3 requests by API.",
+            "# TYPE minio_s3_requests_total counter",
+        ]
+        with self._mu:
+            for api, n in sorted(self.requests_total.items()):
+                lines.append(f'minio_s3_requests_total{{api="{api}"}} {n}')
+            lines += [
+                "# TYPE minio_s3_requests_errors_total counter",
+            ]
+            for api, n in sorted(self.errors_total.items()):
+                lines.append(f'minio_s3_requests_errors_total{{api="{api}"}} {n}')
+            lines += [
+                "# TYPE minio_s3_requests_4xx_errors_total counter",
+                f"minio_s3_requests_4xx_errors_total {self.errors_4xx}",
+                "# TYPE minio_s3_requests_5xx_errors_total counter",
+                f"minio_s3_requests_5xx_errors_total {self.errors_5xx}",
+                "# TYPE minio_s3_traffic_received_bytes counter",
+                f"minio_s3_traffic_received_bytes {self.rx_bytes}",
+                "# TYPE minio_s3_traffic_sent_bytes counter",
+                f"minio_s3_traffic_sent_bytes {self.tx_bytes}",
+                "# TYPE minio_s3_request_seconds_total counter",
+            ]
+            for api, s in sorted(self.request_seconds.items()):
+                lines.append(f'minio_s3_request_seconds_total{{api="{api}"}} {s:.6f}')
+        # storage series
+        store = server.store
+        if store is not None:
+            online, offline, total_b, free_b = 0, 0, 0, 0
+            for d in store.disks:
+                try:
+                    di = d.disk_info()
+                    online += 1
+                    total_b += di.total
+                    free_b += di.free
+                except Exception:  # noqa: BLE001
+                    offline += 1
+            lines += [
+                "# TYPE minio_cluster_drive_online_total gauge",
+                f"minio_cluster_drive_online_total {online}",
+                "# TYPE minio_cluster_drive_offline_total gauge",
+                f"minio_cluster_drive_offline_total {offline}",
+                "# TYPE minio_cluster_capacity_raw_total_bytes gauge",
+                f"minio_cluster_capacity_raw_total_bytes {total_b}",
+                "# TYPE minio_cluster_capacity_raw_free_bytes gauge",
+                f"minio_cluster_capacity_raw_free_bytes {free_b}",
+            ]
+        bg = getattr(server, "background", None)
+        if bg is not None:
+            lines += [
+                "# TYPE minio_heal_objects_healed_total counter",
+                f"minio_heal_objects_healed_total {bg.stats['heals_done']}",
+                "# TYPE minio_heal_objects_queued_total counter",
+                f"minio_heal_objects_queued_total {bg.stats['heals_queued']}",
+                "# TYPE minio_heal_objects_errors_total counter",
+                f"minio_heal_objects_errors_total {bg.stats['heals_failed']}",
+                "# TYPE minio_scanner_objects_scanned_total counter",
+                f"minio_scanner_objects_scanned_total {bg.stats['objects_scanned']}",
+                "# TYPE minio_bucket_usage_total_bytes gauge",
+            ]
+            for b, u in sorted(bg.usage.buckets.items()):
+                lines.append(f'minio_bucket_usage_total_bytes{{bucket="{b}"}} {u["size"]}')
+                lines.append(
+                    f'minio_bucket_usage_object_total{{bucket="{b}"}} {u["objects"]}'
+                )
+        lines += [
+            "# TYPE minio_node_uptime_seconds gauge",
+            f"minio_node_uptime_seconds {time.time() - server.started_at:.0f}",
+        ]
+        return "\n".join(lines) + "\n"
+
+
+class TracePubSub:
+    """Fan-out of request trace records; zero-cost with no subscribers
+    (the reference checks NumSubscribers before building the record)."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._subs: list = []
+
+    @property
+    def active(self) -> bool:
+        return bool(self._subs)
+
+    def subscribe(self):
+        import queue
+
+        q = queue.Queue(maxsize=1000)
+        with self._mu:
+            self._subs.append(q)
+        return q
+
+    def unsubscribe(self, q) -> None:
+        with self._mu:
+            if q in self._subs:
+                self._subs.remove(q)
+
+    def publish(self, record: dict) -> None:
+        with self._mu:
+            subs = list(self._subs)
+        for q in subs:
+            try:
+                q.put_nowait(record)
+            except Exception:  # noqa: BLE001 — slow subscriber drops records
+                pass
+
+
+def trace_record(request, status: int, dur: float, rx: int, tx: int) -> dict:
+    return {
+        "time": time.time(),
+        "type": "s3",
+        "method": request.method,
+        "path": request.path,
+        "query": request.rel_url.raw_query_string,
+        "statusCode": status,
+        "durationNs": int(dur * 1e9),
+        "rx": rx,
+        "tx": tx,
+        "remote": request.remote or "",
+    }
+
+
+def classify_api(method: str, bucket: str, key: str, query) -> str:
+    """Request -> metrics API label (coarse version of the reference's
+    api names in cmd/metrics-v2.go)."""
+    if not bucket:
+        return "ListBuckets" if method == "GET" else "STS"
+    if not key:
+        if method == "GET":
+            if "versions" in query:
+                return "ListObjectVersions"
+            return "ListObjectsV2" if query.get("list-type") == "2" else "ListObjectsV1"
+        return {
+            "PUT": "PutBucket", "DELETE": "DeleteBucket", "HEAD": "HeadBucket",
+            "POST": "DeleteMultipleObjects",
+        }.get(method, method)
+    if "uploadId" in query or "uploads" in query:
+        return "Multipart"
+    return {
+        "GET": "GetObject", "PUT": "PutObject", "HEAD": "HeadObject",
+        "DELETE": "DeleteObject", "POST": "PostObject",
+    }.get(method, method)
+
+
+def dump_json(obj) -> bytes:
+    return json.dumps(obj).encode()
