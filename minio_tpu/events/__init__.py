@@ -1,0 +1,1 @@
+"""Bucket event notifications: config, targets, dispatch, listen API."""

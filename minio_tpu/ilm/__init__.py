@@ -1,0 +1,1 @@
+"""ILM: bucket lifecycle configuration and evaluation."""

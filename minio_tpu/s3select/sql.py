@@ -1,0 +1,274 @@
+"""S3 Select SQL engine (subset).
+
+Mirrors the query surface of the reference's s3select SQL package
+(/root/reference/internal/s3select/sql) most clients use:
+    SELECT */cols/aggregates FROM S3Object [alias]
+    [WHERE col op literal [AND|OR ...]] [LIMIT n]
+with =, !=/<>, <, <=, >, >=, LIKE, IS [NOT] NULL; aggregates COUNT(*),
+SUM/AVG/MIN/MAX(col). Records are dicts (CSV row by header, JSON object).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+
+class SQLError(Exception):
+    pass
+
+
+_TOKEN = re.compile(
+    r"""\s*(?:
+        (?P<number>-?\d+(?:\.\d+)?)
+      | (?P<string>'(?:[^']|'')*')
+      | (?P<ident>[A-Za-z_][A-Za-z0-9_.\*]*|\*)
+      | (?P<op><=|>=|!=|<>|=|<|>|\(|\)|,)
+    )""",
+    re.VERBOSE,
+)
+
+
+def _tokenize(s: str) -> list[str]:
+    out, pos = [], 0
+    while pos < len(s):
+        m = _TOKEN.match(s, pos)
+        if not m:
+            if s[pos:].strip() == "":
+                break
+            raise SQLError(f"bad token at {s[pos:pos+20]!r}")
+        out.append(m.group(0).strip())
+        pos = m.end()
+    return out
+
+
+@dataclass
+class Condition:
+    column: str
+    op: str
+    value: object  # float | str | None
+
+
+@dataclass
+class Query:
+    columns: list[str] = field(default_factory=list)  # [] == *
+    aggregates: list[tuple[str, str]] = field(default_factory=list)  # (fn, col)
+    conditions: list = field(default_factory=list)  # [Condition|'AND'|'OR']
+    limit: int = -1
+    alias: str = "s3object"
+
+
+def parse(expr: str) -> Query:
+    try:
+        return _parse(expr)
+    except SQLError:
+        raise
+    except (IndexError, ValueError) as e:
+        # truncated/garbled user input must be a 400-class SQLError,
+        # never an unhandled 500
+        raise SQLError(f"malformed query: {e}") from None
+
+
+def _parse(expr: str) -> Query:
+    toks = _tokenize(expr)
+    if not toks or toks[0].upper() != "SELECT":
+        raise SQLError("expected SELECT")
+    q = Query()
+    i = 1
+    # projection
+    while i < len(toks) and toks[i].upper() != "FROM":
+        t = toks[i]
+        up = t.upper()
+        if up in ("COUNT", "SUM", "AVG", "MIN", "MAX") and i + 1 < len(toks) and toks[i + 1] == "(":
+            j = i + 2
+            col = toks[j]
+            if toks[j + 1] != ")":
+                raise SQLError("bad aggregate")
+            q.aggregates.append((up, col))
+            i = j + 2
+        elif t == ",":
+            i += 1
+        elif t == "*":
+            i += 1  # all columns
+        else:
+            q.columns.append(t)
+            i += 1
+    if i >= len(toks):
+        raise SQLError("expected FROM")
+    i += 1  # FROM
+    if i < len(toks):
+        src = toks[i]
+        if not src.lower().startswith("s3object"):
+            raise SQLError("FROM must reference S3Object")
+        i += 1
+        if i < len(toks) and toks[i].upper() not in ("WHERE", "LIMIT"):
+            q.alias = toks[i].lower()
+            i += 1
+    # WHERE
+    if i < len(toks) and toks[i].upper() == "WHERE":
+        i += 1
+        while i < len(toks) and toks[i].upper() != "LIMIT":
+            t = toks[i].upper()
+            if t in ("AND", "OR"):
+                q.conditions.append(t)
+                i += 1
+                continue
+            col = toks[i]
+            if i + 1 >= len(toks):
+                raise SQLError("dangling predicate")
+            op = toks[i + 1].upper()
+            if op == "IS":
+                neg = toks[i + 2].upper() == "NOT"
+                k = i + 3 if neg else i + 2
+                if toks[k].upper() != "NULL":
+                    raise SQLError("expected NULL")
+                q.conditions.append(Condition(col, "IS NOT NULL" if neg else "IS NULL", None))
+                i = k + 1
+                continue
+            if op == "LIKE":
+                val = toks[i + 2]
+                q.conditions.append(Condition(col, "LIKE", _literal(val)))
+                i += 3
+                continue
+            if op not in ("=", "!=", "<>", "<", "<=", ">", ">="):
+                raise SQLError(f"unsupported operator {op}")
+            q.conditions.append(Condition(col, op, _literal(toks[i + 2])))
+            i += 3
+    if i < len(toks) and toks[i].upper() == "LIMIT":
+        q.limit = int(toks[i + 1])
+        i += 2
+    return q
+
+
+def _literal(tok: str):
+    if tok.startswith("'"):
+        return tok[1:-1].replace("''", "'")
+    try:
+        return float(tok)
+    except ValueError:
+        raise SQLError(f"bad literal {tok!r}") from None
+
+
+def _col_key(col: str, alias: str) -> str:
+    c = col
+    if c.lower().startswith(alias + "."):
+        c = c[len(alias) + 1 :]
+    if c.lower().startswith("s3object."):
+        c = c[len("s3object.") :]
+    return c
+
+
+def _get(record: dict, col: str, alias: str):
+    key = _col_key(col, alias)
+    if key in record:
+        return record[key]
+    # case-insensitive fallback
+    lk = key.lower()
+    for k, v in record.items():
+        if k.lower() == lk:
+            return v
+    return None
+
+
+def _cmp(v, op: str, want) -> bool:
+    if op == "IS NULL":
+        return v is None or v == ""
+    if op == "IS NOT NULL":
+        return v is not None and v != ""
+    if v is None:
+        return False
+    if isinstance(want, float):
+        try:
+            v = float(v)
+        except (TypeError, ValueError):
+            return False
+    else:
+        v = str(v)
+    if op == "=":
+        return v == want
+    if op in ("!=", "<>"):
+        return v != want
+    if op == "<":
+        return v < want
+    if op == "<=":
+        return v <= want
+    if op == ">":
+        return v > want
+    if op == ">=":
+        return v >= want
+    if op == "LIKE":
+        pat = re.escape(str(want)).replace("%", ".*").replace("_", ".")
+        return re.fullmatch(pat, str(v)) is not None
+    return False
+
+
+def _match(q: Query, record: dict) -> bool:
+    if not q.conditions:
+        return True
+    result = None
+    pending_op = "AND"
+    for item in q.conditions:
+        if isinstance(item, str):
+            pending_op = item
+            continue
+        ok = _cmp(_get(record, item.column, q.alias), item.op, item.value)
+        if result is None:
+            result = ok
+        elif pending_op == "AND":
+            result = result and ok
+        else:
+            result = result or ok
+    return bool(result)
+
+
+def execute(q: Query, records) -> tuple[list[dict], dict | None]:
+    """(projected rows, aggregate row|None)."""
+    out: list[dict] = []
+    agg_state = {i: {"count": 0, "sum": 0.0, "min": None, "max": None}
+                 for i in range(len(q.aggregates))}
+    matched = 0
+    for rec in records:
+        if not _match(q, rec):
+            continue
+        matched += 1
+        if q.aggregates:
+            for i, (fn, col) in enumerate(q.aggregates):
+                st = agg_state[i]
+                if fn == "COUNT":
+                    st["count"] += 1
+                    continue
+                v = _get(rec, col, q.alias)
+                try:
+                    x = float(v)
+                except (TypeError, ValueError):
+                    continue
+                st["count"] += 1
+                st["sum"] += x
+                st["min"] = x if st["min"] is None else min(st["min"], x)
+                st["max"] = x if st["max"] is None else max(st["max"], x)
+            continue
+        if 0 <= q.limit <= len(out):
+            break
+        if q.columns:
+            out.append({ _col_key(c, q.alias): _get(rec, c, q.alias) for c in q.columns })
+        else:
+            out.append(dict(rec))
+        if 0 <= q.limit <= len(out):
+            break
+    if q.aggregates:
+        row = {}
+        for i, (fn, col) in enumerate(q.aggregates):
+            st = agg_state[i]
+            name = f"{fn.lower()}" if len(q.aggregates) == 1 else f"{fn.lower()}_{i}"
+            if fn == "COUNT":
+                row[name] = st["count"]
+            elif fn == "SUM":
+                row[name] = st["sum"]
+            elif fn == "AVG":
+                row[name] = st["sum"] / st["count"] if st["count"] else None
+            elif fn == "MIN":
+                row[name] = st["min"]
+            elif fn == "MAX":
+                row[name] = st["max"]
+        return [], row
+    return out, None

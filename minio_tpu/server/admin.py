@@ -264,7 +264,9 @@ async def _stream_trace(server, request: web.Request) -> web.StreamResponse:
     try:
         while True:
             try:
-                rec = await loop.run_in_executor(None, q.get, True, 1.0)
+                rec = await loop.run_in_executor(
+                    server._longpoll_pool, q.get, True, 1.0
+                )
             except _queue.Empty:
                 continue
             await resp.write(json.dumps(rec).encode() + b"\n")

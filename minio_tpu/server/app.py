@@ -65,6 +65,8 @@ def _route_action(m: str, bucket: str, key: str, q, headers) -> tuple[str, str, 
                 return "s3:DeleteObjectVersion", bucket, key
             return "s3:DeleteObject", bucket, key
         if m == "POST":
+            if "select" in q:
+                return "s3:GetObject", bucket, key  # Select is a READ
             return "s3:PutObject", bucket, key
         return "s3:*", bucket, key
     # bucket level
@@ -92,6 +94,20 @@ def _route_conditions(q) -> dict[str, str]:
     return {"s3:prefix": q.get("prefix", ""), "s3:delimiter": q.get("delimiter", "")}
 
 
+def _bucket_sse_algo(encryption_xml: str | None) -> str | None:
+    """SSEAlgorithm from a bucket's default-encryption config XML."""
+    if not encryption_xml:
+        return None
+    try:
+        root = ET.fromstring(encryption_xml)
+        for el in root.iter():
+            if el.tag.endswith("SSEAlgorithm"):
+                return el.text or None
+    except ET.ParseError:
+        return None
+    return None
+
+
 def _iso8601(ns: int) -> str:
     return datetime.fromtimestamp(ns / 1e9, tz=timezone.utc).strftime(
         "%Y-%m-%dT%H:%M:%S.%f"
@@ -108,9 +124,16 @@ class S3Server:
     def __init__(self, store=None, region: str = "us-east-1"):
         import time as _time
 
+        from ..crypto.sse import KMS
         from .metrics import Metrics, TracePubSub
 
+        from concurrent.futures import ThreadPoolExecutor as _TPE
+
+        self.kms = KMS()
         self.store = None
+        # long-poll waits (trace/listen subscribers) get their own pool so
+        # they can never starve the default executor that serves store I/O
+        self._longpoll_pool = _TPE(max_workers=64, thread_name_prefix="longpoll")
         self.region = region
         self.started_at = _time.time()
         self.metrics = Metrics()
@@ -140,12 +163,17 @@ class S3Server:
         # fine — missing documents load as empty)
         self.iam.load()
         self.verifier = signature.SigV4Verifier(self.iam.lookup_secret, self.region)
+        from ..events.notify import EventNotifier
+
+        self.notifier = EventNotifier(self.buckets)
         self.store = store
         # background durability plane: scanner + MRF heal workers
         from ..erasure.background import BackgroundOps
 
         interval = float(os.environ.get("MINIO_TPU_SCAN_INTERVAL", "300"))
-        self.background = BackgroundOps(store, scan_interval=interval)
+        self.background = BackgroundOps(
+            store, scan_interval=interval, bucket_meta=self.buckets
+        )
         for p in getattr(store, "pools", [store]):
             for s in getattr(p, "sets", [p]):
                 s.on_degraded = self.background.mrf.add
@@ -316,7 +344,16 @@ class S3Server:
         q = request.rel_url.query
         m = request.method
 
-        # admin + STS planes
+        # admin + STS + KMS planes
+        if bucket == "minio" and key.startswith("kms/"):
+            if not ak or not self.iam.is_allowed(ak, "kms:Status", ""):
+                raise s3err.AccessDenied
+            import json as _json
+
+            return web.Response(
+                body=_json.dumps(self.kms.status()).encode(),
+                content_type="application/json",
+            )
         if bucket == "minio" and key.startswith("admin/"):
             from .admin import handle_admin
 
@@ -371,6 +408,8 @@ class S3Server:
             if m == "HEAD":
                 return await self.head_bucket(request, bucket)
             if m == "GET":
+                if "events" in q:  # MinIO listen-notification extension
+                    return await self.listen_events(request, bucket)
                 if "location" in q:
                     return await self.get_bucket_location(request, bucket)
                 if "versioning" in q:
@@ -421,6 +460,8 @@ class S3Server:
                 return await self.new_multipart(request, bucket, key)
             if "uploadId" in q:
                 return await self.complete_multipart(request, bucket, key, body)
+            if "select" in q and q.get("select-type") == "2":
+                return await self.select_object_content(request, bucket, key, body)
         raise s3err.MethodNotAllowed
 
     # -- service -------------------------------------------------------------
@@ -529,10 +570,57 @@ class S3Server:
         return web.Response(body=val.encode() if isinstance(val, str) else val,
                             content_type="application/xml")
 
+    async def listen_events(self, request, bucket: str) -> web.StreamResponse:
+        """Real-time event firehose (reference
+        cmd/listen-notification-handlers.go)."""
+        import asyncio as _asyncio
+        import json as _json
+        import queue as _queue
+
+        q = request.rel_url.query
+        events = [e for e in q.get("events", "").split(",") if e]
+        ent = self.notifier.subscribe(
+            bucket, q.get("prefix", ""), q.get("suffix", ""), events
+        )
+        resp = web.StreamResponse(headers={"Content-Type": "application/json"})
+        await resp.prepare(request)
+        loop = _asyncio.get_running_loop()
+        try:
+            while True:
+                try:
+                    rec = await loop.run_in_executor(
+                        self._longpoll_pool, ent[0].get, True, 1.0
+                    )
+                except _queue.Empty:
+                    await resp.write(b" \n")  # keep-alive, like the reference
+                    continue
+                await resp.write(
+                    _json.dumps({"Records": [rec]}).encode() + b"\n"
+                )
+        except (ConnectionResetError, _asyncio.CancelledError):
+            pass
+        finally:
+            self.notifier.unsubscribe(ent)
+        return resp
+
     async def put_bucket_simple(self, request, bucket, attr, body: bytes) -> web.Response:
         if not await self._run(self.store.bucket_exists, bucket):
             raise s3err.NoSuchBucket
         bm = self.buckets.get(bucket)
+        if attr == "notification":
+            try:
+                self.notifier.validate_config(body.decode())
+            except ValueError:
+                raise s3err.InvalidArgument from None
+            except ET.ParseError:
+                raise s3err.MalformedXML from None
+        if attr == "lifecycle":
+            from ..ilm.lifecycle import validate_lifecycle
+
+            try:
+                validate_lifecycle(body.decode())
+            except (ValueError, ET.ParseError):
+                raise s3err.MalformedXML from None
         if attr == "policy":
             import json
 
@@ -655,6 +743,8 @@ class S3Server:
     # -- objects ---------------------------------------------------------------
 
     def _obj_headers(self, oi: ObjectInfo) -> dict[str, str]:
+        from ..crypto import sse as ssemod
+
         h = {
             "ETag": f'"{oi.etag}"',
             "Last-Modified": _http_date(oi.mod_time),
@@ -666,6 +756,19 @@ class S3Server:
         for k, v in oi.user_defined.items():
             if k.startswith("x-amz-meta-") or k in ("cache-control", "content-disposition", "content-encoding", "content-language", "expires"):
                 h[k] = v
+        algo = oi.user_defined.get(ssemod.META_ALGO)
+        if algo == "SSE-S3":
+            h["x-amz-server-side-encryption"] = "AES256"
+        elif algo == "SSE-KMS":
+            h["x-amz-server-side-encryption"] = "aws:kms"
+            h["x-amz-server-side-encryption-aws-kms-key-id"] = oi.user_defined.get(
+                ssemod.META_KMS_KEY_ID, ""
+            )
+        elif algo == "SSE-C":
+            h["x-amz-server-side-encryption-customer-algorithm"] = "AES256"
+            h["x-amz-server-side-encryption-customer-key-MD5"] = oi.user_defined.get(
+                ssemod.META_SSEC_KEY_MD5, ""
+            )
         return h
 
     def _check_preconditions(self, request, oi: ObjectInfo) -> None:
@@ -713,6 +816,24 @@ class S3Server:
             ):
                 user_defined[lk] = v
         bm = self.buckets.get(bucket)
+        # transparent compression + server-side encryption
+        from . import transforms
+
+        req_headers = {k.lower(): v for k, v in request.headers.items()}
+        try:
+            tr = transforms.encode_for_store(
+                body, key, ct or "", req_headers,
+                _bucket_sse_algo(bm.encryption), self.kms, bucket,
+            )
+        except Exception as e:
+            from ..crypto.sse import CryptoError
+
+            if isinstance(e, CryptoError):
+                raise s3err.InvalidArgument from None
+            raise
+        if tr.metadata:
+            user_defined.update(tr.metadata)
+            body = tr.data
         oi = await self._run(
             self.store.put_object,
             bucket,
@@ -723,8 +844,15 @@ class S3Server:
             bm.versioning,
         )
         headers = {"ETag": f'"{oi.etag}"'}
+        headers.update(tr.response_headers)
         if oi.version_id:
             headers["x-amz-version-id"] = oi.version_id
+        from ..events import notify as ev
+
+        self.notifier.notify(
+            ev.OBJECT_CREATED_PUT, bucket, listing.decode_dir_object(key),
+            oi.size, oi.etag, oi.version_id, request.get("access_key", ""),
+        )
         return web.Response(status=200, headers=headers)
 
     def _parse_copy_source(self, request, access_key: str) -> tuple[str, str, str]:
@@ -748,6 +876,9 @@ class S3Server:
         return src_bucket, src_key, src_vid
 
     async def copy_object(self, request, bucket: str, key: str) -> web.Response:
+        from ..crypto.sse import CryptoError
+        from . import transforms
+
         src_bucket, src_key, src_vid = self._parse_copy_source(
             request, request.get("access_key", "")
         )
@@ -755,8 +886,34 @@ class S3Server:
             self.store.get_object, src_bucket, src_key, src_vid
         )
         data = b"".join(it)
+        req_headers = {k.lower(): v for k, v in request.headers.items()}
+        # decode the SOURCE pipeline: sealed keys are bound to the source
+        # bucket/key context and must never be copied verbatim
+        if transforms.is_transformed(oi.user_defined):
+            src_headers = dict(req_headers)
+            # SSE-C sources present their key under the copy-source header set
+            from ..crypto import sse as ssemod
+
+            for h in ("algorithm", "key", "key-md5"):
+                v = req_headers.get(
+                    f"x-amz-copy-source-server-side-encryption-customer-{h}"
+                )
+                if v:
+                    src_headers[
+                        f"x-amz-server-side-encryption-customer-{h}"
+                    ] = v
+            try:
+                data = await self._run(
+                    transforms.decode_full, data, oi.user_defined, src_headers,
+                    src_bucket, src_key, self.kms,
+                )
+            except CryptoError:
+                raise s3err.AccessDenied from None
         directive = request.headers.get("x-amz-metadata-directive", "COPY")
-        user_defined = dict(oi.user_defined)
+        user_defined = {
+            k: v for k, v in oi.user_defined.items()
+            if not k.startswith("x-minio-internal-")
+        }
         user_defined["content-type"] = oi.content_type
         if directive == "REPLACE":
             user_defined = {
@@ -767,6 +924,17 @@ class S3Server:
             if request.headers.get("Content-Type"):
                 user_defined["content-type"] = request.headers["Content-Type"]
         bm = self.buckets.get(bucket)
+        # re-encode for the destination (its SSE headers / bucket default)
+        try:
+            tr = transforms.encode_for_store(
+                data, key, user_defined.get("content-type", ""), req_headers,
+                _bucket_sse_algo(bm.encryption), self.kms, bucket,
+            )
+        except CryptoError:
+            raise s3err.InvalidArgument from None
+        if tr.metadata:
+            user_defined.update(tr.metadata)
+            data = tr.data
         new_oi = await self._run(
             self.store.put_object,
             bucket,
@@ -784,6 +952,12 @@ class S3Server:
         headers = {}
         if new_oi.version_id:
             headers["x-amz-version-id"] = new_oi.version_id
+        from ..events import notify as ev
+
+        self.notifier.notify(
+            ev.OBJECT_CREATED_COPY, bucket, listing.decode_dir_object(key),
+            new_oi.size, new_oi.etag, new_oi.version_id,
+        )
         return web.Response(body=xml.encode(), content_type="application/xml", headers=headers)
 
     def _parse_range(self, request, size: int) -> tuple[int, int] | None:
@@ -816,6 +990,10 @@ class S3Server:
         if vid == "null":
             vid = ""
         oi, handle = await self._run(self.store.open_object, bucket, key, vid)
+        from . import transforms
+
+        if transforms.is_transformed(oi.user_defined):
+            return await self._get_transformed(request, bucket, key, oi, handle)
         try:
             self._check_preconditions(request, oi)
             rng = self._parse_range(request, oi.size) if oi.size else None
@@ -848,6 +1026,46 @@ class S3Server:
         await resp.write_eof()
         return resp
 
+    async def _get_transformed(self, request, bucket, key, oi, handle) -> web.Response:
+        """GET for compressed/encrypted objects: decode through the
+        transform pipeline (ranges map to packets for SSE-only)."""
+        from ..crypto.sse import CryptoError
+        from . import transforms
+
+        try:
+            self._check_preconditions(request, oi)
+            logical = transforms.logical_size(oi.user_defined, oi.size)
+            rng = self._parse_range(request, logical) if logical else None
+            req_headers = {k.lower(): v for k, v in request.headers.items()}
+
+            def read_fn(off, ln):
+                return b"".join(handle.read(off, ln))
+
+            def decode():
+                if rng:
+                    start, end = rng
+                    return transforms.decode_range(
+                        read_fn, oi.size, oi.user_defined, req_headers,
+                        bucket, key, self.kms, start, end - start + 1,
+                    )
+                return transforms.decode_full(
+                    read_fn(0, oi.size), oi.user_defined, req_headers,
+                    bucket, key, self.kms,
+                )
+
+            try:
+                data = await self._run(decode)
+            except CryptoError:
+                raise s3err.AccessDenied from None
+            headers = self._obj_headers(oi)
+            if rng:
+                start, end = rng
+                headers["Content-Range"] = f"bytes {start}-{end}/{logical}"
+                return web.Response(status=206, headers=headers, body=data)
+            return web.Response(status=200, headers=headers, body=data)
+        finally:
+            handle.close()
+
     async def head_object(self, request, bucket: str, key: str) -> web.Response:
         key = listing.encode_dir_object(key)
         vid = request.rel_url.query.get("versionId", "")
@@ -857,8 +1075,10 @@ class S3Server:
         if oi.delete_marker:
             return web.Response(status=405, headers={"x-amz-delete-marker": "true"})
         self._check_preconditions(request, oi)
+        from . import transforms
+
         headers = self._obj_headers(oi)
-        headers["Content-Length"] = str(oi.size)
+        headers["Content-Length"] = str(transforms.logical_size(oi.user_defined, oi.size))
         return web.Response(status=200, headers=headers)
 
     async def delete_object(self, request, bucket: str, key: str) -> web.Response:
@@ -876,6 +1096,13 @@ class S3Server:
                 headers["x-amz-delete-marker"] = "true"
             if oi.version_id:
                 headers["x-amz-version-id"] = oi.version_id
+            from ..events import notify as ev
+
+            self.notifier.notify(
+                ev.OBJECT_REMOVED_MARKER if oi.delete_marker else ev.OBJECT_REMOVED_DELETE,
+                bucket, listing.decode_dir_object(key),
+                version_id=oi.version_id, user=request.get("access_key", ""),
+            )
         except (quorum.ObjectNotFound, quorum.VersionNotFound):
             pass  # S3 deletes are idempotent
         return web.Response(status=204, headers=headers)
@@ -941,6 +1168,16 @@ class S3Server:
     # -- multipart -------------------------------------------------------------
 
     async def new_multipart(self, request, bucket, key) -> web.Response:
+        # encryption for multipart needs per-part packet sequencing that the
+        # transform pipeline doesn't implement yet — refuse loudly rather
+        # than silently storing plaintext against the bucket's policy
+        bm = self.buckets.get(bucket)
+        if (
+            request.headers.get("x-amz-server-side-encryption")
+            or request.headers.get("x-amz-server-side-encryption-customer-algorithm")
+            or _bucket_sse_algo(bm.encryption)
+        ):
+            raise s3err.NotImplemented_
         key = listing.encode_dir_object(key)
         user_defined = {}
         if request.headers.get("Content-Type"):
@@ -1064,6 +1301,12 @@ class S3Server:
         headers = {}
         if oi.version_id:
             headers["x-amz-version-id"] = oi.version_id
+        from ..events import notify as ev
+
+        self.notifier.notify(
+            ev.OBJECT_CREATED_MULTIPART, bucket, listing.decode_dir_object(key),
+            oi.size, oi.etag, oi.version_id, request.get("access_key", ""),
+        )
         return web.Response(body=xml.encode(), content_type="application/xml", headers=headers)
 
     async def abort_multipart(self, request, bucket, key) -> web.Response:
@@ -1129,6 +1372,36 @@ class S3Server:
                     )
             return web.Response(status=200)
         return web.Response(status=404)
+
+    async def select_object_content(self, request, bucket, key, body) -> web.Response:
+        """SelectObjectContent: SQL over CSV/JSON objects
+        (reference cmd/object-handlers.go:105 + internal/s3select)."""
+        from ..s3select import engine
+        from . import transforms
+
+        key = listing.encode_dir_object(key)
+        oi, handle = await self._run(self.store.open_object, bucket, key, "")
+        try:
+            req_headers = {k.lower(): v for k, v in request.headers.items()}
+
+            def load() -> bytes:
+                raw = b"".join(handle.read())
+                if transforms.is_transformed(oi.user_defined):
+                    return transforms.decode_full(
+                        raw, oi.user_defined, req_headers, bucket, key, self.kms
+                    )
+                return raw
+
+            data = await self._run(load)
+        finally:
+            handle.close()
+        try:
+            stream = await self._run(engine.run_select, body, data)
+        except engine.SelectError:
+            raise s3err.InvalidArgument from None
+        return web.Response(
+            body=stream, content_type="application/octet-stream"
+        )
 
     # -- admin helpers ---------------------------------------------------------
 

@@ -1,0 +1,207 @@
+"""Event notifications + ILM lifecycle (reference: cmd/event-notification.go,
+internal/event, internal/bucket/lifecycle, cmd/data-scanner.go ILM)."""
+
+import json
+import os
+import time
+
+os.environ.setdefault("MINIO_TPU_BACKEND", "numpy")
+os.environ.setdefault("MINIO_TPU_SCAN_INTERVAL", "0")
+
+import threading
+
+import pytest
+
+from minio_tpu.client import S3Client
+from minio_tpu.events import notify as ev
+from minio_tpu.ilm import lifecycle as ilm
+from tests.test_s3_api import ServerThread, _free_port
+
+
+# -- pure unit ----------------------------------------------------------------
+
+def test_notification_config_parse_and_match():
+    xml = """<NotificationConfiguration>
+      <QueueConfiguration>
+        <Queue>arn:minio:sqs::hook1:webhook</Queue>
+        <Event>s3:ObjectCreated:*</Event>
+        <Filter><S3Key>
+          <FilterRule><Name>prefix</Name><Value>img/</Value></FilterRule>
+          <FilterRule><Name>suffix</Name><Value>.jpg</Value></FilterRule>
+        </S3Key></Filter>
+      </QueueConfiguration>
+    </NotificationConfiguration>"""
+    rules = ev.parse_notification_config(xml)
+    assert len(rules) == 1
+    r = rules[0]
+    assert r.arn == "arn:minio:sqs::hook1:webhook"
+    assert r.matches("s3:ObjectCreated:Put", "img/cat.jpg")
+    assert not r.matches("s3:ObjectCreated:Put", "img/cat.png")
+    assert not r.matches("s3:ObjectRemoved:Delete", "img/cat.jpg")
+
+
+def test_lifecycle_eval():
+    xml = """<LifecycleConfiguration>
+      <Rule><ID>old</ID><Status>Enabled</Status>
+        <Filter><Prefix>tmp/</Prefix></Filter>
+        <Expiration><Days>7</Days></Expiration>
+        <NoncurrentVersionExpiration><NoncurrentDays>3</NoncurrentDays></NoncurrentVersionExpiration>
+      </Rule>
+    </LifecycleConfiguration>"""
+    rules = ilm.parse_lifecycle(xml)
+    now = time.time()
+    old = ilm.ObjectState("tmp/x", int((now - 8 * ilm.DAY) * 1e9), True, False)
+    fresh = ilm.ObjectState("tmp/y", int((now - 1 * ilm.DAY) * 1e9), True, False)
+    other = ilm.ObjectState("keep/z", int((now - 90 * ilm.DAY) * 1e9), True, False)
+    assert ilm.eval_action(rules, old, now) == ilm.ACTION_DELETE
+    assert ilm.eval_action(rules, fresh, now) == ilm.ACTION_NONE
+    assert ilm.eval_action(rules, other, now) == ilm.ACTION_NONE
+    noncurrent = ilm.ObjectState(
+        "tmp/x", int((now - 10 * ilm.DAY) * 1e9), False, False,
+        successor_mod_time_ns=int((now - 5 * ilm.DAY) * 1e9),
+    )
+    assert ilm.eval_action(rules, noncurrent, now) == ilm.ACTION_DELETE_VERSION
+
+
+# -- server-level -------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def hook():
+    """In-process webhook receiver."""
+    import http.server
+
+    received = []
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            received.append(json.loads(self.rfile.read(n)))
+            self.send_response(200)
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    port = _free_port()
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", port), H)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    yield {"port": port, "received": received}
+    httpd.shutdown()
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory, hook):
+    os.environ["MINIO_NOTIFY_WEBHOOK_ENABLE_HOOK1"] = "on"
+    os.environ["MINIO_NOTIFY_WEBHOOK_ENDPOINT_HOOK1"] = (
+        f"http://127.0.0.1:{hook['port']}/events"
+    )
+    base = tmp_path_factory.mktemp("ev-drives")
+    st = ServerThread([str(base / f"d{i}") for i in range(4)])
+    yield st
+    st.stop()
+    os.environ.pop("MINIO_NOTIFY_WEBHOOK_ENABLE_HOOK1", None)
+    os.environ.pop("MINIO_NOTIFY_WEBHOOK_ENDPOINT_HOOK1", None)
+
+
+@pytest.fixture(scope="module")
+def cli(server):
+    c = S3Client(f"127.0.0.1:{server.port}")
+    c.make_bucket("evb")
+    return c
+
+
+def test_webhook_delivery(cli, hook):
+    cfg = """<NotificationConfiguration>
+      <QueueConfiguration>
+        <Queue>arn:minio:sqs::hook1:webhook</Queue>
+        <Event>s3:ObjectCreated:*</Event>
+        <Event>s3:ObjectRemoved:*</Event>
+      </QueueConfiguration>
+    </NotificationConfiguration>"""
+    r = cli.request("PUT", "/evb", query={"notification": ""}, body=cfg.encode())
+    assert r.status == 200, r.body
+    cli.put_object("evb", "pics/a.jpg", b"jpegdata")
+    cli.delete_object("evb", "pics/a.jpg")
+    deadline = time.time() + 10
+    while time.time() < deadline and len(hook["received"]) < 2:
+        time.sleep(0.1)
+    names = [rec["EventName"] for rec in hook["received"]]
+    assert "s3:ObjectCreated:Put" in names and "s3:ObjectRemoved:Delete" in names
+    rec = hook["received"][0]["Records"][0]
+    assert rec["s3"]["bucket"]["name"] == "evb"
+    assert rec["s3"]["object"]["key"] == "pics/a.jpg"
+
+
+def test_unknown_target_rejected(cli):
+    cfg = """<NotificationConfiguration><QueueConfiguration>
+      <Queue>arn:minio:sqs::nope:webhook</Queue>
+      <Event>s3:ObjectCreated:*</Event>
+    </QueueConfiguration></NotificationConfiguration>"""
+    r = cli.request("PUT", "/evb", query={"notification": ""}, body=cfg.encode())
+    assert r.status == 400
+
+
+def test_listen_api(cli, server):
+    import http.client
+
+    from minio_tpu.server.signature import sign_request
+
+    url = f"http://127.0.0.1:{server.port}/evb?events=s3:ObjectCreated:*"
+    q = {"events": "s3:ObjectCreated:*"}
+    headers = sign_request(
+        "GET", url, {}, b"", "minioadmin", "minioadmin"
+    )
+    conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=15)
+    conn.request("GET", "/evb?events=s3%3AObjectCreated%3A%2A", headers=headers)
+    resp = conn.getresponse()
+    assert resp.status == 200
+
+    def put_later():
+        time.sleep(0.3)
+        cli.put_object("evb", "live.txt", b"evt")
+
+    threading.Thread(target=put_later).start()
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        line = resp.readline().strip()
+        if line and line != b"":
+            rec = json.loads(line)
+            assert rec["Records"][0]["s3"]["object"]["key"] == "live.txt"
+            break
+    else:
+        raise AssertionError("no event received on listen stream")
+    conn.close()
+
+
+def test_ilm_expiry_applied_by_scanner(cli, server):
+    cli.make_bucket("ilmb")
+    cfg = """<LifecycleConfiguration><Rule>
+      <ID>exp</ID><Status>Enabled</Status>
+      <Filter><Prefix>tmp/</Prefix></Filter>
+      <Expiration><Days>1</Days></Expiration>
+    </Rule></LifecycleConfiguration>"""
+    assert cli.request("PUT", "/ilmb", query={"lifecycle": ""}, body=cfg.encode()).status == 200
+    cli.put_object("ilmb", "tmp/old.log", b"expired-data")
+    cli.put_object("ilmb", "keep/fresh.log", b"kept-data")
+    # age the object: rewind mod_time in every drive's xl.meta via storage API
+    from minio_tpu.storage.datatypes import FileInfo
+
+    store = server.srv.store
+    old_ns = int((time.time() - 3 * ilm.DAY) * 1e9)
+    for s in store.pools[0].sets:
+        for d in s.disks:
+            try:
+                fi = d.read_version("ilmb", "tmp/old.log", read_data=True)
+                fi.mod_time = old_ns
+                d.write_metadata("ilmb", "tmp/old.log", fi)
+            except Exception:
+                pass
+    server.srv.background.scan_once()
+    assert cli.get_object("ilmb", "tmp/old.log").status == 404
+    assert cli.get_object("ilmb", "keep/fresh.log").status == 200
+
+
+def test_bad_lifecycle_rejected(cli):
+    r = cli.request("PUT", "/evb", query={"lifecycle": ""}, body=b"<Lifecycle/>")
+    assert r.status == 400

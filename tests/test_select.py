@@ -1,0 +1,154 @@
+"""S3 Select: SQL subset, CSV/JSON readers, event-stream framing
+(reference: internal/s3select)."""
+
+import os
+
+os.environ.setdefault("MINIO_TPU_BACKEND", "numpy")
+os.environ.setdefault("MINIO_TPU_SCAN_INTERVAL", "0")
+
+import struct
+import zlib
+
+import pytest
+
+from minio_tpu.client import S3Client
+from minio_tpu.s3select import engine, sql
+from tests.test_s3_api import ServerThread
+
+CSV_DATA = b"name,age,city\nalice,31,oslo\nbob,25,paris\ncarol,42,oslo\n"
+JSON_DATA = b'{"name":"alice","age":31}\n{"name":"bob","age":25}\n'
+
+
+# -- unit ---------------------------------------------------------------------
+
+def test_sql_parse_and_execute():
+    q = sql.parse("SELECT name, age FROM S3Object s WHERE s.city = 'oslo' AND age > 32")
+    rows, _ = sql.execute(q, engine.read_csv(CSV_DATA, {"FileHeaderInfo": "USE"}))
+    assert rows == [{"name": "carol", "age": "42"}]
+
+
+def test_sql_aggregates():
+    q = sql.parse("SELECT COUNT(*) FROM S3Object")
+    _, agg = sql.execute(q, engine.read_csv(CSV_DATA, {"FileHeaderInfo": "USE"}))
+    assert agg == {"count": 3}
+    q = sql.parse("SELECT AVG(age) FROM S3Object WHERE city = 'oslo'")
+    _, agg = sql.execute(q, engine.read_csv(CSV_DATA, {"FileHeaderInfo": "USE"}))
+    assert agg["avg"] == pytest.approx((31 + 42) / 2)
+
+
+def test_sql_like_and_limit():
+    q = sql.parse("SELECT name FROM S3Object WHERE name LIKE 'a%' LIMIT 5")
+    rows, _ = sql.execute(q, engine.read_csv(CSV_DATA, {"FileHeaderInfo": "USE"}))
+    assert rows == [{"name": "alice"}]
+
+
+def test_json_lines():
+    q = sql.parse("SELECT name FROM S3Object WHERE age >= 30")
+    rows, _ = sql.execute(q, engine.read_json(JSON_DATA, {"Type": "LINES"}))
+    assert rows == [{"name": "alice"}]
+
+
+def _decode_stream(buf: bytes):
+    """Parse event-stream messages -> [(event_type, payload)]."""
+    out = []
+    off = 0
+    while off < len(buf):
+        total, hlen = struct.unpack_from(">II", buf, off)
+        pcrc = struct.unpack_from(">I", buf, off + 8)[0]
+        assert pcrc == zlib.crc32(buf[off : off + 8]) & 0xFFFFFFFF
+        headers = buf[off + 12 : off + 12 + hlen]
+        payload = buf[off + 12 + hlen : off + total - 4]
+        mcrc = struct.unpack_from(">I", buf, off + total - 4)[0]
+        assert mcrc == zlib.crc32(buf[off : off + total - 4]) & 0xFFFFFFFF
+        # extract :event-type
+        etype, ho = "", 0
+        while ho < len(headers):
+            klen = headers[ho]
+            kname = headers[ho + 1 : ho + 1 + klen].decode()
+            vlen = struct.unpack_from(">H", headers, ho + 2 + klen)[0]
+            val = headers[ho + 4 + klen : ho + 4 + klen + vlen].decode()
+            if kname == ":event-type":
+                etype = val
+            ho += 4 + klen + vlen
+        out.append((etype, payload))
+        off += total
+    return out
+
+
+def test_event_stream_framing():
+    stream = engine.run_select(
+        b"""<SelectObjectContentRequest>
+          <Expression>SELECT * FROM S3Object</Expression>
+          <InputSerialization><CSV><FileHeaderInfo>USE</FileHeaderInfo></CSV></InputSerialization>
+          <OutputSerialization><CSV/></OutputSerialization>
+        </SelectObjectContentRequest>""",
+        CSV_DATA,
+    )
+    msgs = _decode_stream(stream)
+    types = [t for t, _ in msgs]
+    assert types == ["Records", "Stats", "End"]
+    assert msgs[0][1] == b"alice,31,oslo\nbob,25,paris\ncarol,42,oslo\n"
+
+
+# -- server-level -------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    base = tmp_path_factory.mktemp("sel-drives")
+    st = ServerThread([str(base / f"d{i}") for i in range(4)])
+    yield st
+    st.stop()
+
+
+@pytest.fixture(scope="module")
+def cli(server):
+    c = S3Client(f"127.0.0.1:{server.port}")
+    c.make_bucket("selb")
+    return c
+
+
+def test_select_over_http(cli):
+    cli.put_object("selb", "people.csv", CSV_DATA)
+    req = b"""<SelectObjectContentRequest>
+      <Expression>SELECT name FROM S3Object WHERE city = 'oslo'</Expression>
+      <InputSerialization><CSV><FileHeaderInfo>USE</FileHeaderInfo></CSV></InputSerialization>
+      <OutputSerialization><JSON/></OutputSerialization>
+    </SelectObjectContentRequest>"""
+    r = cli.request(
+        "POST", "/selb/people.csv",
+        query={"select": "", "select-type": "2"}, body=req,
+    )
+    assert r.status == 200, r.body
+    msgs = _decode_stream(r.body)
+    records = b"".join(p for t, p in msgs if t == "Records")
+    assert records == b'{"name": "alice"}\n{"name": "carol"}\n'
+    assert msgs[-1][0] == "End"
+
+
+def test_select_bad_sql(cli):
+    req = b"""<SelectObjectContentRequest>
+      <Expression>DROP TABLE users</Expression>
+      <InputSerialization><CSV/></InputSerialization>
+      <OutputSerialization><CSV/></OutputSerialization>
+    </SelectObjectContentRequest>"""
+    r = cli.request(
+        "POST", "/selb/people.csv",
+        query={"select": "", "select-type": "2"}, body=req,
+    )
+    assert r.status == 400
+
+
+def test_select_limit_zero_and_truncated_query(cli):
+    req = b"""<SelectObjectContentRequest>
+      <Expression>SELECT * FROM S3Object LIMIT 0</Expression>
+      <InputSerialization><CSV><FileHeaderInfo>USE</FileHeaderInfo></CSV></InputSerialization>
+      <OutputSerialization><CSV/></OutputSerialization>
+    </SelectObjectContentRequest>"""
+    r = cli.request("POST", "/selb/people.csv",
+                    query={"select": "", "select-type": "2"}, body=req)
+    assert r.status == 200
+    assert not any(t == "Records" for t, _ in _decode_stream(r.body))
+    req = req.replace(b"SELECT * FROM S3Object LIMIT 0", b"SELECT * FROM S3Object LIMIT")
+    r = cli.request("POST", "/selb/people.csv",
+                    query={"select": "", "select-type": "2"}, body=req)
+    assert r.status == 400
