@@ -34,9 +34,15 @@ def verify_block(
     if len(buf) != DIGEST_SIZE + expect_len:
         raise errors.FileCorrupt("short shard block")
     digest, block = buf[:DIGEST_SIZE], buf[DIGEST_SIZE:]
-    h = algo.new()
-    h.update(block)
-    if h.digest() != digest:
+    if algo in (BitrotAlgorithm.HIGHWAYHASH256, BitrotAlgorithm.HIGHWAYHASH256S):
+        from ..ops.bitrot import fast_hash256
+
+        got = fast_hash256(block)
+    else:
+        h = algo.new()
+        h.update(block)
+        got = h.digest()
+    if got != digest:
         raise errors.FileCorrupt("bitrot detected")
     return block
 
@@ -66,12 +72,8 @@ def bitrot_verify_file(
         left = want_file_size
         while left > 0:
             n = min(shard_size, left)
-            digest = f.read(DIGEST_SIZE)
-            block = f.read(n)
-            if len(digest) != DIGEST_SIZE or len(block) != n:
+            buf = f.read(DIGEST_SIZE + n)
+            if len(buf) != DIGEST_SIZE + n:
                 raise errors.FileCorrupt("short read during verify")
-            h = algo.new()
-            h.update(block)
-            if h.digest() != digest:
-                raise errors.FileCorrupt("bitrot detected")
+            verify_block(buf, n, algo)
             left -= n

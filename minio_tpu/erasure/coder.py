@@ -60,6 +60,16 @@ class ErasureCoder:
     # -- encode ------------------------------------------------------------
 
     def _encode_block_np(self, block: bytes) -> tuple[np.ndarray, np.ndarray]:
+        from .. import native
+        from ..ops.highwayhash import MINIO_KEY
+
+        if native.available():
+            shards = self._np.split(block)
+            parity, digests = native.gf_encode_hash(
+                self._np.parity_matrix, shards[: self.d], MINIO_KEY
+            )
+            shards[self.d :] = parity
+            return shards, digests
         shards = self._np.encode_data(block)  # [t, per]
         digests = hash256_batch_numpy(shards)
         return shards, digests
@@ -72,12 +82,16 @@ class ErasureCoder:
             parity, digests = encode_and_hash(self._jax, blocks)
             shards = np.concatenate([blocks, np.asarray(parity)], axis=1)
             return shards, np.asarray(digests)
+        from ..ops.bitrot import fast_hash256_batch
+
         b = blocks.shape[0]
         shards = np.zeros((b, self.t, blocks.shape[2]), dtype=np.uint8)
         shards[:, : self.d] = blocks
         for i in range(b):
             shards[i, self.d :] = self._np.encode(shards[i].copy())[self.d :]
-        digests = hash256_batch_numpy(shards.reshape(b * self.t, -1)).reshape(b, self.t, 32)
+        digests = fast_hash256_batch(shards.reshape(b * self.t, -1)).reshape(
+            b, self.t, 32
+        )
         return shards, digests
 
     def encode_part(self, data: bytes) -> EncodedPart:

@@ -17,8 +17,7 @@ from typing import Callable, Iterator
 
 import numpy as np
 
-from ..ops.bitrot import DEFAULT_BITROT_ALGO
-from ..ops.highwayhash import hash256
+from ..ops.bitrot import DEFAULT_BITROT_ALGO, fast_hash256
 from ..storage import errors
 from ..storage.datatypes import (
     ChecksumInfo,
@@ -578,7 +577,7 @@ class ErasureSet:
                 rec = coder.reconstruct_block(got, per)
                 for idx, _ in stale:
                     blk = rec[idx].tobytes()
-                    rebuilt[idx] += hash256(blk)
+                    rebuilt[idx] += fast_hash256(blk)
                     rebuilt[idx] += blk
             per_part_rebuilt[part.number] = rebuilt
         healed = []

@@ -1,0 +1,126 @@
+"""Native CPU kernel bindings (ctypes over gfhash.cpp).
+
+Builds the shared library on first import (g++ -O3 -mavx2) and caches the
+.so next to the source; every entry point has a pure-Python fallback in
+ops/, so an environment without a toolchain still works (slower).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "gfhash.cpp")
+_SO = os.path.join(_HERE, "gfhash.so")
+
+_lib = None
+_lock = threading.Lock()
+_build_failed = False
+
+
+def _build() -> bool:
+    cmd = ["g++", "-O3", "-mavx2", "-shared", "-fPIC", _SRC, "-o", _SO + ".tmp"]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(_SO + ".tmp", _SO)
+        return True
+    except (subprocess.SubprocessError, OSError):
+        return False
+
+
+def _load():
+    global _lib, _build_failed
+    if _lib is not None or _build_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        if os.environ.get("MINIO_TPU_NO_NATIVE") == "1":
+            _build_failed = True
+            return None
+        try:
+            needs_build = (
+                not os.path.exists(_SO)
+                or os.path.getmtime(_SO) < os.path.getmtime(_SRC)
+            )
+            if needs_build and not _build():
+                _build_failed = True
+                return None
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            _build_failed = True
+            return None
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lib.gf_apply.argtypes = [u8p, ctypes.c_int, ctypes.c_int, u8p, u8p, ctypes.c_long]
+        lib.hh256.argtypes = [u8p, u8p, ctypes.c_long, u8p]
+        lib.hh256_batch.argtypes = [
+            u8p, u8p, ctypes.c_long, ctypes.c_long, ctypes.c_int, u8p,
+        ]
+        lib.gf_encode_hash.argtypes = [
+            u8p, ctypes.c_int, ctypes.c_int, u8p, u8p, ctypes.c_long, u8p, u8p,
+        ]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def gf_apply(mat: np.ndarray, data: np.ndarray) -> np.ndarray:
+    """out[r] = XOR_c mat[r,c] * data[c] over GF(2^8). data: [cols, n]."""
+    lib = _load()
+    mat = np.ascontiguousarray(mat, dtype=np.uint8)
+    data = np.ascontiguousarray(data, dtype=np.uint8)
+    rows, cols = mat.shape
+    n = data.shape[1]
+    out = np.empty((rows, n), dtype=np.uint8)
+    lib.gf_apply(_ptr(mat), rows, cols, _ptr(data), _ptr(out), n)
+    return out
+
+
+def hh256(key: bytes, data: bytes | np.ndarray) -> bytes:
+    lib = _load()
+    buf = np.frombuffer(data, dtype=np.uint8) if isinstance(data, (bytes, bytearray)) else np.ascontiguousarray(data, dtype=np.uint8)
+    out = np.empty(32, dtype=np.uint8)
+    karr = np.frombuffer(key, dtype=np.uint8)
+    lib.hh256(_ptr(karr), _ptr(buf), buf.size, _ptr(out))
+    return out.tobytes()
+
+
+def hh256_batch(key: bytes, blocks: np.ndarray) -> np.ndarray:
+    """[B, n] uint8 -> [B, 32] digests."""
+    lib = _load()
+    blocks = np.ascontiguousarray(blocks, dtype=np.uint8)
+    b, n = blocks.shape
+    out = np.empty((b, 32), dtype=np.uint8)
+    karr = np.frombuffer(key, dtype=np.uint8)
+    lib.hh256_batch(_ptr(karr), _ptr(blocks), n, n, b, _ptr(out))
+    return out
+
+
+def gf_encode_hash(
+    parity_mat: np.ndarray, data: np.ndarray, key: bytes
+) -> tuple[np.ndarray, np.ndarray]:
+    """Fused CPU encode+hash: data [d, n] -> (parity [p, n], digests [d+p, 32])."""
+    lib = _load()
+    parity_mat = np.ascontiguousarray(parity_mat, dtype=np.uint8)
+    data = np.ascontiguousarray(data, dtype=np.uint8)
+    p, d = parity_mat.shape
+    n = data.shape[1]
+    parity = np.empty((p, n), dtype=np.uint8)
+    digests = np.empty((d + p, 32), dtype=np.uint8)
+    karr = np.frombuffer(key, dtype=np.uint8)
+    lib.gf_encode_hash(
+        _ptr(parity_mat), p, d, _ptr(data), _ptr(parity), n, _ptr(karr), _ptr(digests)
+    )
+    return parity, digests

@@ -46,6 +46,31 @@ class BitrotAlgorithm(IntEnum):
 
 DEFAULT_BITROT_ALGO = BitrotAlgorithm.HIGHWAYHASH256S
 
+
+def fast_hash256(data: bytes | bytearray | memoryview) -> bytes:
+    """One-shot HighwayHash-256 with the MinIO key — native C++ when built,
+    pure Python otherwise. The hot digest on every read/verify/heal."""
+    from .. import native
+
+    if native.available():
+        return native.hh256(MINIO_KEY, bytes(data))
+    h = HighwayHash256(MINIO_KEY)
+    h.update(bytes(data))
+    return h.digest()
+
+
+def fast_hash256_batch(blocks) -> "object":
+    """[B, n] uint8 -> [B, 32] digests, native when available."""
+    import numpy as np
+
+    from .. import native
+    from .highwayhash import hash256_batch_numpy
+
+    blocks = np.ascontiguousarray(blocks, dtype=np.uint8)
+    if native.available():
+        return native.hh256_batch(MINIO_KEY, blocks)
+    return hash256_batch_numpy(blocks)
+
 _NAMES = {
     BitrotAlgorithm.SHA256: "sha256",
     BitrotAlgorithm.BLAKE2B512: "blake2b",
