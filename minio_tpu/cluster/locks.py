@@ -104,6 +104,23 @@ class LocalLocker:
                 del self._locks[resource]
             return True
 
+    def refresh(self, resource: str, uid: str) -> bool:
+        """Re-arm the TTL of a held lock (the reference's refreshLock loop
+        keeps long-held dsync locks alive the same way)."""
+        with self._mu:
+            e = self._locks.get(resource)
+            if not e:
+                return False
+            ok = False
+            if e["writer"] == uid:
+                e["wexp"] = time.monotonic() + LOCK_TTL
+                ok = True
+            if uid in e["readers"]:
+                c, _ = e["readers"][uid]
+                e["readers"][uid] = (c, time.monotonic() + LOCK_TTL)
+                ok = True
+            return ok
+
     def force_unlock(self, resource: str) -> bool:
         with self._mu:
             return self._locks.pop(resource, None) is not None
@@ -133,7 +150,7 @@ class LockRESTServer:
             ok = self.locker.stats()
         elif op == "force_unlock":
             ok = self.locker.force_unlock(args["resource"])
-        elif op in ("lock", "unlock", "rlock", "runlock"):
+        elif op in ("lock", "unlock", "rlock", "runlock", "refresh"):
             ok = getattr(self.locker, op)(args["resource"], args.get("uid", ""))
         else:
             return web.Response(status=404)
@@ -176,6 +193,9 @@ class _RemoteLocker:
 
     def runlock(self, r, u):
         return self._call("runlock", r, u)
+
+    def refresh(self, r, u):
+        return self._call("refresh", r, u)
 
 
 class DRWMutex:
@@ -238,6 +258,14 @@ class DRWMutex:
     def runlock(self) -> None:
         for lk in self.lockers:
             lk.runlock(self.resource, self.uid)
+
+    def refresh(self) -> None:
+        """Keep a long-held lock alive past the TTL."""
+        for lk in self.lockers:
+            try:
+                lk.refresh(self.resource, self.uid)
+            except Exception:  # noqa: BLE001
+                pass
 
 
 class NamespaceLock:

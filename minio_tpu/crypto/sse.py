@@ -43,7 +43,7 @@ class KMS:
     """Builtin single-master-key KMS (reference: MINIO_KMS_SECRET_KEY,
     internal/kms/secret-key.go). Key spec: 'name:base64(32 bytes)'."""
 
-    def __init__(self, key_spec: str | None = None):
+    def __init__(self, key_spec: str | None = None, store=None):
         spec = key_spec or os.environ.get("MINIO_KMS_SECRET_KEY", "")
         if spec and ":" in spec:
             name, b64 = spec.split(":", 1)
@@ -51,15 +51,33 @@ class KMS:
             if len(key) != 32:
                 raise CryptoError("KMS master key must be 32 bytes")
             self.key_id, self._master = name, key
+        elif store is not None:
+            # auto-generated master key persisted in the backend — NOT
+            # derived from credentials, so rotating root credentials can
+            # never brick encrypted objects (the reference's single-node
+            # KMS persists generated key material the same way)
+            self.key_id = "minio-tpu-auto-key"
+            self._master = self._load_or_create(store)
         else:
-            # derived default so SSE-S3 works out of the box (dev parity
-            # with the reference's auto-generated KMS in single-node mode)
-            root = os.environ.get("MINIO_ROOT_USER", "minioadmin")
-            pwd = os.environ.get("MINIO_ROOT_PASSWORD", "minioadmin")
-            self.key_id = "minio-tpu-default-key"
-            self._master = hashlib.sha256(
-                f"kms:{root}:{pwd}".encode()
-            ).digest()
+            # last-resort ephemeral key (tests / keyless library use)
+            self.key_id = "minio-tpu-ephemeral-key"
+            self._master = hashlib.sha256(b"minio-tpu-ephemeral").digest()
+
+    @staticmethod
+    def _load_or_create(store) -> bytes:
+        from ..erasure.quorum import ObjectNotFound
+
+        path = "config/kms/master-key"
+        try:
+            _, it = store.get_object(".minio.sys", path)
+            key = base64.b64decode(b"".join(it))
+            if len(key) == 32:
+                return key
+        except ObjectNotFound:
+            pass
+        key = secrets.token_bytes(32)
+        store.put_object(".minio.sys", path, base64.b64encode(key))
+        return key
 
     def generate_key(self, context: str) -> tuple[bytes, bytes]:
         """(plaintext_key, sealed_key) bound to a context string."""

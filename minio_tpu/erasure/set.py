@@ -86,6 +86,7 @@ class ErasureSet:
         self._coders: dict[tuple[int, int], ErasureCoder] = {}
         # read-path degradation hook (MRF heal-on-read, reference cmd/mrf.go)
         self.on_degraded = None
+        self._bucket_cache: dict[str, float] = {}
 
     # -- helpers -----------------------------------------------------------
 
@@ -119,6 +120,7 @@ class ErasureSet:
         reduce_quorum_errs(errs, self.n // 2 + 1, ignored=(errors.VolumeExists,))
 
     def delete_bucket(self, bucket: str, force: bool = False) -> None:
+        self._bucket_cache.pop(bucket, None)
         res = self._parallel(lambda d: d.delete_vol(bucket, force=force))
         errs = [e for _, e in res]
         for e in errs:
@@ -128,11 +130,24 @@ class ErasureSet:
                 raise BucketNotEmpty(bucket)
         reduce_quorum_errs(errs, self.n // 2 + 1, ignored=(errors.VolumeNotFound,))
 
+    _BUCKET_CACHE_TTL = 30.0
+
     def bucket_exists(self, bucket: str) -> bool:
         # read-quorum semantics: half the drives answering is enough to
-        # know the bucket exists (writes still enforce write quorum)
+        # know the bucket exists (writes still enforce write quorum).
+        # Positive answers cache briefly so the hot PUT path doesn't pay a
+        # stat fan-out per request (negatives never cache: another node may
+        # have just created the bucket).
+        import time as _time
+
+        hit = self._bucket_cache.get(bucket)
+        if hit is not None and _time.monotonic() - hit < self._BUCKET_CACHE_TTL:
+            return True
         res = self._parallel(lambda d: d.stat_vol(bucket))
-        return count_none([e for _, e in res]) >= max(self.n // 2, 1)
+        ok = count_none([e for _, e in res]) >= max(self.n // 2, 1)
+        if ok:
+            self._bucket_cache[bucket] = _time.monotonic()
+        return ok
 
     def list_buckets(self) -> list[BucketInfo]:
         for disk, (vols, err) in zip(self.disks, self._parallel(lambda d: d.list_vols())):
@@ -302,9 +317,9 @@ class ErasureSet:
             raise
         oi = self._to_object_info(bucket, obj, fi)
         # the read lock stays held while the handle streams (the reference
-        # holds GetObject's lock until the reader closes); the TTL backstops
-        # abandoned handles
-        return oi, ObjectHandle(self, bucket, obj, fi, metas, release=mtx.runlock)
+        # holds GetObject's lock until the reader closes) and is refreshed
+        # during long streams; the TTL backstops abandoned handles
+        return oi, ObjectHandle(self, bucket, obj, fi, metas, mutex=mtx)
 
     def get_object(
         self,
@@ -637,25 +652,30 @@ class ErasureSet:
 class ObjectHandle:
     """Resolved read handle: concrete set + quorum-picked version + per-drive
     metadata, holding the namespace read lock until closed. Constructing
-    reads is free; all I/O happens during iteration; the lock releases when
-    the last read() iterator finishes (or close() is called)."""
+    reads is free; all I/O happens during iteration; the lock is refreshed
+    during long streams and released when the last read() iterator finishes
+    (or close() is called)."""
+
+    _REFRESH_EVERY = 30.0  # seconds; well under the 120s lock TTL
 
     def __init__(
-        self, es: ErasureSet, bucket: str, obj: str, fi: FileInfo, metas, release=None
+        self, es: ErasureSet, bucket: str, obj: str, fi: FileInfo, metas, mutex=None
     ):
         self.es = es
         self.bucket = bucket
         self.obj = obj
         self.fi = fi
         self.metas = metas
-        self._release = release
+        self._mutex = mutex
 
     def close(self) -> None:
-        rel, self._release = self._release, None
-        if rel is not None:
-            rel()
+        mtx, self._mutex = self._mutex, None
+        if mtx is not None:
+            mtx.runlock()
 
     def read(self, offset: int = 0, length: int = -1) -> Iterator[bytes]:
+        import time as _time
+
         if length < 0:
             length = self.fi.size - offset
         if offset < 0 or offset + length > self.fi.size:
@@ -663,10 +683,16 @@ class ObjectHandle:
             raise ValueError("invalid range")
 
         def gen():
+            last_refresh = _time.monotonic()
             try:
-                yield from self.es._read_range(
+                for chunk in self.es._read_range(
                     self.bucket, self.obj, self.fi, self.metas, offset, length
-                )
+                ):
+                    now = _time.monotonic()
+                    if self._mutex is not None and now - last_refresh > self._REFRESH_EVERY:
+                        self._mutex.refresh()
+                        last_refresh = now
+                    yield chunk
             finally:
                 self.close()
 

@@ -141,6 +141,11 @@ async def handle_admin(server, request: web.Request, access_key: str, subpath: s
         except ValueError:
             raise s3err.InvalidArgument from None
         parent = d.get("targetUser") or access_key
+        # creating credentials for ANOTHER identity inherits that identity's
+        # privileges — only the cluster owner may do it (else any holder of
+        # admin:CreateServiceAccount could mint root-equivalent keys)
+        if parent != access_key and not iam.is_owner(access_key):
+            raise s3err.AccessDenied
         u = await server._run(
             iam.new_service_account,
             parent,

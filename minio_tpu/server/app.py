@@ -80,7 +80,7 @@ def _route_action(m: str, bucket: str, key: str, q, headers) -> tuple[str, str, 
     if m == "DELETE":
         return "s3:DeleteBucket", bucket, ""
     if m == "POST":
-        return "s3:DeleteObject", bucket, ""  # multi-delete
+        return "", bucket, ""  # multi-delete authorizes PER KEY in its handler
     if "versions" in q:
         return "s3:ListBucketVersions", bucket, ""
     if "location" in q:
@@ -163,9 +163,11 @@ class S3Server:
         # fine — missing documents load as empty)
         self.iam.load()
         self.verifier = signature.SigV4Verifier(self.iam.lookup_secret, self.region)
+        from ..crypto.sse import KMS
         from ..events.notify import EventNotifier
 
         self.notifier = EventNotifier(self.buckets)
+        self.kms = KMS(store=store)  # persisted auto-key unless env-provided
         self.store = store
         # background durability plane: scanner + MRF heal workers
         from ..erasure.background import BackgroundOps
@@ -297,6 +299,7 @@ class S3Server:
                 headers.get("x-amz-date", ""),
                 auth.scope,
                 self.iam.lookup_secret(ak) or "",
+                trailer_mode=content_sha == signature.STREAMING_PAYLOAD_TRAILER,
             )
         elif content_sha not in (signature.UNSIGNED_PAYLOAD,):
             if hashlib.sha256(body).hexdigest() != content_sha:
@@ -323,6 +326,8 @@ class S3Server:
         self, access_key: str, action: str, bucket: str, key: str = "",
         conditions: dict[str, str] | None = None,
     ) -> None:
+        if not action:
+            return  # handler performs its own per-key authorization
         resource = f"{bucket}/{key}" if key else bucket
         bucket_policy = None
         if bucket:
@@ -340,7 +345,9 @@ class S3Server:
         ak, body = await self._authenticate(request)
         request["access_key"] = ak
         bucket = request.match_info.get("bucket", "")
-        key = urllib.parse.unquote(request.match_info.get("key", ""))
+        # aiohttp match_info is already percent-decoded; decoding again
+        # would corrupt keys that legitimately contain %-sequences
+        key = request.match_info.get("key", "")
         q = request.rel_url.query
         m = request.method
 
@@ -1128,8 +1135,21 @@ class S3Server:
                         v = sub.text or ""
                 targets.append((k, v))
         bm = self.buckets.get(bucket)
+        ak = request.get("access_key", "")
         results = []
         for k, v in targets[:1000]:
+            # per-object authorization: a Deny on a key prefix must hold
+            # through multi-delete exactly as through single DELETE
+            try:
+                self._authorize(
+                    ak,
+                    "s3:DeleteObjectVersion" if v else "s3:DeleteObject",
+                    bucket,
+                    k,
+                )
+            except s3err.APIError:
+                results.append((k, v, s3err.AccessDenied, None))
+                continue
             try:
                 oi = await self._run(
                     self.store.delete_object,

@@ -48,6 +48,7 @@ def decode_signed_chunked(
     amz_date: str,
     scope: str,
     secret_key: str,
+    trailer_mode: bool = False,
 ) -> bytes:
     """Decode + verify STREAMING-AWS4-HMAC-SHA256-PAYLOAD bodies.
 
@@ -78,6 +79,11 @@ def decode_signed_chunked(
         chunk = body[pos : pos + size]
         if len(chunk) != size:
             raise s3err.IncompleteBody
+        if trailer_mode and size == 0 and not sig:
+            # trailer mode: the final 0-chunk carries no chunk-signature;
+            # integrity of the trailers rides x-amz-trailer-signature
+            # (content already chain-verified chunk by chunk)
+            return bytes(out)
         sts = "\n".join(
             [
                 f"{SIGN_V4_ALGORITHM}-PAYLOAD",

@@ -238,3 +238,44 @@ def test_policy_bracket_literal():
     }))
     assert p.is_allowed("s3:GetObject", "b/report[1].pdf") is False
     assert p.is_allowed("s3:GetObject", "b/report1.pdf") is None
+
+
+def test_multi_delete_per_key_authorization(admin, server):
+    # deny-on-prefix must hold through multi-delete
+    pol = {"Version": "2012-10-17", "Statement": [
+        {"Effect": "Allow", "Action": ["s3:DeleteObject", "s3:PutObject"],
+         "Resource": ["arn:aws:s3:::pub/*"]},
+        {"Effect": "Deny", "Action": ["s3:DeleteObject"],
+         "Resource": ["arn:aws:s3:::pub/protected/*"]}]}
+    admin.request("PUT", "/minio/admin/v3/add-canned-policy",
+                  query={"name": "del-guard"}, body=json.dumps(pol).encode())
+    admin.request("PUT", "/minio/admin/v3/add-user", query={"accessKey": "erin"},
+                  body=json.dumps({"secretKey": "erinsecret"}).encode())
+    admin.request("PUT", "/minio/admin/v3/set-user-or-group-policy",
+                  query={"policyName": "del-guard", "userOrGroup": "erin"})
+    admin.put_object("pub", "protected/keep.txt", b"keep")
+    admin.put_object("pub", "scratch.txt", b"scratch")
+    erin = S3Client(f"127.0.0.1:{server.port}", "erin", "erinsecret")
+    xml = (b"<Delete><Object><Key>protected/keep.txt</Key></Object>"
+           b"<Object><Key>scratch.txt</Key></Object></Delete>")
+    r = erin.request("POST", "/pub", query={"delete": ""}, body=xml)
+    assert r.status == 200
+    assert b"<Error><Key>protected/keep.txt</Key><Code>AccessDenied" in r.body
+    assert admin.get_object("pub", "protected/keep.txt").status == 200
+    assert admin.get_object("pub", "scratch.txt").status == 404
+
+
+def test_service_account_escalation_blocked(admin, server):
+    # non-owner with CreateServiceAccount must not mint creds for root
+    pol = {"Version": "2012-10-17", "Statement": [
+        {"Effect": "Allow", "Action": ["admin:CreateServiceAccount"], "Resource": []}]}
+    admin.request("PUT", "/minio/admin/v3/add-canned-policy",
+                  query={"name": "sa-only"}, body=json.dumps(pol).encode())
+    admin.request("PUT", "/minio/admin/v3/add-user", query={"accessKey": "mallory"},
+                  body=json.dumps({"secretKey": "mallorysecret"}).encode())
+    admin.request("PUT", "/minio/admin/v3/set-user-or-group-policy",
+                  query={"policyName": "sa-only", "userOrGroup": "mallory"})
+    mal = S3Client(f"127.0.0.1:{server.port}", "mallory", "mallorysecret")
+    r = mal.request("PUT", "/minio/admin/v3/add-service-account",
+                    body=json.dumps({"targetUser": "minioadmin"}).encode())
+    assert r.status == 403, r.body
