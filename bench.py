@@ -34,7 +34,7 @@ def main() -> None:
 
     d, p = 8, 8
     n = (1 << 20) // d  # 1 MiB stripe block -> 128 KiB shards
-    B = 64  # concurrent stripe blocks per dispatch (64 MiB of data)
+    B = 128  # concurrent stripe blocks per dispatch (2048 shard lanes)
     codec = get_tpu_codec(d, p)
     data = np.random.default_rng(0).integers(0, 256, size=(B, d, n), dtype=np.uint8)
     dd = jax.device_put(data)
@@ -54,7 +54,7 @@ def main() -> None:
     _ = int(checksum(out))
     sync_cost = time.perf_counter() - t0
 
-    iters = 30
+    iters = 20
     t0 = time.perf_counter()
     for _ in range(iters):
         out = fused(dd)

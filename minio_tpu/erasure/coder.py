@@ -75,13 +75,14 @@ class ErasureCoder:
         return shards, digests
 
     def _encode_full_blocks(self, blocks: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
-        """blocks: [B, d, shard_size] -> (shards [B, t, n], digests [B, t, 32])."""
-        if self._jax is not None:
-            from ..ops.bitrot_jax import encode_and_hash
+        """blocks: [B, d, shard_size] -> (shards [B, t, n], digests [B, t, 32]).
 
-            parity, digests = encode_and_hash(self._jax, blocks)
-            shards = np.concatenate([blocks, np.asarray(parity)], axis=1)
-            return shards, np.asarray(digests)
+        The device path goes through the batching dispatcher: blocks from
+        concurrent requests coalesce into one fused dispatch."""
+        if self._jax is not None:
+            from ..parallel.dispatcher import get_dispatcher
+
+            return get_dispatcher(self._jax, blocks.shape[2]).encode(blocks)
         from ..ops.bitrot import fast_hash256_batch
 
         b = blocks.shape[0]

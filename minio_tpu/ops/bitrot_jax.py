@@ -195,9 +195,17 @@ def hash256_blocks(blocks: jax.Array, key: bytes = MINIO_KEY) -> jax.Array:
     blocks = jnp.asarray(blocks, dtype=jnp.uint8)
     b, n = blocks.shape
     s = _init_state(b, key)
+    return _finish_from_state(s, blocks, 0, n)
+
+
+def _finish_from_state(s: "_St", blocks: jax.Array, done: int, n: int) -> jax.Array:
+    """Continue a hash from packet offset `done` bytes: remaining whole
+    packets (XLA scan), the tail packet, finalization, digest assembly.
+    Shared by the pure-XLA path (done=0) and the Pallas chain kernel."""
+    b = blocks.shape[0]
     whole = n - (n % 32)
-    if whole:
-        hi, lo = _load_packets(blocks[:, :whole])
+    if whole > done:
+        hi, lo = _load_packets(blocks[:, done:whole])
 
         def step(carry, x):
             xhi, xlo = x
@@ -274,10 +282,20 @@ def encode_and_hash(
     (/root/reference/cmd/erasure-encode.go:76-108 +
     /root/reference/cmd/bitrot-streaming.go:44-75).
     """
+    import os
+
     data = jnp.asarray(data, dtype=jnp.uint8)
     b, d, n = data.shape
     parity = codec.encode_blocks(data)
     shards = jnp.concatenate([data, parity], axis=1)  # [B, t, n]
     t = d + codec.parity_shards
-    digests = hash256_blocks(shards.reshape(b * t, n), key).reshape(b, t, 32)
+    hash_fn = hash256_blocks
+    if (
+        jax.default_backend() == "tpu"
+        and os.environ.get("MINIO_TPU_PALLAS", "1") != "0"
+    ):
+        from .bitrot_pallas import hash256_blocks_pallas
+
+        hash_fn = hash256_blocks_pallas
+    digests = hash_fn(shards.reshape(b * t, n), key).reshape(b, t, 32)
     return parity, digests

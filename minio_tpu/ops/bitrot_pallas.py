@@ -1,0 +1,119 @@
+"""Pallas TPU kernel for the HighwayHash packet chain.
+
+The XLA lax.scan pays per-step dispatch overhead on a chain of ~n/32
+sequential packet updates (ops/bitrot_jax.py). This kernel runs the whole
+chain inside one Pallas program: hash state lives in VMEM scratch that
+persists across the sequential TPU grid, each grid step consuming a chunk
+of packets with an inner fori_loop. Packet prep (byte->lane transpose) and
+tail/finalization stay in XLA where they're cheap one-offs.
+
+All arithmetic is uint32 (Mosaic legalizes 32-bit vector shifts/compares;
+8-bit shifts it does not — see rs_pallas.py)."""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .bitrot_jax import _St, _init_state, _load_packets, _update
+from .highwayhash import MINIO_KEY
+
+def _chunk_for(b: int) -> int:
+    """Packets per grid step, sized so hi+lo blocks stay ~4 MB of VMEM.
+
+    Per packet the blocks cost 2 (hi+lo) x 4 lanes x 8 sublanes x
+    max(b/8, 128) lanes x 4 bytes — the lane dim pads to 128."""
+    lane = max(b // 8, 128)
+    return max(8, min(512, (4 << 20) // (256 * lane)))
+
+
+def _chain_kernel(hi_ref, lo_ref, init_ref, out_ref, st_ref):
+    """Grid step: advance the hash state over CHUNK packets.
+
+    hi/lo: [CHUNK, 4, B] u32 packet lanes; init/out/st: [32, B] u32 state
+    (rows: v0h[0:4], v0l[4:8], v1h[8:12], v1l[12:16], m0h[16:20],
+    m0l[20:24], m1h[24:28], m1l[28:32])."""
+    step = pl.program_id(0)
+
+    @pl.when(step == 0)
+    def _init():
+        st_ref[:] = init_ref[:]
+
+    def body(k, state):
+        s = _St.of(tuple(state))
+        ahi = [hi_ref[k, i] for i in range(4)]
+        alo = [lo_ref[k, i] for i in range(4)]
+        s = _update(s, ahi, alo)
+        return tuple(s.tup())
+
+    # state rows are [8, B/8] 2-D tiles: fully-packed VREGs (a 1-D [B]
+    # vector would occupy one sublane of eight, wasting ~8x VPU issue)
+    state = tuple(st_ref[i] for i in range(32))
+    state = jax.lax.fori_loop(0, hi_ref.shape[0], body, state)
+    for i in range(32):
+        st_ref[i] = state[i]
+
+    @pl.when(step == pl.num_programs(0) - 1)
+    def _done():
+        out_ref[:] = st_ref[:]
+
+
+@functools.partial(jax.jit, static_argnames=("key",))
+def hash256_blocks_pallas(blocks: jax.Array, key: bytes = MINIO_KEY) -> jax.Array:
+    """[B, n] uint8 -> [B, 32] digests; packet chain runs in Pallas."""
+    from . import bitrot_jax as bj
+
+    blocks = jnp.asarray(blocks, dtype=jnp.uint8)
+    b, n = blocks.shape
+    s = _init_state(b, key)
+    whole = n - (n % 32)
+    chunk = _chunk_for(b)
+    if (
+        b % 8 == 0
+        and whole >= 32 * chunk
+        and jax.default_backend() == "tpu"  # Mosaic kernels need a TPU
+    ):
+        packets = whole // 32
+        main = (packets // chunk) * chunk
+        hi, lo = _load_packets(blocks[:, : main * 32])
+        b8 = b // 8
+        hi4 = jnp.stack(hi, axis=1).reshape(main, 4, 8, b8)  # packed tiles
+        lo4 = jnp.stack(lo, axis=1).reshape(main, 4, 8, b8)
+        init = jnp.concatenate(
+            [jnp.stack(s.v0h), jnp.stack(s.v0l), jnp.stack(s.v1h),
+             jnp.stack(s.v1l), jnp.stack(s.m0h), jnp.stack(s.m0l),
+             jnp.stack(s.m1h), jnp.stack(s.m1l)],
+            axis=0,
+        ).reshape(32, 8, b8)
+        out = pl.pallas_call(
+            _chain_kernel,
+            out_shape=jax.ShapeDtypeStruct((32, 8, b8), jnp.uint32),
+            grid=(main // chunk,),
+            in_specs=[
+                pl.BlockSpec((chunk, 4, 8, b8), lambda i: (i, 0, 0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((chunk, 4, 8, b8), lambda i: (i, 0, 0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((32, 8, b8), lambda i: (0, 0, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((32, 8, b8), lambda i: (0, 0, 0),
+                                   memory_space=pltpu.VMEM),
+            scratch_shapes=[pltpu.VMEM((32, 8, b8), jnp.uint32)],
+        )(hi4, lo4, init)
+        rows = [out[i].reshape(b) for i in range(32)]
+        fields = [[rows[4 * i + j] for j in range(4)] for i in range(8)]
+        (s.v0h, s.v0l, s.v1h, s.v1l, s.m0h, s.m0l, s.m1h, s.m1l) = fields
+        done = main * 32
+    else:
+        done = 0
+    # leftover whole packets + remainder + finalize via the XLA path
+    return bj._finish_from_state(s, blocks, done, n)
+
+
+def pallas_hash_supported() -> bool:
+    return jax.default_backend() == "tpu"

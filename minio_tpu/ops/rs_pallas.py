@@ -1,0 +1,97 @@
+"""Pallas TPU kernel for the GF(2^8) bit-plane matmul.
+
+The pure-XLA formulation (ops/rs_jax.py) materializes the [8d, n] bit
+expansion and the [8r, n] int32 accumulator in HBM around the matmul. This
+kernel fuses bit-extract -> MXU matmul -> mod-2 -> bit-pack inside VMEM per
+tile, so HBM traffic collapses to `read data + write parity` — the roofline
+the design doc targets (SURVEY.md §7 hard part (b)).
+
+Grid: (batch, n // TILE). Each step loads a [d, TILE] uint8 tile, builds
+the [8d, TILE] bit planes in registers, multiplies by the static [8r, 8d]
+binary matrix on the MXU with int32 accumulation, and packs eight result
+planes back into each output byte row.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+TILE = 512  # lanes per grid step (multiple of 128)
+
+
+def _encode_kernel(w_ref, data_ref, out_ref, *, d: int, r: int):
+    # Mosaic has no 8-bit vector shifts: all shift/pack arithmetic runs in
+    # int32 on the VPU; only the matmul operands drop to int8 for the MXU.
+    data = data_ref[0].astype(jnp.int32)  # [d, TILE]
+    planes = []
+    for ki in range(d):
+        row = data[ki]
+        for bit in range(8):
+            planes.append((row >> bit) & 1)
+    bits = jnp.stack(planes).astype(jnp.int8)  # [8d, TILE]
+    acc = jax.lax.dot_general(
+        w_ref[:],
+        bits,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )  # [8r, TILE]
+    acc = acc & 1
+    rows = []
+    for ri in range(r):
+        out = acc[8 * ri]
+        for bit in range(1, 8):
+            out = out | (acc[8 * ri + bit] << bit)
+        rows.append(out)
+    out_ref[0] = jnp.stack(rows).astype(jnp.uint8)  # [r, TILE]
+
+
+@functools.partial(jax.jit, static_argnames=("d", "r", "interpret"))
+def _encode_padded(w, data, d: int, r: int, interpret: bool = False):
+    b, _, n = data.shape
+    grid = (b, n // TILE)
+    return pl.pallas_call(
+        functools.partial(_encode_kernel, d=d, r=r),
+        out_shape=jax.ShapeDtypeStruct((b, r, n), jnp.uint8),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((8 * r, 8 * d), lambda i, j: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, d, TILE), lambda i, j: (i, 0, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, r, TILE), lambda i, j: (i, 0, j),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(w, data)
+
+
+def gf_apply_pallas(w_bits: np.ndarray, data, out_shards: int, interpret: bool = False):
+    """[8r, 8k] bit-plane matrix applied to [..., k, n] shard bytes.
+
+    Pads n up to a TILE multiple (zero parity contributions slice away
+    exactly); same contract as rs_jax.gf_apply_bits.
+    """
+    w = jnp.asarray(w_bits, dtype=jnp.int8)
+    data = jnp.asarray(data, dtype=jnp.uint8)
+    squeeze = data.ndim == 2
+    if squeeze:
+        data = data[None]
+    b, k, n = data.shape
+    pad = (-n) % TILE
+    if pad:
+        data = jnp.pad(data, ((0, 0), (0, 0), (0, pad)))
+    out = _encode_padded(w, data, k, out_shards, interpret)
+    if pad:
+        out = out[..., :n]
+    return out[0] if squeeze else out
+
+
+def pallas_supported() -> bool:
+    return jax.default_backend() == "tpu"

@@ -1,0 +1,1 @@
+"""Device parallelism: request batching onto the TPU, mesh sharding."""

@@ -1,0 +1,147 @@
+"""TPU batching dispatcher — many requests, one device dispatch.
+
+The north-star architecture (SURVEY.md §7, BASELINE.json): concurrent
+PutObject calls each produce independent fixed-shape 1 MiB stripe blocks;
+instead of one device call per block, a dispatcher thread packs every
+block that arrives within a short window into a single fused
+encode+bitrot dispatch ([B, d, n] -> parity + digests) and fans results
+back to the waiting request threads. The reference's analogue is the
+per-request AVX loop (cmd/erasure-encode.go:76) — batching is what the
+accelerator changes about the architecture.
+
+Latency contract: a block waits at most `window` (default 2 ms) before
+dispatch; an idle queue dispatches immediately. p99 PUT latency gains the
+window; throughput gains the full batch width of the MXU/VPU.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+from concurrent.futures import Future
+
+import numpy as np
+
+
+class TpuDispatcher:
+    """Batches fixed-shape [d, n] encode requests for one (d, p, n) shape."""
+
+    def __init__(self, codec, n: int, window_s: float | None = None,
+                 max_shards: int = 4096):
+        from ..ops.bitrot_jax import encode_and_hash
+
+        self.codec = codec
+        self.n = n
+        self.window = (
+            float(os.environ.get("MINIO_TPU_BATCH_WINDOW_MS", "2")) / 1e3
+            if window_s is None
+            else window_s
+        )
+        self.max_blocks = max(1, max_shards // (codec.data_shards + codec.parity_shards))
+        self._encode_and_hash = encode_and_hash
+        self._q: queue.Queue = queue.Queue()
+        self._carry: tuple | None = None
+        self.stats = {"dispatches": 0, "blocks": 0, "max_batch": 0}
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"tpu-dispatch-{codec.data_shards}+{codec.parity_shards}",
+        )
+        self._thread.start()
+
+    def submit(self, blocks: np.ndarray) -> Future:
+        """blocks: [k, d, n] -> Future of (shards [k, t, n], digests [k, t, 32])."""
+        fut: Future = Future()
+        self._q.put((blocks, fut))
+        return fut
+
+    def encode(self, blocks: np.ndarray):
+        return self.submit(blocks).result()
+
+    # -- worker ------------------------------------------------------------
+
+    def _collect(self) -> list[tuple[np.ndarray, Future]]:
+        if self._carry is not None:
+            batch = [self._carry]
+            self._carry = None
+        else:
+            batch = [self._q.get()]  # block until work arrives
+        total = batch[0][0].shape[0]
+        if self._q.empty():
+            return batch  # idle queue: dispatch immediately, no added latency
+        deadline = _monotonic() + self.window
+        while total < self.max_blocks:
+            timeout = deadline - _monotonic()
+            try:
+                item = self._q.get(timeout=max(timeout, 0)) if timeout > 0 else self._q.get_nowait()
+            except queue.Empty:
+                break
+            k = item[0].shape[0]
+            if total + k > self.max_blocks:
+                self._carry = item  # don't overshoot the HBM shard cap
+                break
+            batch.append(item)
+            total += k
+        return batch
+
+    @staticmethod
+    def _bucket(k: int) -> int:
+        """Pad batch sizes to power-of-two buckets: the jitted encode+hash
+        is shape-specialized, and arbitrary batch sizes would recompile the
+        (expensive) hash chain per novel size."""
+        b = 1
+        while b < k:
+            b <<= 1
+        return b
+
+    def _loop(self) -> None:
+        while True:
+            batch = self._collect()
+            try:
+                all_blocks = np.concatenate([b for b, _ in batch], axis=0)
+                k = all_blocks.shape[0]
+                bucket = self._bucket(k)
+                if bucket != k:
+                    pad = np.zeros(
+                        (bucket - k, *all_blocks.shape[1:]), dtype=np.uint8
+                    )
+                    all_blocks = np.concatenate([all_blocks, pad], axis=0)
+                parity, digests = self._encode_and_hash(self.codec, all_blocks)
+                parity = np.asarray(parity)[:k]
+                digests = np.asarray(digests)[:k]
+                shards = np.concatenate(
+                    [all_blocks[:k], parity], axis=1
+                )  # [B, t, n]
+                self.stats["dispatches"] += 1
+                self.stats["blocks"] += k
+                self.stats["max_batch"] = max(self.stats["max_batch"], k)
+                off = 0
+                for blocks, fut in batch:
+                    k = blocks.shape[0]
+                    fut.set_result((shards[off : off + k], digests[off : off + k]))
+                    off += k
+            except Exception as e:  # noqa: BLE001 — fail all waiters
+                for _, fut in batch:
+                    if not fut.done():
+                        fut.set_exception(e)
+
+
+def _monotonic() -> float:
+    import time
+
+    return time.monotonic()
+
+
+_dispatchers: dict[tuple[int, int, int], TpuDispatcher] = {}
+_dlock = threading.Lock()
+
+
+def get_dispatcher(codec, n: int) -> TpuDispatcher:
+    key = (codec.data_shards, codec.parity_shards, n)
+    d = _dispatchers.get(key)
+    if d is None:
+        with _dlock:
+            d = _dispatchers.get(key)
+            if d is None:
+                d = _dispatchers[key] = TpuDispatcher(codec, n)
+    return d
