@@ -1,0 +1,1 @@
+"""Batch jobs: YAML-driven replicate/expire with checkpointed progress."""

@@ -1,0 +1,179 @@
+"""Pool decommission and rebalance.
+
+Mirrors /root/reference/cmd/erasure-server-pool-decom.go and
+-rebalance.go: decommission drains every object of a pool into the
+remaining pools (walk + re-PUT + delete, checkpointed under .minio.sys so
+a restart resumes); rebalance moves objects from over-full pools toward
+the pool free-space average. Both run as background threads driven from
+the admin API.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass
+
+SYSTEM_BUCKET = ".minio.sys"
+
+
+@dataclass
+class DecomStatus:
+    pool_index: int
+    state: str = "idle"  # idle | draining | complete | failed | canceled
+    objects_moved: int = 0
+    failed: int = 0
+    bytes_moved: int = 0
+    last_object: str = ""
+    started: float = 0.0
+    finished: float = 0.0
+    error: str = ""
+
+    def to_dict(self) -> dict:
+        return dict(self.__dict__)
+
+
+class PoolManager:
+    """Decommission/rebalance controller over ServerPools."""
+
+    def __init__(self, pools):
+        self.pools = pools  # ServerPools
+        self.decoms: dict[int, DecomStatus] = {}
+        self._cancel: set[int] = set()
+        self._mu = threading.Lock()
+
+    # -- persistence -------------------------------------------------------
+
+    def _ckpt_key(self, idx: int) -> str:
+        return f"pool-decom/{idx}.json"
+
+    def _save(self, st: DecomStatus) -> None:
+        try:
+            self.pools.put_object(
+                SYSTEM_BUCKET, self._ckpt_key(st.pool_index),
+                json.dumps(st.to_dict()).encode(),
+            )
+        except Exception:  # noqa: BLE001
+            pass
+
+    def load_checkpoint(self, idx: int) -> DecomStatus | None:
+        from .quorum import ObjectNotFound
+
+        try:
+            _, it = self.pools.get_object(SYSTEM_BUCKET, self._ckpt_key(idx))
+            return DecomStatus(**json.loads(b"".join(it)))
+        except (ObjectNotFound, Exception):  # noqa: BLE001
+            return None
+
+    # -- decommission ------------------------------------------------------
+
+    def start_decommission(self, pool_index: int) -> DecomStatus:
+        if len(self.pools.pools) < 2:
+            raise ValueError("cannot decommission the only pool")
+        if not 0 <= pool_index < len(self.pools.pools):
+            raise ValueError("bad pool index")
+        prev = self.load_checkpoint(pool_index)
+        st = prev if prev and prev.state == "draining" else DecomStatus(pool_index)
+        st.state = "draining"
+        st.started = st.started or time.time()
+        with self._mu:
+            self.decoms[pool_index] = st
+        threading.Thread(
+            target=self._drain, args=(st,), daemon=True,
+            name=f"decom-{pool_index}",
+        ).start()
+        return st
+
+    def cancel_decommission(self, pool_index: int) -> None:
+        self._cancel.add(pool_index)
+
+    def status(self, pool_index: int) -> DecomStatus | None:
+        return self.decoms.get(pool_index) or self.load_checkpoint(pool_index)
+
+    def _drain(self, st: DecomStatus) -> None:
+        src = self.pools.pools[st.pool_index]
+        others = [
+            p for i, p in enumerate(self.pools.pools) if i != st.pool_index
+        ]
+        dst = others[0]
+        try:
+            for b in src.list_buckets():
+                for raw in src.walk_objects(b.name):
+                    if st.pool_index in self._cancel:
+                        st.state = "canceled"
+                        self._save(st)
+                        return
+                    cursor = f"{b.name}/{raw}"
+                    if st.last_object and cursor <= st.last_object:
+                        continue
+                    try:
+                        oi, it = src.get_object(b.name, raw)
+                        data = b"".join(it)
+                        meta = dict(oi.user_defined)
+                        meta["content-type"] = oi.content_type
+                        meta["etag"] = oi.etag
+                        dst.put_object(b.name, raw, data, user_defined=meta)
+                        src.delete_object(b.name, raw)
+                        st.objects_moved += 1
+                        st.bytes_moved += len(data)
+                    except Exception:  # noqa: BLE001
+                        st.failed += 1
+                    st.last_object = cursor
+                    if st.objects_moved % 100 == 0:
+                        self._save(st)
+            st.state = "complete" if st.failed == 0 else "failed"
+        except Exception as e:  # noqa: BLE001
+            st.state = "failed"
+            st.error = str(e)
+        st.finished = time.time()
+        self._save(st)
+
+    # -- rebalance ---------------------------------------------------------
+
+    def pool_usage(self) -> list[dict]:
+        out = []
+        for i, p in enumerate(self.pools.pools):
+            total = free = 0
+            for d in p.disks:
+                try:
+                    di = d.disk_info()
+                    total += di.total
+                    free += di.free
+                except Exception:  # noqa: BLE001
+                    pass
+            out.append(
+                {"pool": i, "total": total, "free": free,
+                 "usedPct": 0.0 if not total else round(100 * (1 - free / total), 2)}
+            )
+        return out
+
+    def start_rebalance(self, max_objects: int = 1000) -> dict:
+        """Move objects from the fullest pool to the emptiest until counts
+        are bounded (simplified fill-percent equalization)."""
+        if len(self.pools.pools) < 2:
+            raise ValueError("rebalance needs multiple pools")
+        usage = self.pool_usage()
+        src_i = max(range(len(usage)), key=lambda i: usage[i]["usedPct"])
+        dst_i = min(range(len(usage)), key=lambda i: usage[i]["usedPct"])
+        if src_i == dst_i:
+            return {"moved": 0}
+        src, dst = self.pools.pools[src_i], self.pools.pools[dst_i]
+        moved = 0
+        for b in src.list_buckets():
+            for raw in src.walk_objects(b.name):
+                if moved >= max_objects:
+                    return {"moved": moved, "from": src_i, "to": dst_i}
+                try:
+                    oi, it = src.get_object(b.name, raw)
+                    dst.put_object(
+                        b.name, raw, b"".join(it),
+                        user_defined={**oi.user_defined,
+                                      "content-type": oi.content_type,
+                                      "etag": oi.etag},
+                    )
+                    src.delete_object(b.name, raw)
+                    moved += 1
+                except Exception:  # noqa: BLE001
+                    pass
+        return {"moved": moved, "from": src_i, "to": dst_i}

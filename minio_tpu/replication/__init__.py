@@ -1,0 +1,1 @@
+"""Bucket replication: remote targets, async workers, resync."""

@@ -25,6 +25,19 @@ def _json(data, status=200) -> web.Response:
     )
 
 
+def _int_q(q, name: str, default: int, lo: int | None = None, hi: int | None = None) -> int:
+    """Query param as int -> 400 InvalidArgument on garbage, clamped."""
+    try:
+        v = int(q.get(name, str(default)))
+    except ValueError:
+        raise s3err.InvalidArgument from None
+    if lo is not None:
+        v = max(v, lo)
+    if hi is not None:
+        v = min(v, hi)
+    return v
+
+
 async def handle_admin(server, request: web.Request, access_key: str, subpath: str, body: bytes):
     """Dispatch /minio/admin/v3/<op> requests."""
     op = subpath.split("?")[0]
@@ -192,6 +205,127 @@ async def handle_admin(server, request: web.Request, access_key: str, subpath: s
                     stats.update(lk.stats())
         return _json(stats)
 
+    # -- replication targets ----------------------------------------------
+    if op == "set-remote-target" and m == "PUT":
+        authz("admin:SetBucketTarget")
+        from ..replication.replicate import RemoteTarget
+
+        try:
+            d = json.loads(body)
+            import uuid as _uuid
+
+            arn = d.get("arn") or (
+                f"arn:minio:replication::{str(_uuid.uuid4())[:8]}:{d['targetbucket']}"
+            )
+            t = RemoteTarget(
+                arn=arn,
+                source_bucket=d["sourcebucket"],
+                endpoint=d["endpoint"],
+                access_key=d["credentials"]["accessKey"],
+                secret_key=d["credentials"]["secretKey"],
+                target_bucket=d["targetbucket"],
+            )
+        except (ValueError, KeyError):
+            raise s3err.InvalidArgument from None
+        await server._run(server.repl_targets.set, t)
+        return _json({"arn": t.arn})
+    if op == "list-remote-targets" and m == "GET":
+        authz("admin:GetBucketTarget")
+        out = [t.to_dict() for t in server.repl_targets.list(q.get("bucket", ""))]
+        for t in out:
+            t.pop("secret_key", None)
+        return _json(out)
+    if op == "remove-remote-target" and m == "DELETE":
+        authz("admin:SetBucketTarget")
+        await server._run(server.repl_targets.remove, q.get("arn", ""))
+        return web.Response(status=204)
+    if op == "replication/status" and m == "GET":
+        authz("admin:GetBucketTarget")
+        return _json(server.replication.stats)
+    if op == "replication/resync" and m == "POST":
+        authz("admin:SetBucketTarget")
+        n = await server._run(server.replication.resync, q.get("bucket", ""))
+        return _json({"queued": n})
+
+    # -- batch jobs --------------------------------------------------------
+    if op == "start-job" and m == "POST":
+        authz("admin:StartBatchJob")
+        import yaml as _yaml
+
+        try:
+            st = await server._run(server.batch.start, body.decode())
+        except (ValueError, _yaml.YAMLError) as e:
+            return _json({"error": str(e)}, 400)
+        return _json(st.to_dict())
+    if op == "list-jobs" and m == "GET":
+        authz("admin:ListBatchJobs")
+        return _json([s.to_dict() for s in server.batch.list()])
+    if op == "describe-job" and m == "GET":
+        authz("admin:DescribeBatchJob")
+        st = server.batch.describe(q.get("jobId", ""))
+        return _json(st.to_dict() if st else {"error": "not found"},
+                     200 if st else 404)
+    if op == "cancel-job" and m == "DELETE":
+        authz("admin:CancelBatchJob")
+        ok = server.batch.cancel(q.get("jobId", ""))
+        return web.Response(status=204 if ok else 404)
+
+    # -- pools: decommission / rebalance ----------------------------------
+    if op.startswith("pools/") and server.pool_mgr is not None:
+        pm = server.pool_mgr
+        if op == "pools/list" and m == "GET":
+            authz("admin:ServerInfo")
+            return _json(pm.pool_usage())
+        if op == "pools/decommission" and m == "POST":
+            authz("admin:DecommissionPool")
+            try:
+                st = await server._run(
+                    pm.start_decommission, _int_q(q, "pool", -1)
+                )
+            except ValueError as e:
+                return _json({"error": str(e)}, 400)
+            return _json(st.to_dict())
+        if op == "pools/decommission/status" and m == "GET":
+            authz("admin:DecommissionPool")
+            st = pm.status(_int_q(q, "pool", -1))
+            return _json(st.to_dict() if st else {"state": "idle"})
+        if op == "pools/decommission/cancel" and m == "POST":
+            authz("admin:DecommissionPool")
+            pm.cancel_decommission(_int_q(q, "pool", -1))
+            return web.Response(status=200)
+        if op == "pools/rebalance" and m == "POST":
+            authz("admin:RebalancePool")
+            try:
+                out = await server._run(pm.start_rebalance)
+            except ValueError as e:
+                return _json({"error": str(e)}, 400)
+            return _json(out)
+
+    # -- config KV ---------------------------------------------------------
+    if op == "get-config" and m == "GET":
+        authz("admin:ConfigUpdate")
+        return _json(server.config.dump())
+    if op == "set-config-kv" and m == "PUT":
+        authz("admin:ConfigUpdate")
+        try:
+            d = json.loads(body)
+            await server._run(
+                server.config.set, d["subsys"], d["key"], str(d["value"])
+            )
+        except (ValueError, KeyError) as e:
+            return _json({"error": str(e)}, 400)
+        return web.Response(status=200)
+
+    # -- speedtest ---------------------------------------------------------
+    if op == "speedtest/drive" and m == "POST":
+        authz("admin:Health")
+        return _json(await server._run(_drive_speedtest, server))
+    if op == "speedtest/object" and m == "POST":
+        authz("admin:Health")
+        size = _int_q(q, "size", 1 << 20, lo=4096, hi=64 << 20)
+        count = _int_q(q, "count", 8, lo=1, hi=32)
+        return _json(await server._run(_object_speedtest, server, size, count))
+
     # -- info / heal ------------------------------------------------------
     if op == "info" and m == "GET":
         authz("admin:ServerInfo")
@@ -280,3 +414,71 @@ async def _stream_trace(server, request: web.Request) -> web.StreamResponse:
     finally:
         server.trace.unsubscribe(q)
     return resp
+
+
+def _drive_speedtest(server) -> dict:
+    """Sequential write/read throughput per drive (reference
+    cmd/speedtest.go driveSpeedTest)."""
+    import os as _os
+    import time as _time
+
+    import uuid as _uuid
+
+    run_id = str(_uuid.uuid4())[:8]
+    payload = _os.urandom(4 << 20)
+    out = []
+    for i, d in enumerate(server.store.disks):
+        path = f"speedtest/{run_id}-{i}.bin"
+        try:
+            t0 = _time.perf_counter()
+            d.create_file(".minio.sys", path, payload)
+            wdt = _time.perf_counter() - t0
+            t0 = _time.perf_counter()
+            got = d.read_file(".minio.sys", path)
+            rdt = _time.perf_counter() - t0
+            d.delete(".minio.sys", path)
+            out.append(
+                {
+                    "endpoint": d.endpoint,
+                    "writeMiBps": round(len(payload) / 2**20 / wdt, 1),
+                    "readMiBps": round(len(got) / 2**20 / rdt, 1),
+                }
+            )
+        except Exception as e:  # noqa: BLE001
+            out.append({"endpoint": d.endpoint, "error": str(e)})
+    return {"drives": out}
+
+
+def _object_speedtest(server, size: int, count: int) -> dict:
+    """PUT+GET throughput through the full object path (reference
+    cmd/perf-tests.go selfSpeedTest)."""
+    import os as _os
+    import time as _time
+
+    import uuid as _uuid
+
+    bucket = ".minio.sys"
+    run_id = str(_uuid.uuid4())[:8]
+    payload = _os.urandom(min(size, 64 << 20))
+    t0 = _time.perf_counter()
+    for i in range(count):
+        server.store.put_object(bucket, f"speedtest/{run_id}-{i}", payload)
+    put_dt = _time.perf_counter() - t0
+    t0 = _time.perf_counter()
+    for i in range(count):
+        _, it = server.store.get_object(bucket, f"speedtest/{run_id}-{i}")
+        for _ in it:
+            pass
+    get_dt = _time.perf_counter() - t0
+    for i in range(count):
+        try:
+            server.store.delete_object(bucket, f"speedtest/{run_id}-{i}")
+        except Exception:  # noqa: BLE001
+            pass
+    total = len(payload) * count / 2**20
+    return {
+        "objectSize": len(payload),
+        "count": count,
+        "putMiBps": round(total / put_dt, 1),
+        "getMiBps": round(total / get_dt, 1),
+    }

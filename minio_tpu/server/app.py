@@ -163,11 +163,40 @@ class S3Server:
         # fine — missing documents load as empty)
         self.iam.load()
         self.verifier = signature.SigV4Verifier(self.iam.lookup_secret, self.region)
+        from ..batch.jobs import BatchJobPool
         from ..crypto.sse import KMS
+        from ..erasure.decommission import PoolManager
         from ..events.notify import EventNotifier
+        from ..replication.replicate import ReplicationPool, TargetRegistry
+        from .audit import AuditLog
+        from .config_kv import ConfigKV
 
         self.notifier = EventNotifier(self.buckets)
         self.kms = KMS(store=store)  # persisted auto-key unless env-provided
+        self.audit = AuditLog()
+        self.config = ConfigKV(store)
+        self.repl_targets = TargetRegistry(store)
+
+        def _repl_decode(oi, data, bucket, key):
+            from ..crypto import sse as ssemod
+            from . import transforms
+
+            if not transforms.is_transformed(oi.user_defined):
+                return data
+            if oi.user_defined.get(ssemod.META_ALGO) == "SSE-C":
+                # the server has no customer key; cannot replicate SSE-C
+                raise RuntimeError("SSE-C objects cannot be auto-replicated")
+            return transforms.decode_full(
+                data, oi.user_defined, {}, bucket, key, self.kms
+            )
+
+        self.replication = ReplicationPool(
+            store, self.buckets, self.repl_targets, decode=_repl_decode
+        )
+        self.batch = BatchJobPool(store, self.buckets, self.replication)
+        self.pool_mgr = (
+            PoolManager(store) if hasattr(store, "pools") else None
+        )
         self.store = store
         # background durability plane: scanner + MRF heal workers
         from ..erasure.background import BackgroundOps
@@ -220,6 +249,13 @@ class S3Server:
             self.metrics.observe(api, status, dur, rx, tx)
             if self.trace.active:
                 self.trace.publish(trace_record(request, status, dur, rx, tx))
+            audit = getattr(self, "audit", None)
+            if audit is not None and audit.enabled:
+                from .audit import audit_record
+
+                audit.emit(
+                    audit_record(request, status, dur, request.get("access_key", ""))
+                )
 
     async def _entry_inner(self, request: web.Request) -> web.StreamResponse:
         # unauthenticated planes: health + metrics
@@ -860,6 +896,7 @@ class S3Server:
             ev.OBJECT_CREATED_PUT, bucket, listing.decode_dir_object(key),
             oi.size, oi.etag, oi.version_id, request.get("access_key", ""),
         )
+        self.replication.queue_mutation(bucket, key, oi.version_id, "put")
         return web.Response(status=200, headers=headers)
 
     def _parse_copy_source(self, request, access_key: str) -> tuple[str, str, str]:
@@ -964,6 +1001,9 @@ class S3Server:
         self.notifier.notify(
             ev.OBJECT_CREATED_COPY, bucket, listing.decode_dir_object(key),
             new_oi.size, new_oi.etag, new_oi.version_id,
+        )
+        self.replication.queue_mutation(
+            bucket, listing.encode_dir_object(key), new_oi.version_id, "put"
         )
         return web.Response(body=xml.encode(), content_type="application/xml", headers=headers)
 
@@ -1110,6 +1150,10 @@ class S3Server:
                 bucket, listing.decode_dir_object(key),
                 version_id=oi.version_id, user=request.get("access_key", ""),
             )
+            if not vid:
+                # only logical deletes replicate; removing a SPECIFIC old
+                # version must never delete the replica's live object
+                self.replication.queue_mutation(bucket, key, "", "delete")
         except (quorum.ObjectNotFound, quorum.VersionNotFound):
             pass  # S3 deletes are idempotent
         return web.Response(status=204, headers=headers)
@@ -1327,6 +1371,7 @@ class S3Server:
             ev.OBJECT_CREATED_MULTIPART, bucket, listing.decode_dir_object(key),
             oi.size, oi.etag, oi.version_id, request.get("access_key", ""),
         )
+        self.replication.queue_mutation(bucket, key, oi.version_id, "put")
         return web.Response(body=xml.encode(), content_type="application/xml", headers=headers)
 
     async def abort_multipart(self, request, bucket, key) -> web.Response:

@@ -1,0 +1,80 @@
+"""Dynamic config KV store.
+
+Mirrors the reference's layered config system (internal/config/config.go +
+cmd/config-current.go): subsystem-scoped key/value settings persisted in
+the backend (.minio.sys/config/settings.json), readable/settable over the
+admin API, and applied live for dynamic keys.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+SYSTEM_BUCKET = ".minio.sys"
+CONFIG_KEY = "config/settings.json"
+
+# subsystem -> {key: default}  (subset of the reference's 30 subsystems)
+DEFAULTS: dict[str, dict[str, str]] = {
+    "scanner": {"interval": "300", "deep_verify": "off"},
+    "compression": {"enable": "off", "extensions": "", "mime_types": ""},
+    "heal": {"workers": "2"},
+    "api": {"requests_max": "0", "cors_allow_origin": "*"},
+    "storage_class": {"standard": "", "rrs": ""},
+    "replication": {"workers": "2"},
+    "batch": {"workers": "1"},
+}
+
+
+class ConfigKV:
+    def __init__(self, store):
+        self.store = store
+        self._mu = threading.Lock()
+        self._kv: dict[str, dict[str, str]] = {}
+        self._listeners: list = []  # callbacks(subsys, key, value)
+        self._load()
+
+    def _load(self) -> None:
+        from ..erasure.quorum import ObjectNotFound
+
+        try:
+            _, it = self.store.get_object(SYSTEM_BUCKET, CONFIG_KEY)
+            self._kv = json.loads(b"".join(it))
+        except ObjectNotFound:
+            self._kv = {}
+
+    def _persist(self) -> None:
+        self.store.put_object(
+            SYSTEM_BUCKET, CONFIG_KEY, json.dumps(self._kv).encode()
+        )
+
+    def get(self, subsys: str, key: str) -> str:
+        with self._mu:
+            v = self._kv.get(subsys, {}).get(key)
+        if v is not None:
+            return v
+        return DEFAULTS.get(subsys, {}).get(key, "")
+
+    def set(self, subsys: str, key: str, value: str) -> None:
+        if subsys not in DEFAULTS:
+            raise KeyError(f"unknown config subsystem {subsys!r}")
+        if key not in DEFAULTS[subsys]:
+            raise KeyError(f"unknown key {subsys}.{key}")
+        with self._mu:
+            self._kv.setdefault(subsys, {})[key] = value
+            self._persist()
+        for cb in list(self._listeners):
+            try:
+                cb(subsys, key, value)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def dump(self) -> dict:
+        out = {s: dict(kv) for s, kv in DEFAULTS.items()}
+        with self._mu:
+            for s, kv in self._kv.items():
+                out.setdefault(s, {}).update(kv)
+        return out
+
+    def on_change(self, cb) -> None:
+        self._listeners.append(cb)

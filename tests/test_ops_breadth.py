@@ -1,0 +1,244 @@
+"""Operations breadth: replication, batch jobs, decommission/rebalance,
+speedtest, config KV, audit (reference: cmd/bucket-replication.go,
+cmd/batch-*.go, cmd/erasure-server-pool-decom.go, cmd/speedtest.go,
+internal/config)."""
+
+import json
+import os
+import time
+
+os.environ.setdefault("MINIO_TPU_BACKEND", "numpy")
+os.environ.setdefault("MINIO_TPU_SCAN_INTERVAL", "0")
+
+import pytest
+
+from minio_tpu.client import S3Client
+from tests.test_s3_api import ServerThread
+
+
+@pytest.fixture(scope="module")
+def site_a(tmp_path_factory):
+    base = tmp_path_factory.mktemp("site-a")
+    st = ServerThread([str(base / f"d{i}") for i in range(4)])
+    yield st
+    st.stop()
+
+
+@pytest.fixture(scope="module")
+def site_b(tmp_path_factory):
+    base = tmp_path_factory.mktemp("site-b")
+    st = ServerThread([str(base / f"d{i}") for i in range(4)])
+    yield st
+    st.stop()
+
+
+@pytest.fixture(scope="module")
+def cli_a(site_a):
+    c = S3Client(f"127.0.0.1:{site_a.port}")
+    c.make_bucket("srcb")
+    return c
+
+
+@pytest.fixture(scope="module")
+def cli_b(site_b):
+    c = S3Client(f"127.0.0.1:{site_b.port}")
+    c.make_bucket("dstb")
+    return c
+
+
+def test_bucket_replication_end_to_end(site_a, site_b, cli_a, cli_b):
+    # register the remote target on site A
+    r = cli_a.request(
+        "PUT", "/minio/admin/v3/set-remote-target",
+        body=json.dumps({
+            "sourcebucket": "srcb",
+            "endpoint": f"127.0.0.1:{site_b.port}",
+            "credentials": {"accessKey": "minioadmin", "secretKey": "minioadmin"},
+            "targetbucket": "dstb",
+        }).encode(),
+    )
+    assert r.status == 200, r.body
+    arn = json.loads(r.body)["arn"]
+    cfg = f"""<ReplicationConfiguration>
+      <Rule><ID>r1</ID><Status>Enabled</Status><Priority>1</Priority>
+        <Destination><Bucket>{arn}</Bucket></Destination>
+      </Rule></ReplicationConfiguration>"""
+    assert cli_a.request("PUT", "/srcb", query={"replication": ""},
+                         body=cfg.encode()).status == 200
+    cli_a.put_object("srcb", "mirror/me.txt", b"replicate-this",
+                     headers={"x-amz-meta-tag": "x1"})
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        g = cli_b.get_object("dstb", "mirror/me.txt")
+        if g.status == 200:
+            break
+        time.sleep(0.2)
+    assert g.status == 200 and g.body == b"replicate-this"
+    assert g.headers.get("x-amz-meta-tag") == "x1"
+    # delete replication
+    cli_a.delete_object("srcb", "mirror/me.txt")
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        if cli_b.get_object("dstb", "mirror/me.txt").status == 404:
+            break
+        time.sleep(0.2)
+    assert cli_b.get_object("dstb", "mirror/me.txt").status == 404
+    r = cli_a.request("GET", "/minio/admin/v3/replication/status")
+    assert json.loads(r.body)["replicated"] >= 1
+
+
+def test_batch_replicate_job(site_a, site_b, cli_a, cli_b):
+    for i in range(5):
+        cli_a.put_object("srcb", f"batchset/f{i}", f"payload-{i}".encode())
+    job = f"""
+replicate:
+  source:
+    bucket: srcb
+    prefix: batchset/
+  target:
+    endpoint: "127.0.0.1:{site_b.port}"
+    bucket: dstb
+    credentials:
+      accessKey: minioadmin
+      secretKey: minioadmin
+"""
+    r = cli_a.request("POST", "/minio/admin/v3/start-job", body=job.encode())
+    assert r.status == 200, r.body
+    job_id = json.loads(r.body)["job_id"]
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        st = json.loads(cli_a.request(
+            "GET", "/minio/admin/v3/describe-job", query={"jobId": job_id}
+        ).body)
+        if st["state"] in ("done", "failed"):
+            break
+        time.sleep(0.2)
+    assert st["state"] == "done" and st["objects_acted"] == 5, st
+    for i in range(5):
+        assert cli_b.get_object("dstb", f"batchset/f{i}").body == f"payload-{i}".encode()
+
+
+def test_batch_expire_job(cli_a):
+    cli_a.put_object("srcb", "expireme/old", b"x")
+    job = "expire:\n  bucket: srcb\n  prefix: expireme/\n  olderThan: 0s\n"
+    r = cli_a.request("POST", "/minio/admin/v3/start-job", body=job.encode())
+    job_id = json.loads(r.body)["job_id"]
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        st = json.loads(cli_a.request(
+            "GET", "/minio/admin/v3/describe-job", query={"jobId": job_id}
+        ).body)
+        if st["state"] in ("done", "failed"):
+            break
+        time.sleep(0.2)
+    assert st["state"] == "done" and st["objects_acted"] >= 1
+    assert cli_a.get_object("srcb", "expireme/old").status == 404
+
+
+def test_config_kv(cli_a):
+    r = cli_a.request("GET", "/minio/admin/v3/get-config")
+    cfg = json.loads(r.body)
+    assert "scanner" in cfg and "compression" in cfg
+    r = cli_a.request("PUT", "/minio/admin/v3/set-config-kv",
+                      body=json.dumps({"subsys": "scanner", "key": "interval",
+                                       "value": "120"}).encode())
+    assert r.status == 200
+    cfg = json.loads(cli_a.request("GET", "/minio/admin/v3/get-config").body)
+    assert cfg["scanner"]["interval"] == "120"
+    r = cli_a.request("PUT", "/minio/admin/v3/set-config-kv",
+                      body=json.dumps({"subsys": "nope", "key": "x", "value": "1"}).encode())
+    assert r.status == 400
+
+
+def test_speedtests(cli_a):
+    r = cli_a.request("POST", "/minio/admin/v3/speedtest/drive")
+    assert r.status == 200 and b"writeMiBps" in r.body
+    r = cli_a.request("POST", "/minio/admin/v3/speedtest/object",
+                      query={"size": "65536", "count": "3"})
+    d = json.loads(r.body)
+    assert d["putMiBps"] > 0 and d["getMiBps"] > 0
+
+
+def test_decommission_and_rebalance(tmp_path_factory):
+    base = tmp_path_factory.mktemp("decom")
+    st = ServerThread([
+        str(base / "p1-d{1...4}"),
+        str(base / "p2-d{1...4}"),
+    ])
+    try:
+        cli = S3Client(f"127.0.0.1:{st.port}")
+        cli.make_bucket("poolb")
+        keys = [f"obj-{i}" for i in range(10)]
+        for k in keys:
+            cli.put_object("poolb", k, f"data-{k}".encode())
+        r = cli.request("GET", "/minio/admin/v3/pools/list")
+        assert r.status == 200 and len(json.loads(r.body)) == 2
+        # find a pool that actually holds some objects, drain it
+        srv = st.srv
+        p0 = srv.store.pools[0]
+        held = [k for k in keys]
+        r = cli.request("POST", "/minio/admin/v3/pools/decommission",
+                        query={"pool": "0"})
+        assert r.status == 200, r.body
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            s = json.loads(cli.request(
+                "GET", "/minio/admin/v3/pools/decommission/status",
+                query={"pool": "0"}).body)
+            if s["state"] in ("complete", "failed"):
+                break
+            time.sleep(0.2)
+        assert s["state"] == "complete", s
+        # every object still readable, none left in pool 0
+        for k in keys:
+            assert cli.get_object("poolb", k).body == f"data-{k}".encode()
+        from minio_tpu.erasure.quorum import ObjectNotFound
+
+        for k in keys:
+            try:
+                p0.get_object_info("poolb", k)
+                raise AssertionError(f"{k} still in pool 0")
+            except Exception:
+                pass
+        r = cli.request("POST", "/minio/admin/v3/pools/rebalance")
+        assert r.status == 200
+    finally:
+        st.stop()
+
+
+def test_replication_decodes_transformed_objects(site_a, site_b, cli_a, cli_b, monkeypatch):
+    # a compressed object must arrive at the replica as LOGICAL bytes
+    os.environ["MINIO_COMPRESSION_ENABLE"] = "on"
+    try:
+        body = b"Z" * (1 << 20)  # compressible, > inline thresholds
+        cli_a.put_object("srcb", "mirror/big.log", body)
+        deadline = time.time() + 15
+        g = None
+        while time.time() < deadline:
+            g = cli_b.get_object("dstb", "mirror/big.log")
+            if g.status == 200:
+                break
+            time.sleep(0.2)
+        assert g is not None and g.status == 200
+        assert g.body == body, "replica must hold logical bytes, not frames"
+    finally:
+        os.environ["MINIO_COMPRESSION_ENABLE"] = "off"
+
+
+def test_version_delete_does_not_nuke_replica(site_a, site_b, cli_a, cli_b):
+    cfgv = (b'<VersioningConfiguration><Status>Enabled</Status>'
+            b'</VersioningConfiguration>')
+    cli_a.request("PUT", "/srcb", query={"versioning": ""}, body=cfgv)
+    r = cli_a.put_object("srcb", "mirror/versioned", b"v1")
+    vid1 = r.headers["x-amz-version-id"]
+    cli_a.put_object("srcb", "mirror/versioned", b"v2")
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        g = cli_b.get_object("dstb", "mirror/versioned")
+        if g.status == 200 and g.body == b"v2":
+            break
+        time.sleep(0.2)
+    # deleting the OLD source version must leave the replica's live object
+    cli_a.delete_object("srcb", "mirror/versioned", version_id=vid1)
+    time.sleep(1.5)
+    assert cli_b.get_object("dstb", "mirror/versioned").body == b"v2"
