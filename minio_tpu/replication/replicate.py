@@ -164,15 +164,23 @@ class ReplicationPool:
         self.buckets = bucket_meta
         self.targets = targets
         self.decode = decode
-        self._q: queue.Queue[_Task] = queue.Queue(maxsize=10000)
+        # one queue per worker with key-affinity: mutations of the SAME
+        # object stay ordered (v1 must never land after v2 on the replica)
+        self._qs: list[queue.Queue[_Task]] = [
+            queue.Queue(maxsize=10000) for _ in range(workers)
+        ]
         self._rules_cache: dict[str, tuple[str, list[ReplicationRule]]] = {}
         self.stats = {"replicated": 0, "deletes": 0, "failed": 0, "queued": 0}
         self._threads = [
-            threading.Thread(target=self._loop, daemon=True, name=f"repl-{i}")
-            for i in range(workers)
+            threading.Thread(target=self._loop, args=(q_,), daemon=True,
+                             name=f"repl-{i}")
+            for i, q_ in enumerate(self._qs)
         ]
         for t in self._threads:
             t.start()
+
+    def _queue_for(self, bucket: str, key: str) -> "queue.Queue[_Task]":
+        return self._qs[hash((bucket, key)) % len(self._qs)]
 
     def rules_for(self, bucket: str) -> list[ReplicationRule]:
         xml_text = self.buckets.get(bucket).replication or ""
@@ -191,7 +199,9 @@ class ReplicationPool:
         for rule in self.rules_for(bucket):
             if rule.matches(key):
                 try:
-                    self._q.put_nowait(_Task(bucket, key, version_id, op))
+                    self._queue_for(bucket, key).put_nowait(
+                        _Task(bucket, key, version_id, op)
+                    )
                     self.stats["queued"] += 1
                 except queue.Full:
                     self.stats["failed"] += 1
@@ -209,14 +219,14 @@ class ReplicationPool:
         import time
 
         deadline = time.monotonic() + timeout
-        while not self._q.empty() and time.monotonic() < deadline:
+        while any(not q_.empty() for q_ in self._qs) and time.monotonic() < deadline:
             time.sleep(0.05)
 
     # -- worker ------------------------------------------------------------
 
-    def _loop(self) -> None:
+    def _loop(self, q_: "queue.Queue[_Task]") -> None:
         while True:
-            task = self._q.get()
+            task = q_.get()
             try:
                 self._replicate(task)
             except Exception as e:  # noqa: BLE001 — retry then count as failed
@@ -224,7 +234,7 @@ class ReplicationPool:
                 self.stats["last_error"] = f"{type(e).__name__}: {e}"
                 if task.attempts < 3:
                     threading.Timer(
-                        2 ** task.attempts, lambda: self._q.put(task)
+                        2 ** task.attempts, lambda: q_.put(task)
                     ).start()
                 else:
                     self.stats["failed"] += 1

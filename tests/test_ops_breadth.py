@@ -208,6 +208,7 @@ def test_decommission_and_rebalance(tmp_path_factory):
 
 def test_replication_decodes_transformed_objects(site_a, site_b, cli_a, cli_b, monkeypatch):
     # a compressed object must arrive at the replica as LOGICAL bytes
+    prev = os.environ.get("MINIO_COMPRESSION_ENABLE")
     os.environ["MINIO_COMPRESSION_ENABLE"] = "on"
     try:
         body = b"Z" * (1 << 20)  # compressible, > inline thresholds
@@ -222,7 +223,10 @@ def test_replication_decodes_transformed_objects(site_a, site_b, cli_a, cli_b, m
         assert g is not None and g.status == 200
         assert g.body == body, "replica must hold logical bytes, not frames"
     finally:
-        os.environ["MINIO_COMPRESSION_ENABLE"] = "off"
+        if prev is None:
+            os.environ.pop("MINIO_COMPRESSION_ENABLE", None)
+        else:
+            os.environ["MINIO_COMPRESSION_ENABLE"] = prev
 
 
 def test_version_delete_does_not_nuke_replica(site_a, site_b, cli_a, cli_b):
