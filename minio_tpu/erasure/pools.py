@@ -142,3 +142,13 @@ class ServerPools:
     def walk_objects(self, bucket: str, prefix: str = "") -> Iterator[str]:
         for p in self.pools:
             yield from p.walk_objects(bucket, prefix)
+
+    def set_object_tags(self, bucket, obj, tags, version_id=""):
+        return self._pool_holding(bucket, obj, version_id).set_object_tags(
+            bucket, obj, tags, version_id
+        )
+
+    def get_object_tags(self, bucket, obj, version_id=""):
+        return self._pool_holding(bucket, obj, version_id).get_object_tags(
+            bucket, obj, version_id
+        )

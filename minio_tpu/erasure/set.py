@@ -495,6 +495,60 @@ class ErasureSet:
         oi = ObjectInfo(bucket=bucket, name=obj, version_id=version_id)
         return oi
 
+    # -- object tags -------------------------------------------------------
+
+    TAGS_META_KEY = "x-minio-internal-tags"
+
+    def set_object_tags(
+        self, bucket: str, obj: str, tags: dict[str, str], version_id: str = ""
+    ) -> None:
+        """Store object tags in version metadata (reference PutObjectTags,
+        cmd/erasure-object.go)."""
+        import urllib.parse as _up
+
+        mtx = self.ns.new(bucket, obj)
+        if not mtx.lock(30.0):
+            raise QuorumError(f"lock timeout tagging {bucket}/{obj}")
+        try:
+            # read_data=True: the rewrite below persists the FileInfo as-is,
+            # so inline payloads must ride along (the metadata-only read
+            # masks them to an empty marker, which would wipe the object)
+            fi, metas, _, write_q = self._quorum_fileinfo(
+                bucket, obj, version_id, read_data=True
+            )
+            if fi.deleted:
+                raise ObjectNotFound(f"{bucket}/{obj}")
+            encoded = _up.urlencode(tags)
+
+            def update(disk, m):
+                if m is None:
+                    raise errors.FileNotFound(obj)
+                if encoded:
+                    m.metadata[self.TAGS_META_KEY] = encoded
+                else:
+                    m.metadata.pop(self.TAGS_META_KEY, None)
+                disk.update_metadata(bucket, obj, m)
+
+            errs = []
+            for disk, m in zip(self.disks, metas):
+                try:
+                    update(disk, m)
+                    errs.append(None)
+                except Exception as e:  # noqa: BLE001
+                    errs.append(e)
+            reduce_quorum_errs(errs, write_q)
+        finally:
+            mtx.unlock()
+
+    def get_object_tags(
+        self, bucket: str, obj: str, version_id: str = ""
+    ) -> dict[str, str]:
+        import urllib.parse as _up
+
+        fi, *_ = self._quorum_fileinfo(bucket, obj, version_id)
+        raw = fi.metadata.get(self.TAGS_META_KEY, "")
+        return dict(_up.parse_qsl(raw))
+
     # -- versions ----------------------------------------------------------
 
     def list_object_versions(self, bucket: str, obj: str) -> list[ObjectInfo]:

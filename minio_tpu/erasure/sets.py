@@ -112,6 +112,12 @@ class ErasureSets:
         for raw in listing._merged_keys(self, bucket, prefix):
             yield raw
 
+    def set_object_tags(self, bucket, obj, tags, version_id=""):
+        return self.get_hashed_set(obj).set_object_tags(bucket, obj, tags, version_id)
+
+    def get_object_tags(self, bucket, obj, version_id=""):
+        return self.get_hashed_set(obj).get_object_tags(bucket, obj, version_id)
+
 
 def _dep_bytes(deployment_id: str) -> bytes:
     import uuid as _uuid

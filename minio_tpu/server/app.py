@@ -50,6 +50,12 @@ def _route_action(m: str, bucket: str, key: str, q, headers) -> tuple[str, str, 
     """(action, bucket, key) for authorization — the request->policy-action
     mapping the reference does per-handler via checkRequestAuthType."""
     if key:
+        if "tagging" in q:
+            return {
+                "GET": "s3:GetObjectTagging",
+                "PUT": "s3:PutObjectTagging",
+                "DELETE": "s3:DeleteObjectTagging",
+            }.get(m, "s3:*"), bucket, key
         if m in ("GET", "HEAD"):
             if "uploadId" in q:
                 return "s3:ListMultipartUploadParts", bucket, key
@@ -480,6 +486,13 @@ class S3Server:
             raise s3err.MethodNotAllowed
 
         # object-level
+        if "tagging" in q:
+            if m == "PUT":
+                return await self.put_object_tagging(request, bucket, key, body)
+            if m == "GET":
+                return await self.get_object_tagging(request, bucket, key)
+            if m == "DELETE":
+                return await self.delete_object_tagging(request, bucket, key)
         if m == "PUT":
             if "partNumber" in q and "uploadId" in q:
                 if "x-amz-copy-source" in request.headers:
@@ -491,6 +504,8 @@ class S3Server:
         if m == "GET":
             if "uploadId" in q:
                 return await self.list_parts(request, bucket, key)
+            if "lambdaArn" in q:
+                return await self.get_object_lambda(request, bucket, key)
             return await self.get_object(request, bucket, key)
         if m == "HEAD":
             return await self.head_object(request, bucket, key)
@@ -1437,6 +1452,96 @@ class S3Server:
                     )
             return web.Response(status=200)
         return web.Response(status=404)
+
+    async def get_object_lambda(self, request, bucket, key) -> web.Response:
+        """Object lambda: transform a GET through a user webhook
+        (reference cmd/object-lambda-handlers.go). Targets come from
+        MINIO_LAMBDA_WEBHOOK_ENABLE_<ID>/..._ENDPOINT_<ID>."""
+        import base64
+        import urllib.request as _ur
+
+        arn = request.rel_url.query.get("lambdaArn", "")
+        ident = arn.rsplit(":", 2)[-2] if arn.count(":") >= 2 else arn
+        endpoint = os.environ.get(f"MINIO_LAMBDA_WEBHOOK_ENDPOINT_{ident.upper()}", "")
+        enabled = os.environ.get(
+            f"MINIO_LAMBDA_WEBHOOK_ENABLE_{ident.upper()}", ""
+        ) in ("on", "true", "1")
+        if not endpoint or not enabled:
+            raise s3err.InvalidArgument
+        key_enc = listing.encode_dir_object(key)
+        oi, it = await self._run(self.store.get_object, bucket, key_enc)
+        payload = {
+            "getObjectContext": {
+                "inputS3Url": f"/{bucket}/{key}",
+                "bucket": bucket,
+                "key": key,
+                "content": base64.b64encode(b"".join(it)).decode(),
+            },
+            "userRequest": {"headers": dict(request.headers)},
+        }
+        import json as _json
+
+        def call():
+            req = _ur.Request(
+                endpoint, data=_json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            return _ur.urlopen(req, timeout=30).read()
+
+        try:
+            out = await self._run(call)
+        except Exception:  # noqa: BLE001
+            raise s3err.InternalError from None
+        try:
+            body = base64.b64decode(_json.loads(out)["content"])
+        except (ValueError, KeyError):
+            body = out  # raw transformed bytes are accepted too
+        return web.Response(body=body, content_type=oi.content_type)
+
+    # -- object tagging --------------------------------------------------------
+
+    async def put_object_tagging(self, request, bucket, key, body) -> web.Response:
+        key = listing.encode_dir_object(key)
+        vid = request.rel_url.query.get("versionId", "")
+        try:
+            root = ET.fromstring(body)
+        except ET.ParseError:
+            raise s3err.MalformedXML from None
+        tags = {}
+        for el in root.iter():
+            if el.tag.endswith("Tag"):
+                k = v = ""
+                for sub in el:
+                    if sub.tag.endswith("Key"):
+                        k = sub.text or ""
+                    elif sub.tag.endswith("Value"):
+                        v = sub.text or ""
+                if k:
+                    tags[k] = v
+        if len(tags) > 10:
+            raise s3err.InvalidArgument
+        await self._run(self.store.set_object_tags, bucket, key, tags, vid)
+        return web.Response(status=200)
+
+    async def get_object_tagging(self, request, bucket, key) -> web.Response:
+        key = listing.encode_dir_object(key)
+        vid = request.rel_url.query.get("versionId", "")
+        tags = await self._run(self.store.get_object_tags, bucket, key, vid)
+        items = "".join(
+            f"<Tag><Key>{escape(k)}</Key><Value>{escape(v)}</Value></Tag>"
+            for k, v in tags.items()
+        )
+        xml = (
+            '<?xml version="1.0" encoding="UTF-8"?>'
+            f"<Tagging><TagSet>{items}</TagSet></Tagging>"
+        )
+        return web.Response(body=xml.encode(), content_type="application/xml")
+
+    async def delete_object_tagging(self, request, bucket, key) -> web.Response:
+        key = listing.encode_dir_object(key)
+        vid = request.rel_url.query.get("versionId", "")
+        await self._run(self.store.set_object_tags, bucket, key, {}, vid)
+        return web.Response(status=204)
 
     async def select_object_content(self, request, bucket, key, body) -> web.Response:
         """SelectObjectContent: SQL over CSV/JSON objects

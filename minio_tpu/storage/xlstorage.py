@@ -172,6 +172,9 @@ class XLStorage(StorageAPI):
             self._write_meta(volume, path, meta)
 
     def update_metadata(self, volume: str, path: str, fi: FileInfo) -> None:
+        """Replace an existing version's record. CAUTION: fi is persisted
+        as-is — callers must have read it with read_data=True or an inline
+        object's payload would be replaced by the metadata-only marker."""
         with self._meta_lock:
             meta = self._read_meta(volume, path)
             if meta.find_version(fi.version_id) < 0:

@@ -246,3 +246,62 @@ def test_version_delete_does_not_nuke_replica(site_a, site_b, cli_a, cli_b):
     cli_a.delete_object("srcb", "mirror/versioned", version_id=vid1)
     time.sleep(1.5)
     assert cli_b.get_object("dstb", "mirror/versioned").body == b"v2"
+
+
+def test_object_tagging(cli_a):
+    cli_a.put_object("srcb", "tagged.txt", b"data")
+    xml = (b"<Tagging><TagSet>"
+           b"<Tag><Key>env</Key><Value>prod</Value></Tag>"
+           b"<Tag><Key>team</Key><Value>core</Value></Tag>"
+           b"</TagSet></Tagging>")
+    assert cli_a.request("PUT", "/srcb/tagged.txt", query={"tagging": ""},
+                         body=xml).status == 200
+    r = cli_a.request("GET", "/srcb/tagged.txt", query={"tagging": ""})
+    assert b"<Key>env</Key><Value>prod</Value>" in r.body
+    assert b"<Key>team</Key>" in r.body
+    assert cli_a.request("DELETE", "/srcb/tagged.txt", query={"tagging": ""}).status == 204
+    r = cli_a.request("GET", "/srcb/tagged.txt", query={"tagging": ""})
+    assert b"<Tag>" not in r.body
+    # object data unaffected by tagging churn
+    assert cli_a.get_object("srcb", "tagged.txt").body == b"data"
+
+
+def test_object_lambda(site_a, cli_a):
+    import http.server
+    import threading as _threading
+
+    from tests.test_s3_api import _free_port
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            import base64
+
+            n = int(self.headers.get("Content-Length", 0))
+            req = json.loads(self.rfile.read(n))
+            content = base64.b64decode(req["getObjectContext"]["content"])
+            out = json.dumps(
+                {"content": base64.b64encode(content.upper()).decode()}
+            ).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(out)))
+            self.end_headers()
+            self.wfile.write(out)
+
+        def log_message(self, *a):
+            pass
+
+    port = _free_port()
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", port), H)
+    _threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    os.environ["MINIO_LAMBDA_WEBHOOK_ENABLE_FN1"] = "on"
+    os.environ["MINIO_LAMBDA_WEBHOOK_ENDPOINT_FN1"] = f"http://127.0.0.1:{port}/fn"
+    try:
+        cli_a.put_object("srcb", "lambda.txt", b"hello lambda")
+        r = cli_a.get_object("srcb", "lambda.txt",
+                             query={"lambdaArn": "arn:minio:s3-object-lambda::fn1:webhook"})
+        assert r.status == 200, r.body
+        assert r.body == b"HELLO LAMBDA"
+    finally:
+        httpd.shutdown()
+        os.environ.pop("MINIO_LAMBDA_WEBHOOK_ENABLE_FN1", None)
+        os.environ.pop("MINIO_LAMBDA_WEBHOOK_ENDPOINT_FN1", None)
