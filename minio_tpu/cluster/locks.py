@@ -61,13 +61,18 @@ class LocalLocker:
     def lock(self, resource: str, uid: str) -> bool:
         with self._mu:
             e = self._locks.setdefault(
-                resource, {"writer": None, "wexp": 0.0, "readers": {}}
+                resource, {"writer": None, "wexp": 0.0, "readers": {},
+                           "wwait": 0.0}
             )
             self._purge(e)
             if e["writer"] or e["readers"]:
+                # writer priority: park a waiting-writer marker so a
+                # continuous stream of readers can't starve this writer
+                e["wwait"] = time.monotonic() + 2.0
                 return False
             e["writer"] = uid
             e["wexp"] = time.monotonic() + LOCK_TTL
+            e["wwait"] = 0.0
             return True
 
     def unlock(self, resource: str, uid: str) -> bool:
@@ -81,11 +86,14 @@ class LocalLocker:
     def rlock(self, resource: str, uid: str) -> bool:
         with self._mu:
             e = self._locks.setdefault(
-                resource, {"writer": None, "wexp": 0.0, "readers": {}}
+                resource, {"writer": None, "wexp": 0.0, "readers": {},
+                           "wwait": 0.0}
             )
             self._purge(e)
             if e["writer"]:
                 return False
+            if e.get("wwait", 0.0) > time.monotonic() and uid not in e["readers"]:
+                return False  # yield to the waiting writer
             c, _ = e["readers"].get(uid, (0, 0.0))
             e["readers"][uid] = (c + 1, time.monotonic() + LOCK_TTL)
             return True

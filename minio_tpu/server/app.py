@@ -137,8 +137,14 @@ class S3Server:
 
         self.kms = KMS()
         self.store = None
+        # store I/O runs on an ample dedicated pool: the default executor
+        # on small machines has ~cpus+4 workers, and writers blocking on
+        # namespace locks inside it can starve the reader that HOLDS the
+        # lock out of a thread to finish its stream (deadlock-by-pool)
+        io_threads = int(os.environ.get("MINIO_TPU_IO_THREADS", "64"))
+        self._io_pool = _TPE(max_workers=io_threads, thread_name_prefix="s3io")
         # long-poll waits (trace/listen subscribers) get their own pool so
-        # they can never starve the default executor that serves store I/O
+        # they can never starve the I/O pool
         self._longpoll_pool = _TPE(max_workers=64, thread_name_prefix="longpoll")
         self.region = region
         self.started_at = _time.time()
@@ -221,7 +227,7 @@ class S3Server:
 
     async def _run(self, fn, *args, **kw):
         return await asyncio.get_running_loop().run_in_executor(
-            None, lambda: fn(*args, **kw)
+            self._io_pool, lambda: fn(*args, **kw)
         )
 
     def _err_response(self, request, err: s3err.APIError) -> web.Response:
@@ -1079,7 +1085,7 @@ class S3Server:
         nxt = lambda: next(it, sentinel)  # noqa: E731
         try:
             while True:
-                chunk = await loop.run_in_executor(None, nxt)
+                chunk = await loop.run_in_executor(self._io_pool, nxt)
                 if chunk is sentinel:
                     break
                 await resp.write(chunk)
@@ -1665,18 +1671,24 @@ def make_object_layer(
     for pool_idx, paths in enumerate(pool_specs):
         disks = []
         any_local = False
+        from ..storage.health import HealthCheckedDisk
+
         for p in paths:
             ep = parse_endpoint(p, my_port)
             if ep.is_local:
                 d = XLStorage(ep.path, endpoint=p)
                 if local_drive_registry is not None:
+                    # the RPC server serves the RAW drive; health wrapping
+                    # happens on the calling side
                     local_drive_registry[global_idx] = d
                 any_local = True
             else:
                 d = StorageRESTClient(
                     ep.host, ep.port, global_idx, internode_token_value, endpoint=p
                 )
-            disks.append(d)
+            # circuit breaker: a dead drive fails fast instead of adding
+            # its timeout to every quorum operation
+            disks.append(HealthCheckedDisk(d))
             global_idx += 1
         if not any_local and local_drive_registry is not None:
             raise ValueError(f"pool {pool_idx}: no local drives for this node")
@@ -1713,6 +1725,7 @@ def main(argv: list[str] | None = None) -> None:
     )
     ap.add_argument("--address", default="0.0.0.0:9000")
     ap.add_argument("--set-size", type=int, default=0, help="drives per erasure set")
+    ap.add_argument("--ftp", type=int, default=0, help="FTP gateway port (0=off)")
     args = ap.parse_args(argv)
     host, _, port = args.address.rpartition(":")
     my_port = int(port)
@@ -1773,7 +1786,15 @@ def main(argv: list[str] | None = None) -> None:
         # RPC, so the listener must come up FIRST (on_startup blocks it)
         import asyncio
 
-        app["bootstrap"] = asyncio.create_task(bootstrap())
+        async def boot_then_gateways():
+            await bootstrap()
+            if args.ftp:
+                from .ftp import FTPGateway
+
+                await FTPGateway(srv).serve(host or "0.0.0.0", args.ftp)
+                print(f"FTP gateway on port {args.ftp}", flush=True)
+
+        app["bootstrap"] = asyncio.create_task(boot_then_gateways())
 
     srv.app.on_startup.append(on_start)
     web.run_app(srv.app, host=host or "0.0.0.0", port=my_port, print=None)

@@ -1,0 +1,166 @@
+"""Drive health tracking with circuit breaking.
+
+Mirrors the reference's per-drive health wrapper
+(/root/reference/cmd/xl-storage-disk-id-check.go): every StorageAPI call
+is timed and fault-counted; a drive that keeps failing is taken offline
+(calls short-circuit to DiskNotFound) and probed again after a cooldown,
+so one dead remote drive can't keep adding its full timeout to every
+quorum operation.
+
+Logical errors (missing files/volumes, corrupt shards) are NOT drive
+faults — only transport/OS-level failures trip the breaker.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+
+from . import errors
+from .interface import StorageAPI
+
+# errors that indicate the DRIVE is fine and the request was just wrong
+_LOGICAL = (
+    errors.FileNotFound,
+    errors.FileVersionNotFound,
+    errors.VolumeNotFound,
+    errors.VolumeExists,
+    errors.VolumeNotEmpty,
+    errors.FileAccessDenied,
+    errors.FileCorrupt,
+    errors.IsNotRegular,
+)
+
+_WRAPPED = (
+    "disk_info", "make_vol", "list_vols", "stat_vol", "delete_vol",
+    "write_metadata", "update_metadata", "read_version", "read_versions",
+    "delete_version", "delete_versions", "rename_data", "create_file",
+    "append_file", "read_file", "read_file_stream", "rename_file", "delete",
+    "list_dir", "stat_info_file", "verify_file",
+)
+
+
+class HealthCheckedDisk(StorageAPI):
+    """Circuit-breaking, latency-tracking proxy around any StorageAPI."""
+
+    def __init__(self, inner: StorageAPI, fail_threshold: int = 4,
+                 cooldown: float = 15.0):
+        self._inner = inner
+        self._threshold = fail_threshold
+        self._cooldown = cooldown
+        self._mu = threading.Lock()
+        self._consecutive_faults = 0
+        self._open_until = 0.0  # circuit-open deadline
+        self._probe_inflight = False
+        self._latencies: collections.deque = collections.deque(maxlen=64)
+        self.total_faults = 0
+
+    # passthrough identity
+    @property
+    def endpoint(self) -> str:  # type: ignore[override]
+        return self._inner.endpoint
+
+    @property
+    def disk_id(self) -> str:  # type: ignore[override]
+        return getattr(self._inner, "disk_id", "")
+
+    @disk_id.setter
+    def disk_id(self, v: str) -> None:
+        self._inner.disk_id = v
+
+    @property
+    def online(self) -> bool:
+        with self._mu:
+            return time.monotonic() >= self._open_until
+
+    def health(self) -> dict:
+        with self._mu:
+            lat = list(self._latencies)
+        return {
+            "endpoint": self.endpoint,
+            "online": self.online,
+            "totalFaults": self.total_faults,
+            "avgLatencyMs": round(sum(lat) / len(lat) * 1e3, 3) if lat else 0.0,
+        }
+
+    def _enter(self) -> bool:
+        """False -> circuit open, fail fast. After the cooldown exactly ONE
+        probe call is admitted (half-open); everyone else keeps failing
+        fast until the probe verdict lands."""
+        with self._mu:
+            now = time.monotonic()
+            if self._open_until == 0.0:
+                return True
+            if now < self._open_until:
+                return False
+            if self._probe_inflight:
+                return False  # someone is already probing
+            self._probe_inflight = True
+            return True
+
+    def _ok(self, dt: float) -> None:
+        with self._mu:
+            self._consecutive_faults = 0
+            self._open_until = 0.0  # probe success closes the circuit
+            self._probe_inflight = False
+            self._latencies.append(dt)
+
+    def _fault(self) -> None:
+        with self._mu:
+            self._consecutive_faults += 1
+            self.total_faults += 1
+            if self._probe_inflight:
+                # failed probe: re-open immediately, no threshold grace
+                self._probe_inflight = False
+                self._open_until = time.monotonic() + self._cooldown
+                self._consecutive_faults = 0
+            elif self._consecutive_faults >= self._threshold:
+                self._open_until = time.monotonic() + self._cooldown
+                self._consecutive_faults = 0
+
+    def _call(self, name: str, *a, **kw):
+        if not self._enter():
+            raise errors.DiskNotFound(f"{self.endpoint} (circuit open)")
+        t0 = time.monotonic()
+        try:
+            out = getattr(self._inner, name)(*a, **kw)
+        except _LOGICAL:
+            self._ok(time.monotonic() - t0)  # drive answered correctly
+            raise
+        except Exception:
+            self._fault()
+            raise
+        self._ok(time.monotonic() - t0)
+        return out
+
+    def walk_dir(self, volume, base=""):
+        # generator: account the iteration, not just construction
+        if not self._enter():
+            raise errors.DiskNotFound(f"{self.endpoint} (circuit open)")
+        t0 = time.monotonic()
+        try:
+            yield from self._inner.walk_dir(volume, base)
+        except _LOGICAL:
+            self._ok(time.monotonic() - t0)
+            raise
+        except Exception:
+            self._fault()
+            raise
+        self._ok(time.monotonic() - t0)
+
+
+def _make_method(name):
+    def method(self, *a, **kw):
+        return self._call(name, *a, **kw)
+
+    method.__name__ = name
+    return method
+
+
+for _name in _WRAPPED:
+    setattr(HealthCheckedDisk, _name, _make_method(_name))
+
+# the proxies above satisfy the StorageAPI contract, but ABC computed
+# abstractness before they were attached
+HealthCheckedDisk.__abstractmethods__ = frozenset()
