@@ -100,6 +100,78 @@ def _route_conditions(q) -> dict[str, str]:
     return {"s3:prefix": q.get("prefix", ""), "s3:delimiter": q.get("delimiter", "")}
 
 
+def _parse_form_data(body: bytes, boundary: bytes) -> tuple[dict[str, str], bytes]:
+    """Minimal multipart/form-data parser for POST-policy uploads.
+
+    Returns (fields, file_bytes); the file part's filename lands in
+    fields['__filename'].
+    """
+    fields: dict[str, str] = {}
+    file_data = b""
+    delim = b"--" + boundary
+    chunks = body.split(delim)
+    for part in chunks[1:]:  # [0] is the preamble
+        if part.startswith(b"--"):
+            break  # closing boundary
+        # strip EXACTLY the framing CRLFs — file payloads may legitimately
+        # begin/end with newline bytes that must survive
+        if part.startswith(b"\r\n"):
+            part = part[2:]
+        if part.endswith(b"\r\n"):
+            part = part[:-2]
+        head, _, content = part.partition(b"\r\n\r\n")
+        disp = ""
+        for line in head.split(b"\r\n"):
+            if line.lower().startswith(b"content-disposition"):
+                disp = line.decode("utf-8", "replace")
+        name = ""
+        filename = None
+        for tok in disp.split(";"):
+            tok = tok.strip()
+            if tok.startswith("name="):
+                name = tok[5:].strip('"')
+            elif tok.startswith("filename="):
+                filename = tok[9:].strip('"')
+        if not name:
+            continue
+        if name == "file":
+            file_data = content
+            if filename:
+                fields["__filename"] = filename.rsplit("/", 1)[-1]
+        else:
+            fields[name] = content.decode("utf-8", "replace")
+    return fields, file_data
+
+
+def _verify_checksum_headers(headers, body: bytes) -> dict[str, str]:
+    """AWS flexible-checksums: verify x-amz-checksum-* when present and
+    return internal metadata recording them (reference internal/hash
+    checksum readers). CRC32 via zlib, SHA1/SHA256 via hashlib; CRC32C is
+    stored unverified (no native implementation in the image)."""
+    import base64
+    import zlib as _zlib
+
+    out: dict[str, str] = {}
+    for algo in ("crc32", "crc32c", "sha1", "sha256"):
+        v = headers.get(f"x-amz-checksum-{algo}")
+        if not v:
+            continue
+        if algo == "crc32":
+            got = base64.b64encode(
+                (_zlib.crc32(body) & 0xFFFFFFFF).to_bytes(4, "big")
+            ).decode()
+        elif algo == "sha1":
+            got = base64.b64encode(hashlib.sha1(body).digest()).decode()
+        elif algo == "sha256":
+            got = base64.b64encode(hashlib.sha256(body).digest()).decode()
+        else:
+            got = v  # crc32c: stored, not verified
+        if got != v:
+            raise s3err.InvalidDigest
+        out[f"x-minio-internal-checksum-{algo}"] = v
+    return out
+
+
 def _bucket_sse_algo(encryption_xml: str | None) -> str | None:
     """SSEAlgorithm from a bucket's default-encryption config XML."""
     if not encryption_xml:
@@ -489,6 +561,9 @@ class S3Server:
             if m == "POST":
                 if "delete" in q:
                     return await self.delete_multiple(request, bucket, body)
+                ctype = request.headers.get("Content-Type", "")
+                if ctype.startswith("multipart/form-data"):
+                    return await self.post_policy_upload(request, bucket, body)
             raise s3err.MethodNotAllowed
 
         # object-level
@@ -820,6 +895,10 @@ class S3Server:
         for k, v in oi.user_defined.items():
             if k.startswith("x-amz-meta-") or k in ("cache-control", "content-disposition", "content-encoding", "content-language", "expires"):
                 h[k] = v
+        for calgo in ("crc32", "crc32c", "sha1", "sha256"):
+            v = oi.user_defined.get(f"x-minio-internal-checksum-{calgo}")
+            if v:
+                h[f"x-amz-checksum-{calgo}"] = v
         algo = oi.user_defined.get(ssemod.META_ALGO)
         if algo == "SSE-S3":
             h["x-amz-server-side-encryption"] = "AES256"
@@ -868,6 +947,7 @@ class S3Server:
 
             if base64.b64encode(hashlib.md5(body).digest()).decode() != md5_hdr:
                 raise s3err.BadDigest
+        checksum_meta = _verify_checksum_headers(request.headers, body)
         user_defined = {}
         ct = request.headers.get("Content-Type")
         if ct:
@@ -898,6 +978,7 @@ class S3Server:
         if tr.metadata:
             user_defined.update(tr.metadata)
             body = tr.data
+        user_defined.update(checksum_meta)
         oi = await self._run(
             self.store.put_object,
             bucket,
@@ -909,6 +990,8 @@ class S3Server:
         )
         headers = {"ETag": f'"{oi.etag}"'}
         headers.update(tr.response_headers)
+        for k, v in checksum_meta.items():
+            headers[k.replace("x-minio-internal-", "x-amz-")] = v
         if oi.version_id:
             headers["x-amz-version-id"] = oi.version_id
         from ..events import notify as ev
@@ -1503,6 +1586,134 @@ class S3Server:
         except (ValueError, KeyError):
             body = out  # raw transformed bytes are accepted too
         return web.Response(body=body, content_type=oi.content_type)
+
+    async def post_policy_upload(self, request, bucket: str, body: bytes) -> web.Response:
+        """POST object (browser form upload) with V4 POST-policy signature
+        (reference cmd/post-policy.go)."""
+        import base64
+        import hmac as _hmac
+        import json as _json
+
+        ctype = request.headers.get("Content-Type", "")
+        if "boundary=" not in ctype:
+            raise s3err.MalformedXML
+        boundary = (
+            ctype.split("boundary=", 1)[1].split(";", 1)[0].strip().strip('"').encode()
+        )
+        fields, file_data = _parse_form_data(body, boundary)
+        key = fields.get("key", "")
+        if not key:
+            raise s3err.InvalidArgument
+        if "${filename}" in key:
+            key = key.replace("${filename}", fields.get("__filename", "upload"))
+
+        policy_b64 = fields.get("policy", "")
+        ak = ""
+        if policy_b64:
+            cred = fields.get("x-amz-credential", "")
+            sig = fields.get("x-amz-signature", "")
+            parts = cred.split("/")
+            if len(parts) < 5 or parts[-1] != "aws4_request":
+                raise s3err.AccessDenied
+            ak = "/".join(parts[:-4])
+            secret = self.iam.lookup_secret(ak)
+            if secret is None:
+                raise s3err.InvalidAccessKeyId
+            skey = signature.signing_key(secret, parts[-4], parts[-3], parts[-2])
+            want = _hmac.new(skey, policy_b64.encode(), hashlib.sha256).hexdigest()
+            if not _hmac.compare_digest(want, sig):
+                raise s3err.SignatureDoesNotMatch
+            try:
+                pol = _json.loads(base64.b64decode(policy_b64))
+            except ValueError:
+                raise s3err.AccessDenied from None
+            import datetime as _dt
+
+            exp = pol.get("expiration", "")
+            if exp:
+                try:
+                    t = _dt.datetime.fromisoformat(exp.replace("Z", "+00:00"))
+                except ValueError:
+                    raise s3err.AccessDenied from None
+                if _dt.datetime.now(_dt.timezone.utc) > t:
+                    raise s3err.AccessDenied
+            for cond in pol.get("conditions", []):
+                if isinstance(cond, dict):
+                    for ck, cv in cond.items():
+                        if ck == "bucket" and cv != bucket:
+                            raise s3err.AccessDenied
+                        if ck == "key" and cv != key:
+                            raise s3err.AccessDenied
+                elif isinstance(cond, list) and len(cond) == 3:
+                    op, name, val = cond
+                    if str(op) == "content-length-range":
+                        try:
+                            lo, hi = int(name), int(val)
+                        except (TypeError, ValueError):
+                            raise s3err.AccessDenied from None
+                        if not lo <= len(file_data) <= hi:
+                            raise s3err.EntityTooLarge
+                        continue
+                    name = str(name).lstrip("$")
+                    have = {"bucket": bucket, "key": key}.get(name, fields.get(name, ""))
+                    if op == "eq" and have != val:
+                        raise s3err.AccessDenied
+                    if op == "starts-with" and not str(have).startswith(str(val)):
+                        raise s3err.AccessDenied
+        self._authorize(ak, "s3:PutObject", bucket, key)
+        user_defined = {
+            k: v for k, v in fields.items() if k.startswith("x-amz-meta-")
+        }
+        ct = fields.get("Content-Type") or fields.get("content-type") or ""
+        if ct:
+            user_defined["content-type"] = ct
+        bm = self.buckets.get(bucket)
+        # same pipeline as PUT: bucket-default SSE/compression apply here too
+        from ..crypto.sse import CryptoError
+        from . import transforms
+
+        try:
+            tr = transforms.encode_for_store(
+                file_data, key, ct, {}, _bucket_sse_algo(bm.encryption),
+                self.kms, bucket,
+            )
+        except CryptoError:
+            raise s3err.InvalidArgument from None
+        if tr.metadata:
+            user_defined.update(tr.metadata)
+            file_data = tr.data
+        oi = await self._run(
+            self.store.put_object, bucket, listing.encode_dir_object(key),
+            file_data, user_defined, None, bm.versioning,
+        )
+        from ..events import notify as ev
+
+        self.notifier.notify(
+            "s3:ObjectCreated:Post", bucket, key, oi.size, oi.etag,
+            oi.version_id, ak,
+        )
+        self.replication.queue_mutation(
+            bucket, listing.encode_dir_object(key), oi.version_id, "put"
+        )
+        try:
+            status = int(fields.get("success_action_status", "204"))
+        except ValueError:
+            status = 204
+        if status not in (200, 201, 204):
+            status = 204
+        headers = {"ETag": f'"{oi.etag}"'}
+        if status == 201:
+            xml = (
+                '<?xml version="1.0" encoding="UTF-8"?>'
+                f"<PostResponse><Bucket>{escape(bucket)}</Bucket>"
+                f"<Key>{escape(key)}</Key><ETag>&quot;{oi.etag}&quot;</ETag>"
+                "</PostResponse>"
+            )
+            return web.Response(
+                status=201, body=xml.encode(), content_type="application/xml",
+                headers=headers,
+            )
+        return web.Response(status=status, headers=headers)
 
     # -- object tagging --------------------------------------------------------
 

@@ -265,3 +265,121 @@ def test_complete_multipart_empty_parts(cli):
     r = cli.request("POST", "/mty/obj", query={"uploadId": uid},
                     body=b"<CompleteMultipartUpload></CompleteMultipartUpload>")
     assert r.status == 400, r.body
+
+
+def test_checksum_headers(cli):
+    import base64 as _b64
+    import zlib as _zlib
+
+    cli.make_bucket("cksum")
+    body = b"checksummed content"
+    crc = _b64.b64encode((_zlib.crc32(body) & 0xFFFFFFFF).to_bytes(4, "big")).decode()
+    r = cli.put_object("cksum", "ok", body, headers={"x-amz-checksum-crc32": crc})
+    assert r.status == 200 and r.headers.get("x-amz-checksum-crc32") == crc
+    g = cli.get_object("cksum", "ok")
+    assert g.headers.get("x-amz-checksum-crc32") == crc
+    # wrong checksum refused
+    r = cli.put_object("cksum", "bad", body, headers={"x-amz-checksum-crc32": "AAAAAA=="})
+    assert r.status == 400
+
+
+def test_post_policy_upload(cli, server):
+    import base64 as _b64
+    import hashlib as _hashlib
+    import hmac as _hmac
+    import json as _json
+    import time as _time
+
+    from minio_tpu.server.signature import signing_key
+
+    cli.make_bucket("forms")
+    key = "uploads/photo.bin"
+    amz_date = _time.strftime("%Y%m%dT%H%M%SZ", _time.gmtime())
+    scope_date = amz_date[:8]
+    cred = f"minioadmin/{scope_date}/us-east-1/s3/aws4_request"
+    policy = {
+        "expiration": _time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", _time.gmtime(_time.time() + 600)
+        ),
+        "conditions": [
+            {"bucket": "forms"},
+            ["starts-with", "$key", "uploads/"],
+            {"x-amz-credential": cred},
+            {"x-amz-date": amz_date},
+        ],
+    }
+    policy_b64 = _b64.b64encode(_json.dumps(policy).encode()).decode()
+    skey = signing_key("minioadmin", scope_date, "us-east-1")
+    sig = _hmac.new(skey, policy_b64.encode(), _hashlib.sha256).hexdigest()
+    boundary = "xxFORMBOUNDARYxx"
+    fields = [
+        ("key", key), ("policy", policy_b64),
+        ("x-amz-algorithm", "AWS4-HMAC-SHA256"),
+        ("x-amz-credential", cred), ("x-amz-date", amz_date),
+        ("x-amz-signature", sig), ("success_action_status", "201"),
+    ]
+    parts = []
+    for n, v in fields:
+        parts.append(
+            f'--{boundary}\r\nContent-Disposition: form-data; name="{n}"\r\n\r\n{v}\r\n'
+        )
+    parts.append(
+        f'--{boundary}\r\nContent-Disposition: form-data; name="file"; '
+        f'filename="photo.bin"\r\nContent-Type: application/octet-stream\r\n\r\n'
+    )
+    body = "".join(parts).encode() + b"FORMDATA-BYTES\r\n" + f"--{boundary}--\r\n".encode()
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", server.port)
+    conn.request(
+        "POST", "/forms", body=body,
+        headers={"Content-Type": f"multipart/form-data; boundary={boundary}"},
+    )
+    resp = conn.getresponse()
+    out = resp.read()
+    assert resp.status == 201, out
+    assert b"<PostResponse>" in out
+    assert cli.get_object("forms", key).body == b"FORMDATA-BYTES"
+    # tampered signature refused
+    bad = body.replace(sig.encode(), b"0" * 64)
+    conn = http.client.HTTPConnection("127.0.0.1", server.port)
+    conn.request("POST", "/forms", body=bad,
+                 headers={"Content-Type": f"multipart/form-data; boundary={boundary}"})
+    assert conn.getresponse().status == 403
+
+
+def test_post_upload_preserves_newline_bytes(cli, server):
+    # file content beginning/ending with CRLF must survive form framing
+    import http.client
+
+    import base64 as _b64
+    import hashlib as _hashlib
+    import hmac as _hmac
+    import json as _json
+    import time as _time
+
+    from minio_tpu.server.signature import signing_key
+
+    cli.make_bucket("newlines")
+    b = "bd789"
+    content = b"\r\nline1\nline2\r\n"
+    amz_date = _time.strftime("%Y%m%dT%H%M%SZ", _time.gmtime())
+    cred = f"minioadmin/{amz_date[:8]}/us-east-1/s3/aws4_request"
+    pb = _b64.b64encode(_json.dumps({"conditions": [{"bucket": "newlines"}]}).encode()).decode()
+    sig = _hmac.new(
+        signing_key("minioadmin", amz_date[:8], "us-east-1"), pb.encode(), _hashlib.sha256
+    ).hexdigest()
+    form = "".join(
+        f'--{b}\r\nContent-Disposition: form-data; name="{n}"\r\n\r\n{v}\r\n'
+        for n, v in [("key", "nl.txt"), ("policy", pb), ("x-amz-credential", cred),
+                     ("x-amz-signature", sig)]
+    ).encode() + (
+        f'--{b}\r\nContent-Disposition: form-data; name="file"; filename="x"\r\n\r\n'
+    ).encode() + content + f"\r\n--{b}--\r\n".encode()
+    conn = http.client.HTTPConnection("127.0.0.1", server.port)
+    conn.request(
+        "POST", "/newlines", body=form,
+        headers={"Content-Type": f"multipart/form-data; boundary={b}; charset=utf-8"},
+    )
+    assert conn.getresponse().status == 204
+    assert cli.get_object("newlines", "nl.txt").body == content
