@@ -34,7 +34,8 @@ def main() -> None:
 
     d, p = 8, 8
     n = (1 << 20) // d  # 1 MiB stripe block -> 128 KiB shards
-    B = 128  # concurrent stripe blocks per dispatch (2048 shard lanes)
+    B = 192  # concurrent stripe blocks per dispatch (3072 shard lanes;
+    # 256 blocks OOMs HBM with the hash lane arrays)
     codec = get_tpu_codec(d, p)
     data = np.random.default_rng(0).integers(0, 256, size=(B, d, n), dtype=np.uint8)
     dd = jax.device_put(data)

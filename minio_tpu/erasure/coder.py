@@ -22,8 +22,9 @@ from ..ops import rs
 from ..ops.highwayhash import hash256_batch_numpy
 from . import bitrot_io
 
-# max shards per device dispatch (HBM headroom: see bitrot_jax scan inputs)
-MAX_DEVICE_SHARDS = 4096
+# max shards per device dispatch (HBM headroom: the hash lane arrays
+# OOM above ~3072 shards of 128 KiB on a 16 GB chip)
+MAX_DEVICE_SHARDS = 3072
 
 BLOCK_SIZE = 1 << 20  # 1 MiB stripe block, reference blockSizeV2
 # (/root/reference/cmd/object-api-common.go:37)
