@@ -148,6 +148,11 @@ class ServerPools:
             bucket, obj, tags, version_id
         )
 
+    def update_object_metadata(self, bucket, obj, version_id, mutate):
+        return self._pool_holding(bucket, obj, version_id).update_object_metadata(
+            bucket, obj, version_id, mutate
+        )
+
     def get_object_tags(self, bucket, obj, version_id=""):
         return self._pool_holding(bucket, obj, version_id).get_object_tags(
             bucket, obj, version_id

@@ -499,16 +499,15 @@ class ErasureSet:
 
     TAGS_META_KEY = "x-minio-internal-tags"
 
-    def set_object_tags(
-        self, bucket: str, obj: str, tags: dict[str, str], version_id: str = ""
+    def update_object_metadata(
+        self, bucket: str, obj: str, version_id: str, mutate
     ) -> None:
-        """Store object tags in version metadata (reference PutObjectTags,
-        cmd/erasure-object.go)."""
-        import urllib.parse as _up
-
+        """Quorum read-modify-write of a version's metadata under the
+        namespace write lock. `mutate(metadata_dict)` edits in place.
+        Serves tagging, retention, and legal holds."""
         mtx = self.ns.new(bucket, obj)
         if not mtx.lock(30.0):
-            raise QuorumError(f"lock timeout tagging {bucket}/{obj}")
+            raise QuorumError(f"lock timeout updating {bucket}/{obj}")
         try:
             # read_data=True: the rewrite below persists the FileInfo as-is,
             # so inline payloads must ride along (the metadata-only read
@@ -518,27 +517,37 @@ class ErasureSet:
             )
             if fi.deleted:
                 raise ObjectNotFound(f"{bucket}/{obj}")
-            encoded = _up.urlencode(tags)
-
-            def update(disk, m):
-                if m is None:
-                    raise errors.FileNotFound(obj)
-                if encoded:
-                    m.metadata[self.TAGS_META_KEY] = encoded
-                else:
-                    m.metadata.pop(self.TAGS_META_KEY, None)
-                disk.update_metadata(bucket, obj, m)
 
             errs = []
             for disk, m in zip(self.disks, metas):
                 try:
-                    update(disk, m)
+                    if m is None:
+                        raise errors.FileNotFound(obj)
+                    mutate(m.metadata)
+                    disk.update_metadata(bucket, obj, m)
                     errs.append(None)
                 except Exception as e:  # noqa: BLE001
                     errs.append(e)
             reduce_quorum_errs(errs, write_q)
         finally:
             mtx.unlock()
+
+    def set_object_tags(
+        self, bucket: str, obj: str, tags: dict[str, str], version_id: str = ""
+    ) -> None:
+        """Store object tags in version metadata (reference PutObjectTags,
+        cmd/erasure-object.go)."""
+        import urllib.parse as _up
+
+        encoded = _up.urlencode(tags)
+
+        def mutate(md: dict) -> None:
+            if encoded:
+                md[self.TAGS_META_KEY] = encoded
+            else:
+                md.pop(self.TAGS_META_KEY, None)
+
+        self.update_object_metadata(bucket, obj, version_id, mutate)
 
     def get_object_tags(
         self, bucket: str, obj: str, version_id: str = ""

@@ -115,6 +115,11 @@ class ErasureSets:
     def set_object_tags(self, bucket, obj, tags, version_id=""):
         return self.get_hashed_set(obj).set_object_tags(bucket, obj, tags, version_id)
 
+    def update_object_metadata(self, bucket, obj, version_id, mutate):
+        return self.get_hashed_set(obj).update_object_metadata(
+            bucket, obj, version_id, mutate
+        )
+
     def get_object_tags(self, bucket, obj, version_id=""):
         return self.get_hashed_set(obj).get_object_tags(bucket, obj, version_id)
 

@@ -50,6 +50,16 @@ def _route_action(m: str, bucket: str, key: str, q, headers) -> tuple[str, str, 
     """(action, bucket, key) for authorization — the request->policy-action
     mapping the reference does per-handler via checkRequestAuthType."""
     if key:
+        if "retention" in q:
+            return (
+                "s3:GetObjectRetention" if m in ("GET", "HEAD")
+                else "s3:PutObjectRetention"
+            ), bucket, key
+        if "legal-hold" in q:
+            return (
+                "s3:GetObjectLegalHold" if m in ("GET", "HEAD")
+                else "s3:PutObjectLegalHold"
+            ), bucket, key
         if "tagging" in q:
             return {
                 "GET": "s3:GetObjectTagging",
@@ -567,6 +577,16 @@ class S3Server:
             raise s3err.MethodNotAllowed
 
         # object-level
+        if "retention" in q:
+            if m == "PUT":
+                return await self.put_object_retention(request, bucket, key, body)
+            if m == "GET":
+                return await self.get_object_retention(request, bucket, key)
+        if "legal-hold" in q:
+            if m == "PUT":
+                return await self.put_legal_hold(request, bucket, key, body)
+            if m == "GET":
+                return await self.get_legal_hold(request, bucket, key)
         if "tagging" in q:
             if m == "PUT":
                 return await self.put_object_tagging(request, bucket, key, body)
@@ -687,6 +707,10 @@ class S3Server:
         except ET.ParseError:
             raise s3err.MalformedXML from None
         bm = self.buckets.get(bucket)
+        if bm.object_lock and status != "Enabled":
+            # AWS: versioning cannot be suspended on object-lock buckets
+            # (retention would otherwise guard nothing)
+            raise s3err.InvalidBucketState
         bm.versioning = status == "Enabled"
         bm.versioning_suspended = status == "Suspended"
         await self._run(self.buckets.set, bucket, bm)
@@ -784,6 +808,7 @@ class S3Server:
     async def list_objects(self, request, bucket: str) -> web.Response:
         q = request.rel_url.query
         v2 = q.get("list-type") == "2"
+        url_encode = q.get("encoding-type") == "url"
         prefix = q.get("prefix", "")
         delimiter = q.get("delimiter", "")
         try:
@@ -797,27 +822,33 @@ class S3Server:
         res = await self._run(
             listing.list_objects, self.store, bucket, prefix, marker, delimiter, max_keys
         )
+        def enc(s: str) -> str:
+            # encoding-type=url: keys percent-encoded so control chars in
+            # names survive XML (reference s3EncodeName)
+            return urllib.parse.quote(s, safe="/") if url_encode else escape(s)
+
         contents = "".join(
-            f"<Contents><Key>{escape(o.name)}</Key>"
+            f"<Contents><Key>{enc(o.name)}</Key>"
             f"<LastModified>{_iso8601(o.mod_time)}</LastModified>"
             f'<ETag>"{o.etag}"</ETag><Size>{o.size}</Size>'
             f"<StorageClass>STANDARD</StorageClass></Contents>"
             for o in res.objects
         )
         prefixes = "".join(
-            f"<CommonPrefixes><Prefix>{escape(p)}</Prefix></CommonPrefixes>"
+            f"<CommonPrefixes><Prefix>{enc(p)}</Prefix></CommonPrefixes>"
             for p in res.prefixes
         )
         common = (
-            f"<Name>{escape(bucket)}</Name><Prefix>{escape(prefix)}</Prefix>"
+            f"<Name>{escape(bucket)}</Name><Prefix>{enc(prefix)}</Prefix>"
             f"<MaxKeys>{max_keys}</MaxKeys>"
             f"<Delimiter>{escape(delimiter)}</Delimiter>"
-            f"<IsTruncated>{'true' if res.is_truncated else 'false'}</IsTruncated>"
+            + ("<EncodingType>url</EncodingType>" if url_encode else "")
+            + f"<IsTruncated>{'true' if res.is_truncated else 'false'}</IsTruncated>"
         )
         if v2:
             extra = f"<KeyCount>{len(res.objects) + len(res.prefixes)}</KeyCount>"
             if res.is_truncated:
-                extra += f"<NextContinuationToken>{escape(res.next_marker)}</NextContinuationToken>"
+                extra += f"<NextContinuationToken>{enc(res.next_marker)}</NextContinuationToken>"
             xml = (
                 '<?xml version="1.0" encoding="UTF-8"?>'
                 '<ListBucketResult xmlns="http://s3.amazonaws.com/doc/2006-03-01/">'
@@ -826,7 +857,7 @@ class S3Server:
         else:
             extra = ""
             if res.is_truncated:
-                extra = f"<NextMarker>{escape(res.next_marker)}</NextMarker>"
+                extra = f"<NextMarker>{enc(res.next_marker)}</NextMarker>"
             xml = (
                 '<?xml version="1.0" encoding="UTF-8"?>'
                 '<ListBucketResult xmlns="http://s3.amazonaws.com/doc/2006-03-01/">'
@@ -1239,6 +1270,7 @@ class S3Server:
             vid = ""
         bm = self.buckets.get(bucket)
         headers = {}
+        await self._run(self._check_object_lock, bucket, key, vid)
         try:
             oi = await self._run(
                 self.store.delete_object, bucket, key, vid, bm.versioning
@@ -1299,6 +1331,12 @@ class S3Server:
                 results.append((k, v, s3err.AccessDenied, None))
                 continue
             try:
+                # retention/legal hold protects versions through
+                # multi-delete exactly as through single DELETE
+                await self._run(
+                    self._check_object_lock, bucket,
+                    listing.encode_dir_object(k), "" if v == "null" else v,
+                )
                 oi = await self._run(
                     self.store.delete_object,
                     bucket,
@@ -1309,6 +1347,8 @@ class S3Server:
                 results.append((k, v, None, oi))
             except (quorum.ObjectNotFound, quorum.VersionNotFound):
                 results.append((k, v, None, None))
+            except s3err.APIError as e:
+                results.append((k, v, e, None))  # e.g. retention AccessDenied
             except Exception:  # noqa: BLE001
                 results.append((k, v, s3err.InternalError, None))
         parts = []
@@ -1714,6 +1754,147 @@ class S3Server:
                 headers=headers,
             )
         return web.Response(status=status, headers=headers)
+
+    # -- object lock: retention + legal hold ----------------------------------
+
+    RETENTION_META = "x-minio-internal-retention"  # "<mode>|<iso-until>"
+    LEGALHOLD_META = "x-minio-internal-legalhold"
+
+    def _require_lock_bucket(self, bucket: str) -> None:
+        if not self.buckets.get(bucket).object_lock:
+            raise s3err.InvalidArgument  # lock config required on bucket
+
+    @staticmethod
+    def _parse_retain_until(until: str):
+        """Aware datetime or raises MalformedXML (naive/garbage dates must
+        never be stored: they'd poison every later delete)."""
+        import datetime as _dt
+
+        try:
+            t = _dt.datetime.fromisoformat(until.replace("Z", "+00:00"))
+        except ValueError:
+            raise s3err.MalformedXML from None
+        if t.tzinfo is None:
+            raise s3err.MalformedXML
+        return t
+
+    async def put_object_retention(self, request, bucket, key, body) -> web.Response:
+        import datetime as _dt
+
+        self._require_lock_bucket(bucket)
+        key = listing.encode_dir_object(key)
+        vid = request.rel_url.query.get("versionId", "")
+        try:
+            root = ET.fromstring(body)
+            mode = until = ""
+            for el in root.iter():
+                if el.tag.endswith("Mode"):
+                    mode = el.text or ""
+                elif el.tag.endswith("RetainUntilDate"):
+                    until = (el.text or "").strip()
+            if mode not in ("GOVERNANCE", "COMPLIANCE") or not until:
+                raise s3err.MalformedXML
+        except ET.ParseError:
+            raise s3err.MalformedXML from None
+        new_until = self._parse_retain_until(until)
+        # COMPLIANCE retention can never be shortened or weakened
+        oi = await self._run(self.store.get_object_info, bucket, key, vid)
+        existing = oi.user_defined.get(self.RETENTION_META, "")
+        if existing:
+            old_mode, old_until_s = existing.split("|", 1)
+            try:
+                old_until = self._parse_retain_until(old_until_s)
+            except s3err.APIError:
+                old_until = None
+            if (
+                old_mode == "COMPLIANCE"
+                and old_until is not None
+                and _dt.datetime.now(_dt.timezone.utc) < old_until
+                and (mode != "COMPLIANCE" or new_until < old_until)
+            ):
+                raise s3err.AccessDenied
+        val = "{}|{}".format(
+            mode,
+            new_until.astimezone(_dt.timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ"),
+        )
+        await self._run(
+            self.store.update_object_metadata, bucket, key, vid,
+            lambda md: md.__setitem__(self.RETENTION_META, val),
+        )
+        return web.Response(status=200)
+
+    async def get_object_retention(self, request, bucket, key) -> web.Response:
+        key = listing.encode_dir_object(key)
+        vid = request.rel_url.query.get("versionId", "")
+        oi = await self._run(self.store.get_object_info, bucket, key, vid)
+        raw = oi.user_defined.get(self.RETENTION_META, "")
+        if not raw:
+            raise s3err.ObjectLockConfigurationNotFoundError
+        mode, until = raw.split("|", 1)
+        xml = (
+            '<?xml version="1.0" encoding="UTF-8"?>'
+            f"<Retention><Mode>{escape(mode)}</Mode>"
+            f"<RetainUntilDate>{escape(until)}</RetainUntilDate></Retention>"
+        )
+        return web.Response(body=xml.encode(), content_type="application/xml")
+
+    async def put_legal_hold(self, request, bucket, key, body) -> web.Response:
+        self._require_lock_bucket(bucket)
+        key = listing.encode_dir_object(key)
+        vid = request.rel_url.query.get("versionId", "")
+        try:
+            root = ET.fromstring(body)
+            status = ""
+            for el in root.iter():
+                if el.tag.endswith("Status"):
+                    status = (el.text or "").strip()
+        except ET.ParseError:
+            raise s3err.MalformedXML from None
+        if status not in ("ON", "OFF"):
+            # malformed input must never silently CLEAR an active hold
+            raise s3err.MalformedXML
+        await self._run(
+            self.store.update_object_metadata, bucket, key, vid,
+            lambda md: md.__setitem__(self.LEGALHOLD_META, status),
+        )
+        return web.Response(status=200)
+
+    async def get_legal_hold(self, request, bucket, key) -> web.Response:
+        key = listing.encode_dir_object(key)
+        vid = request.rel_url.query.get("versionId", "")
+        oi = await self._run(self.store.get_object_info, bucket, key, vid)
+        status = oi.user_defined.get(self.LEGALHOLD_META, "OFF")
+        xml = (
+            '<?xml version="1.0" encoding="UTF-8"?>'
+            f"<LegalHold><Status>{status}</Status></LegalHold>"
+        )
+        return web.Response(body=xml.encode(), content_type="application/xml")
+
+    def _check_object_lock(self, bucket: str, key: str, vid: str) -> None:
+        """Block data-destroying deletes while retention/legal hold is
+        active (reference: enforceRetentionForDeletion)."""
+        if not vid:
+            # on a VERSIONED bucket this only adds a marker; on an
+            # unversioned one it destroys the latest version — guard it
+            if self.buckets.get(bucket).versioning:
+                return
+        try:
+            oi = self.store.get_object_info(bucket, key, vid)
+        except Exception:  # noqa: BLE001 — missing version: nothing to guard
+            return
+        if oi.user_defined.get(self.LEGALHOLD_META) == "ON":
+            raise s3err.AccessDenied
+        raw = oi.user_defined.get(self.RETENTION_META, "")
+        if raw:
+            import datetime as _dt
+
+            _, until = raw.split("|", 1)
+            try:
+                t = _dt.datetime.fromisoformat(until.replace("Z", "+00:00"))
+            except ValueError:
+                raise s3err.AccessDenied from None
+            if t.tzinfo is None or _dt.datetime.now(_dt.timezone.utc) < t:
+                raise s3err.AccessDenied
 
     # -- object tagging --------------------------------------------------------
 

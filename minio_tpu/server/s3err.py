@@ -51,6 +51,7 @@ NotModified = APIError("NotModified", "Not Modified", 304)
 SignatureDoesNotMatch = APIError("SignatureDoesNotMatch", "The request signature we calculated does not match the signature you provided. Check your key and signing method.", 403)
 MethodNotAllowed = APIError("MethodNotAllowed", "The specified method is not allowed against this resource.", 405)
 BucketNotEmpty = APIError("BucketNotEmpty", "The bucket you tried to delete is not empty", 409)
+InvalidBucketState = APIError("InvalidBucketState", "The request is not valid with the current state of the bucket.", 409)
 BucketAlreadyOwnedByYou = APIError("BucketAlreadyOwnedByYou", "Your previous request to create the named bucket succeeded and you already own it.", 409)
 BucketAlreadyExists = APIError("BucketAlreadyExists", "The requested bucket name is not available. The bucket namespace is shared by all users of the system. Please select a different name and try again.", 409)
 InvalidPart = APIError("InvalidPart", "One or more of the specified parts could not be found.  The part may not have been uploaded, or the specified entity tag may not match the part's entity tag.", 400)

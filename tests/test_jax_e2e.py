@@ -1,0 +1,42 @@
+"""End-to-end object store on the JAX backend (CPU): the dispatcher and
+fused device pipeline serve real put/get/heal traffic, not just op tests."""
+
+import os
+
+import numpy as np
+import pytest
+
+from minio_tpu.erasure.set import ErasureSet
+from minio_tpu.storage.xlstorage import XLStorage
+
+
+@pytest.fixture
+def jax_store(tmp_path, monkeypatch):
+    monkeypatch.setenv("MINIO_TPU_BACKEND", "jax")
+    disks = [XLStorage(str(tmp_path / f"d{i}")) for i in range(4)]
+    es = ErasureSet(disks)
+    es.make_bucket("jaxb")
+    return es
+
+
+def test_jax_backend_put_get_heal(jax_store, tmp_path):
+    rng = np.random.default_rng(4)
+    # 2.5 MiB: two full device-encoded stripe blocks + native CPU tail
+    data = rng.integers(0, 256, size=(5 << 19) + 77, dtype=np.uint8).tobytes()
+    oi = jax_store.put_object("jaxb", "dev-obj", data)
+    assert oi.size == len(data)
+    _, it = jax_store.get_object("jaxb", "dev-obj")
+    assert b"".join(it) == data
+    # the device dispatcher actually carried the full blocks
+    from minio_tpu.parallel.dispatcher import _dispatchers
+
+    assert any(d.stats["blocks"] > 0 for d in _dispatchers.values())
+    # kill a drive; degraded read + heal on the same pipeline
+    import shutil
+
+    shutil.rmtree(tmp_path / "d1" / "jaxb")
+    (tmp_path / "d1" / "jaxb").mkdir()
+    _, it = jax_store.get_object("jaxb", "dev-obj")
+    assert b"".join(it) == data
+    res = jax_store.heal_object("jaxb", "dev-obj")
+    assert len(res["healed"]) == 1

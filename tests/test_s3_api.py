@@ -383,3 +383,74 @@ def test_post_upload_preserves_newline_bytes(cli, server):
     )
     assert conn.getresponse().status == 204
     assert cli.get_object("newlines", "nl.txt").body == content
+
+
+def test_object_lock_retention(cli, server):
+    import time as _time
+
+    r = cli.request("PUT", "/lockbkt", headers={
+        "x-amz-bucket-object-lock-enabled": "true"})
+    assert r.status == 200
+    v = cli.put_object("lockbkt", "held.doc", b"immutable").headers["x-amz-version-id"]
+    until = _time.strftime("%Y-%m-%dT%H:%M:%SZ", _time.gmtime(_time.time() + 3600))
+    ret = (f"<Retention><Mode>GOVERNANCE</Mode>"
+           f"<RetainUntilDate>{until}</RetainUntilDate></Retention>").encode()
+    assert cli.request("PUT", "/lockbkt/held.doc",
+                       query={"retention": "", "versionId": v}, body=ret).status == 200
+    g = cli.request("GET", "/lockbkt/held.doc", query={"retention": ""})
+    assert b"GOVERNANCE" in g.body and until.encode() in g.body
+    # deleting the protected VERSION is refused; marker deletes still work
+    assert cli.delete_object("lockbkt", "held.doc", version_id=v).status == 403
+    d = cli.delete_object("lockbkt", "held.doc")
+    assert d.status == 204 and d.headers.get("x-amz-delete-marker") == "true"
+    # legal hold
+    v2 = cli.put_object("lockbkt", "legal.doc", b"on hold").headers["x-amz-version-id"]
+    assert cli.request("PUT", "/lockbkt/legal.doc",
+                       query={"legal-hold": "", "versionId": v2},
+                       body=b"<LegalHold><Status>ON</Status></LegalHold>").status == 200
+    assert cli.delete_object("lockbkt", "legal.doc", version_id=v2).status == 403
+    cli.request("PUT", "/lockbkt/legal.doc",
+                query={"legal-hold": "", "versionId": v2},
+                body=b"<LegalHold><Status>OFF</Status></LegalHold>")
+    assert cli.delete_object("lockbkt", "legal.doc", version_id=v2).status == 204
+
+
+def test_encoding_type_url(cli):
+    cli.make_bucket("encb")
+    cli.put_object("encb", "sp ace/key#1.txt", b"x")
+    r = cli.list_objects_v2("encb")
+    # default: literal (xml-escaped) keys
+    assert b"sp ace/key#1.txt" in r.body
+    r = cli.request("GET", "/encb", query={"list-type": "2", "encoding-type": "url"})
+    assert b"sp%20ace/key%231.txt" in r.body
+    assert b"<EncodingType>url</EncodingType>" in r.body
+
+
+def test_object_lock_multi_delete_and_compliance(cli):
+    import time as _time
+
+    cli.request("PUT", "/wormb", headers={"x-amz-bucket-object-lock-enabled": "true"})
+    v = cli.put_object("wormb", "ledger", b"entries").headers["x-amz-version-id"]
+    until = _time.strftime("%Y-%m-%dT%H:%M:%SZ", _time.gmtime(_time.time() + 3600))
+    ret = (f"<Retention><Mode>COMPLIANCE</Mode>"
+           f"<RetainUntilDate>{until}</RetainUntilDate></Retention>").encode()
+    assert cli.request("PUT", "/wormb/ledger",
+                       query={"retention": "", "versionId": v}, body=ret).status == 200
+    # multi-delete must not bypass retention
+    xml = f"<Delete><Object><Key>ledger</Key><VersionId>{v}</VersionId></Object></Delete>".encode()
+    r = cli.request("POST", "/wormb", query={"delete": ""}, body=xml)
+    assert r.status == 200 and b"AccessDenied" in r.body
+    assert cli.get_object("wormb", "ledger", query={"versionId": v}).status == 200
+    # COMPLIANCE cannot be shortened or downgraded
+    sooner = _time.strftime("%Y-%m-%dT%H:%M:%SZ", _time.gmtime(_time.time() + 5))
+    weak = (f"<Retention><Mode>GOVERNANCE</Mode>"
+            f"<RetainUntilDate>{sooner}</RetainUntilDate></Retention>").encode()
+    assert cli.request("PUT", "/wormb/ledger",
+                       query={"retention": "", "versionId": v}, body=weak).status == 403
+    # malformed legal hold must not clear anything (400, not silent OFF)
+    assert cli.request("PUT", "/wormb/ledger",
+                       query={"legal-hold": "", "versionId": v},
+                       body=b"<LegalHold><Status>MAYBE</Status></LegalHold>").status == 400
+    # lock bucket cannot suspend versioning
+    cfg = b"<VersioningConfiguration><Status>Suspended</Status></VersioningConfiguration>"
+    assert cli.request("PUT", "/wormb", query={"versioning": ""}, body=cfg).status == 409
