@@ -630,28 +630,81 @@ class ErasureSet:
         if not stale:
             return {"healed": [], "type": "object"}
 
-        # rebuild the full shard files for stale drives, part by part
+        # rebuild the full shard files for stale drives, part by part —
+        # FULL stripe blocks batch onto the device (one reconstruct matmul
+        # + one hash dispatch for many blocks, the HealObject north-star);
+        # tails and small objects take the native CPU path
         per_part_rebuilt: dict[int, dict[int, bytearray]] = {}
+        survivors_idx = sorted(good.keys())[:d]
+        missing_idx = tuple(sorted(idx for idx, _ in stale))
+
+        def read_block(part, idx, f_off, per):
+            disk, m = good[idx]
+            if m.inline_data:
+                buf = m.inline_data[f_off : f_off + DIGEST + per]
+            else:
+                buf = disk.read_file(
+                    bucket, f"{obj}/{fi.data_dir}/part.{part.number}",
+                    f_off, DIGEST + per,
+                )
+            return bitrot_io.verify_block(buf, per)
+
         for part in fi.parts:
             geometry = coder.shard_sizes_for(part.size)
             rebuilt: dict[int, bytearray] = {idx: bytearray() for idx, _ in stale}
+            full_n = sum(1 for _, per in geometry if per == coder.shard_size)
+            # device heal wins only when the accelerator link is fast
+            # (PCIe-class); over a slow tunnel the native AVX2 path is
+            # several times faster — see PERF.md heal measurements
+            import os as _os
+
+            use_device = (
+                coder._jax is not None
+                and full_n >= 4
+                and not fi.inline_data
+                and _os.environ.get("MINIO_TPU_DEVICE_HEAL", "0") == "1"
+            )
+            batched_done = 0
+            if use_device:
+                from ..ops.bitrot import fast_hash256_batch
+
+                max_blocks = max(1, 3072 // max(len(missing_idx), 1))
+                for start in range(0, full_n, max_blocks):
+                    count = min(max_blocks, full_n - start)
+                    surv = np.empty(
+                        (count, d, coder.shard_size), dtype=np.uint8
+                    )
+                    for bi in range(count):
+                        f_off = bitrot_io.block_offset(
+                            coder.shard_size, start + bi
+                        )
+                        for si, idx in enumerate(survivors_idx):
+                            surv[bi, si] = np.frombuffer(
+                                read_block(part, idx, f_off, coder.shard_size),
+                                dtype=np.uint8,
+                            )
+                    recon = np.asarray(
+                        coder._jax.reconstruct_blocks(
+                            surv, tuple(survivors_idx), missing_idx
+                        )
+                    )  # [count, M, n]
+                    digs = fast_hash256_batch(
+                        recon.reshape(count * len(missing_idx), -1)
+                    ).reshape(count, len(missing_idx), 32)
+                    for bi in range(count):
+                        for mi, idx in enumerate(missing_idx):
+                            rebuilt[idx] += digs[bi, mi].tobytes()
+                            rebuilt[idx] += recon[bi, mi].tobytes()
+                batched_done = full_n
             for block_i, (data_len, per) in enumerate(geometry):
+                if block_i < batched_done:
+                    continue
                 f_off = bitrot_io.block_offset(coder.shard_size, block_i)
                 got: dict[int, np.ndarray] = {}
-                for idx, (disk, m) in good.items():
-                    if len(got) >= d:
-                        break
-                    if m.inline_data:
-                        buf = m.inline_data[f_off : f_off + DIGEST + per]
-                    else:
-                        buf = disk.read_file(
-                            bucket,
-                            f"{obj}/{fi.data_dir}/part.{part.number}",
-                            f_off,
-                            DIGEST + per,
-                        )
-                    block = bitrot_io.verify_block(buf, per)
-                    got[idx] = np.frombuffer(block, dtype=np.uint8)
+                for idx in survivors_idx:
+                    got[idx] = np.frombuffer(
+                        read_block(part, idx, f_off, per), dtype=np.uint8
+                    )
                 rec = coder.reconstruct_block(got, per)
                 for idx, _ in stale:
                     blk = rec[idx].tobytes()
