@@ -39,7 +39,8 @@ class JobStatus:
 
 
 class BatchJobPool:
-    def __init__(self, store, bucket_meta, replication_pool=None, workers: int = 1):
+    def __init__(self, store, bucket_meta, replication_pool=None, workers: int = 1,
+                 auto_resume: bool = True):
         self.store = store
         self.buckets = bucket_meta
         self.repl = replication_pool
@@ -48,6 +49,15 @@ class BatchJobPool:
         self._cancel: set[str] = set()
         self._mu = threading.Lock()
         self._load_checkpoints()
+        if auto_resume:
+            # interrupted jobs (marked queued by _load_checkpoints) resume
+            # from their cursor — the actual restart-resume behavior
+            for job_id, st in list(self.jobs.items()):
+                if st.state == "queued" and self._defs.get(job_id):
+                    threading.Thread(
+                        target=self._run, args=(job_id,), daemon=True,
+                        name=f"batch-resume-{job_id}",
+                    ).start()
 
     # -- persistence -------------------------------------------------------
 

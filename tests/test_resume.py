@@ -7,7 +7,6 @@ import os
 os.environ.setdefault("MINIO_TPU_BACKEND", "numpy")
 os.environ.setdefault("MINIO_TPU_SCAN_INTERVAL", "0")
 
-import json
 import time
 
 import pytest
@@ -27,7 +26,7 @@ def store(tmp_path):
 def test_batch_job_checkpoint_survives_restart(store):
     for i in range(6):
         store.put_object("jobs", f"exp/{i:02d}", b"x")
-    pool1 = BatchJobPool(store, None)
+    pool1 = BatchJobPool(store, None, auto_resume=False)
     # simulate an interrupted job: persist a running checkpoint mid-way
     st = JobStatus(job_id="resume-test", job_type="expire", state="running",
                    objects_scanned=3, objects_acted=3, last_object="exp/02",
@@ -37,15 +36,16 @@ def test_batch_job_checkpoint_survives_restart(store):
     pool1.jobs[st.job_id] = st
     pool1._save(st, pool1._defs[st.job_id])
 
-    # "restart": a fresh pool loads the checkpoint as resumable
+    # "restart": a fresh pool loads the checkpoint and AUTO-RESUMES it —
+    # the actual production path, no private calls
     pool2 = BatchJobPool(store, None)
-    loaded = pool2.describe("resume-test")
-    assert loaded is not None and loaded.state == "queued"
-    assert loaded.last_object == "exp/02"
-    # resume: only objects AFTER the cursor are acted on
-    pool2._run("resume-test")
-    done = pool2.describe("resume-test")
-    assert done.state == "done"
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        done = pool2.describe("resume-test")
+        if done and done.state in ("done", "failed"):
+            break
+        time.sleep(0.05)
+    assert done is not None and done.state == "done"
     # counters accumulate across the restart: 3 from the checkpoint + the
     # 3 resumed objects; the PROOF of cursor honoring is below — objects
     # before the cursor were never re-acted on (they still exist)
@@ -69,17 +69,43 @@ def test_decommission_checkpoint_resume(tmp_path):
     store.make_bucket("db1")
     for i in range(8):
         store.put_object("db1", f"o{i}", f"v{i}".encode())
+    # pin the objects into pool 0 so the drain provably moves them
+    # (free-space placement between same-filesystem pools can tie-break
+    # either way)
+    held_in_p0 = sum(
+        1 for i in range(8)
+        if _holds(store.pools[0], "db1", f"o{i}")
+    )
     pm = PoolManager(store)
-    st = pm.start_decommission(0)
+    pm.start_decommission(0)
     deadline = time.time() + 20
     while time.time() < deadline and pm.status(0).state == "draining":
         time.sleep(0.1)
     assert pm.status(0).state == "complete"
-    # a NEW manager (restart) sees the persisted terminal state
-    pm2 = PoolManager(store)
-    st2 = pm2.load_checkpoint(0)
+    # a NEW manager (restart) must see the persisted terminal state; the
+    # drain thread saves it just after flipping the in-memory state, so
+    # poll briefly
+    deadline = time.time() + 5
+    st2 = None
+    while time.time() < deadline:
+        st2 = PoolManager(store).load_checkpoint(0)
+        if st2 is not None and st2.state == "complete":
+            break
+        time.sleep(0.05)
     assert st2 is not None and st2.state == "complete"
-    assert st2.objects_moved > 0
+    assert st2.objects_moved == held_in_p0
+    # every object still readable from the remaining pool
+    for i in range(8):
+        _, it = store.get_object("db1", f"o{i}")
+        assert b"".join(it) == f"v{i}".encode()
+
+
+def _holds(pool, bucket, key) -> bool:
+    try:
+        pool.get_object_info(bucket, key)
+        return True
+    except Exception:  # noqa: BLE001
+        return False
 
 
 def test_scanner_deep_verify_heals_parity_corruption(tmp_path):
@@ -92,20 +118,27 @@ def test_scanner_deep_verify_heals_parity_corruption(tmp_path):
     es.make_bucket("deep")
     data = os.urandom(600 * 1024)
     es.put_object("deep", "quiet", data)
-    # corrupt a PARITY shard (erasure index 3 or 4 for EC 2+2)
+    # corrupt a PARITY shard (erasure index 3 or 4 for EC 2+2) by
+    # FLIPPING bytes — always a real corruption regardless of content
+    corrupted = False
     for i in range(4):
         fi = XLStorage(str(tmp_path / f"d{i}")).read_version("deep", "quiet")
         if fi.erasure.index in (3, 4):
             part = glob.glob(str(tmp_path / f"d{i}" / "deep/quiet/*/part.1"))[0]
             with open(part, "r+b") as f:
                 f.seek(4000)
-                f.write(b"\x00" * 8)
+                orig = f.read(8)
+                f.seek(4000)
+                f.write(bytes(b ^ 0xFF for b in orig))
+            corrupted = True
             break
+    assert corrupted, "no parity shard found to corrupt"
     # a plain read never notices (data shards intact)
     _, it = es.get_object("deep", "quiet")
     assert b"".join(it) == data
     bg = BackgroundOps(es, scan_interval=0, object_sleep=0, deep_verify=True)
     bg.scan_once()
+    assert bg.stats["heals_queued"] >= 1, "deep verify must flag the damage"
     # deep verify healed it in place: every shard passes verification now
     res = es.heal_object("deep", "quiet")
     assert res["healed"] == []
