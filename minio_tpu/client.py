@@ -69,6 +69,21 @@ class S3Client:
         finally:
             conn.close()
 
+    def presign(
+        self, method: str, bucket: str, key: str, expires: int = 604800
+    ) -> str:
+        from .server.signature import presign_url
+
+        path = urllib.parse.quote(f"/{bucket}/{key}", safe="/~-._")
+        return presign_url(
+            method,
+            f"http://{self.host}:{self.port}{path}",
+            self.access_key,
+            self.secret_key,
+            self.region,
+            expires,
+        )
+
     # -- convenience wrappers ------------------------------------------------
 
     def make_bucket(self, bucket: str) -> S3Response:

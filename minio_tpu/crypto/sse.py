@@ -39,15 +39,45 @@ class CryptoError(Exception):
     pass
 
 
+def _ns_mutex(store, bucket: str, obj: str):
+    """The store's distributed namespace mutex for (bucket, obj), or None.
+
+    Walks the object-layer composition (pools -> sets -> set) to the
+    NamespaceLock the erasure set holds; in multi-node deployments that
+    lock spans the cluster's lockers.
+    """
+    layer = store
+    pools = getattr(layer, "pools", None)
+    if pools:
+        layer = pools[0]
+    sets = getattr(layer, "sets", None)
+    if sets:
+        layer = sets[0]
+    ns = getattr(layer, "ns", None)
+    return ns.new(bucket, obj) if ns is not None else None
+
+
 class KMS:
     """Builtin single-master-key KMS (reference: MINIO_KMS_SECRET_KEY,
     internal/kms/secret-key.go). Key spec: 'name:base64(32 bytes)'."""
 
     def __init__(self, key_spec: str | None = None, store=None):
         spec = key_spec or os.environ.get("MINIO_KMS_SECRET_KEY", "")
-        if spec and ":" in spec:
+        if spec:
+            # a configured-but-malformed spec must fail loudly: silently
+            # falling through would encrypt data under a key the operator
+            # did not configure
+            if ":" not in spec:
+                raise CryptoError(
+                    "malformed MINIO_KMS_SECRET_KEY (want 'name:base64(32B)')"
+                )
             name, b64 = spec.split(":", 1)
-            key = base64.b64decode(b64)
+            try:
+                key = base64.b64decode(b64, validate=True)
+            except Exception:
+                raise CryptoError(
+                    "MINIO_KMS_SECRET_KEY key material is not valid base64"
+                ) from None
             if len(key) != 32:
                 raise CryptoError("KMS master key must be 32 bytes")
             self.key_id, self._master = name, key
@@ -59,25 +89,65 @@ class KMS:
             self.key_id = "minio-tpu-auto-key"
             self._master = self._load_or_create(store)
         else:
-            # last-resort ephemeral key (tests / keyless library use)
+            # last-resort ephemeral key (tests / keyless library use) —
+            # random, never a well-known constant
             self.key_id = "minio-tpu-ephemeral-key"
-            self._master = hashlib.sha256(b"minio-tpu-ephemeral").digest()
+            self._master = secrets.token_bytes(32)
 
     @staticmethod
     def _load_or_create(store) -> bytes:
+        """Load the persisted master key, generating it exactly once.
+
+        Creation is guarded by the store's distributed namespace lock and
+        re-read after acquisition: on concurrent first boot of multiple
+        nodes, only one generated key may ever persist — a lost race here
+        would leave objects sealed under a vanished key permanently
+        undecryptable.
+        """
         from ..erasure.quorum import ObjectNotFound
 
         path = "config/kms/master-key"
+
+        def read() -> bytes | None:
+            """Persisted key, or None iff absent. A PRESENT-but-corrupt key
+            must abort boot: regenerating over it would permanently brick
+            every object sealed under the original."""
+            try:
+                _, it = store.get_object(".minio.sys", path)
+            except ObjectNotFound:
+                return None
+            try:
+                key = base64.b64decode(b"".join(it), validate=True)
+            except Exception:
+                raise CryptoError(
+                    "persisted KMS master key is corrupt (invalid base64); "
+                    "refusing to regenerate over it"
+                ) from None
+            if len(key) != 32:
+                raise CryptoError(
+                    "persisted KMS master key is corrupt (not 32 bytes); "
+                    "refusing to regenerate over it"
+                )
+            return key
+
+        key = read()
+        if key is not None:
+            return key
+        # distinct sentinel resource: put_object takes the object's own
+        # namespace lock internally, so locking `path` here would deadlock
+        mtx = _ns_mutex(store, ".minio.sys", path + ".init")
+        if mtx is not None and not mtx.lock(timeout=30.0):
+            raise CryptoError("could not lock KMS master key for creation")
         try:
-            _, it = store.get_object(".minio.sys", path)
-            key = base64.b64decode(b"".join(it))
-            if len(key) == 32:
+            key = read()  # re-check under the lock: another node may have won
+            if key is not None:
                 return key
-        except ObjectNotFound:
-            pass
-        key = secrets.token_bytes(32)
-        store.put_object(".minio.sys", path, base64.b64encode(key))
-        return key
+            key = secrets.token_bytes(32)
+            store.put_object(".minio.sys", path, base64.b64encode(key))
+            return key
+        finally:
+            if mtx is not None:
+                mtx.unlock()
 
     def generate_key(self, context: str) -> tuple[bytes, bytes]:
         """(plaintext_key, sealed_key) bound to a context string."""

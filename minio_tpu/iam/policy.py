@@ -73,9 +73,11 @@ class Statement:
             return not any(match_pattern(p, action) for p in self.not_actions)
         return any(match_pattern(p, action) for p in self.actions)
 
-    def matches_resource(self, resource: str) -> bool:
+    def matches_resource(self, resource: str, require_resource: bool = False) -> bool:
         if not self.resources:
-            return True  # identity policies may omit Resource
+            # identity policies may omit Resource; resource (bucket)
+            # policies must name one — fail closed on malformed documents
+            return not require_resource
         for r in self.resources:
             r = r.removeprefix("arn:aws:s3:::")
             if match_pattern(r, resource):
@@ -166,13 +168,14 @@ class Policy:
     ) -> bool | None:
         """True=explicit allow, False=explicit deny, None=no match.
 
-        require_principal=True for resource (bucket) policies."""
+        require_principal=True for resource (bucket) policies; it also
+        requires each statement to name a Resource."""
         ctx = conditions or {}
         verdict: bool | None = None
         for s in self.statements:
             if not s.matches_action(action):
                 continue
-            if not s.matches_resource(resource):
+            if not s.matches_resource(resource, require_resource=require_principal):
                 continue
             if not s.matches_principal(access_key, require_principal):
                 continue

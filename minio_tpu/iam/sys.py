@@ -265,16 +265,30 @@ class IAMSys:
 
     # -- auth --------------------------------------------------------------
 
+    @staticmethod
+    def _is_live(u) -> bool:
+        if u is None or u.status != "enabled":
+            return False
+        return not (u.expiration and time.time() > u.expiration)
+
     def lookup_secret(self, access_key: str) -> str | None:
-        """Credential lookup for SigV4 verification."""
+        """Credential lookup for SigV4 verification.
+
+        Derived credentials (service accounts, STS temp creds) die with
+        their parent: a disabled/expired/deleted parent user must cut off
+        every credential minted under it (the reference rejects
+        service-account auth when the parent is disabled — cmd/iam.go
+        checkServiceAccount parent-status path).
+        """
         if access_key == self.root_user:
             return self.root_password
         with self._lock:
             u = self.users.get(access_key)
-        if u is None or u.status != "enabled":
-            return None
-        if u.expiration and time.time() > u.expiration:
-            return None
+            if not self._is_live(u):
+                return None
+            if u.parent and u.parent != self.root_user:
+                if not self._is_live(self.users.get(u.parent)):
+                    return None
         return u.secret_key
 
     def is_owner(self, access_key: str) -> bool:
@@ -299,7 +313,8 @@ class IAMSys:
                 parent = self.users.get(u.parent)
                 if u.parent == self.root_user:
                     return [CANNED_POLICIES["consoleAdmin"]], session
-                if parent is None:
+                if not self._is_live(parent):
+                    # dead parent -> derived credential has no grants
                     return [], session
                 target = parent
             names.extend(target.policies)

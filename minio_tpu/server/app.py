@@ -787,10 +787,22 @@ class S3Server:
         if attr == "policy":
             import json
 
+            from ..iam.policy import Policy
+
             try:
-                setattr(bm, attr, json.loads(body))
+                doc = json.loads(body)
+                pol = Policy.from_dict(doc)
             except ValueError:
                 raise s3err.MalformedXML from None
+            except (AttributeError, TypeError):
+                # valid JSON but not policy-shaped (e.g. a list or scalar)
+                raise s3err.MalformedPolicy from None
+            # resource policies must name a Resource per statement — an
+            # omitted Resource would otherwise match every object
+            # (reference validates this at PutBucketPolicy time)
+            if not pol.statements or any(not s.resources for s in pol.statements):
+                raise s3err.MalformedPolicy
+            setattr(bm, attr, doc)
         else:
             setattr(bm, attr, body.decode())
         await self._run(self.buckets.set, bucket, bm)

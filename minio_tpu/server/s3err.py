@@ -61,6 +61,8 @@ AuthorizationHeaderMalformed = APIError("AuthorizationHeaderMalformed", "The aut
 RequestTimeTooSkewed = APIError("RequestTimeTooSkewed", "The difference between the request time and the server's time is too large.", 403)
 ExpiredPresignRequest = APIError("ExpiredPresignRequest", "Request has expired", 403)
 MissingFields = APIError("MissingFields", "Missing fields in request.", 400)
+AuthorizationQueryParametersError = APIError("AuthorizationQueryParametersError", "X-Amz-Expires must be between 1 and 604800 seconds", 400)
+MalformedPolicy = APIError("MalformedPolicy", "Policy has invalid resource.", 400)
 XAmzContentSHA256Mismatch = APIError("XAmzContentSHA256Mismatch", "The provided 'x-amz-content-sha256' header does not match what was computed.", 400)
 NoSuchBucketPolicy = APIError("NoSuchBucketPolicy", "The bucket policy does not exist", 404)
 NoSuchTagSet = APIError("NoSuchTagSet", "The TagSet does not exist", 404)

@@ -192,6 +192,9 @@ class SigV4Verifier:
             raise s3err.InvalidArgument from None
         if len(cred) < 5 or cred[-1] != "aws4_request":
             raise s3err.AuthorizationHeaderMalformed
+        if not 1 <= expires <= 604800:
+            # reference enforces 1s..7d (cmd/signature-v4-parser.go)
+            raise s3err.AuthorizationQueryParametersError
         access_key = "/".join(cred[:-4])
         scope_date, region, service = cred[-4], cred[-3], cred[-2]
         secret = self.lookup_secret(access_key)
@@ -220,6 +223,40 @@ class SigV4Verifier:
         if not hmac.compare_digest(want, signature):
             raise s3err.SignatureDoesNotMatch
         return access_key
+
+
+def presign_url(
+    method: str,
+    url: str,
+    access_key: str,
+    secret_key: str,
+    region: str = "us-east-1",
+    expires: int = 604800,
+    service: str = "s3",
+) -> str:
+    """Client-side: produce a presigned (query-auth) URL for ``url``."""
+    u = urllib.parse.urlsplit(url)
+    amz_date = datetime.now(timezone.utc).strftime("%Y%m%dT%H%M%SZ")
+    scope_date = amz_date[:8]
+    scope = f"{scope_date}/{region}/{service}/aws4_request"
+    q = urllib.parse.parse_qsl(u.query, keep_blank_values=True)
+    q += [
+        ("X-Amz-Algorithm", SIGN_V4_ALGORITHM),
+        ("X-Amz-Credential", f"{access_key}/{scope}"),
+        ("X-Amz-Date", amz_date),
+        ("X-Amz-Expires", str(expires)),
+        ("X-Amz-SignedHeaders", "host"),
+    ]
+    canon = canonical_request(
+        method, u.path or "/", q, {"host": u.netloc}, ["host"], UNSIGNED_PAYLOAD
+    )
+    sts = string_to_sign(amz_date, scope, canon)
+    key = signing_key(secret_key, scope_date, region, service)
+    sig = hmac.new(key, sts.encode(), hashlib.sha256).hexdigest()
+    q.append(("X-Amz-Signature", sig))
+    return urllib.parse.urlunsplit(
+        (u.scheme, u.netloc, u.path, urllib.parse.urlencode(q), "")
+    )
 
 
 def sign_request(

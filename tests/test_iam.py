@@ -279,3 +279,67 @@ def test_service_account_escalation_blocked(admin, server):
     r = mal.request("PUT", "/minio/admin/v3/add-service-account",
                     body=json.dumps({"targetUser": "minioadmin"}).encode())
     assert r.status == 403, r.body
+
+
+def test_disabled_parent_cuts_off_derived_credentials(admin, server):
+    # ADVICE r1: a disabled parent must disable its service accounts and
+    # STS temp creds (reference rejects SA auth when parent is disabled)
+    admin.request("PUT", "/minio/admin/v3/add-user", query={"accessKey": "carol"},
+                  body=json.dumps({"secretKey": "carolsecret"}).encode())
+    admin.request("PUT", "/minio/admin/v3/set-user-or-group-policy",
+                  query={"policyName": "readwrite", "userOrGroup": "carol"})
+    r = admin.request("PUT", "/minio/admin/v3/add-service-account",
+                      body=json.dumps({"targetUser": "carol"}).encode())
+    assert r.status == 200, r.body
+    creds = json.loads(r.body)["credentials"]
+    sa = S3Client(f"127.0.0.1:{server.port}", creds["accessKey"], creds["secretKey"])
+    admin.put_object("pub", "carol-doc", b"x")
+    assert sa.get_object("pub", "carol-doc").status == 200
+    # disable the parent: the SA must be refused immediately
+    assert admin.request("PUT", "/minio/admin/v3/set-user-status",
+                         query={"accessKey": "carol", "status": "disabled"}).status == 200
+    assert sa.get_object("pub", "carol-doc").status == 403
+    # re-enable restores the SA
+    admin.request("PUT", "/minio/admin/v3/set-user-status",
+                  query={"accessKey": "carol", "status": "enabled"})
+    assert sa.get_object("pub", "carol-doc").status == 200
+    # deleting the parent kills the SA too
+    admin.request("DELETE", "/minio/admin/v3/remove-user", query={"accessKey": "carol"})
+    assert sa.get_object("pub", "carol-doc").status == 403
+
+
+def test_bucket_policy_statement_without_resource_rejected(admin, server):
+    # ADVICE r1: a bucket policy statement omitting Resource must be
+    # rejected at PUT time (it would otherwise match every object)
+    pol = {"Version": "2012-10-17", "Statement": [
+        {"Effect": "Allow", "Principal": "*", "Action": ["s3:GetObject"]}]}
+    r = admin.request("PUT", "/pub", query={"policy": ""},
+                      body=json.dumps(pol).encode())
+    assert r.status == 400 and b"MalformedPolicy" in r.body
+
+
+def test_presigned_expires_bounds(admin, server):
+    # ADVICE r1: X-Amz-Expires outside [1, 604800] must be rejected
+    admin.put_object("pub", "pre.txt", b"presigned")
+    import http.client
+
+    for bad in (0, 10**9):
+        url = admin.presign("GET", "pub", "pre.txt", expires=bad)
+        path = url.split(f"127.0.0.1:{server.port}", 1)[1]
+        conn = http.client.HTTPConnection("127.0.0.1", server.port)
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        body = resp.read()
+        assert resp.status == 400 and b"AuthorizationQueryParametersError" in body
+    url = admin.presign("GET", "pub", "pre.txt", expires=300)
+    path = url.split(f"127.0.0.1:{server.port}", 1)[1]
+    conn = http.client.HTTPConnection("127.0.0.1", server.port)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    assert resp.status == 200 and resp.read() == b"presigned"
+
+
+def test_bucket_policy_not_policy_shaped_is_400(admin, server):
+    for bad in (b"[]", b'"str"', b'{"Statement": "foo"}', b'{"Statement": [1]}'):
+        r = admin.request("PUT", "/pub", query={"policy": ""}, body=bad)
+        assert r.status == 400, (bad, r.status, r.body)

@@ -216,7 +216,9 @@ def test_bucket_location_and_policy(cli):
     cli.make_bucket("locb")
     r = cli.request("GET", "/locb", query={"location": ""})
     assert b"us-east-1" in r.body
-    pol = b'{"Version":"2012-10-17","Statement":[]}'
+    pol = (b'{"Version":"2012-10-17","Statement":[{"Effect":"Allow",'
+           b'"Principal":"*","Action":["s3:GetObject"],'
+           b'"Resource":["arn:aws:s3:::locb/*"]}]}')
     assert cli.request("PUT", "/locb", query={"policy": ""}, body=pol).status == 204
     r = cli.request("GET", "/locb", query={"policy": ""})
     assert r.status == 200 and b"2012-10-17" in r.body

@@ -161,3 +161,108 @@ def test_multipart_sse_refused(cli):
     r = cli.request("POST", "/secure/mp-enc", query={"uploads": ""},
                     headers={"x-amz-server-side-encryption": "AES256"})
     assert r.status == 501
+
+
+# -- KMS key-handling hardening (ADVICE r1) ---------------------------------
+
+class _FakeStore:
+    """Minimal object store for KMS persistence tests."""
+
+    def __init__(self):
+        self.objs = {}
+        self.puts = 0
+
+    def get_object(self, bucket, key):
+        from minio_tpu.erasure.quorum import ObjectNotFound
+
+        if (bucket, key) not in self.objs:
+            raise ObjectNotFound(key)
+        return None, iter([self.objs[(bucket, key)]])
+
+    def put_object(self, bucket, key, data):
+        self.puts += 1
+        self.objs[(bucket, key)] = bytes(data)
+
+
+def test_kms_malformed_spec_raises():
+    from minio_tpu.crypto.sse import KMS, CryptoError
+
+    with pytest.raises(CryptoError):
+        KMS(key_spec="no-colon-here")
+    with pytest.raises(CryptoError):
+        KMS(key_spec="name:!!!not-base64!!!")
+    with pytest.raises(CryptoError):
+        KMS(key_spec="name:" + base64.b64encode(b"short").decode())
+
+
+def test_kms_ephemeral_key_is_random():
+    from minio_tpu.crypto.sse import KMS
+
+    a, b = KMS(), KMS()
+    sealed = a.seal(b"\x01" * 32, "ctx")
+    # a well-known constant key would let any instance unseal
+    from minio_tpu.crypto.sse import CryptoError
+
+    with pytest.raises(CryptoError):
+        b.unseal(sealed, "ctx")
+    assert a.unseal(sealed, "ctx") == b"\x01" * 32
+
+
+def test_kms_master_key_created_once_and_shared():
+    from minio_tpu.crypto.sse import KMS
+
+    store = _FakeStore()
+    k1 = KMS(store=store)
+    k2 = KMS(store=store)
+    # second boot reads, never re-creates
+    assert store.puts == 1
+    sealed = k1.seal(b"\x02" * 32, "ctx")
+    assert k2.unseal(sealed, "ctx") == b"\x02" * 32
+
+
+def test_kms_concurrent_first_boot_with_ns_lock():
+    import threading
+    import time as _t
+
+    from minio_tpu.cluster.locks import NamespaceLock
+    from minio_tpu.crypto.sse import KMS
+
+    class _LockableStore(_FakeStore):
+        def __init__(self):
+            super().__init__()
+            self.ns = NamespaceLock()
+
+        def get_object(self, bucket, key):
+            r = super().get_object(bucket, key)
+            _t.sleep(0.005)
+            return r
+
+    store = _LockableStore()
+    kms_list = []
+
+    def boot():
+        kms_list.append(KMS(store=store))
+
+    ts = [threading.Thread(target=boot) for _ in range(6)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert store.puts == 1, "exactly one generated master key may persist"
+    sealed = kms_list[0].seal(b"\x03" * 32, "c")
+    for k in kms_list[1:]:
+        assert k.unseal(sealed, "c") == b"\x03" * 32
+
+
+def test_kms_corrupt_persisted_key_aborts():
+    from minio_tpu.crypto.sse import KMS, CryptoError
+
+    store = _FakeStore()
+    store.objs[(".minio.sys", "config/kms/master-key")] = b"!!corrupt!!"
+    with pytest.raises(CryptoError):
+        KMS(store=store)
+    store.objs[(".minio.sys", "config/kms/master-key")] = base64.b64encode(b"short")
+    with pytest.raises(CryptoError):
+        KMS(store=store)
+    # and the corrupt key was never overwritten
+    assert store.puts == 0
