@@ -1,0 +1,55 @@
+"""Chunk-major fused mega-kernel: packing helpers (CPU) + device correctness
+(TPU only — Mosaic kernels cannot run on the CPU backend the suite pins)."""
+
+import numpy as np
+import pytest
+
+from minio_tpu.ops import fused_pallas as fp
+
+
+def test_pack_unpack_roundtrip():
+    rng = np.random.default_rng(0)
+    blocks = rng.integers(0, 256, size=(16, 4, 4 * fp.CHUNK_BYTES), dtype=np.uint8)
+    cm = fp.pack_chunk_major(blocks)
+    assert cm.shape == (4, 16, 4, fp.CHUNK_BYTES)
+    # chunk c of shard (b, j) is the c-th CB-slice of that shard
+    assert (cm[1, 3, 2] == blocks[3, 2, fp.CHUNK_BYTES:2 * fp.CHUNK_BYTES]).all()
+    back = fp.unpack_chunk_major(cm)
+    assert (back == blocks).all()
+
+
+def test_supports_gates():
+    import jax
+
+    on_tpu = jax.default_backend() == "tpu"
+    # shape gates hold regardless of backend
+    assert fp.supports(8, 8, 192, 1 << 17) == on_tpu
+    assert not fp.supports(12, 4, 192, 1 << 17)   # d > 8
+    assert not fp.supports(8, 8, 12, 1 << 17)     # batch not multiple of 16
+    assert not fp.supports(8, 8, 192, 1000)       # n not chunk-aligned
+
+
+@pytest.mark.skipif(
+    __import__("jax").default_backend() != "tpu",
+    reason="Mosaic mega-kernel needs a TPU backend",
+)
+def test_fused_mega_matches_reference():
+    import jax
+
+    from minio_tpu.ops.highwayhash import hash256_batch_numpy
+    from minio_tpu.ops.rs import get_codec
+
+    d, p, B = 4, 2, 16
+    n = 2 * fp.CHUNK_BYTES
+    rng = np.random.default_rng(1)
+    blocks = rng.integers(0, 256, size=(B, d, n), dtype=np.uint8)
+    parity_cm, digests = fp.fused_encode_hash_cm(
+        jax.device_put(fp.pack_chunk_major(blocks)), d, p
+    )
+    parity = fp.unpack_chunk_major(np.asarray(parity_cm))
+    ref = get_codec(d, p)
+    for b in range(B):
+        shards = ref.split(blocks[b].tobytes())
+        ref.encode(shards)
+        assert (shards[d:] == parity[b]).all(), f"parity b={b}"
+        assert (hash256_batch_numpy(shards) == np.asarray(digests)[b]).all(), f"digest b={b}"
