@@ -159,6 +159,86 @@ class ErasureCoder:
         rec = self._np.reconstruct(shards)
         return {i: rec[i] for i in range(self.t)}
 
+    def reconstruct_data_flat(
+        self,
+        survivors: np.ndarray,
+        present: tuple[int, ...],
+        missing: tuple[int, ...],
+        pool=None,
+    ) -> np.ndarray:
+        """Rebuild missing data shards from [d, W, per] (shard-major) input.
+
+        Returns [len(missing), W, per]. The GET hot layout: survivors land
+        contiguous per shard row, the native AVX2 GF apply consumes them
+        without a transpose, and a thread pool splits the column range so
+        the apply scales past one core (ctypes releases the GIL).
+        """
+        from .. import native
+
+        d_, w, per = survivors.shape
+        if (
+            self._jax is not None
+            and w * self.t >= int(os.environ.get("MINIO_TPU_DECODE_MIN_SHARDS", "64"))
+        ):
+            out = self._jax.reconstruct_blocks(
+                survivors.transpose(1, 0, 2), present, missing
+            )
+            return np.asarray(out).transpose(1, 0, 2)
+        mat = self._decode_rows(present, missing)
+        flat = survivors.reshape(self.d, w * per)
+        if native.available():
+            cols = w * per
+            shards_split = max(1, min(4, cols // (1 << 20)))
+            if pool is not None and shards_split > 1:
+                step = -(-cols // shards_split)
+                out = np.empty((len(missing), cols), dtype=np.uint8)
+
+                def apply_slice(s):
+                    # the strided->contiguous copy happens in the worker too
+                    return native.gf_apply(mat, flat[:, s:s + step])
+
+                futs = [(s, pool.submit(apply_slice, s)) for s in range(0, cols, step)]
+                for s, f in futs:
+                    piece = f.result()
+                    out[:, s:s + piece.shape[1]] = piece
+            else:
+                out = native.gf_apply(mat, flat)
+            return out.reshape(len(missing), w, per)
+        return self._np_reconstruct_batch(
+            survivors.transpose(1, 0, 2), present, missing
+        ).transpose(1, 0, 2)
+
+    def _decode_rows(
+        self, present: tuple[int, ...], missing: tuple[int, ...]
+    ) -> np.ndarray:
+        return self._np.reconstruct_rows_for(list(present), list(missing))
+
+    def _np_reconstruct_batch(
+        self,
+        survivors: np.ndarray,
+        present: tuple[int, ...],
+        missing: tuple[int, ...],
+    ) -> np.ndarray:
+        from .. import native
+        from ..ops import gf
+
+        mat = self._decode_rows(present, missing)  # [m, d]
+        w, _, per = survivors.shape
+        if native.available():
+            # AVX2 GF apply: fold the window into the column length
+            flat = np.ascontiguousarray(survivors.transpose(1, 0, 2)).reshape(
+                self.d, w * per
+            )
+            return native.gf_apply(mat, flat).reshape(len(missing), w, per).transpose(1, 0, 2)
+        out = np.zeros((w, len(missing), per), dtype=np.uint8)
+        for r, row in enumerate(mat):
+            acc = out[:, r]
+            for k in range(self.d):
+                c = int(row[k])
+                if c:
+                    acc ^= gf.MUL_TABLE[c][survivors[:, k]]
+        return out
+
     # -- geometry ----------------------------------------------------------
 
     def shard_sizes_for(self, total: int) -> list[tuple[int, int]]:

@@ -11,6 +11,8 @@ TPU coder (erasure/coder.py).
 from __future__ import annotations
 
 import hashlib
+import os
+import threading
 import uuid
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Iterator
@@ -45,6 +47,24 @@ from .types import BucketInfo, ObjectInfo
 
 TMP_VOLUME = ".minio.sys/tmp"
 DIGEST = bitrot_io.DIGEST_SIZE
+
+# shared shard-read pool: per-block shard reads of ALL in-flight GETs fan
+# out here (the reference spawns per-shard goroutines; a bounded pool is
+# the python equivalent)
+_READ_POOL: ThreadPoolExecutor | None = None
+_READ_POOL_LOCK = threading.Lock()
+
+
+def _read_pool() -> ThreadPoolExecutor:
+    global _READ_POOL
+    if _READ_POOL is None:
+        with _READ_POOL_LOCK:
+            if _READ_POOL is None:
+                _READ_POOL = ThreadPoolExecutor(
+                    max_workers=int(os.environ.get("MINIO_TPU_READ_WORKERS", "32")),
+                    thread_name_prefix="shard-read",
+                )
+    return _READ_POOL
 
 
 def default_parity_count(drive_count: int) -> int:
@@ -356,10 +376,15 @@ class ErasureSet:
         offset: int,
         length: int,
     ) -> Iterator[bytes]:
-        """Greedy striped read with per-block verification + reconstruction
-        (mirrors /root/reference/cmd/erasure-decode.go parallelReader).
-        Spans multiple parts (multipart objects: each part is its own
-        erasure stream, stitched by metadata only)."""
+        """Windowed parallel striped read: per-shard reads fan out on a
+        thread pool (greedy data-first, parity spill on failure), whole
+        windows of same-pattern blocks reconstruct in ONE batched matrix
+        apply, and the next window's reads start before the current one is
+        decoded (readahead). Mirrors the reference's parallelReader +
+        readahead (/root/reference/cmd/erasure-decode.go:32,127-235,
+        cmd/erasure-object.go:1429) but trades its per-block goroutine
+        choreography for window-batched decode — the TPU-shaped version.
+        Spans multiple parts (each part is its own erasure stream)."""
         if length == 0:
             return
         d = fi.erasure.data_blocks
@@ -390,60 +415,123 @@ class ErasureSet:
                 )
             return bitrot_io.verify_block(buf, per)
 
-        pos = 0  # logical offset of the current part
+        # ---- plan: every stripe block overlapping [offset, offset+length) ----
+        plan: list[tuple[int, int, int, int, int]] = []  # (part#, per, f_off, lo, hi)
+        pos = 0
+        remaining = length
         for part in fi.parts:
-            if length <= 0:
-                return
+            if remaining <= 0:
+                break
             if pos + part.size <= offset:
                 pos += part.size
                 continue
-            geometry = coder.shard_sizes_for(part.size)
-            bpos = pos  # logical offset of current block within the object
-            for block_i, (data_len, per) in enumerate(geometry):
-                if length <= 0:
-                    return
+            bpos = pos
+            for block_i, (data_len, per) in enumerate(coder.shard_sizes_for(part.size)):
+                if remaining <= 0:
+                    break
                 if bpos + data_len <= offset:
                     bpos += data_len
                     continue
-                f_off = bitrot_io.block_offset(coder.shard_size, block_i)
-                got: dict[int, bytes] = {}
-                for idx in range(d):  # prefer data shards: no matrix math
-                    if idx in sources and idx not in bad:
-                        try:
-                            got[idx] = read_shard_block(part.number, idx, per, f_off)
-                        except (errors.FileCorrupt, errors.FileNotFound, OSError):
-                            bad.add(idx)
-                            report_degraded()
-                if len(got) < d:
-                    for idx in range(d, self.n):
-                        if len(got) >= d:
-                            break
-                        if idx in sources and idx not in bad:
-                            try:
-                                got[idx] = read_shard_block(part.number, idx, per, f_off)
-                            except (errors.FileCorrupt, errors.FileNotFound, OSError):
-                                bad.add(idx)
-                                report_degraded()
-                    if len(got) < d:
-                        raise QuorumError(
-                            f"cannot read part {part.number} block {block_i}: "
-                            f"only {len(got)} of {d} shards"
-                        )
-                if all(i in got for i in range(d)):
-                    block = b"".join(got[i] for i in range(d))[:data_len]
-                else:
-                    rec = coder.reconstruct_block(
-                        {i: np.frombuffer(v, dtype=np.uint8) for i, v in got.items()}, per
-                    )
-                    block = b"".join(rec[i].tobytes() for i in range(d))[:data_len]
                 lo = max(offset - bpos, 0)
-                hi = min(lo + length, data_len)
+                hi = min(lo + remaining, data_len)
                 if hi > lo:
-                    chunk = block[lo:hi]
-                    length -= len(chunk)
-                    yield chunk
+                    f_off = bitrot_io.block_offset(coder.shard_size, block_i)
+                    plan.append((part.number, per, f_off, lo, hi))
+                    remaining -= hi - lo
                 bpos += data_len
             pos += part.size
+
+        pool = _read_pool()
+        window = max(1, int(os.environ.get("MINIO_TPU_READ_WINDOW", "8")))
+
+        def start_window(win):
+            """Submit data-first reads for every block of the window."""
+            futs = {}
+            for bi, (pnum, per, f_off, _lo, _hi) in enumerate(win):
+                for idx in range(d):
+                    if idx in sources and idx not in bad:
+                        futs[(bi, idx)] = pool.submit(
+                            read_shard_block, pnum, idx, per, f_off
+                        )
+            return futs
+
+        def gather_window(win, futs):
+            """Resolve reads, spilling to parity until every block has d."""
+            got: list[dict[int, bytes]] = [{} for _ in win]
+            while True:
+                for (bi, idx), f in futs.items():
+                    try:
+                        got[bi][idx] = f.result()
+                    except (errors.FileCorrupt, errors.FileNotFound, OSError):
+                        bad.add(idx)
+                        report_degraded()
+                futs = {}
+                deficient = [bi for bi in range(len(win)) if len(got[bi]) < d]
+                if not deficient:
+                    return got
+                # next spill candidates: indices not yet tried anywhere
+                tried = set().union(*(g.keys() for g in got)) | bad
+                cands = [i for i in range(self.n) if i in sources and i not in tried]
+                if not cands:
+                    bi0 = deficient[0]
+                    pnum, _per, f_off, _lo, _hi = win[bi0]
+                    raise QuorumError(
+                        f"cannot read part {pnum} shard offset {f_off}: "
+                        f"only {len(got[bi0])} of {d} shards"
+                    )
+                for bi in deficient:
+                    pnum, per, f_off, _lo, _hi = win[bi]
+                    # each block spills only as many extra shards as IT needs
+                    for idx in cands[: d - len(got[bi])]:
+                        futs[(bi, idx)] = pool.submit(
+                            read_shard_block, pnum, idx, per, f_off
+                        )
+
+        def decode_window(win, got) -> list[bytes]:
+            """Per-block data bytes; same-pattern degraded blocks batch."""
+            out: list[bytes | None] = [None] * len(win)
+            groups: dict[tuple[tuple[int, ...], int], list[int]] = {}
+            for bi in range(len(win)):
+                present = tuple(sorted(got[bi].keys())[:d])
+                if present == tuple(range(d)):
+                    out[bi] = b"".join(got[bi][i] for i in range(d))
+                else:
+                    # group by (pattern, shard size): the tail block's per
+                    # differs from full blocks and cannot share a stack
+                    groups.setdefault((present, win[bi][1]), []).append(bi)
+            for (present, per), bis in groups.items():
+                missing = tuple(i for i in range(d) if i not in present)
+                # build [d, W', per] directly: the contiguous layout the
+                # native GF apply consumes, no post-stack transpose copies
+                survivors = np.empty((d, len(bis), per), dtype=np.uint8)
+                for k, i in enumerate(present):
+                    for w, bi in enumerate(bis):
+                        survivors[k, w] = np.frombuffer(got[bi][i], dtype=np.uint8)
+                rec = coder.reconstruct_data_flat(survivors, present, missing, pool)
+                for w, bi in enumerate(bis):
+                    shards = {i: got[bi][i] for i in present if i < d}
+                    for mj, i in enumerate(missing):
+                        shards[i] = rec[mj, w].tobytes()
+                    out[bi] = b"".join(shards[i] for i in range(d))
+            return out  # type: ignore[return-value]
+
+        # ---- pipelined execution: window k+1 reads under window k decode ----
+        windows = [plan[i : i + window] for i in range(0, len(plan), window)]
+        futs = start_window(windows[0]) if windows else {}
+        try:
+            for wi, win in enumerate(windows):
+                got = gather_window(win, futs)
+                futs = {}
+                if wi + 1 < len(windows):
+                    futs = start_window(windows[wi + 1])  # readahead
+                blocks = decode_window(win, got)
+                for (pnum, per, f_off, lo, hi), block in zip(win, blocks):
+                    yield block[lo:hi]
+        finally:
+            # abandoned iterator (client hung up) or error: don't let
+            # readahead reads+verifies hog the shared pool
+            for f in futs.values():
+                f.cancel()
 
     # -- delete ------------------------------------------------------------
 

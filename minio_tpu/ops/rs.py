@@ -108,6 +108,28 @@ class ReedSolomon:
         sub = self.matrix[rows, :]
         return gf.gf_mat_inv(sub)
 
+    def reconstruct_rows_for(
+        self, present: list[int], missing: list[int]
+    ) -> np.ndarray:
+        """GF rows mapping the first d present shards -> the missing shards.
+
+        Missing data shard i uses row i of the decode inverse; missing
+        parity shard i composes its parity row with the inverse. Shared by
+        the numpy, native, and bit-plane (rs_jax) reconstruct paths.
+        """
+        from . import gf
+
+        dec = self.decode_matrix_for(present)
+        rows = []
+        for i in missing:
+            if i < self.data_shards:
+                rows.append(dec[i])
+            else:
+                rows.append(
+                    gf.gf_matmul(self.parity_matrix[i - self.data_shards][None], dec)[0]
+                )
+        return np.stack(rows)
+
     def reconstruct(
         self, shards: list[np.ndarray | None], data_only: bool = False
     ) -> list[np.ndarray | None]:

@@ -140,18 +140,7 @@ class TpuRSCodec:
         if cached is not None:
             self._decode_w_cache.move_to_end(key)
             return cached
-        dec = self._ref.decode_matrix_for(list(present))  # [d, d]
-        rows = []
-        for i in missing:
-            if i < self.data_shards:
-                rows.append(dec[i])
-            else:
-                # parity_row_i(data) = parity_matrix[i-d] @ dec @ survivors
-                pr = gf.gf_matmul(
-                    self._ref.parity_matrix[i - self.data_shards][None, :], dec
-                )[0]
-                rows.append(pr)
-        m = np.stack(rows)
+        m = self._ref.reconstruct_rows_for(list(present), list(missing))
         # cache host-side: device placement/sharding is the caller's concern
         w = gf_matrix_to_bitplanes(m)
         self._decode_w_cache[key] = w
