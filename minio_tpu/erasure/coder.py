@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import os
 from dataclasses import dataclass
+from typing import Iterator
 
 import numpy as np
 
@@ -96,48 +97,92 @@ class ErasureCoder:
         )
         return shards, digests
 
+    def _encode_full_buffer(self, data: memoryview) -> list[bytearray]:
+        """len(data) is a multiple of block_size -> per-shard file chunks
+        (digest || shard block interleave) for these stripe blocks."""
+        full = len(data) // self.block_size
+        per = self.shard_size
+        padded_block = self.d * per  # >= block_size; zero padding at tail
+        arr = np.zeros((full, self.d, per), dtype=np.uint8)
+        flat = np.frombuffer(data, dtype=np.uint8)
+        if padded_block == self.block_size:
+            arr[:] = flat.reshape(full, self.d, per)
+        else:
+            for b in range(full):
+                blk = flat[b * self.block_size : (b + 1) * self.block_size]
+                a = arr[b].reshape(-1)
+                a[: self.block_size] = blk
+        files = [bytearray() for _ in range(self.t)]
+        max_blocks = max(1, MAX_DEVICE_SHARDS // self.t)
+        for start in range(0, full, max_blocks):
+            chunk = arr[start : start + max_blocks]
+            shards, digests = self._encode_full_blocks(chunk)
+            for b in range(chunk.shape[0]):
+                for i in range(self.t):
+                    files[i] += digests[b, i].tobytes()
+                    files[i] += shards[b, i].tobytes()
+        return files
+
+    def _encode_tail_buffer(self, data: bytes) -> list[bytearray]:
+        """Partial final block (numpy codec, byte-identical)."""
+        shards, digests = self._encode_block_np(data)
+        files = [bytearray() for _ in range(self.t)]
+        for i in range(self.t):
+            files[i] += digests[i].tobytes()
+            files[i] += shards[i].tobytes()
+        return files
+
+    def iter_encode(
+        self, reader, max_batch_bytes: int | None = None
+    ) -> "Iterator[tuple[list[bytearray], bytes]]":
+        """Streaming encode: consume an iterator of byte chunks, yield
+        (per-shard file chunks, the raw input slice encoded) per batch.
+
+        Bounded memory: at most one batch of input is resident, mirroring
+        the reference's block-at-a-time ring buffer
+        (/root/reference/cmd/bitrot-streaming.go:108-133) at device-batch
+        granularity. The raw slice lets callers fold md5/size incrementally.
+        max_batch_bytes clamps the batch below the device HBM cap —
+        streaming callers pass their memory bound; in-memory callers leave
+        it None for full-width device dispatches.
+        """
+        batch_bytes = max(1, MAX_DEVICE_SHARDS // self.t) * self.block_size
+        if max_batch_bytes is not None:
+            batch_bytes = min(batch_bytes, max(self.block_size, max_batch_bytes))
+        buf = bytearray()
+        for chunk in reader:
+            if not chunk:
+                continue
+            buf += chunk
+            while len(buf) >= batch_bytes:
+                piece = bytes(buf[:batch_bytes])
+                del buf[:batch_bytes]
+                yield self._encode_full_buffer(memoryview(piece)), piece
+        full = (len(buf) // self.block_size) * self.block_size
+        if full:
+            piece = bytes(buf[:full])
+            del buf[:full]
+            yield self._encode_full_buffer(memoryview(piece)), piece
+        if buf:
+            piece = bytes(buf)
+            yield self._encode_tail_buffer(piece), piece
+
     def encode_part(self, data: bytes) -> EncodedPart:
-        """Erasure-code one part into per-drive shard files.
+        """Erasure-code one in-memory part into per-drive shard files.
 
         Full stripe blocks go to the device in batches; the partial tail
         block (if any) uses the numpy codec. Output per drive is the
         bitrot-interleaved shard file (digest || shard block per stripe).
+        Large/streamed parts should use iter_encode via the streaming
+        put path instead of materializing here.
         """
         n = len(data)
         files = [bytearray() for _ in range(self.t)]
         if n == 0:
             return EncodedPart([bytes(f) for f in files], 0)
-        full = n // self.block_size
-        view = memoryview(data)
-
-        if full:
-            per = self.shard_size
-            padded_block = self.d * per  # >= block_size; zero padding at tail
-            arr = np.zeros((full, self.d, per), dtype=np.uint8)
-            flat = np.frombuffer(view[: full * self.block_size], dtype=np.uint8)
-            if padded_block == self.block_size:
-                arr[:] = flat.reshape(full, self.d, per)
-            else:
-                for b in range(full):
-                    blk = flat[b * self.block_size : (b + 1) * self.block_size]
-                    a = arr[b].reshape(-1)
-                    a[: self.block_size] = blk
-            # batch device dispatches under the HBM cap
-            max_blocks = max(1, MAX_DEVICE_SHARDS // self.t)
-            for start in range(0, full, max_blocks):
-                chunk = arr[start : start + max_blocks]
-                shards, digests = self._encode_full_blocks(chunk)
-                for b in range(chunk.shape[0]):
-                    for i in range(self.t):
-                        files[i] += digests[b, i].tobytes()
-                        files[i] += shards[b, i].tobytes()
-
-        tail = n - full * self.block_size
-        if tail:
-            shards, digests = self._encode_block_np(bytes(view[n - tail :]))
+        for chunks, _raw in self.iter_encode(iter([data])):
             for i in range(self.t):
-                files[i] += digests[i].tobytes()
-                files[i] += shards[i].tobytes()
+                files[i] += chunks[i]
         return EncodedPart([bytes(f) for f in files], n)
 
     # -- decode ------------------------------------------------------------

@@ -103,8 +103,7 @@ class MultipartManager:
         oi = self.es.put_object(
             MP_VOLUME,
             self._part_key(bucket, obj, upload_id, part_number),
-            data,
-            user_defined={"__psize": str(len(data))},
+            data,  # bytes or a chunk iterator (streamed parts)
             parity=parity,
             distribution=dist,
             allow_inline=False,

@@ -240,6 +240,11 @@ class ErasureSet:
         distribution: list[int] | None,
         allow_inline: bool,
     ) -> ObjectInfo:
+        if not isinstance(data, (bytes, bytearray, memoryview)):
+            return self._put_object_streaming(
+                bucket, obj, data, user_defined, version_id, versioned,
+                parity, distribution,
+            )
         p = self.default_parity if parity is None else parity
         d = self.n - p
         write_q = d + 1 if d == p else d
@@ -306,6 +311,122 @@ class ErasureSet:
                 except Exception:  # noqa: BLE001 — best-effort cleanup
                     pass
             raise
+        return self._to_object_info(bucket, obj, fi)
+
+    def _put_object_streaming(
+        self,
+        bucket: str,
+        obj: str,
+        reader,
+        user_defined: dict[str, str] | None,
+        version_id: str | None,
+        versioned: bool,
+        parity: int | None,
+        distribution: list[int] | None,
+    ) -> ObjectInfo:
+        """Bounded-memory PUT: encode batches of stripe blocks as they
+        arrive and append shard-file chunks to each drive's staged part
+        file — a part is never fully resident (the reference streams
+        block-by-block through a ring buffer,
+        /root/reference/cmd/bitrot-streaming.go:108-133). Never inlines.
+        """
+        p = self.default_parity if parity is None else parity
+        d = self.n - p
+        write_q = d + 1 if d == p else d
+
+        fi = FileInfo(volume=bucket, name=obj)
+        fi.version_id = version_id if version_id is not None else (
+            str(uuid.uuid4()) if versioned else ""
+        )
+        fi.mod_time = now_ns()
+        fi.metadata = dict(user_defined or {})
+        fi.erasure = ErasureInfo(
+            algorithm="reedsolomon",
+            data_blocks=d,
+            parity_blocks=p,
+            block_size=BLOCK_SIZE,
+            distribution=distribution or hash_order(f"{bucket}/{obj}", self.n),
+            checksums=[ChecksumInfo(1, DEFAULT_BITROT_ALGO.string)],
+        )
+        fi.data_dir = str(uuid.uuid4())
+        tmp_id = str(uuid.uuid4())
+        stage = f"{tmp_id}/{fi.data_dir}/part.1"
+        coder = self.coder(d, p)
+        md5 = hashlib.md5()
+        size = 0
+        # a drive that fails once stops receiving appends (its staged file
+        # would be torn); quorum judged at the end
+        errs: list[Exception | None] = [None] * self.n
+
+        def drive_op(i: int, fn, *args):
+            if errs[i] is None:
+                try:
+                    fn(*args)
+                except Exception as e:  # noqa: BLE001
+                    errs[i] = e
+
+        futs = [
+            self._pool.submit(drive_op, i, disk.create_file, TMP_VOLUME, stage, b"")
+            for i, disk in enumerate(self.disks)
+        ]
+        for f in futs:
+            f.result()
+        renamed = False  # whether any rename_data may have landed
+        stream_cap = int(os.environ.get("MINIO_TPU_STREAM_BATCH_MB", "64")) << 20
+        try:
+            for chunks, raw in coder.iter_encode(reader, max_batch_bytes=stream_cap):
+                md5.update(raw)
+                size += len(raw)
+                futs = []
+                for i, disk in enumerate(self.disks):
+                    shard_idx = fi.erasure.distribution[i] - 1
+                    futs.append(self._pool.submit(
+                        drive_op, i, disk.append_file, TMP_VOLUME, stage,
+                        bytes(chunks[shard_idx]),
+                    ))
+                for f in futs:
+                    f.result()
+                if sum(e is None for e in errs) < write_q:
+                    raise QuorumError("write quorum lost mid-stream")
+
+            etag = md5.hexdigest()
+            fi.size = size
+            fi.metadata.setdefault("etag", etag)
+            fi.parts = [ObjectPartInfo(1, size, size, fi.mod_time, etag)]
+
+            def commit_one(i: int, disk: StorageAPI):
+                shard_idx = fi.erasure.distribution[i] - 1
+                dfi = FileInfo.from_dict(fi.to_dict())
+                dfi.volume, dfi.name = bucket, obj
+                dfi.erasure.index = shard_idx + 1
+                disk.rename_data(TMP_VOLUME, tmp_id, dfi, bucket, obj)
+
+            renamed = True
+            futs = [
+                self._pool.submit(drive_op, i, commit_one, i, disk)
+                for i, disk in enumerate(self.disks)
+            ]
+            for f in futs:
+                f.result()
+            reduce_quorum_errs(errs, write_q)
+        except Exception:
+            for disk, err in zip(self.disks, errs):
+                try:
+                    # only roll back committed renames: a failure BEFORE the
+                    # rename phase must never touch the pre-existing object
+                    # (deleting the null version here would destroy the live
+                    # object an aborted overwrite never replaced)
+                    if renamed and err is None:
+                        disk.delete_version(bucket, obj, fi)
+                    disk.delete(TMP_VOLUME, tmp_id, recursive=True)
+                except Exception:  # noqa: BLE001 — best-effort cleanup
+                    pass
+            raise
+        for disk in self.disks:
+            try:
+                disk.delete(TMP_VOLUME, tmp_id, recursive=True)
+            except Exception:  # noqa: BLE001
+                pass
         return self._to_object_info(bucket, obj, fi)
 
     # -- get ---------------------------------------------------------------

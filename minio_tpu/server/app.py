@@ -46,6 +46,10 @@ _SUBRESOURCE_ACTIONS = {
 }
 
 
+class _ConsumerDone(Exception):
+    """Streaming-put pump: the erasure consumer finished before EOF."""
+
+
 def _route_action(m: str, bucket: str, key: str, q, headers) -> tuple[str, str, str]:
     """(action, bucket, key) for authorization — the request->policy-action
     mapping the reference does per-handler via checkRequestAuthType."""
@@ -219,6 +223,13 @@ class S3Server:
 
         self.kms = KMS()
         self.store = None
+        self.streaming_puts = 0  # observability: bodies that never buffered
+        # dedicated pool for streaming-body pumps: put_item can block on a
+        # full queue, and parking it in the default executor would starve
+        # the storage-REST plane that shares it
+        self._pump_pool = _TPE(
+            max_workers=8, thread_name_prefix="body-pump"
+        )
         # store I/O runs on an ample dedicated pool: the default executor
         # on small machines has ~cpus+4 workers, and writers blocking on
         # namespace locks inside it can starve the reader that HOLDS the
@@ -395,14 +406,24 @@ class S3Server:
             traceback.print_exc()
             return self._err_response(request, s3err.InternalError)
 
-    async def _authenticate(self, request: web.Request) -> tuple[str, bytes]:
-        """Verify request auth; returns (access_key, payload bytes)."""
+    async def _authenticate(
+        self, request: web.Request, stream_body: bool = False
+    ) -> tuple[str, bytes | None]:
+        """Verify request auth; returns (access_key, payload bytes).
+
+        stream_body=True leaves the body unread (returned as None) for the
+        streaming PUT path — only valid for auth modes that don't hash the
+        payload (presigned / UNSIGNED-PAYLOAD), which _streamable_put
+        guarantees."""
         headers = {k.lower(): v for k, v in request.headers.items()}
         raw_path = request.rel_url.raw_path
         query = urllib.parse.parse_qsl(
             request.rel_url.raw_query_string, keep_blank_values=True
         )
-        body = await request.read() if request.body_exists else b""
+        if stream_body:
+            body = None
+        else:
+            body = await request.read() if request.body_exists else b""
 
         if "X-Amz-Signature" in dict(query):
             ak = self.verifier.verify_presigned(request.method, raw_path, query, headers)
@@ -436,6 +457,116 @@ class S3Server:
                 raise s3err.XAmzContentSHA256Mismatch
         self._check_session_token(ak, headers, {})
         return ak, body
+
+    def _streamable_put(self, request: web.Request) -> bool:
+        """True for object PUTs whose body can flow straight into the
+        erasure plane without buffering: auth never hashes the payload
+        (presigned or UNSIGNED-PAYLOAD), no Content-MD5/checksum headers
+        to verify over the whole body, no copy source, and the body is big
+        enough for streaming to matter. Transform applicability (SSE,
+        compression) is re-checked in the handler, which falls back to the
+        buffered path since the body is still unread."""
+        if request.method != "PUT":
+            return False
+        bucket = request.match_info.get("bucket", "")
+        key = request.match_info.get("key", "")
+        if not bucket or not key or bucket == "minio" or bucket.startswith(".minio.sys"):
+            return False
+        q = request.rel_url.query
+        for sub in ("retention", "legal-hold", "tagging", "acl"):
+            if sub in q:
+                return False
+        headers = {k.lower() for k in request.headers}
+        if "x-amz-copy-source" in headers or "content-md5" in headers:
+            return False
+        if any(
+            h.startswith((
+                "x-amz-checksum-", "x-amz-sdk-checksum", "x-amz-trailer",
+                # request-level SSE needs the transform pipeline (whole body)
+                "x-amz-server-side-encryption",
+            ))
+            for h in headers
+        ):
+            return False
+        presigned = "X-Amz-Signature" in q
+        sha = request.headers.get("x-amz-content-sha256", signature.UNSIGNED_PAYLOAD)
+        if not presigned and sha != signature.UNSIGNED_PAYLOAD:
+            return False
+        try:
+            cl = int(request.headers.get("Content-Length", "0"))
+        except ValueError:
+            return False
+        return cl >= int(os.environ.get("MINIO_TPU_STREAM_MIN_BYTES", str(8 << 20)))
+
+    async def _run_streaming_put(self, request: web.Request, consume):
+        """Run consume(chunk_iterator) in the io pool while pumping the
+        request body into it through a bounded queue (8 x 1 MiB): the
+        async HTTP read and the sync erasure encode/write overlap, and a
+        part is never fully resident. A short body (client hung up) or
+        pump failure raises into the consumer so the put aborts cleanly.
+        """
+        import queue as _queue
+
+        q: _queue.Queue = _queue.Queue(maxsize=8)
+
+        def gen():
+            while True:
+                item = q.get()
+                if item is None:
+                    return
+                if isinstance(item, Exception):
+                    raise item
+                yield item
+
+        self.streaming_puts += 1
+        task = asyncio.ensure_future(self._run(consume, gen()))
+        loop = asyncio.get_running_loop()
+
+        def put_item(item):
+            while True:
+                if task.done():
+                    raise _ConsumerDone
+                try:
+                    q.put(item, timeout=0.25)
+                    return
+                except _queue.Full:
+                    continue
+
+        def inject_error(e: Exception):
+            """Guaranteed delivery: drain the queue until the sentinel fits
+            so the consumer can never block forever on q.get() (which would
+            wedge the namespace write lock and leak the io-pool thread)."""
+            while True:
+                try:
+                    q.put_nowait(e)
+                    return
+                except _queue.Full:
+                    try:
+                        q.get_nowait()
+                    except _queue.Empty:
+                        pass
+
+        expect = int(request.headers.get("Content-Length", "0"))
+        got = 0
+        try:
+            while True:
+                chunk = await request.content.read(1 << 20)
+                if not chunk:
+                    if got != expect:
+                        await loop.run_in_executor(
+                            self._pump_pool, put_item, s3err.IncompleteBody,
+                        )
+                    else:
+                        await loop.run_in_executor(self._pump_pool, put_item, None)
+                    break
+                got += len(chunk)
+                await loop.run_in_executor(self._pump_pool, put_item, chunk)
+        except _ConsumerDone:
+            pass  # consumer already finished/failed; its result surfaces below
+        except BaseException as e:
+            inject_error(e if isinstance(e, Exception) else RuntimeError(str(e)))
+            raise
+        return await task
 
     def _check_session_token(self, access_key: str, headers, query) -> None:
         """Temp (STS) credentials must present a valid session token whose
@@ -472,7 +603,9 @@ class S3Server:
             raise s3err.AccessDenied
 
     async def _dispatch(self, request: web.Request) -> web.StreamResponse:
-        ak, body = await self._authenticate(request)
+        ak, body = await self._authenticate(
+            request, stream_body=self._streamable_put(request)
+        )
         request["access_key"] = ak
         bucket = request.match_info.get("bucket", "")
         # aiohttp match_info is already percent-decoded; decoding again
@@ -982,17 +1115,28 @@ class S3Server:
             except (ValueError, TypeError):
                 pass
 
-    async def put_object(self, request, bucket: str, key: str, body: bytes) -> web.Response:
+    async def put_object(
+        self, request, bucket: str, key: str, body: bytes | None
+    ) -> web.Response:
         key = listing.encode_dir_object(key)
+        bm = self.buckets.get(bucket)
+        from . import transforms
+
+        ct = request.headers.get("Content-Type")
+        if body is None and (
+            _bucket_sse_algo(bm.encryption) or transforms.compression_enabled()
+        ):
+            # a transform needs the whole payload: fall back to buffering
+            # (the body is still unread on the socket)
+            body = await request.read() if request.body_exists else b""
         md5_hdr = request.headers.get("Content-MD5")
         if md5_hdr:
             import base64
 
             if base64.b64encode(hashlib.md5(body).digest()).decode() != md5_hdr:
                 raise s3err.BadDigest
-        checksum_meta = _verify_checksum_headers(request.headers, body)
+        checksum_meta = _verify_checksum_headers(request.headers, body or b"")
         user_defined = {}
-        ct = request.headers.get("Content-Type")
         if ct:
             user_defined["content-type"] = ct
         for k, v in request.headers.items():
@@ -1002,10 +1146,27 @@ class S3Server:
                 "content-language", "expires", "x-amz-storage-class",
             ):
                 user_defined[lk] = v
-        bm = self.buckets.get(bucket)
-        # transparent compression + server-side encryption
-        from . import transforms
+        if body is None:
+            # streaming path: body flows HTTP -> erasure encode -> drives
+            user_defined.update(checksum_meta)
+            oi = await self._run_streaming_put(
+                request,
+                lambda rd: self.store.put_object(
+                    bucket, key, rd, user_defined, None, bm.versioning
+                ),
+            )
+            headers = {"ETag": f'"{oi.etag}"'}
+            if oi.version_id:
+                headers["x-amz-version-id"] = oi.version_id
+            from ..events import notify as ev
 
+            self.notifier.notify(
+                ev.OBJECT_CREATED_PUT, bucket, listing.decode_dir_object(key),
+                oi.size, oi.etag, oi.version_id, request.get("access_key", ""),
+            )
+            self.replication.queue_mutation(bucket, key, oi.version_id, "put")
+            return web.Response(status=200, headers=headers)
+        # transparent compression + server-side encryption
         req_headers = {k.lower(): v for k, v in request.headers.items()}
         try:
             tr = transforms.encode_for_store(
@@ -1427,9 +1588,19 @@ class S3Server:
             raise s3err.InvalidArgument from None
         upload_id = q.get("uploadId", "")
         try:
-            etag = await self._run(
-                self.mp.put_part, bucket, key, upload_id, part_number, body
-            )
+            if body is None:
+                # streaming part upload (multipart is how huge objects
+                # arrive: each part flows straight into its erasure stream)
+                etag = await self._run_streaming_put(
+                    request,
+                    lambda rd: self.mp.put_part(
+                        bucket, key, upload_id, part_number, rd
+                    ),
+                )
+            else:
+                etag = await self._run(
+                    self.mp.put_part, bucket, key, upload_id, part_number, body
+                )
         except mp_mod.UploadNotFound:
             raise s3err.NoSuchUpload from None
         except mp_mod.InvalidPart:

@@ -1,0 +1,242 @@
+"""Streaming (bounded-memory) write path: iter_encode + _put_object_streaming.
+
+The RSS test runs in a clean subprocess (numpy backend, no jax) so the
+parent's interpreter baseline doesn't pollute ru_maxrss.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+os.environ.setdefault("MINIO_TPU_BACKEND", "numpy")
+
+import numpy as np
+
+from minio_tpu.erasure.coder import ErasureCoder
+from minio_tpu.erasure.set import ErasureSet
+from minio_tpu.storage.xlstorage import XLStorage
+
+RNG = np.random.default_rng(5)
+
+
+def test_iter_encode_matches_encode_part():
+    coder = ErasureCoder(2, 2)
+    data = RNG.integers(0, 256, size=5 * 1024 * 1024 + 999, dtype=np.uint8).tobytes()
+    want = coder.encode_part(data)
+    # stream in awkward chunk sizes
+    chunks = [data[i : i + 700_001] for i in range(0, len(data), 700_001)]
+    files = [bytearray() for _ in range(coder.t)]
+    raws = []
+    for shard_chunks, raw in coder.iter_encode(iter(chunks)):
+        raws.append(raw)
+        for i in range(coder.t):
+            files[i] += shard_chunks[i]
+    assert b"".join(raws) == data
+    assert [bytes(f) for f in files] == want.shard_files
+
+
+def test_streaming_put_roundtrip(tmp_path):
+    disks = [XLStorage(str(tmp_path / f"d{i}")) for i in range(4)]
+    es = ErasureSet(disks)
+    es.make_bucket("strm")
+    data = RNG.integers(0, 256, size=3 * 1024 * 1024 + 77, dtype=np.uint8).tobytes()
+
+    def gen():
+        for i in range(0, len(data), 512 * 1024):
+            yield data[i : i + 512 * 1024]
+
+    oi = es.put_object("strm", "obj", gen())
+    assert oi.size == len(data)
+    import hashlib
+
+    assert oi.etag == hashlib.md5(data).hexdigest()
+    _, it = es.get_object("strm", "obj")
+    assert b"".join(it) == data
+    # degraded read of a streamed object
+    import shutil
+
+    shutil.rmtree(tmp_path / "d3" / "strm")
+    _, it = es.get_object("strm", "obj")
+    assert b"".join(it) == data
+
+
+def test_streaming_put_empty_and_failed_drive(tmp_path):
+    disks = [XLStorage(str(tmp_path / f"e{i}")) for i in range(4)]
+    es = ErasureSet(disks)
+    es.make_bucket("strm")
+    oi = es.put_object("strm", "empty", iter([]))
+    assert oi.size == 0
+    _, it = es.get_object("strm", "empty")
+    assert b"".join(it) == b""
+
+
+def test_streaming_put_bounded_rss(tmp_path):
+    """512 MiB streamed part must stay far under whole-part RSS."""
+    script = textwrap.dedent(
+        f"""
+        import os, resource, sys
+        os.environ["MINIO_TPU_BACKEND"] = "numpy"
+        sys.path.insert(0, {str(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))!r})
+        import numpy as np
+        from minio_tpu.erasure.set import ErasureSet
+        from minio_tpu.storage.xlstorage import XLStorage
+
+        base = {str(tmp_path)!r}
+        disks = [XLStorage(os.path.join(base, f"r{{i}}")) for i in range(4)]
+        es = ErasureSet(disks)
+        es.make_bucket("big")
+        total = 512 * 1024 * 1024
+        chunk = np.random.default_rng(0).integers(
+            0, 256, size=1024 * 1024, dtype=np.uint8).tobytes()
+
+        def gen():
+            for _ in range(total // len(chunk)):
+                yield chunk
+
+        oi = es.put_object("big", "obj", gen())
+        assert oi.size == total, oi.size
+        peak_mib = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
+        print(f"peak RSS {{peak_mib:.0f}} MiB")
+        # the buffered path measures ~2.9 GiB for the same 512 MiB part
+        # (and grows linearly with part size); the streamed path is flat
+        # (~520-950 MiB incl. interpreter + allocator variance) regardless
+        # of part size -- 565 MiB measured at 1 GiB
+        assert peak_mib < 1200, peak_mib
+        """
+    )
+    r = subprocess.run(
+        [sys.executable, "-c", script], capture_output=True, text=True, timeout=600,
+        env={
+            # minimal env: PALLAS_AXON_POOL_IPS would make sitecustomize
+            # import jax (+~400 MiB RSS baseline); this subprocess measures
+            # the numpy erasure plane only
+            "PATH": os.environ.get("PATH", ""),
+            "HOME": os.environ.get("HOME", "/root"),
+            "MINIO_TPU_BACKEND": "numpy",
+            "MINIO_TPU_STREAM_BATCH_MB": "32",
+        },
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "peak RSS" in r.stdout
+
+
+def test_http_streaming_put_and_multipart(monkeypatch):
+    """Server-level: >8 MiB unsigned-payload PUTs stream HTTP -> erasure."""
+    from minio_tpu.client import S3Client
+    from tests.test_s3_api import ServerThread
+    import hashlib
+    import tempfile
+
+    # other modules flip compression on at import; streaming requires the
+    # identity transform
+    monkeypatch.setenv("MINIO_COMPRESSION_ENABLE", "off")
+    base = tempfile.mkdtemp(prefix="http-stream-")
+    st = ServerThread([os.path.join(base, f"d{i}") for i in range(4)])
+    try:
+        c = S3Client(f"127.0.0.1:{st.port}")
+        assert c.make_bucket("strmhttp").status == 200
+        body = RNG.integers(0, 256, size=12 * 1024 * 1024 + 55, dtype=np.uint8).tobytes()
+        r = c.request("PUT", "/strmhttp/big.bin", body=body, unsigned_payload=True)
+        assert r.status == 200, r.body
+        assert r.headers["etag"].strip('"') == hashlib.md5(body).hexdigest()
+        g = c.get_object("strmhttp", "big.bin")
+        assert g.status == 200 and g.body == body
+
+        # multipart with streamed parts
+        r = c.request("POST", "/strmhttp/mp.bin", query={"uploads": ""})
+        upload_id = r.body.decode().split("<UploadId>")[1].split("<")[0]
+        p1 = RNG.integers(0, 256, size=9 * 1024 * 1024, dtype=np.uint8).tobytes()
+        p2 = RNG.integers(0, 256, size=8 * 1024 * 1024 + 3, dtype=np.uint8).tobytes()
+        etags = []
+        for i, p in enumerate((p1, p2), 1):
+            r = c.request("PUT", "/strmhttp/mp.bin",
+                          query={"partNumber": str(i), "uploadId": upload_id},
+                          body=p, unsigned_payload=True)
+            assert r.status == 200, r.body
+            etags.append(r.headers["etag"].strip('"'))
+        xml = "<CompleteMultipartUpload>" + "".join(
+            f"<Part><PartNumber>{i}</PartNumber><ETag>{e}</ETag></Part>"
+            for i, e in enumerate(etags, 1)
+        ) + "</CompleteMultipartUpload>"
+        r = c.request("POST", "/strmhttp/mp.bin", query={"uploadId": upload_id},
+                      body=xml.encode())
+        assert r.status == 200, r.body
+        g = c.get_object("strmhttp", "mp.bin")
+        assert g.status == 200 and g.body == p1 + p2
+        # all three large unsigned PUTs streamed (never buffered)
+        assert st.srv.streaming_puts == 3, st.srv.streaming_puts
+    finally:
+        st.stop()
+
+
+def test_http_signed_payload_still_buffers():
+    """Signed-payload (default S3Client) PUTs still verify content-sha256."""
+    from minio_tpu.client import S3Client
+    from tests.test_s3_api import ServerThread
+    import tempfile
+
+    base = tempfile.mkdtemp(prefix="http-buf-")
+    st = ServerThread([os.path.join(base, f"b{i}") for i in range(4)])
+    try:
+        c = S3Client(f"127.0.0.1:{st.port}")
+        assert c.make_bucket("bufhttp").status == 200
+        body = RNG.integers(0, 256, size=9 * 1024 * 1024, dtype=np.uint8).tobytes()
+        r = c.put_object("bufhttp", "signed.bin", body)
+        assert r.status == 200, r.body
+        assert c.get_object("bufhttp", "signed.bin").body == body
+        assert st.srv.streaming_puts == 0
+    finally:
+        st.stop()
+
+
+def test_streaming_abort_preserves_existing_object(tmp_path):
+    """An overwrite PUT that dies mid-stream must not touch the old object."""
+    disks = [XLStorage(str(tmp_path / f"a{i}")) for i in range(4)]
+    es = ErasureSet(disks)
+    es.make_bucket("keep")
+    old = b"precious-old-data" * 1000
+    es.put_object("keep", "obj", old)
+
+    def dying_gen():
+        yield b"x" * (2 * 1024 * 1024)
+        raise ConnectionError("client hung up")
+
+    import pytest as _pytest
+
+    with _pytest.raises(ConnectionError):
+        es.put_object("keep", "obj", dying_gen())
+    _, it = es.get_object("keep", "obj")
+    assert b"".join(it) == old
+
+
+def test_streaming_sse_header_falls_back_to_encrypting(monkeypatch):
+    """Request-level SSE on a large unsigned PUT must still encrypt."""
+    from minio_tpu.client import S3Client
+    from tests.test_s3_api import ServerThread
+    import glob
+    import tempfile
+
+    monkeypatch.setenv("MINIO_COMPRESSION_ENABLE", "off")
+    base = tempfile.mkdtemp(prefix="sse-stream-")
+    st = ServerThread([os.path.join(base, f"s{i}") for i in range(4)])
+    try:
+        c = S3Client(f"127.0.0.1:{st.port}")
+        assert c.make_bucket("ssestrm").status == 200
+        body = RNG.integers(0, 256, size=9 * 1024 * 1024, dtype=np.uint8).tobytes()
+        r = c.request("PUT", "/ssestrm/enc.bin", body=body, unsigned_payload=True,
+                      headers={"x-amz-server-side-encryption": "AES256"})
+        assert r.status == 200, r.body
+        assert st.srv.streaming_puts == 0  # must have taken the buffered path
+        g = c.get_object("ssestrm", "enc.bin")
+        assert g.status == 200 and g.body == body
+        assert g.headers.get("x-amz-server-side-encryption") == "AES256"
+        # ciphertext at rest
+        probe = body[5000:5032]
+        found = 0
+        for part in glob.glob(f"{base}/s*/ssestrm/enc.bin/*/part.1"):
+            found += 1
+            assert probe not in open(part, "rb").read()
+        assert found
+    finally:
+        st.stop()
