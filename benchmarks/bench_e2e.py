@@ -1,0 +1,191 @@
+"""E2E benchmark harness: the five BASELINE.md driver-tracked configs.
+
+1. single-node 4-drive EC 2+2 PutObject (1 MiB stripe blocks)
+2. 16-drive EC 8+8 PutObject + GetObject
+3. degraded GetObject with 2 drives down (see also bench_read.py)
+4. multipart upload, 16 MiB parts (size via --mp-gib, default 5)
+5. HealObject over a 16-drive set with induced corruption
+
+Runs against a real server process over HTTP (SigV4, streaming PUTs)
+except heal, which drives the erasure set directly (the admin heal API
+adds only dispatch). Prints a markdown table for PERF.md.
+
+Usage: python benchmarks/bench_e2e.py [--mp-gib N] [--quick]
+"""
+
+import argparse
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("MINIO_TPU_BACKEND", "numpy")
+
+import numpy as np
+
+from minio_tpu.client import S3Client
+
+MIB = 1024 * 1024
+
+
+class Server:
+    def __init__(self, drives, port):
+        self.port = port
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "minio_tpu.server",
+             "--address", f"127.0.0.1:{port}"] + drives,
+            env={**os.environ, "MINIO_TPU_SCAN_INTERVAL": "0",
+                 "MINIO_COMPRESSION_ENABLE": "off"},
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        c = S3Client(f"127.0.0.1:{port}")
+        for _ in range(150):
+            try:
+                if c.request("GET", "/").status == 200:
+                    return
+            except Exception:
+                pass
+            time.sleep(0.2)
+        self.stop()
+        raise RuntimeError("server did not come up")
+
+    def stop(self):
+        self.proc.terminate()
+        self.proc.wait()
+
+
+def best_of(n, fn):
+    best = float("inf")
+    for _ in range(n):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def bench_put_get(c, bucket, size, label, rows, repeats=3):
+    body = np.random.default_rng(1).integers(0, 256, size=size, dtype=np.uint8).tobytes()
+
+    def put():
+        r = c.request("PUT", f"/{bucket}/bench-obj", body=body, unsigned_payload=True)
+        assert r.status == 200, r.body
+
+    def get():
+        g = c.get_object(bucket, "bench-obj")
+        assert g.status == 200 and len(g.body) == size
+
+    tp = best_of(repeats, put)
+    tg = best_of(repeats, get)
+    rows.append((f"{label} PUT", f"{size / MIB / tp:.0f} MiB/s"))
+    rows.append((f"{label} GET", f"{size / MIB / tg:.0f} MiB/s"))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mp-gib", type=float, default=5.0)
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+    if args.quick:
+        args.mp_gib = 0.5
+    obj_size = 64 * MIB if not args.quick else 16 * MIB
+    rows: list[tuple[str, str]] = []
+    base = tempfile.mkdtemp(prefix="bench-e2e-")
+    try:
+        # --- config 1: 4-drive EC 2+2 ---
+        srv = Server([os.path.join(base, f"a{i}") for i in range(4)], 19601)
+        try:
+            c = S3Client("127.0.0.1:19601")
+            assert c.make_bucket("bench4").status == 200
+            bench_put_get(c, "bench4", obj_size, f"4-drive EC2+2 {obj_size // MIB}MiB", rows)
+        finally:
+            srv.stop()
+        shutil.rmtree(base, ignore_errors=True)
+        os.makedirs(base, exist_ok=True)
+
+        # --- config 2 + 3 + 4: 16-drive EC 8+8 ---
+        drives = [os.path.join(base, f"b{i}") for i in range(16)]
+        srv = Server(drives, 19602)
+        try:
+            c = S3Client("127.0.0.1:19602")
+            assert c.make_bucket("bench16").status == 200
+            bench_put_get(c, "bench16", obj_size, f"16-drive EC8+8 {obj_size // MIB}MiB", rows)
+
+            # config 4 first (healthy set), then degrade for config 3
+            total = int(args.mp_gib * 1024 * MIB)
+            part_sz = 16 * MIB
+            nparts = total // part_sz
+            part = np.random.default_rng(2).integers(0, 256, size=part_sz, dtype=np.uint8).tobytes()
+            r = c.request("POST", "/bench16/mp-obj", query={"uploads": ""})
+            assert r.status == 200, r.body
+            upload_id = r.body.decode().split("<UploadId>")[1].split("<")[0]
+            t0 = time.perf_counter()
+            etags = []
+            for i in range(1, nparts + 1):
+                r = c.request("PUT", "/bench16/mp-obj",
+                              query={"partNumber": str(i), "uploadId": upload_id},
+                              body=part, unsigned_payload=True)
+                assert r.status == 200, r.body
+                etags.append(r.headers["etag"].strip('"'))
+            xml = "<CompleteMultipartUpload>" + "".join(
+                f"<Part><PartNumber>{i}</PartNumber><ETag>{e}</ETag></Part>"
+                for i, e in enumerate(etags, 1)
+            ) + "</CompleteMultipartUpload>"
+            r = c.request("POST", "/bench16/mp-obj", query={"uploadId": upload_id},
+                          body=xml.encode())
+            assert r.status == 200, r.body
+            dt = time.perf_counter() - t0
+            rows.append((f"multipart {args.mp_gib:g} GiB / 16 MiB parts PUT",
+                         f"{total / MIB / dt:.0f} MiB/s ({dt:.0f}s)"))
+            c.delete_object("bench16", "mp-obj")
+
+            # config 3: degraded GET, 2 drives down
+            for d in (drives[2], drives[9]):
+                shutil.rmtree(os.path.join(d, "bench16"), ignore_errors=True)
+
+            def degraded_get():
+                g = c.get_object("bench16", "bench-obj")
+                assert g.status == 200 and len(g.body) == obj_size, g.status
+
+            t = best_of(2, degraded_get)
+            rows.append(("16-drive EC8+8 degraded GET (2 down)",
+                         f"{obj_size / MIB / t:.0f} MiB/s"))
+        finally:
+            srv.stop()
+        shutil.rmtree(base, ignore_errors=True)
+        os.makedirs(base, exist_ok=True)
+
+        # --- config 5: heal, 16-drive set, induced corruption ---
+        from minio_tpu.erasure.set import ErasureSet
+        from minio_tpu.storage.xlstorage import XLStorage
+
+        disks = [XLStorage(os.path.join(base, f"h{i}")) for i in range(16)]
+        es = ErasureSet(disks, default_parity=4)  # EC 12+4 like PERF round 1
+        es.make_bucket("healb")
+        hsize = obj_size
+        data = np.random.default_rng(3).integers(0, 256, size=hsize, dtype=np.uint8).tobytes()
+        es.put_object("healb", "obj", data)
+        fi, metas, _, _ = es._quorum_fileinfo("healb", "obj", "", read_data=True)
+        src = es._shard_sources(fi, metas)
+        lost = src[0][0]
+        shutil.rmtree(os.path.join(lost.root, "healb"))
+        t0 = time.perf_counter()
+        res = es.heal_object("healb", "obj")
+        dt = time.perf_counter() - t0
+        _, it = es.get_object("healb", "obj")
+        assert b"".join(it) == data
+        rows.append((f"heal 16-drive EC12+4 {hsize // MIB}MiB (1 drive lost)",
+                     f"{hsize / MIB / dt:.0f} MiB/s ({dt * 1e3:.0f}ms)"))
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+
+    print("\n| Config | Result |")
+    print("|---|---|")
+    for k, v in rows:
+        print(f"| {k} | {v} |")
+
+
+if __name__ == "__main__":
+    main()
