@@ -11,6 +11,7 @@ so locks never queue behind data traffic).
 from __future__ import annotations
 
 import http.client
+import os
 import random
 import threading
 import time
@@ -206,6 +207,9 @@ class _RemoteLocker:
         return self._call("refresh", r, u)
 
 
+LOCK_REFRESH_INTERVAL = float(os.environ.get("MINIO_TPU_LOCK_REFRESH_S", "10"))
+
+
 class DRWMutex:
     """Distributed RW mutex over a set of lockers with quorum
     (reference internal/dsync/drwmutex.go:113)."""
@@ -214,6 +218,8 @@ class DRWMutex:
         self.lockers = lockers
         self.resource = resource
         self.uid = str(uuidlib.uuid4())
+        self._lost = threading.Event()
+        self._stop_refresh: threading.Event | None = None
 
     def _quorum(self, write: bool) -> int:
         n = len(self.lockers)
@@ -260,10 +266,12 @@ class DRWMutex:
         return self._acquire(False, timeout)
 
     def unlock(self) -> None:
+        self.stop_refresher()
         for lk in self.lockers:
             lk.unlock(self.resource, self.uid)
 
     def runlock(self) -> None:
+        self.stop_refresher()
         for lk in self.lockers:
             lk.runlock(self.resource, self.uid)
 
@@ -274,6 +282,62 @@ class DRWMutex:
                 lk.refresh(self.resource, self.uid)
             except Exception:  # noqa: BLE001
                 pass
+
+    # -- active refresh (reference internal/dsync/drwmutex.go:340) ---------
+
+    @property
+    def lost(self) -> bool:
+        """True once the refresher observed refresh-quorum loss: the lock
+        is no longer held cluster-wide and the guarded operation must
+        abort rather than keep writing as a zombie holder."""
+        return self._lost.is_set()
+
+    def start_refresher(
+        self,
+        write: bool = True,
+        interval: float | None = None,
+        on_lost=None,
+    ) -> None:
+        """Refresh the held lock every `interval` seconds in a background
+        thread; if a refresh round grants below quorum, set `lost`, call
+        on_lost once, and stop. unlock()/runlock() stop the refresher."""
+        if self._stop_refresh is not None:
+            return  # already running
+        stop = threading.Event()
+        self._stop_refresh = stop
+        quorum = self._quorum(write)
+        if interval is None:  # env read per call so tests can shrink it
+            interval = float(
+                os.environ.get("MINIO_TPU_LOCK_REFRESH_S", str(LOCK_REFRESH_INTERVAL))
+            )
+        iv = interval
+
+        def loop():
+            while not stop.wait(iv):
+                futs = [
+                    _LOCK_POOL.submit(lk.refresh, self.resource, self.uid)
+                    for lk in self.lockers
+                ]
+                granted = sum(1 for f in futs if _safe_result(f))
+                if stop.is_set():
+                    return  # unlocked during the round: not a loss
+                if granted < quorum:
+                    self._lost.set()
+                    if on_lost is not None:
+                        try:
+                            on_lost()
+                        except Exception:  # noqa: BLE001
+                            pass
+                    return
+
+        threading.Thread(
+            target=loop, daemon=True, name=f"lock-refresh-{self.resource[:40]}"
+        ).start()
+
+    def stop_refresher(self) -> None:
+        if self._stop_refresh is not None:
+            self._stop_refresh.set()
+            self._stop_refresh = None
 
 
 class NamespaceLock:

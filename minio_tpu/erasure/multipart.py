@@ -238,6 +238,7 @@ class MultipartManager:
             disk.write_metadata(bucket, obj, dfi)
 
         try:
+            mtx.start_refresher(write=True)  # 10k-part commits can run long
             futs = [
                 self.es._pool.submit(commit, i, disk)
                 for i, disk in enumerate(self.es.disks)

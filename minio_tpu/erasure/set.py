@@ -221,9 +221,13 @@ class ErasureSet:
         if not mtx.lock(30.0):
             raise QuorumError(f"namespace write lock timeout on {bucket}/{obj}")
         try:
+            # active refresh with loss abort: a partitioned holder must stop
+            # writing once the cluster no longer holds its lock
+            # (reference internal/dsync/drwmutex.go:340 refreshLock)
+            mtx.start_refresher(write=True)
             return self._put_object_locked(
                 bucket, obj, data, user_defined, version_id, versioned,
-                parity, distribution, allow_inline,
+                parity, distribution, allow_inline, lock=mtx,
             )
         finally:
             mtx.unlock()
@@ -239,11 +243,12 @@ class ErasureSet:
         parity: int | None,
         distribution: list[int] | None,
         allow_inline: bool,
+        lock=None,
     ) -> ObjectInfo:
         if not isinstance(data, (bytes, bytearray, memoryview)):
             return self._put_object_streaming(
                 bucket, obj, data, user_defined, version_id, versioned,
-                parity, distribution,
+                parity, distribution, lock=lock,
             )
         p = self.default_parity if parity is None else parity
         d = self.n - p
@@ -269,6 +274,8 @@ class ErasureSet:
         fi.parts = [ObjectPartInfo(1, len(data), len(data), fi.mod_time, etag)]
 
         encoded = self.coder(d, p).encode_part(data)
+        if lock is not None and lock.lost:
+            raise QuorumError(f"write lock on {bucket}/{obj} lost; aborting")
         inline = allow_inline and len(data) <= INLINE_DATA_THRESHOLD
         if not inline:
             fi.data_dir = str(uuid.uuid4())
@@ -323,6 +330,7 @@ class ErasureSet:
         versioned: bool,
         parity: int | None,
         distribution: list[int] | None,
+        lock=None,
     ) -> ObjectInfo:
         """Bounded-memory PUT: encode batches of stripe blocks as they
         arrive and append shard-file chunks to each drive's staged part
@@ -375,6 +383,10 @@ class ErasureSet:
         stream_cap = int(os.environ.get("MINIO_TPU_STREAM_BATCH_MB", "64")) << 20
         try:
             for chunks, raw in coder.iter_encode(reader, max_batch_bytes=stream_cap):
+                if lock is not None and lock.lost:
+                    raise QuorumError(
+                        f"write lock on {bucket}/{obj} lost mid-stream; aborting"
+                    )
                 md5.update(raw)
                 size += len(raw)
                 futs = []
@@ -401,6 +413,10 @@ class ErasureSet:
                 dfi.erasure.index = shard_idx + 1
                 disk.rename_data(TMP_VOLUME, tmp_id, dfi, bucket, obj)
 
+            if lock is not None and lock.lost:
+                raise QuorumError(
+                    f"write lock on {bucket}/{obj} lost before commit; aborting"
+                )
             renamed = True
             futs = [
                 self._pool.submit(drive_op, i, commit_one, i, disk)
@@ -792,11 +808,16 @@ class ErasureSet:
         if not mtx.lock(30.0):
             raise QuorumError(f"namespace lock timeout healing {bucket}/{obj}")
         try:
-            return self._heal_object_locked(bucket, obj, version_id)
+            # healing can outlive the TTL on big objects; a healer that lost
+            # its lock must not rename stale shards over a concurrent write
+            mtx.start_refresher(write=True)
+            return self._heal_object_locked(bucket, obj, version_id, lock=mtx)
         finally:
             mtx.unlock()
 
-    def _heal_object_locked(self, bucket: str, obj: str, version_id: str) -> dict:
+    def _heal_object_locked(
+        self, bucket: str, obj: str, version_id: str, lock=None
+    ) -> dict:
         fi, metas, read_q, write_q = self._quorum_fileinfo(
             bucket, obj, version_id, read_data=True
         )
@@ -920,6 +941,8 @@ class ErasureSet:
                     rebuilt[idx] += fast_hash256(blk)
                     rebuilt[idx] += blk
             per_part_rebuilt[part.number] = rebuilt
+        if lock is not None and lock.lost:
+            raise QuorumError(f"heal lock on {bucket}/{obj} lost; aborting commit")
         healed = []
         tmp_id = str(uuid.uuid4())
         for shard_idx, disk in stale:

@@ -1,0 +1,79 @@
+"""Active lock refresh + loss abort (reference internal/dsync/drwmutex.go:340).
+
+A crashed/partitioned lock plane must abort the guarded write promptly —
+not let the holder keep writing as a zombie until the 120 s TTL."""
+
+import os
+import threading
+import time
+
+os.environ.setdefault("MINIO_TPU_BACKEND", "numpy")
+
+import numpy as np
+import pytest
+
+from minio_tpu.cluster.locks import DRWMutex, LocalLocker, NamespaceLock
+from minio_tpu.erasure.quorum import QuorumError
+from minio_tpu.erasure.set import ErasureSet
+from minio_tpu.storage.xlstorage import XLStorage
+
+
+def test_refresher_detects_quorum_loss():
+    lockers = [LocalLocker() for _ in range(3)]
+    mtx = DRWMutex(lockers, "bkt/obj")
+    assert mtx.lock(1.0)
+    fired = threading.Event()
+    mtx.start_refresher(write=True, interval=0.05, on_lost=fired.set)
+    # healthy refreshes keep the lock
+    time.sleep(0.2)
+    assert not mtx.lost
+    # two of three lock servers lose state (crash/restart)
+    lockers[0].force_unlock("bkt/obj")
+    lockers[1].force_unlock("bkt/obj")
+    assert fired.wait(2.0), "loss callback must fire"
+    assert mtx.lost
+    mtx.unlock()
+
+
+def test_refresher_stops_on_unlock():
+    lockers = [LocalLocker()]
+    mtx = DRWMutex(lockers, "bkt/obj2")
+    assert mtx.lock(1.0)
+    mtx.start_refresher(write=True, interval=0.05)
+    mtx.unlock()
+    # after unlock the refresher must not flag loss
+    time.sleep(0.2)
+    assert not mtx.lost
+
+
+def test_streaming_put_aborts_on_lock_loss(tmp_path, monkeypatch):
+    monkeypatch.setenv("MINIO_TPU_LOCK_REFRESH_S", "0.05")
+    lockers = [LocalLocker() for _ in range(3)]
+    ns = NamespaceLock(lockers)
+    disks = [XLStorage(str(tmp_path / f"d{i}")) for i in range(4)]
+    es = ErasureSet(disks, ns_lock=ns)
+    es.make_bucket("lkb")
+    old = b"old-object-must-survive"
+    es.put_object("lkb", "obj", old)
+
+    chunk = np.random.default_rng(0).integers(
+        0, 256, size=1024 * 1024, dtype=np.uint8
+    ).tobytes()
+
+    def gen():
+        for i in range(64):
+            if i == 2:
+                # the lock plane loses our lock mid-stream
+                lockers[0].force_unlock("lkb/obj")
+                lockers[1].force_unlock("lkb/obj")
+            time.sleep(0.08)
+            yield chunk
+
+    t0 = time.monotonic()
+    with pytest.raises(QuorumError, match="lost"):
+        es.put_object("lkb", "obj", gen())
+    # aborted promptly, not after a 120 s TTL wedge
+    assert time.monotonic() - t0 < 20
+    # pre-existing object untouched
+    _, it = es.get_object("lkb", "obj")
+    assert b"".join(it) == old
