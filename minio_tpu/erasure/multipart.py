@@ -225,6 +225,12 @@ class MultipartManager:
             shard_idx = dist[i] - 1
             # move each part's shard file into the final object layout
             for (n, _), pfi in zip(parts, part_fis):
+                if mtx.lost:
+                    # zombie-holder guard: a committer whose lock was lost
+                    # must not rename stale shards over a concurrent write
+                    raise QuorumError(
+                        f"lock on {bucket}/{obj} lost mid-commit; aborting"
+                    )
                 src = (
                     f"{self._part_key(bucket, obj, upload_id, n)}/"
                     f"{pfi.data_dir}/part.1"

@@ -222,9 +222,14 @@ class ErasureSet:
             raise QuorumError(f"namespace write lock timeout on {bucket}/{obj}")
         try:
             # active refresh with loss abort: a partitioned holder must stop
-            # writing once the cluster no longer holds its lock
-            # (reference internal/dsync/drwmutex.go:340 refreshLock)
-            mtx.start_refresher(write=True)
+            # writing once the cluster no longer holds its lock (reference
+            # internal/dsync/drwmutex.go:340 refreshLock). Only long-running
+            # writes need it — a refresher thread per millisecond PUT would
+            # be pure overhead against the 120 s TTL.
+            long_running = not isinstance(data, (bytes, bytearray, memoryview)) \
+                or len(data) > (8 << 20)
+            if long_running:
+                mtx.start_refresher(write=True)
             return self._put_object_locked(
                 bucket, obj, data, user_defined, version_id, versioned,
                 parity, distribution, allow_inline, lock=mtx,
@@ -808,9 +813,6 @@ class ErasureSet:
         if not mtx.lock(30.0):
             raise QuorumError(f"namespace lock timeout healing {bucket}/{obj}")
         try:
-            # healing can outlive the TTL on big objects; a healer that lost
-            # its lock must not rename stale shards over a concurrent write
-            mtx.start_refresher(write=True)
             return self._heal_object_locked(bucket, obj, version_id, lock=mtx)
         finally:
             mtx.unlock()
@@ -821,6 +823,10 @@ class ErasureSet:
         fi, metas, read_q, write_q = self._quorum_fileinfo(
             bucket, obj, version_id, read_data=True
         )
+        if lock is not None and fi.size > (8 << 20):
+            # healing big objects can outlive the TTL; a healer that lost
+            # its lock must not rename stale shards over a concurrent write
+            lock.start_refresher(write=True)
         if fi.deleted:
             # replicate the delete marker onto drives that miss it
             healed = []

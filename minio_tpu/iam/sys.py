@@ -75,6 +75,10 @@ class IAMSys:
         self.groups: dict[str, dict] = {}  # name -> {"members": [...], "policies": [...], "status": ...}
         self.policies: dict[str, Policy] = dict(CANNED_POLICIES)
         self._loaded = False
+        # post-persist hook (site replication); applying_remote suppresses
+        # it while importing a peer's snapshot
+        self.on_mutation = None
+        self.applying_remote = False
 
     # -- persistence -------------------------------------------------------
 
@@ -82,6 +86,11 @@ class IAMSys:
         self.store.put_object(
             SYSTEM_BUCKET, f"{IAM_PREFIX}/{name}.json", json.dumps(payload).encode()
         )
+        if self.on_mutation is not None and not self.applying_remote:
+            try:
+                self.on_mutation()
+            except Exception:  # noqa: BLE001 — sync is best-effort async
+                pass
 
     def _load_doc(self, name: str) -> dict:
         from ..erasure.quorum import BucketNotFound, ObjectNotFound, VersionNotFound

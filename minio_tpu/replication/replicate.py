@@ -140,12 +140,16 @@ class TargetRegistry:
         ]
 
 
+REPLICA_MARKER = "x-minio-source-replication-request"
+
+
 @dataclass
 class _Task:
     bucket: str
     key: str
     version_id: str
     op: str  # "put" | "delete"
+    arn: str = ""  # destination (multi-target buckets fan out one task per rule)
     attempts: int = 0
 
 
@@ -195,17 +199,21 @@ class ReplicationPool:
         return rules
 
     def queue_mutation(self, bucket: str, key: str, version_id: str, op: str) -> None:
-        """Called from the write path after a successful put/delete."""
+        """Called from the write path after a successful put/delete.
+
+        Fans out one task per matching rule destination — a bucket in a
+        multi-site group replicates every mutation to every peer."""
+        seen: set[str] = set()
         for rule in self.rules_for(bucket):
-            if rule.matches(key):
+            if rule.matches(key) and rule.destination_arn not in seen:
+                seen.add(rule.destination_arn)
                 try:
                     self._queue_for(bucket, key).put_nowait(
-                        _Task(bucket, key, version_id, op)
+                        _Task(bucket, key, version_id, op, rule.destination_arn)
                     )
                     self.stats["queued"] += 1
                 except queue.Full:
                     self.stats["failed"] += 1
-                return
 
     def resync(self, bucket: str) -> int:
         """Replay the whole bucket to its targets (reference resync)."""
@@ -240,16 +248,27 @@ class ReplicationPool:
                     self.stats["failed"] += 1
 
     def _replicate(self, task: _Task) -> None:
-        rules = self.rules_for(task.bucket)
-        rule = next((r for r in rules if r.matches(task.key)), None)
-        if rule is None:
-            return
-        target = self.targets.get(rule.destination_arn)
+        arn = task.arn
+        if not arn:
+            rules = self.rules_for(task.bucket)
+            rule = next((r for r in rules if r.matches(task.key)), None)
+            if rule is None:
+                return
+            arn = rule.destination_arn
+        target = self.targets.get(arn)
         if target is None:
-            raise RuntimeError(f"no target for {rule.destination_arn}")
+            raise RuntimeError(f"no target for {arn}")
         cli = target.client()
+        # the marker tells the replica's server not to re-replicate (the
+        # loop breaker for active-active site groups; reference marks
+        # replicas with x-amz-replication-status=REPLICA the same way)
+        marker = {REPLICA_MARKER: "true"}
         if task.op == "delete":
-            cli.delete_object(target.target_bucket, task.key)
+            r = cli.request(
+                "DELETE", f"/{target.target_bucket}/{task.key}", headers=marker
+            )
+            if r.status not in (200, 204, 404):
+                raise RuntimeError(f"remote delete failed: HTTP {r.status}")
             self.stats["deletes"] += 1
             return
         oi, it = self.store.get_object(task.bucket, task.key, task.version_id)
@@ -257,7 +276,7 @@ class ReplicationPool:
         if self.decode is not None:
             # invert compression/SSE so the replica stores logical bytes
             data = self.decode(oi, data, task.bucket, task.key)
-        headers = {"content-type": oi.content_type}
+        headers = {"content-type": oi.content_type, **marker}
         for k, v in oi.user_defined.items():
             if k.startswith("x-amz-meta-"):
                 headers[k] = v

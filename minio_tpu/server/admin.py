@@ -49,6 +49,41 @@ async def handle_admin(server, request: web.Request, access_key: str, subpath: s
         if not iam.is_allowed(access_key, action, ""):
             raise s3err.AccessDenied
 
+    # -- site replication (reference cmd/site-replication.go) --------------
+    if op == "site-replication/info" and m == "GET":
+        authz("admin:SiteReplicationInfo")
+        return _json(await server._run(server.site.info))
+    if op == "site-replication/add" and m == "POST":
+        authz("admin:SiteReplicationAdd")
+        try:
+            sites = json.loads(body)
+            assert isinstance(sites, list) and len(sites) >= 2
+        except (ValueError, AssertionError):
+            raise s3err.InvalidArgument from None
+        try:
+            return _json(await server._run(server.site.add_sites, sites))
+        except (ValueError, RuntimeError) as e:
+            return _json({"error": str(e)}, status=400)
+    if op == "site-replication/join" and m == "POST":
+        authz("admin:SiteReplicationAdd")
+        try:
+            doc = json.loads(body)
+            await server._run(server.site.join, doc)
+        except (ValueError, KeyError, TypeError):
+            # malformed or version-skewed peer request: a 400, not a 500
+            raise s3err.InvalidArgument from None
+        return _json({"success": True})
+    if op == "site-replication/apply" and m == "POST":
+        authz("admin:SiteReplicationOperation")
+        try:
+            doc = json.loads(body)
+            await server._run(
+                server.site.apply, doc.get("kind", ""), doc.get("payload", {})
+            )
+        except (ValueError, KeyError, TypeError):
+            raise s3err.InvalidArgument from None
+        return _json({"success": True})
+
     # -- users ------------------------------------------------------------
     if op == "add-user" and m == "PUT":
         authz("admin:CreateUser")

@@ -298,11 +298,19 @@ class S3Server:
         self.replication = ReplicationPool(
             store, self.buckets, self.repl_targets, decode=_repl_decode
         )
+        from ..replication.site import SiteReplicationSys
+
+        self.site = SiteReplicationSys(self)
+        self.buckets.on_change = (
+            lambda bucket, bm: self.site.sync_bucket_meta(bucket, bm)
+        )
+        self.iam.on_mutation = self.site.sync_iam
         self.batch = BatchJobPool(store, self.buckets, self.replication)
         self.pool_mgr = (
             PoolManager(store) if hasattr(store, "pools") else None
         )
         self.store = store
+        self.site.load()  # resume a persisted site group across restarts
         # background durability plane: scanner + MRF heal workers
         from ..erasure.background import BackgroundOps
 
@@ -317,6 +325,15 @@ class S3Server:
             self.background.start()
 
     # -- plumbing ------------------------------------------------------------
+
+    def _queue_repl(self, request, bucket, key, version_id, op) -> None:
+        """Queue a bucket-replication task unless this write IS a replica
+        (the marker header breaks active-active site-replication loops)."""
+        from ..replication.replicate import REPLICA_MARKER
+
+        if request.headers.get(REPLICA_MARKER) == "true":
+            return
+        self.replication.queue_mutation(bucket, key, version_id, op)
 
     async def _run(self, fn, *args, **kw):
         return await asyncio.get_running_loop().run_in_executor(
@@ -785,6 +802,8 @@ class S3Server:
             bm.versioning = True
             bm.object_lock = "<ObjectLockConfiguration><ObjectLockEnabled>Enabled</ObjectLockEnabled></ObjectLockConfiguration>"
             await self._run(self.buckets.set, bucket, bm)
+        if self.site.enabled:
+            await self._run(self.site.sync_bucket_create, bucket)
         return web.Response(status=200, headers={"Location": f"/{bucket}"})
 
     async def head_bucket(self, request, bucket: str) -> web.Response:
@@ -802,6 +821,8 @@ class S3Server:
             raise s3err.BucketNotEmpty
         await self._run(self.store.delete_bucket, bucket, force or bool(res.objects))
         self.buckets.drop(bucket)
+        if self.site.enabled:
+            await self._run(self.site.sync_bucket_delete, bucket)
         return web.Response(status=204)
 
     async def get_bucket_location(self, request, bucket: str) -> web.Response:
@@ -1164,7 +1185,7 @@ class S3Server:
                 ev.OBJECT_CREATED_PUT, bucket, listing.decode_dir_object(key),
                 oi.size, oi.etag, oi.version_id, request.get("access_key", ""),
             )
-            self.replication.queue_mutation(bucket, key, oi.version_id, "put")
+            self._queue_repl(request, bucket, key, oi.version_id, "put")
             return web.Response(status=200, headers=headers)
         # transparent compression + server-side encryption
         req_headers = {k.lower(): v for k, v in request.headers.items()}
@@ -1204,7 +1225,7 @@ class S3Server:
             ev.OBJECT_CREATED_PUT, bucket, listing.decode_dir_object(key),
             oi.size, oi.etag, oi.version_id, request.get("access_key", ""),
         )
-        self.replication.queue_mutation(bucket, key, oi.version_id, "put")
+        self._queue_repl(request, bucket, key, oi.version_id, "put")
         return web.Response(status=200, headers=headers)
 
     def _parse_copy_source(self, request, access_key: str) -> tuple[str, str, str]:
@@ -1310,7 +1331,7 @@ class S3Server:
             ev.OBJECT_CREATED_COPY, bucket, listing.decode_dir_object(key),
             new_oi.size, new_oi.etag, new_oi.version_id,
         )
-        self.replication.queue_mutation(
+        self._queue_repl(request, 
             bucket, listing.encode_dir_object(key), new_oi.version_id, "put"
         )
         return web.Response(body=xml.encode(), content_type="application/xml", headers=headers)
@@ -1462,7 +1483,7 @@ class S3Server:
             if not vid:
                 # only logical deletes replicate; removing a SPECIFIC old
                 # version must never delete the replica's live object
-                self.replication.queue_mutation(bucket, key, "", "delete")
+                self._queue_repl(request, bucket, key, "", "delete")
         except (quorum.ObjectNotFound, quorum.VersionNotFound):
             pass  # S3 deletes are idempotent
         return web.Response(status=204, headers=headers)
@@ -1698,7 +1719,7 @@ class S3Server:
             ev.OBJECT_CREATED_MULTIPART, bucket, listing.decode_dir_object(key),
             oi.size, oi.etag, oi.version_id, request.get("access_key", ""),
         )
-        self.replication.queue_mutation(bucket, key, oi.version_id, "put")
+        self._queue_repl(request, bucket, key, oi.version_id, "put")
         return web.Response(body=xml.encode(), content_type="application/xml", headers=headers)
 
     async def abort_multipart(self, request, bucket, key) -> web.Response:
@@ -1915,7 +1936,7 @@ class S3Server:
             "s3:ObjectCreated:Post", bucket, key, oi.size, oi.etag,
             oi.version_id, ak,
         )
-        self.replication.queue_mutation(
+        self._queue_repl(request, 
             bucket, listing.encode_dir_object(key), oi.version_id, "put"
         )
         try:

@@ -52,6 +52,9 @@ class BucketMetadataSys:
         self.store = store  # object layer (ErasureSet / pools)
         self._cache: dict[str, BucketMetadata] = {}
         self._lock = threading.Lock()
+        # post-persist hook (site replication); set to None while applying
+        # a remote change to avoid echo loops
+        self.on_change = None
 
     def _key(self, bucket: str) -> str:
         return f"{CONFIG_PREFIX}/{bucket}/.metadata.json"
@@ -72,10 +75,18 @@ class BucketMetadataSys:
             self._cache[bucket] = bm
         return bm
 
-    def set(self, bucket: str, bm: BucketMetadata) -> None:
+    def set(self, bucket: str, bm: BucketMetadata, notify: bool = True) -> None:
+        """notify=False for internally-applied changes (site replication
+        applying a peer's update) — toggling the shared hook instead would
+        race across threads and could permanently drop it."""
         self.store.put_object(SYSTEM_BUCKET, self._key(bucket), bm.to_json())
         with self._lock:
             self._cache[bucket] = bm
+        if notify and self.on_change is not None:
+            try:
+                self.on_change(bucket, bm)
+            except Exception:  # noqa: BLE001 — sync is best-effort async
+                pass
 
     def drop(self, bucket: str) -> None:
         with self._lock:
