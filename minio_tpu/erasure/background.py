@@ -80,9 +80,11 @@ class BackgroundOps:
         heal_workers: int = 2,
         deep_verify: bool = False,
         bucket_meta=None,
+        tiers=None,
     ):
         self.store = store
         self.bucket_meta = bucket_meta  # BucketMetadataSys for ILM evaluation
+        self.tiers = tiers  # TierRegistry for ILM transitions
         self.scan_interval = scan_interval
         self.object_sleep = object_sleep
         self.deep_verify = deep_verify
@@ -233,9 +235,62 @@ class BackgroundOps:
                     self.store.delete_object(
                         bucket, obj, version_id=oi.version_id or ""
                     )
+                elif act == ilm.ACTION_TRANSITION and oi.is_latest:
+                    tier_name = ilm.transition_tier_for(rules, st)
+                    self._transition(bucket, obj, oi, tier_name)
             except Exception:  # noqa: BLE001 — transient; retry next cycle
                 pass
+        # restored copies past their window re-stub (data stays in the tier).
+        # Cheap pre-check on the already-loaded version list: an extra quorum
+        # metadata read per object per cycle is only paid when the marker is
+        # actually present.
+        from ..ilm.tier import RESTORE_EXPIRY_META
+
+        latest = versions[0]
+        if getattr(latest, "user_defined", {}).get(RESTORE_EXPIRY_META):
+            try:
+                self._expire_restores(bucket, obj)
+            except Exception:  # noqa: BLE001
+                pass
         return expired_current
+
+    def _transition(self, bucket: str, obj: str, oi, tier_name: str) -> None:
+        """Move one object's data to a warm tier and stub it locally
+        (reference cmd/bucket-lifecycle.go:430 transition workers)."""
+        from ..ilm import tier as tiermod
+
+        if self.tiers is None:
+            return
+        t = self.tiers.get(tier_name)
+        if t is None:
+            return
+        info = self.store.get_object_info(bucket, obj)
+        if tiermod.is_transitioned(info.user_defined):
+            return
+        # compressed/SSE objects would tier their TRANSFORMED bytes and the
+        # read-through could not invert them; keep those local (the
+        # reference decrypts and re-encrypts per tier — future work)
+        if any(k.startswith("x-minio-internal-sse") for k in info.user_defined) or \
+                info.user_defined.get("x-minio-internal-compression"):
+            return
+        _, it = self.store.get_object(bucket, obj)
+        data = b"".join(it)
+        remote_key = t.remote_key(bucket, obj)
+        r = t.client().put_object(t.bucket, remote_key, data)
+        if r.status != 200:
+            raise RuntimeError(f"tier upload failed: HTTP {r.status}")
+        self.store.transition_object(bucket, obj, tier_name, remote_key)
+        self.stats["ilm_transitioned"] = self.stats.get("ilm_transitioned", 0) + 1
+
+    def _expire_restores(self, bucket: str, obj: str) -> None:
+        from ..ilm import tier as tiermod
+
+        info = self.store.get_object_info(bucket, obj)
+        exp = info.user_defined.get(tiermod.RESTORE_EXPIRY_META)
+        if not exp or float(exp) > time.time():
+            return
+        self.store.transition_object(bucket, obj, "", "", restub=True)
+        self.stats["ilm_restore_expired"] = self.stats.get("ilm_restore_expired", 0) + 1
 
     def _candidate_sets(self, obj: str):
         """The set that would hold obj in EACH pool (multi-pool objects

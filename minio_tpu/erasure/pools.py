@@ -148,6 +148,16 @@ class ServerPools:
             bucket, obj, tags, version_id
         )
 
+    def transition_object(self, bucket, obj, tier, remote_key, version_id="", restub=False):
+        return self._pool_holding(bucket, obj, version_id).transition_object(
+            bucket, obj, tier, remote_key, version_id, restub
+        )
+
+    def restore_object(self, bucket, obj, data, days, version_id=""):
+        return self._pool_holding(bucket, obj, version_id).restore_object(
+            bucket, obj, data, days, version_id
+        )
+
     def update_object_metadata(self, bucket, obj, version_id, mutate):
         return self._pool_holding(bucket, obj, version_id).update_object_metadata(
             bucket, obj, version_id, mutate

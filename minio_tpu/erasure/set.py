@@ -762,6 +762,111 @@ class ErasureSet:
         finally:
             mtx.unlock()
 
+    def transition_object(
+        self, bucket: str, obj: str, tier: str, remote_key: str,
+        version_id: str = "", restub: bool = False,
+    ) -> None:
+        """Replace a version's local data with a metadata stub pointing at
+        warm-tier storage (reference cmd/bucket-lifecycle.go transition
+        workers). Size/etag/mod_time are preserved; parts are dropped so
+        the scanner/heal planes treat the stub as data-free. restub=True
+        re-stubs an already-transitioned object whose restored copy
+        expired (data is already in the tier)."""
+        mtx = self.ns.new(bucket, obj)
+        if not mtx.lock(30.0):
+            raise QuorumError(f"lock timeout transitioning {bucket}/{obj}")
+        try:
+            from ..ilm.tier import RESTORE_EXPIRY_META, TRANSITION_KEY_META, TRANSITION_TIER_META
+
+            fi, metas, _, write_q = self._quorum_fileinfo(
+                bucket, obj, version_id, read_data=True
+            )
+            if fi.deleted:
+                raise ObjectNotFound(f"{bucket}/{obj}")
+            already = bool(fi.metadata.get(TRANSITION_TIER_META))
+            if already and not restub:
+                return
+            if restub and not already:
+                return
+            old_data_dir = fi.data_dir
+            nfi = FileInfo.from_dict(fi.to_dict())
+            nfi.parts = []
+            nfi.data_dir = None
+            nfi.inline_data = None
+            if restub:
+                nfi.metadata.pop(RESTORE_EXPIRY_META, None)
+            else:
+                nfi.metadata[TRANSITION_TIER_META] = tier
+                nfi.metadata[TRANSITION_KEY_META] = remote_key
+            errs = []
+            for i, disk in enumerate(self.disks):
+                try:
+                    dfi = FileInfo.from_dict(nfi.to_dict())
+                    dfi.volume, dfi.name = bucket, obj
+                    dfi.erasure.index = fi.erasure.distribution[i]
+                    disk.write_metadata(bucket, obj, dfi)
+                    errs.append(None)
+                except Exception as e:  # noqa: BLE001
+                    errs.append(e)
+            reduce_quorum_errs(errs, write_q)
+            if old_data_dir:
+                for disk in self.disks:
+                    try:
+                        disk.delete(bucket, f"{obj}/{old_data_dir}", recursive=True)
+                    except Exception:  # noqa: BLE001 — already absent
+                        pass
+        finally:
+            mtx.unlock()
+
+    def restore_object(
+        self, bucket: str, obj: str, data: bytes, days: int, version_id: str = ""
+    ) -> None:
+        """Bring a transitioned version's data back locally for `days`
+        (reference RestoreObject, cmd/bucket-lifecycle.go restoreObject).
+        The object STAYS transitioned; the scanner re-stubs it after the
+        restore window."""
+        import time as _time
+
+        mtx = self.ns.new(bucket, obj)
+        if not mtx.lock(30.0):
+            raise QuorumError(f"lock timeout restoring {bucket}/{obj}")
+        try:
+            from ..ilm.tier import RESTORE_EXPIRY_META, TRANSITION_TIER_META
+
+            fi, metas, _, write_q = self._quorum_fileinfo(
+                bucket, obj, version_id, read_data=True
+            )
+            if fi.deleted or not fi.metadata.get(TRANSITION_TIER_META):
+                raise ObjectNotFound(f"{bucket}/{obj} is not transitioned")
+            d, p = fi.erasure.data_blocks, fi.erasure.parity_blocks
+            encoded = self.coder(d, p).encode_part(data)
+            nfi = FileInfo.from_dict(fi.to_dict())
+            nfi.data_dir = str(uuid.uuid4())
+            nfi.parts = [
+                ObjectPartInfo(1, len(data), len(data), fi.mod_time,
+                               fi.metadata.get("etag", ""))
+            ]
+            nfi.metadata[RESTORE_EXPIRY_META] = str(
+                _time.time() + days * 86400
+            )
+            tmp_id = str(uuid.uuid4())
+            errs = []
+            for i, disk in enumerate(self.disks):
+                try:
+                    shard_idx = fi.erasure.distribution[i] - 1
+                    dfi = FileInfo.from_dict(nfi.to_dict())
+                    dfi.volume, dfi.name = bucket, obj
+                    dfi.erasure.index = shard_idx + 1
+                    stage = f"{tmp_id}/{nfi.data_dir}/part.1"
+                    disk.create_file(TMP_VOLUME, stage, encoded.shard_files[shard_idx])
+                    disk.rename_data(TMP_VOLUME, tmp_id, dfi, bucket, obj)
+                    errs.append(None)
+                except Exception as e:  # noqa: BLE001
+                    errs.append(e)
+            reduce_quorum_errs(errs, write_q)
+        finally:
+            mtx.unlock()
+
     def set_object_tags(
         self, bucket: str, obj: str, tags: dict[str, str], version_id: str = ""
     ) -> None:

@@ -115,6 +115,16 @@ class ErasureSets:
     def set_object_tags(self, bucket, obj, tags, version_id=""):
         return self.get_hashed_set(obj).set_object_tags(bucket, obj, tags, version_id)
 
+    def transition_object(self, bucket, obj, tier, remote_key, version_id="", restub=False):
+        return self.get_hashed_set(obj).transition_object(
+            bucket, obj, tier, remote_key, version_id, restub
+        )
+
+    def restore_object(self, bucket, obj, data, days, version_id=""):
+        return self.get_hashed_set(obj).restore_object(
+            bucket, obj, data, days, version_id
+        )
+
     def update_object_metadata(self, bucket, obj, version_id, mutate):
         return self.get_hashed_set(obj).update_object_metadata(
             bucket, obj, version_id, mutate

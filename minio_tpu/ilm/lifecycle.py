@@ -21,6 +21,7 @@ ACTION_NONE = "none"
 ACTION_DELETE = "delete"  # expire current version (adds marker if versioned)
 ACTION_DELETE_VERSION = "delete-version"  # hard-delete a noncurrent version
 ACTION_DELETE_MARKER = "delete-marker"  # remove an expired delete marker
+ACTION_TRANSITION = "transition"  # move data to a warm tier
 
 
 @dataclass
@@ -35,7 +36,15 @@ class Rule:
     noncurrent_days: int = 0
     newer_noncurrent_versions: int = 0
     transition_days: int = 0
+    transition_date: float = 0.0
     transition_tier: str = ""
+
+    def transition_due(self, age: float, now: float) -> bool:
+        if not self.transition_tier:
+            return False
+        if self.transition_date:
+            return now >= self.transition_date
+        return age >= self.transition_days * DAY
 
     @property
     def enabled(self) -> bool:
@@ -106,6 +115,10 @@ def parse_lifecycle(xml_text: str) -> list[Rule]:
                     st = sub.tag.split("}")[-1]
                     if st == "Days" and sub.text:
                         r.transition_days = int(sub.text)
+                    elif st == "Date" and sub.text:
+                        r.transition_date = datetime.fromisoformat(
+                            sub.text.replace("Z", "+00:00")
+                        ).timestamp()
                     elif st == "StorageClass" and sub.text:
                         r.transition_tier = sub.text
         rules.append(r)
@@ -119,7 +132,7 @@ def validate_lifecycle(xml_text: str) -> None:
     for r in rules:
         if not (
             r.expiry_days or r.expiry_date or r.expire_delete_marker
-            or r.noncurrent_days or r.transition_days
+            or r.noncurrent_days or r.transition_days or r.transition_tier
         ):
             raise ValueError(f"rule {r.rule_id!r} has no action")
 
@@ -165,4 +178,17 @@ def eval_action(rules: list[Rule], obj: ObjectState, now: float | None = None) -
             return ACTION_DELETE
         if r.expiry_date and now >= r.expiry_date:
             return ACTION_DELETE
+        if r.transition_due(age, now):
+            return ACTION_TRANSITION
     return ACTION_NONE
+
+
+def transition_tier_for(rules: list[Rule], obj: ObjectState, now: float | None = None) -> str:
+    """The tier a matching Transition rule names (after eval_action said
+    ACTION_TRANSITION)."""
+    now = time.time() if now is None else now
+    age = now - obj.mod_time_ns / 1e9
+    for r in rules:
+        if r.enabled and r.matches(obj.key, obj.tags) and r.transition_due(age, now):
+            return r.transition_tier
+    return ""

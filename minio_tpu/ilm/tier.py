@@ -1,0 +1,105 @@
+"""Warm-tier backends for ILM transitions.
+
+Mirrors the reference's tier config + warm backends
+(/root/reference/cmd/tier.go, cmd/warm-backend-minio.go,
+cmd/warm-backend-s3.go): a named remote S3-compatible endpoint where
+transitioned object data lives. The tier registry persists in the
+backend; transitioned objects carry the tier name + remote key in their
+metadata and are read through (or restored) on demand.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import uuid
+from dataclasses import dataclass
+
+from ..client import S3Client
+
+SYSTEM_BUCKET = ".minio.sys"
+TIERS_KEY = "config/tiers.json"
+
+# object metadata markers (internal; stripped from client responses)
+TRANSITION_TIER_META = "x-minio-internal-transition-tier"
+TRANSITION_KEY_META = "x-minio-internal-transitioned-key"
+RESTORE_EXPIRY_META = "x-minio-internal-restore-expiry"
+
+
+@dataclass
+class Tier:
+    name: str
+    endpoint: str
+    access_key: str
+    secret_key: str
+    bucket: str
+    prefix: str = ""
+    tier_type: str = "minio"  # "minio" | "s3" — same wire protocol
+
+    def client(self) -> S3Client:
+        return S3Client(self.endpoint, self.access_key, self.secret_key)
+
+    def remote_key(self, bucket: str, obj: str) -> str:
+        """Unique per transition epoch: a later re-transition of a changed
+        object must not collide with stale tier data."""
+        return f"{self.prefix}{bucket}/{obj}/{uuid.uuid4()}"
+
+    def to_dict(self) -> dict:
+        return dict(self.__dict__)
+
+
+def is_transitioned(user_defined: dict) -> bool:
+    return bool(user_defined.get(TRANSITION_TIER_META))
+
+
+class TierRegistry:
+    """Named warm tiers persisted in the backend (reference cmd/tier.go)."""
+
+    def __init__(self, store):
+        self.store = store
+        self._tiers: dict[str, Tier] = {}
+        self._loaded = False
+        self._mu = threading.Lock()
+
+    def _load(self) -> None:
+        if self._loaded:
+            return
+        with self._mu:
+            if self._loaded:
+                return
+            from ..erasure.quorum import BucketNotFound, ObjectNotFound
+
+            try:
+                _, it = self.store.get_object(SYSTEM_BUCKET, TIERS_KEY)
+                self._tiers = {
+                    name: Tier(**d) for name, d in json.loads(b"".join(it)).items()
+                }
+            except (ObjectNotFound, BucketNotFound):
+                self._tiers = {}
+            self._loaded = True
+
+    def _persist(self) -> None:
+        self.store.put_object(
+            SYSTEM_BUCKET, TIERS_KEY,
+            json.dumps({n: t.to_dict() for n, t in self._tiers.items()}).encode(),
+        )
+
+    def set(self, t: Tier) -> None:
+        self._load()
+        with self._mu:
+            self._tiers[t.name] = t
+            self._persist()
+
+    def remove(self, name: str) -> None:
+        self._load()
+        with self._mu:
+            self._tiers.pop(name, None)
+            self._persist()
+
+    def get(self, name: str) -> Tier | None:
+        self._load()
+        return self._tiers.get(name)
+
+    def list(self) -> list[Tier]:
+        self._load()
+        return list(self._tiers.values())

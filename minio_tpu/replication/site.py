@@ -237,18 +237,22 @@ class SiteReplicationSys:
     def _enqueue(self, kind: str, payload: dict) -> None:
         if not self.enabled:
             return
-        if kind == "iam" and self._iam_pending:
-            # coalesce: frequent IAM persists (e.g. STS mints) need only the
-            # latest snapshot on the wire
-            return
+        if kind == "iam":
+            # coalesce under the lock: frequent IAM persists (e.g. STS
+            # mints) need only the latest snapshot on the wire
+            with self._mu:
+                if self._iam_pending:
+                    return
+                self._iam_pending = True
         try:
             self._q.put_nowait(
                 _SyncItem(kind, payload, pending=[p.name for p in self.others()])
             )
             self.stats["queued"] += 1
-            if kind == "iam":  # only after a successful enqueue
-                self._iam_pending = True
         except queue.Full:
+            if kind == "iam":
+                with self._mu:
+                    self._iam_pending = False
             self.stats["failed"] += 1
 
     def sync_bucket_create(self, bucket: str) -> None:
@@ -297,7 +301,8 @@ class SiteReplicationSys:
         while True:
             item = self._q.get()
             if item.kind == "iam":
-                self._iam_pending = False
+                with self._mu:
+                    self._iam_pending = False
                 item.payload = self._iam_snapshot()  # freshest state wins
             remaining = []
             for pname in item.pending:
@@ -340,10 +345,16 @@ class SiteReplicationSys:
                 pass
             self.wire_bucket(b)
         elif kind == "bucket-delete":
+            b = payload["bucket"]
             try:
-                self.server.store.delete_bucket(payload["bucket"])
-            except Exception:  # noqa: BLE001 — already gone / not empty
-                pass
+                if self.server.store.bucket_exists(b):
+                    # may race the still-draining object-replication deletes:
+                    # raising makes the origin retry with backoff
+                    self.server.store.delete_bucket(b)
+                self.server.buckets.drop(b)  # stale metadata must not
+                # resurrect on recreate (e.g. an old public-read policy)
+            except Exception:
+                raise
         elif kind == "bucket-meta":
             self._apply_bucket_meta(payload["bucket"], payload["meta"])
         elif kind == "iam":
@@ -394,7 +405,7 @@ class SiteReplicationSys:
         the rules live in LOCAL bucket metadata and are never synced."""
         if not self.enabled or bucket.startswith(".minio.sys"):
             return
-        from .replicate import RemoteTarget
+        from .replicate import RemoteTarget, parse_replication_config
 
         rules = []
         for p in self.others():
@@ -410,6 +421,22 @@ class SiteReplicationSys:
                 f"</Destination></Rule>"
             )
         bm = self.server.buckets.get(bucket)
+        # preserve user-configured rules (non site-*); only our own rules
+        # are replaced
+        try:
+            existing = parse_replication_config(bm.replication or "")
+        except Exception:  # noqa: BLE001
+            existing = []
+        for r in existing:
+            if r.rule_id.startswith("site-"):
+                continue
+            rules.append(
+                f"<Rule><ID>{r.rule_id}</ID><Status>{r.status}</Status>"
+                f"<Priority>{r.priority}</Priority>"
+                + (f"<Prefix>{r.prefix}</Prefix>" if r.prefix else "")
+                + f"<Destination><Bucket>{r.destination_arn}</Bucket>"
+                f"</Destination></Rule>"
+            )
         bm.replication = (
             "<ReplicationConfiguration>" + "".join(rules)
             + "</ReplicationConfiguration>"

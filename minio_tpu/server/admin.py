@@ -49,6 +49,35 @@ async def handle_admin(server, request: web.Request, access_key: str, subpath: s
         if not iam.is_allowed(access_key, action, ""):
             raise s3err.AccessDenied
 
+    # -- warm tiers (reference cmd/tier.go, admin-handlers-tiers) ----------
+    if op == "tier" and m == "PUT":
+        authz("admin:SetTier")
+        from ..ilm.tier import Tier
+
+        try:
+            d = json.loads(body)
+            t = Tier(
+                name=d["name"], endpoint=d["endpoint"],
+                access_key=d["accessKey"], secret_key=d["secretKey"],
+                bucket=d["bucket"], prefix=d.get("prefix", ""),
+                tier_type=d.get("type", "minio"),
+            )
+        except (ValueError, KeyError, TypeError):
+            raise s3err.InvalidArgument from None
+        await server._run(server.tiers.set, t)
+        return _json({"success": True})
+    if op == "tier" and m == "GET":
+        authz("admin:ListTier")
+        return _json([
+            {"name": t.name, "endpoint": t.endpoint, "bucket": t.bucket,
+             "prefix": t.prefix, "type": t.tier_type}
+            for t in server.tiers.list()
+        ])
+    if op == "tier" and m == "DELETE":
+        authz("admin:SetTier")
+        await server._run(server.tiers.remove, q.get("name", ""))
+        return _json({"success": True})
+
     # -- site replication (reference cmd/site-replication.go) --------------
     if op == "site-replication/info" and m == "GET":
         authz("admin:SiteReplicationInfo")

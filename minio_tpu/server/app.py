@@ -50,6 +50,20 @@ class _ConsumerDone(Exception):
     """Streaming-put pump: the erasure consumer finished before EOF."""
 
 
+def _restored_locally(oi) -> bool:
+    """A transitioned object whose restore window is still open has its
+    data back on local drives and serves the normal path."""
+    import time as _time
+
+    from ..ilm import tier as tiermod
+
+    exp = oi.user_defined.get(tiermod.RESTORE_EXPIRY_META)
+    try:
+        return bool(exp) and float(exp) > _time.time()
+    except (TypeError, ValueError):
+        return False
+
+
 def _route_action(m: str, bucket: str, key: str, q, headers) -> tuple[str, str, str]:
     """(action, bucket, key) for authorization — the request->policy-action
     mapping the reference does per-handler via checkRequestAuthType."""
@@ -87,6 +101,8 @@ def _route_action(m: str, bucket: str, key: str, q, headers) -> tuple[str, str, 
         if m == "POST":
             if "select" in q:
                 return "s3:GetObject", bucket, key  # Select is a READ
+            if "restore" in q:
+                return "s3:RestoreObject", bucket, key
             return "s3:PutObject", bucket, key
         return "s3:*", bucket, key
     # bucket level
@@ -281,6 +297,9 @@ class S3Server:
         self.audit = AuditLog()
         self.config = ConfigKV(store)
         self.repl_targets = TargetRegistry(store)
+        from ..ilm.tier import TierRegistry
+
+        self.tiers = TierRegistry(store)
 
         def _repl_decode(oi, data, bucket, key):
             from ..crypto import sse as ssemod
@@ -316,7 +335,8 @@ class S3Server:
 
         interval = float(os.environ.get("MINIO_TPU_SCAN_INTERVAL", "300"))
         self.background = BackgroundOps(
-            store, scan_interval=interval, bucket_meta=self.buckets
+            store, scan_interval=interval, bucket_meta=self.buckets,
+            tiers=self.tiers,
         )
         for p in getattr(store, "pools", [store]):
             for s in getattr(p, "sets", [p]):
@@ -328,10 +348,16 @@ class S3Server:
 
     def _queue_repl(self, request, bucket, key, version_id, op) -> None:
         """Queue a bucket-replication task unless this write IS a replica
-        (the marker header breaks active-active site-replication loops)."""
+        (the marker header breaks active-active site-replication loops).
+        Only cluster owners (site peers authenticate with admin creds) may
+        set the marker — an ordinary writer must not be able to opt its
+        writes out of replication."""
         from ..replication.replicate import REPLICA_MARKER
 
-        if request.headers.get(REPLICA_MARKER) == "true":
+        if (
+            request.headers.get(REPLICA_MARKER) == "true"
+            and self.iam.is_owner(request.get("access_key", ""))
+        ):
             return
         self.replication.queue_mutation(bucket, key, version_id, op)
 
@@ -769,6 +795,8 @@ class S3Server:
                 return await self.new_multipart(request, bucket, key)
             if "uploadId" in q:
                 return await self.complete_multipart(request, bucket, key, body)
+            if "restore" in q:
+                return await self.restore_object(request, bucket, key, body)
             if "select" in q and q.get("select-type") == "2":
                 return await self.select_object_content(request, bucket, key, body)
         raise s3err.MethodNotAllowed
@@ -1078,6 +1106,78 @@ class S3Server:
 
     # -- objects ---------------------------------------------------------------
 
+    async def _get_from_tier(self, request, bucket, key, oi) -> web.StreamResponse:
+        """Read-through GET of a transitioned object: bytes come from the
+        warm tier (reference streams transitioned objects from the tier
+        the same way, cmd/bucket-lifecycle.go getTransitionedObjectReader).
+        """
+        from ..ilm import tier as tiermod
+
+        tname = oi.user_defined.get(tiermod.TRANSITION_TIER_META, "")
+        rkey = oi.user_defined.get(tiermod.TRANSITION_KEY_META, "")
+        t = self.tiers.get(tname)
+        if t is None:
+            raise s3err.InternalError
+        self._check_preconditions(request, oi)
+        hdrs = {}
+        rng = self._parse_range(request, oi.size) if oi.size else None
+        if rng:
+            hdrs["Range"] = f"bytes={rng[0]}-{rng[1]}"
+
+        def fetch():
+            r = t.client().get_object(t.bucket, rkey, headers=hdrs)
+            if r.status not in (200, 206):
+                raise RuntimeError(f"tier read failed: HTTP {r.status}")
+            return r.body
+
+        body = await self._run(fetch)
+        headers = self._obj_headers(oi)
+        headers["x-amz-storage-class"] = tname
+        if rng:
+            start, end = rng
+            if len(body) == oi.size:
+                # tier ignored the Range header: slice locally rather than
+                # serving the whole object mislabeled as a range
+                body = body[start:end + 1]
+            headers["Content-Range"] = f"bytes {start}-{end}/{oi.size}"
+            return web.Response(status=206, body=body, headers=headers)
+        return web.Response(status=200, body=body, headers=headers)
+
+    async def restore_object(self, request, bucket: str, key: str, body: bytes) -> web.Response:
+        """POST /bucket/key?restore — bring a transitioned object's data
+        back locally for N days (reference RestoreObjectHandler)."""
+        from ..ilm import tier as tiermod
+
+        key = listing.encode_dir_object(key)
+        days = 1
+        if body:
+            try:
+                root = ET.fromstring(body)
+                for el in root.iter():
+                    if el.tag.split("}")[-1] == "Days" and el.text:
+                        days = max(1, int(el.text))
+            except ET.ParseError:
+                raise s3err.MalformedXML from None
+        oi = await self._run(self.store.get_object_info, bucket, key)
+        if not tiermod.is_transitioned(oi.user_defined):
+            raise s3err.InvalidObjectState
+        if _restored_locally(oi):
+            return web.Response(status=200)  # already restored
+        tname = oi.user_defined.get(tiermod.TRANSITION_TIER_META, "")
+        rkey = oi.user_defined.get(tiermod.TRANSITION_KEY_META, "")
+        t = self.tiers.get(tname)
+        if t is None:
+            raise s3err.InternalError
+
+        def pull_and_restore():
+            r = t.client().get_object(t.bucket, rkey)
+            if r.status != 200:
+                raise RuntimeError(f"tier read failed: HTTP {r.status}")
+            self.store.restore_object(bucket, key, r.body, days)
+
+        await self._run(pull_and_restore)
+        return web.Response(status=202)
+
     def _obj_headers(self, oi: ObjectInfo) -> dict[str, str]:
         from ..crypto import sse as ssemod
 
@@ -1096,6 +1196,17 @@ class S3Server:
             v = oi.user_defined.get(f"x-minio-internal-checksum-{calgo}")
             if v:
                 h[f"x-amz-checksum-{calgo}"] = v
+        from ..ilm import tier as tiermod
+
+        tname = oi.user_defined.get(tiermod.TRANSITION_TIER_META)
+        if tname:
+            h["x-amz-storage-class"] = tname
+            if _restored_locally(oi):
+                exp = float(oi.user_defined[tiermod.RESTORE_EXPIRY_META])
+                h["x-amz-restore"] = (
+                    'ongoing-request="false", expiry-date="'
+                    + _http_date(int(exp * 1e9)) + '"'
+                )
         algo = oi.user_defined.get(ssemod.META_ALGO)
         if algo == "SSE-S3":
             h["x-amz-server-side-encryption"] = "AES256"
@@ -1366,8 +1477,12 @@ class S3Server:
         if vid == "null":
             vid = ""
         oi, handle = await self._run(self.store.open_object, bucket, key, vid)
+        from ..ilm import tier as tiermod
         from . import transforms
 
+        if tiermod.is_transitioned(oi.user_defined) and not _restored_locally(oi):
+            handle.close()
+            return await self._get_from_tier(request, bucket, key, oi)
         if transforms.is_transformed(oi.user_defined):
             return await self._get_transformed(request, bucket, key, oi, handle)
         try:

@@ -63,6 +63,7 @@ ExpiredPresignRequest = APIError("ExpiredPresignRequest", "Request has expired",
 MissingFields = APIError("MissingFields", "Missing fields in request.", 400)
 AuthorizationQueryParametersError = APIError("AuthorizationQueryParametersError", "X-Amz-Expires must be between 1 and 604800 seconds", 400)
 MalformedPolicy = APIError("MalformedPolicy", "Policy has invalid resource.", 400)
+InvalidObjectState = APIError("InvalidObjectState", "The operation is not valid for the current state of the object.", 403)
 XAmzContentSHA256Mismatch = APIError("XAmzContentSHA256Mismatch", "The provided 'x-amz-content-sha256' header does not match what was computed.", 400)
 NoSuchBucketPolicy = APIError("NoSuchBucketPolicy", "The bucket policy does not exist", 404)
 NoSuchTagSet = APIError("NoSuchTagSet", "The TagSet does not exist", 404)

@@ -1,0 +1,135 @@
+"""ILM transitions to a warm tier + restore (reference
+cmd/bucket-lifecycle.go:430 transition workers, cmd/warm-backend-minio.go,
+RestoreObject)."""
+
+import glob
+import json
+import os
+import time
+
+os.environ.setdefault("MINIO_TPU_BACKEND", "numpy")
+os.environ.setdefault("MINIO_TPU_SCAN_INTERVAL", "0")
+
+import numpy as np
+import pytest
+
+from minio_tpu.client import S3Client
+from tests.test_s3_api import ServerThread
+
+RNG = np.random.default_rng(31)
+
+LC_TRANSITION_NOW = (
+    "<LifecycleConfiguration><Rule><ID>t0</ID><Status>Enabled</Status>"
+    "<Filter><Prefix></Prefix></Filter>"
+    "<Transition><Days>0</Days><StorageClass>WARM</StorageClass></Transition>"
+    "</Rule></LifecycleConfiguration>"
+).encode()
+
+
+@pytest.fixture(scope="module")
+def rig(tmp_path_factory):
+    # compression transforms keep objects local (transition guard); other
+    # modules flip the env on at import
+    prev = os.environ.get("MINIO_COMPRESSION_ENABLE")
+    os.environ["MINIO_COMPRESSION_ENABLE"] = "off"
+    base = tmp_path_factory.mktemp("tiers")
+    warm = ServerThread([str(base / f"w{i}") for i in range(4)])
+    hot = ServerThread([str(base / f"h{i}") for i in range(4)])
+    hot.base = str(base)
+    cw = S3Client(f"127.0.0.1:{warm.port}")
+    ch = S3Client(f"127.0.0.1:{hot.port}")
+    assert cw.make_bucket("tier-data").status == 200
+    # register the warm tier on the hot cluster
+    r = ch.request("PUT", "/minio/admin/v3/tier", body=json.dumps({
+        "name": "WARM", "endpoint": f"http://127.0.0.1:{warm.port}",
+        "accessKey": "minioadmin", "secretKey": "minioadmin",
+        "bucket": "tier-data", "prefix": "hot1/",
+    }).encode())
+    assert r.status == 200, r.body
+    yield hot, warm, ch, cw
+    hot.stop()
+    warm.stop()
+    if prev is None:
+        os.environ.pop("MINIO_COMPRESSION_ENABLE", None)
+    else:
+        os.environ["MINIO_COMPRESSION_ENABLE"] = prev
+
+
+def test_transition_readthrough_restore(rig):
+    hot, warm, ch, cw = rig
+    assert ch.make_bucket("ilmbkt").status == 200
+    body = RNG.integers(0, 256, size=300_000, dtype=np.uint8).tobytes()
+    assert ch.put_object("ilmbkt", "cold/data.bin", body).status == 200
+    assert ch.request("PUT", "/ilmbkt", query={"lifecycle": ""},
+                      body=LC_TRANSITION_NOW).status == 200
+    # run the scanner once: Days=0 -> immediate transition
+    hot.srv.background.scan_once()
+    # local shard data gone (stub), but HEAD still shows full size
+    parts = glob.glob(f"{hot.base}/h*/ilmbkt/cold/data.bin/*/part.1")
+    assert not parts, parts
+    h = ch.head_object("ilmbkt", "cold/data.bin")
+    assert h.status == 200
+    assert int(h.headers["content-length"]) == len(body)
+    assert h.headers.get("x-amz-storage-class") == "WARM"
+    # the bytes live on the warm cluster
+    listed = cw.list_objects_v2("tier-data", prefix="hot1/ilmbkt/")
+    assert b"<Key>" in listed.body
+    # read-through GET returns the object
+    g = ch.get_object("ilmbkt", "cold/data.bin")
+    assert g.status == 200 and g.body == body
+    # ranged read-through
+    r = ch.get_object("ilmbkt", "cold/data.bin", headers={"Range": "bytes=100-299"})
+    assert r.status == 206 and r.body == body[100:300]
+
+    # restore: data comes back locally
+    r = ch.request("POST", "/ilmbkt/cold/data.bin", query={"restore": ""},
+                   body=b"<RestoreRequest><Days>2</Days></RestoreRequest>")
+    assert r.status == 202, r.body
+    parts = glob.glob(f"{hot.base}/h*/ilmbkt/cold/data.bin/*/part.1")
+    assert parts, "restore must re-materialize local shards"
+    h = ch.head_object("ilmbkt", "cold/data.bin")
+    assert "ongoing-request" in h.headers.get("x-amz-restore", "")
+    g = ch.get_object("ilmbkt", "cold/data.bin")
+    assert g.status == 200 and g.body == body
+
+
+def test_restore_window_expires_and_restubs(rig):
+    hot, warm, ch, cw = rig
+    assert ch.make_bucket("restub").status == 200
+    body = b"restub-me" * 1000
+    ch.put_object("restub", "obj", body)
+    ch.request("PUT", "/restub", query={"lifecycle": ""}, body=LC_TRANSITION_NOW)
+    hot.srv.background.scan_once()
+    r = ch.request("POST", "/restub/obj", query={"restore": ""},
+                   body=b"<RestoreRequest><Days>1</Days></RestoreRequest>")
+    assert r.status == 202, r.body
+    # force-expire the restore window, then rescan
+    from minio_tpu.ilm.tier import RESTORE_EXPIRY_META
+
+    hot.srv.store.update_object_metadata(
+        "restub", "obj", "",
+        lambda md: md.__setitem__(RESTORE_EXPIRY_META, str(time.time() - 10)),
+    )
+    hot.srv.background.scan_once()
+    parts = glob.glob(f"{hot.base}/h*/restub/obj/*/part.1")
+    assert not parts, "expired restore must re-stub"
+    g = ch.get_object("restub", "obj")  # back to read-through
+    assert g.status == 200 and g.body == body
+
+
+def test_transitioned_object_expiry_still_works(rig):
+    hot, warm, ch, cw = rig
+    assert ch.make_bucket("expire-t").status == 200
+    ch.put_object("expire-t", "gone", b"x" * 1000)
+    ch.request("PUT", "/expire-t", query={"lifecycle": ""}, body=LC_TRANSITION_NOW)
+    hot.srv.background.scan_once()
+    assert ch.get_object("expire-t", "gone").status == 200
+    lc = (
+        "<LifecycleConfiguration><Rule><ID>e0</ID><Status>Enabled</Status>"
+        "<Filter><Prefix></Prefix></Filter>"
+        "<Expiration><Date>2020-01-01T00:00:00Z</Date></Expiration>"
+        "</Rule></LifecycleConfiguration>"
+    ).encode()
+    ch.request("PUT", "/expire-t", query={"lifecycle": ""}, body=lc)
+    hot.srv.background.scan_once()
+    assert ch.get_object("expire-t", "gone").status == 404
