@@ -252,25 +252,59 @@ class IAMSys:
             self._persist_users()
             return u
 
+    def _mint_temp(
+        self,
+        duration_secs: int,
+        extra_claims: dict,
+        parent: str = "",
+        session_policy: dict | None = None,
+        policies: list[str] | None = None,
+        max_expiry: float | None = None,
+    ) -> tuple[UserIdentity, str]:
+        """Shared STS credential mint: expiring identity + signed token."""
+        with self._lock:
+            ak = "STS" + pysecrets.token_hex(8).upper()
+            sk = pysecrets.token_urlsafe(24)
+            exp = time.time() + max(900, min(duration_secs, 7 * 24 * 3600))
+            if max_expiry is not None:
+                exp = min(exp, max_expiry)
+            u = UserIdentity(
+                ak, sk, parent=parent, session_policy=session_policy,
+                expiration=exp, is_temp=True,
+            )
+            if policies:
+                u.policies = list(policies)
+            token = self._sign_token({"accessKey": ak, "exp": exp, **extra_claims})
+            self.users[ak] = u
+            self._persist_users()
+            return u, token
+
     def assume_role(
         self, parent: str, duration_secs: int = 3600, policy: dict | None = None
     ) -> tuple[UserIdentity, str]:
         """STS AssumeRole: mint temp credentials under the caller's identity
         (/root/reference/cmd/sts-handlers.go AssumeRole)."""
-        with self._lock:
-            ak = "STS" + pysecrets.token_hex(8).upper()
-            sk = pysecrets.token_urlsafe(24)
-            exp = time.time() + max(900, min(duration_secs, 7 * 24 * 3600))
-            u = UserIdentity(
-                ak, sk, parent=parent, session_policy=policy,
-                expiration=exp, is_temp=True,
-            )
-            token = self._sign_token(
-                {"accessKey": ak, "parent": parent, "exp": exp}
-            )
-            self.users[ak] = u
-            self._persist_users()
-            return u, token
+        return self._mint_temp(
+            duration_secs, {"parent": parent}, parent=parent,
+            session_policy=policy,
+        )
+
+    def assume_role_web_identity(
+        self,
+        subject: str,
+        duration_secs: int,
+        policies: list[str],
+        token_exp: float | None = None,
+    ) -> tuple[UserIdentity, str]:
+        """STS AssumeRoleWithWebIdentity: mint temp credentials for an
+        OIDC-federated identity — no parent user; the validated token's
+        policy claim grants directly, and the credentials never outlive
+        the identity token itself
+        (/root/reference/cmd/sts-handlers.go AssumeRoleWithWebIdentity)."""
+        return self._mint_temp(
+            duration_secs, {"sub": subject}, policies=policies,
+            max_expiry=token_exp,
+        )
 
     # -- auth --------------------------------------------------------------
 

@@ -17,9 +17,27 @@ from aiohttp import web
 from . import s3err
 
 
+def _credentials_xml(action: str, user, token: str) -> bytes:
+    exp = datetime.fromtimestamp(user.expiration, tz=timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%SZ"
+    )
+    return (
+        '<?xml version="1.0" encoding="UTF-8"?>'
+        f'<{action}Response xmlns="https://sts.amazonaws.com/doc/2011-06-15/">'
+        f"<{action}Result><Credentials>"
+        f"<AccessKeyId>{escape(user.access_key)}</AccessKeyId>"
+        f"<SecretAccessKey>{escape(user.secret_key)}</SecretAccessKey>"
+        f"<SessionToken>{escape(token)}</SessionToken>"
+        f"<Expiration>{exp}</Expiration>"
+        f"</Credentials></{action}Result></{action}Response>"
+    ).encode()
+
+
 async def handle_sts(server, request: web.Request, access_key: str, body: bytes):
     form = dict(urllib.parse.parse_qsl(body.decode("utf-8", "replace")))
     action = form.get("Action", "")
+    if action == "AssumeRoleWithWebIdentity":
+        return await _web_identity(server, form)
     if action != "AssumeRole":
         raise s3err.NotImplemented_
     if not access_key:
@@ -37,17 +55,47 @@ async def handle_sts(server, request: web.Request, access_key: str, body: bytes)
     user, token = await server._run(
         server.iam.assume_role, access_key, duration, policy
     )
-    exp = datetime.fromtimestamp(user.expiration, tz=timezone.utc).strftime(
-        "%Y-%m-%dT%H:%M:%SZ"
+    return web.Response(
+        body=_credentials_xml("AssumeRole", user, token),
+        content_type="application/xml",
     )
-    xml = (
-        '<?xml version="1.0" encoding="UTF-8"?>'
-        '<AssumeRoleResponse xmlns="https://sts.amazonaws.com/doc/2011-06-15/">'
-        "<AssumeRoleResult><Credentials>"
-        f"<AccessKeyId>{escape(user.access_key)}</AccessKeyId>"
-        f"<SecretAccessKey>{escape(user.secret_key)}</SecretAccessKey>"
-        f"<SessionToken>{escape(token)}</SessionToken>"
-        f"<Expiration>{exp}</Expiration>"
-        "</Credentials></AssumeRoleResult></AssumeRoleResponse>"
+
+
+async def _web_identity(server, form: dict) -> web.Response:
+    """OIDC-federated STS: unauthenticated; the JWT is the credential
+    (/root/reference/cmd/sts-handlers.go:62 AssumeRoleWithWebIdentity)."""
+    from ..iam.oidc import OIDCError, OIDCProvider
+
+    provider = getattr(server, "_oidc", None)
+    if provider is None or not provider.enabled:
+        provider = OIDCProvider()
+        server._oidc = provider
+    if not provider.enabled:
+        raise s3err.NotImplemented_
+    token = form.get("WebIdentityToken", "")
+    if not token:
+        raise s3err.InvalidArgument
+    try:
+        duration = int(form.get("DurationSeconds", "3600") or "3600")
+    except ValueError:
+        raise s3err.InvalidArgument from None
+    try:
+        claims = await server._run(provider.validate, token)
+    except OIDCError:
+        raise s3err.AccessDenied from None
+    policies = provider.policies_for(claims)
+    if not policies or any(p not in server.iam.policies for p in policies):
+        # no grant, or a claim naming a nonexistent policy: surface the
+        # misconfiguration at login rather than minting dead credentials
+        raise s3err.AccessDenied
+    user, session = await server._run(
+        server.iam.assume_role_web_identity,
+        str(claims.get("sub", "")),
+        duration,
+        policies,
+        float(claims["exp"]),
     )
-    return web.Response(body=xml.encode(), content_type="application/xml")
+    return web.Response(
+        body=_credentials_xml("AssumeRoleWithWebIdentity", user, session),
+        content_type="application/xml",
+    )

@@ -1,0 +1,159 @@
+"""OIDC AssumeRoleWithWebIdentity (reference cmd/sts-handlers.go:62):
+JWT validated against a local JWKS endpoint; policy claim grants access."""
+
+import base64
+import http.client
+import http.server
+import json
+import os
+import threading
+import time
+
+os.environ.setdefault("MINIO_TPU_BACKEND", "numpy")
+os.environ.setdefault("MINIO_TPU_SCAN_INTERVAL", "0")
+
+import pytest
+
+from minio_tpu.client import S3Client
+from tests.test_s3_api import ServerThread
+
+
+def _b64url(b: bytes) -> str:
+    return base64.urlsafe_b64encode(b).rstrip(b"=").decode()
+
+
+@pytest.fixture(scope="module")
+def oidc_rig(tmp_path_factory):
+    from cryptography.hazmat.primitives.asymmetric import padding, rsa
+    from cryptography.hazmat.primitives import hashes
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    pub = key.public_key().public_numbers()
+
+    def uint_b64(n, length):
+        return _b64url(n.to_bytes(length, "big"))
+
+    jwks = {"keys": [{
+        "kty": "RSA", "kid": "k1", "alg": "RS256", "use": "sig",
+        "n": uint_b64(pub.n, 256), "e": uint_b64(pub.e, 3),
+    }]}
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            if self.path == "/.well-known/openid-configuration":
+                body = json.dumps({
+                    "issuer": "http://idp.test",
+                    "jwks_uri": f"http://127.0.0.1:{srv.server_port}/jwks",
+                }).encode()
+            else:
+                body = json.dumps(jwks).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.HTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+
+    def mint(claims: dict) -> str:
+        header = {"alg": "RS256", "typ": "JWT", "kid": "k1"}
+        signing = f"{_b64url(json.dumps(header).encode())}.{_b64url(json.dumps(claims).encode())}"
+        sig = key.sign(signing.encode(), padding.PKCS1v15(), hashes.SHA256())
+        return f"{signing}.{_b64url(sig)}"
+
+    os.environ["MINIO_IDENTITY_OPENID_CONFIG_URL"] = (
+        f"http://127.0.0.1:{srv.server_port}/.well-known/openid-configuration"
+    )
+    os.environ["MINIO_IDENTITY_OPENID_CLIENT_ID"] = "minio-app"
+    base = tmp_path_factory.mktemp("oidc")
+    st = ServerThread([str(base / f"d{i}") for i in range(4)])
+    yield st, mint
+    st.stop()
+    srv.shutdown()
+    os.environ.pop("MINIO_IDENTITY_OPENID_CONFIG_URL", None)
+    os.environ.pop("MINIO_IDENTITY_OPENID_CLIENT_ID", None)
+
+
+def _sts_call(port: int, token: str) -> tuple[int, str]:
+    import urllib.parse
+
+    body = urllib.parse.urlencode({
+        "Action": "AssumeRoleWithWebIdentity", "Version": "2011-06-15",
+        "WebIdentityToken": token, "DurationSeconds": "900",
+    }).encode()
+    conn = http.client.HTTPConnection("127.0.0.1", port)
+    conn.request("POST", "/", body=body,
+                 headers={"Content-Type": "application/x-www-form-urlencoded"})
+    r = conn.getresponse()
+    return r.status, r.read().decode()
+
+
+def test_web_identity_flow(oidc_rig):
+    st, mint = oidc_rig
+    admin = S3Client(f"127.0.0.1:{st.port}")
+    assert admin.make_bucket("fed-bkt").status == 200
+    admin.put_object("fed-bkt", "doc.txt", b"federated!")
+
+    claims = {
+        "sub": "user-42", "aud": "minio-app", "iss": "http://idp.test",
+        "exp": time.time() + 600, "policy": "readonly",
+    }
+    status, xml = _sts_call(st.port, mint(claims))
+    assert status == 200, xml
+    ak = xml.split("<AccessKeyId>")[1].split("<")[0]
+    sk = xml.split("<SecretAccessKey>")[1].split("<")[0]
+    tok = xml.split("<SessionToken>")[1].split("<")[0]
+    fed = S3Client(f"127.0.0.1:{st.port}", ak, sk)
+    hdrs = {"x-amz-security-token": tok}
+    # readonly policy: GET allowed, PUT denied
+    assert fed.get_object("fed-bkt", "doc.txt", headers=hdrs).body == b"federated!"
+    r = fed.request("PUT", "/fed-bkt/nope", body=b"x", headers=hdrs)
+    assert r.status == 403
+
+
+def test_web_identity_rejections(oidc_rig):
+    st, mint = oidc_rig
+    now = time.time()
+    # expired token
+    status, _ = _sts_call(st.port, mint({
+        "sub": "u", "aud": "minio-app", "exp": now - 10, "policy": "readonly"}))
+    assert status == 403
+    # wrong audience
+    status, _ = _sts_call(st.port, mint({
+        "sub": "u", "aud": "other-app", "exp": now + 600, "policy": "readonly"}))
+    assert status == 403
+    # no policy claim
+    status, _ = _sts_call(st.port, mint({
+        "sub": "u", "aud": "minio-app", "exp": now + 600}))
+    assert status == 403
+    # garbage signature
+    good = mint({"sub": "u", "aud": "minio-app", "exp": now + 600, "policy": "readonly"})
+    h, p, s = good.split(".")
+    status, _ = _sts_call(st.port, f"{h}.{p}.{_b64url(b'not-a-signature' * 10)}")
+    assert status == 403
+
+
+def test_web_identity_nonexistent_policy_rejected(oidc_rig):
+    st, mint = oidc_rig
+    status, _ = _sts_call(st.port, mint({
+        "sub": "u", "aud": "minio-app", "exp": time.time() + 600,
+        "policy": "no-such-policy"}))
+    assert status == 403
+
+
+def test_web_identity_creds_bounded_by_token_exp(oidc_rig):
+    st, mint = oidc_rig
+    exp = time.time() + 930  # just over the 900s floor
+    status, xml = _sts_call(st.port, mint({
+        "sub": "u", "aud": "minio-app", "exp": exp, "policy": "readonly"}))
+    assert status == 200, xml
+    got = xml.split("<Expiration>")[1].split("<")[0]
+    from datetime import datetime, timezone
+
+    got_ts = datetime.strptime(got, "%Y-%m-%dT%H:%M:%SZ").replace(
+        tzinfo=timezone.utc).timestamp()
+    assert got_ts <= exp + 1, "credentials must not outlive the identity token"
