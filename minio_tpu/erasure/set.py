@@ -1007,7 +1007,7 @@ class ErasureSet:
             )
             batched_done = 0
             if use_device:
-                from ..ops.bitrot import fast_hash256_batch
+                from ..ops.bitrot_jax import reconstruct_and_hash
 
                 max_blocks = max(1, 3072 // max(len(missing_idx), 1))
                 for start in range(0, full_n, max_blocks):
@@ -1024,14 +1024,13 @@ class ErasureSet:
                                 read_block(part, idx, f_off, coder.shard_size),
                                 dtype=np.uint8,
                             )
-                    recon = np.asarray(
-                        coder._jax.reconstruct_blocks(
-                            surv, tuple(survivors_idx), missing_idx
-                        )
-                    )  # [count, M, n]
-                    digs = fast_hash256_batch(
-                        recon.reshape(count * len(missing_idx), -1)
-                    ).reshape(count, len(missing_idx), 32)
+                    # reconstruct + bitrot-hash in one device dispatch:
+                    # rebuilt shards are hashed while still resident
+                    recon_d, digs_d = reconstruct_and_hash(
+                        coder._jax, surv, tuple(survivors_idx), missing_idx
+                    )
+                    recon = np.asarray(recon_d)
+                    digs = np.asarray(digs_d)
                     for bi in range(count):
                         for mi, idx in enumerate(missing_idx):
                             rebuilt[idx] += digs[bi, mi].tobytes()

@@ -60,6 +60,7 @@ class OIDCProvider:
         )
         self._jwks: dict | None = None
         self._jwks_at = 0.0
+        self._forced_at = 0.0  # negative-cache: unknown-kid refetch backoff
 
     @property
     def enabled(self) -> bool:
@@ -91,8 +92,11 @@ class OIDCProvider:
 
     def _key_for(self, kid: str):
         key = self._key_in(self._get_jwks(), kid)
-        if key is None:
-            # key rotation: the cached JWKS may predate this kid
+        if key is None and time.time() - self._forced_at > 30:
+            # key rotation: the cached JWKS may predate this kid. The
+            # 30 s backoff stops unauthenticated garbage-kid floods from
+            # hammering the IdP with a refetch per request.
+            self._forced_at = time.time()
             key = self._key_in(self._get_jwks(force=True), kid)
         if key is None:
             raise OIDCError(f"no RSA key for kid {kid!r} in JWKS")
@@ -159,7 +163,7 @@ class OIDCProvider:
     def policies_for(self, claims: dict) -> list[str]:
         v = claims.get(self.claim_name, "")
         if isinstance(v, str):
-            return [p for p in v.split(",") if p]
+            return [p.strip() for p in v.split(",") if p.strip()]
         if isinstance(v, list):
-            return [str(p) for p in v]
+            return [str(p).strip() for p in v if str(p).strip()]
         return []

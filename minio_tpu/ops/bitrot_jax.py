@@ -270,6 +270,47 @@ def _finish_from_state(s: "_St", blocks: jax.Array, done: int, n: int) -> jax.Ar
     return jax.lax.bitcast_convert_type(words, jnp.uint8).reshape(b, 32)
 
 
+def _select_hash_fn():
+    """Pallas chain kernel on TPU (unless disabled), XLA scan elsewhere."""
+    import os
+
+    if (
+        jax.default_backend() == "tpu"
+        and os.environ.get("MINIO_TPU_PALLAS", "1") != "0"
+    ):
+        from .bitrot_pallas import hash256_blocks_pallas
+
+        return hash256_blocks_pallas
+    return hash256_blocks
+
+
+def reconstruct_and_hash(
+    codec,
+    survivors: jax.Array,
+    present: tuple[int, ...],
+    missing: tuple[int, ...],
+    key: bytes = MINIO_KEY,
+) -> tuple[jax.Array, jax.Array]:
+    """HealObject's hot loop in ONE device dispatch: rebuild the missing
+    shards (bit-plane MXU matmul) and produce their bitrot digests while
+    they are still device-resident — the reference decodes then hashes the
+    rebuilt shards in separate CPU passes
+    (/root/reference/cmd/erasure-decode.go:317 + cmd/bitrot-streaming.go).
+
+    survivors: [B, d, n] (shards at indices present[:d]); returns
+    (rebuilt [B, m, n], digests [B, m, 32]).
+    """
+    import os
+
+    survivors = jnp.asarray(survivors, dtype=jnp.uint8)
+    b, _, n = survivors.shape
+    m = len(missing)
+    rebuilt = codec.reconstruct_blocks(survivors, present, missing)
+    hash_fn = _select_hash_fn()
+    digests = hash_fn(rebuilt.reshape(b * m, n), key).reshape(b, m, 32)
+    return rebuilt, digests
+
+
 def encode_and_hash(
     codec, data: jax.Array, key: bytes = MINIO_KEY
 ) -> tuple[jax.Array, jax.Array]:
@@ -289,13 +330,6 @@ def encode_and_hash(
     parity = codec.encode_blocks(data)
     shards = jnp.concatenate([data, parity], axis=1)  # [B, t, n]
     t = d + codec.parity_shards
-    hash_fn = hash256_blocks
-    if (
-        jax.default_backend() == "tpu"
-        and os.environ.get("MINIO_TPU_PALLAS", "1") != "0"
-    ):
-        from .bitrot_pallas import hash256_blocks_pallas
-
-        hash_fn = hash256_blocks_pallas
+    hash_fn = _select_hash_fn()
     digests = hash_fn(shards.reshape(b * t, n), key).reshape(b, t, 32)
     return parity, digests
