@@ -300,8 +300,6 @@ def reconstruct_and_hash(
     survivors: [B, d, n] (shards at indices present[:d]); returns
     (rebuilt [B, m, n], digests [B, m, 32]).
     """
-    import os
-
     survivors = jnp.asarray(survivors, dtype=jnp.uint8)
     b, _, n = survivors.shape
     m = len(missing)
@@ -323,8 +321,6 @@ def encode_and_hash(
     (/root/reference/cmd/erasure-encode.go:76-108 +
     /root/reference/cmd/bitrot-streaming.go:44-75).
     """
-    import os
-
     data = jnp.asarray(data, dtype=jnp.uint8)
     b, d, n = data.shape
     parity = codec.encode_blocks(data)

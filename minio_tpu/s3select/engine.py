@@ -165,9 +165,27 @@ def parse_select_request(body: bytes) -> tuple[str, str, dict, str, dict]:
                         out_opts[o.tag.split("}")[-1]] = o.text or ""
     if not expr:
         raise SelectError("missing Expression")
-    if in_fmt == "Parquet":
-        raise SelectError("Parquet input is not supported")
-    return expr, in_fmt or "CSV", in_opts, out_fmt or in_fmt or "CSV", out_opts
+    # default output mirrors the input format; Parquet input (no Parquet
+    # output exists in S3 Select) defaults to JSON records
+    out_default = "JSON" if in_fmt == "Parquet" else (in_fmt or "CSV")
+    return expr, in_fmt or "CSV", in_opts, out_fmt or out_default, out_opts
+
+
+def read_parquet(data: bytes) -> list[dict]:
+    """Parquet rows as record dicts (reference
+    /root/reference/internal/s3select/parquet/reader.go, which wraps a
+    parquet-go reader the same way this wraps pyarrow)."""
+    try:
+        import io
+
+        import pyarrow.parquet as pq
+    except ImportError:
+        raise SelectError("Parquet input is not supported on this build") from None
+    try:
+        table = pq.read_table(io.BytesIO(data))
+    except Exception as e:  # noqa: BLE001 — corrupt/truncated file
+        raise SelectError(f"cannot read Parquet input: {e}") from None
+    return table.to_pylist()
 
 
 def run_select(body_xml: bytes, data: bytes) -> bytes:
@@ -186,7 +204,12 @@ def run_select(body_xml: bytes, data: bytes) -> bytes:
         q = sql.parse(expr)
     except sql.SQLError as e:
         raise SelectError(str(e)) from None
-    records = read_csv(data, in_opts) if in_fmt == "CSV" else read_json(data, in_opts)
+    if in_fmt == "CSV":
+        records = read_csv(data, in_opts)
+    elif in_fmt == "Parquet":
+        records = read_parquet(data)
+    else:
+        records = read_json(data, in_opts)
     rows, agg = sql.execute(q, records)
     if agg is not None:
         rows = [agg]

@@ -324,7 +324,7 @@ class S3Server:
             lambda bucket, bm: self.site.sync_bucket_meta(bucket, bm)
         )
         self.iam.on_mutation = self.site.sync_iam
-        self.batch = BatchJobPool(store, self.buckets, self.replication)
+        self.batch = BatchJobPool(store, self.buckets, self.replication, kms=self.kms)
         self.pool_mgr = (
             PoolManager(store) if hasattr(store, "pools") else None
         )
@@ -1106,6 +1106,116 @@ class S3Server:
 
     # -- objects ---------------------------------------------------------------
 
+    def _parity_for_storage_class(self, request) -> int | None:
+        """Per-request EC parity from x-amz-storage-class (reference
+        cmd/erasure-object.go:1299 + internal/config/storageclass):
+        STANDARD uses MINIO_STORAGE_CLASS_STANDARD when set,
+        REDUCED_REDUNDANCY uses MINIO_STORAGE_CLASS_RRS (default EC:2).
+        Unknown classes (e.g. tier names) keep the set default."""
+        sc = request.headers.get("x-amz-storage-class", "")
+        if not sc or sc == "STANDARD":
+            spec = os.environ.get("MINIO_STORAGE_CLASS_STANDARD", "")
+        elif sc == "REDUCED_REDUNDANCY":
+            spec = os.environ.get("MINIO_STORAGE_CLASS_RRS", "EC:2")
+        else:
+            return None
+        if not spec.startswith("EC:"):
+            return None
+        try:
+            p = int(spec[3:])
+        except ValueError:
+            return None
+        n = getattr(self.store, "n", 0)
+        if n < 2:
+            return None
+        return max(1, min(p, n // 2))
+
+    async def _proxy_get_remote(self, request, bucket, key, vid=""):
+        """Serve a not-yet-replicated object from a replication target.
+
+        Returns None when no target has it (or proxying is disabled /
+        this request already IS a proxy — loop breaker). Streams the
+        remote body chunk by chunk — a lagging multi-GB object must not
+        be buffered whole per request."""
+        if request.headers.get("x-minio-source-proxy-request") == "true":
+            return None
+        if os.environ.get("MINIO_TPU_REPLICATION_PROXY", "on") == "off":
+            return None
+        if not self.buckets.get(bucket).versioning:
+            # the reference requires versioning for replication; without it
+            # a hard delete leaves no local trace and proxying would
+            # resurrect deleted objects
+            return None
+        targets = self.repl_targets.list(bucket)
+        if not targets:
+            return None
+        # only proxy when the object has NO local trace: a local delete
+        # marker (or any version) means the 404 is authoritative — proxying
+        # would resurrect deleted objects from a lagging peer
+        try:
+            if await self._run(self.store.list_object_versions, bucket, key):
+                return None
+        except Exception:  # noqa: BLE001
+            return None
+        hdrs = {"x-minio-source-proxy-request": "true"}
+        rng = request.headers.get("Range")
+        if rng:
+            hdrs["Range"] = rng
+
+        import http.client as _hc
+
+        from .signature import sign_request
+
+        def open_remote():
+            """(status, resp-headers, http response) from the first target
+            that has the object, None otherwise."""
+            q = f"?versionId={urllib.parse.quote(vid)}" if vid else ""
+            for t in targets:
+                try:
+                    path = "/" + t.target_bucket + "/" + urllib.parse.quote(key, safe="/~-._") + q
+                    url = f"http://{t.endpoint.split('//')[-1]}{path}"
+                    signed = sign_request(
+                        "GET", url, dict(hdrs), "UNSIGNED-PAYLOAD",
+                        t.access_key, t.secret_key, self.region,
+                    )
+                    host = t.endpoint.split("//")[-1]
+                    conn = _hc.HTTPConnection(host, timeout=30)
+                    conn.request("GET", path, headers=signed)
+                    resp = conn.getresponse()
+                    if resp.status in (200, 206):
+                        return resp
+                    resp.read()
+                    conn.close()
+                except Exception:  # noqa: BLE001 — peer down: try the next
+                    continue
+            return None
+
+        resp = await self._run(open_remote)
+        if resp is None:
+            return None
+        out_headers = {
+            k.lower(): v for k, v in resp.getheaders()
+            if k.lower() in ("etag", "last-modified", "content-type",
+                             "content-range", "content-length",
+                             "x-amz-version-id")
+            or k.lower().startswith("x-amz-meta-")
+        }
+        sresp = web.StreamResponse(status=resp.status, headers=out_headers)
+        await sresp.prepare(request)
+        loop = asyncio.get_running_loop()
+        try:
+            while True:
+                chunk = await loop.run_in_executor(
+                    self._io_pool, resp.read, 1 << 20
+                )
+                if not chunk:
+                    break
+                await sresp.write(chunk)
+        finally:
+            resp.close()
+        await sresp.write_eof()
+        return sresp
+
     async def _get_from_tier(self, request, bucket, key, oi) -> web.StreamResponse:
         """Read-through GET of a transitioned object: bytes come from the
         warm tier (reference streams transitioned objects from the tier
@@ -1281,10 +1391,12 @@ class S3Server:
         if body is None:
             # streaming path: body flows HTTP -> erasure encode -> drives
             user_defined.update(checksum_meta)
+            sc_parity = self._parity_for_storage_class(request)
             oi = await self._run_streaming_put(
                 request,
                 lambda rd: self.store.put_object(
-                    bucket, key, rd, user_defined, None, bm.versioning
+                    bucket, key, rd, user_defined, None, bm.versioning,
+                    parity=sc_parity,
                 ),
             )
             headers = {"ETag": f'"{oi.etag}"'}
@@ -1323,6 +1435,7 @@ class S3Server:
             user_defined,
             None,
             bm.versioning,
+            parity=self._parity_for_storage_class(request),
         )
         headers = {"ETag": f'"{oi.etag}"'}
         headers.update(tr.response_headers)
@@ -1476,7 +1589,16 @@ class S3Server:
         vid = request.rel_url.query.get("versionId", "")
         if vid == "null":
             vid = ""
-        oi, handle = await self._run(self.store.open_object, bucket, key, vid)
+        try:
+            oi, handle = await self._run(self.store.open_object, bucket, key, vid)
+        except (quorum.ObjectNotFound, quorum.VersionNotFound):
+            # not (yet) here: replication lag in an active-active pair —
+            # proxy the read to a remote target rather than 404ing
+            # (reference cmd/bucket-replication.go:2334 proxyGetToReplicationTarget)
+            resp = await self._proxy_get_remote(request, bucket, key, vid)
+            if resp is not None:
+                return resp
+            raise
         from ..ilm import tier as tiermod
         from . import transforms
 
@@ -1703,7 +1825,8 @@ class S3Server:
             if k.lower().startswith("x-amz-meta-"):
                 user_defined[k.lower()] = v
         upload_id = await self._run(
-            self.mp.new_upload, bucket, key, user_defined
+            self.mp.new_upload, bucket, key, user_defined,
+            self._parity_for_storage_class(request)
         )
         xml = (
             '<?xml version="1.0" encoding="UTF-8"?>'

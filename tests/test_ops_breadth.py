@@ -305,3 +305,117 @@ def test_object_lambda(site_a, cli_a):
         httpd.shutdown()
         os.environ.pop("MINIO_LAMBDA_WEBHOOK_ENABLE_FN1", None)
         os.environ.pop("MINIO_LAMBDA_WEBHOOK_ENDPOINT_FN1", None)
+
+
+def test_storage_class_parity_override(tmp_path):
+    """x-amz-storage-class drives per-request EC parity (reference
+    cmd/erasure-object.go:1299)."""
+    import numpy as _np
+
+    from minio_tpu.client import S3Client
+    from tests.test_s3_api import ServerThread
+
+    st = ServerThread([str(tmp_path / f"sc{i}") for i in range(8)])  # EC 4+4
+    try:
+        c = S3Client(f"127.0.0.1:{st.port}")
+        assert c.make_bucket("scbkt").status == 200
+        body = _np.random.default_rng(0).integers(0, 256, size=300_000, dtype=_np.uint8).tobytes()
+        assert c.put_object("scbkt", "std", body).status == 200
+        assert c.put_object("scbkt", "rrs", body,
+                            headers={"x-amz-storage-class": "REDUCED_REDUNDANCY"}).status == 200
+        layer = st.srv.store
+        if hasattr(layer, "pools"):
+            layer = layer.pools[0]
+        fi_std, _, _, _ = layer.get_hashed_set("std")._quorum_fileinfo(
+            "scbkt", "std", "", read_data=False)
+        fi_rrs, _, _, _ = layer.get_hashed_set("rrs")._quorum_fileinfo(
+            "scbkt", "rrs", "", read_data=False)
+        assert fi_std.erasure.parity_blocks == 4
+        assert fi_rrs.erasure.parity_blocks == 2
+        assert c.get_object("scbkt", "rrs").body == body
+    finally:
+        st.stop()
+
+
+def test_replication_proxy_get(tmp_path):
+    """A not-yet-replicated object is proxied from the remote target
+    (reference cmd/bucket-replication.go:2334)."""
+    import json as _json
+
+    from minio_tpu.client import S3Client
+    from tests.test_s3_api import ServerThread
+
+    remote = ServerThread([str(tmp_path / f"r{i}") for i in range(4)])
+    local = ServerThread([str(tmp_path / f"l{i}") for i in range(4)])
+    try:
+        cr = S3Client(f"127.0.0.1:{remote.port}")
+        cl = S3Client(f"127.0.0.1:{local.port}")
+        assert cr.make_bucket("proxied").status == 200
+        assert cl.make_bucket("proxied").status == 200
+        # replication/proxying requires versioning (as in the reference)
+        vcfg = (b"<VersioningConfiguration>"
+                b"<Status>Enabled</Status></VersioningConfiguration>")
+        assert cl.request("PUT", "/proxied", query={"versioning": ""},
+                          body=vcfg).status == 200
+        # register the remote as a replication target on local
+        r = cl.request("PUT", "/minio/admin/v3/set-remote-target",
+                       query={"bucket": "proxied"},
+                       body=_json.dumps({
+                           "sourcebucket": "proxied",
+                           "endpoint": f"http://127.0.0.1:{remote.port}",
+                           "credentials": {"accessKey": "minioadmin",
+                                           "secretKey": "minioadmin"},
+                           "targetbucket": "proxied"}).encode())
+        assert r.status == 200, r.body
+        # object exists ONLY on the remote (as if replication lags)
+        cr.put_object("proxied", "lagged.txt", b"remote-only-bytes")
+        g = cl.get_object("proxied", "lagged.txt")
+        assert g.status == 200 and g.body == b"remote-only-bytes", (g.status, g.body[:60])
+        # truly absent object still 404s
+        assert cl.get_object("proxied", "nowhere").status == 404
+    finally:
+        remote.stop()
+        local.stop()
+
+
+def test_batch_keyrotate_job(tmp_path):
+    """Batch key rotation re-encrypts SSE objects under fresh keys
+    (reference cmd/batch-rotate.go)."""
+    import glob as _glob
+    import json as _json
+    import time as _time
+
+    from minio_tpu.client import S3Client
+    from tests.test_s3_api import ServerThread
+
+    st = ServerThread([str(tmp_path / f"kr{i}") for i in range(4)])
+    try:
+        c = S3Client(f"127.0.0.1:{st.port}")
+        assert c.make_bucket("rotbkt").status == 200
+        body = os.urandom(100_000)
+        c.put_object("rotbkt", "enc/secret.bin", body,
+                     headers={"x-amz-server-side-encryption": "AES256"})
+        c.put_object("rotbkt", "enc/plain.bin", b"not-encrypted")
+        before = st.srv.store.get_object_info("rotbkt", "enc/secret.bin").user_defined.copy()
+        job = "keyrotate:\n  bucket: rotbkt\n  prefix: enc/\n"
+        r = c.request("POST", "/minio/admin/v3/start-job", body=job.encode())
+        assert r.status == 200, r.body
+        job_id = _json.loads(r.body)["job_id"]
+        deadline = _time.time() + 15
+        while _time.time() < deadline:
+            s = _json.loads(c.request("GET", "/minio/admin/v3/describe-job",
+                                      query={"jobId": job_id}).body)
+            if s["state"] in ("done", "failed"):
+                break
+            _time.sleep(0.2)
+        assert s["state"] == "done", s
+        assert s["objects_acted"] == 1  # only the encrypted object rotated
+        after = st.srv.store.get_object_info("rotbkt", "enc/secret.bin").user_defined
+        from minio_tpu.crypto.sse import META_SEALED_KEY
+
+        assert before[META_SEALED_KEY] != after[META_SEALED_KEY], "key must change"
+        g = c.get_object("rotbkt", "enc/secret.bin")
+        assert g.status == 200 and g.body == body
+        assert c.get_object("rotbkt", "enc/plain.bin").body == b"not-encrypted"
+    finally:
+        st.stop()

@@ -152,3 +152,45 @@ def test_select_limit_zero_and_truncated_query(cli):
     r = cli.request("POST", "/selb/people.csv",
                     query={"select": "", "select-type": "2"}, body=req)
     assert r.status == 400
+
+
+def test_select_parquet(cli):
+    """Parquet input via pyarrow (reference internal/s3select/parquet)."""
+    import io
+
+    pa = pytest.importorskip("pyarrow")
+    import pyarrow.parquet as pq
+
+    table = pa.table({
+        "name": ["ant", "bee", "cat", "dog"],
+        "legs": [6, 6, 4, 4],
+        "weight": [0.01, 0.02, 4.5, 12.0],
+    })
+    buf = io.BytesIO()
+    pq.write_table(table, buf)
+    cli.put_object("selb", "animals.parquet", buf.getvalue())
+    req = (
+        "<SelectObjectContentRequest>"
+        "<Expression>SELECT name, legs FROM S3Object s WHERE s.legs = 4</Expression>"
+        "<ExpressionType>SQL</ExpressionType>"
+        "<InputSerialization><Parquet/></InputSerialization>"
+        "<OutputSerialization><JSON/></OutputSerialization>"
+        "</SelectObjectContentRequest>"
+    ).encode()
+    r = cli.request("POST", "/selb/animals.parquet",
+                    query={"select": "", "select-type": "2"}, body=req)
+    assert r.status == 200, r.body
+    assert b'"name":"cat"' in r.body.replace(b" ", b"") or b"cat" in r.body
+    assert b"ant" not in r.body
+    # aggregate over parquet
+    req = (
+        "<SelectObjectContentRequest>"
+        "<Expression>SELECT COUNT(*) FROM S3Object</Expression>"
+        "<ExpressionType>SQL</ExpressionType>"
+        "<InputSerialization><Parquet/></InputSerialization>"
+        "<OutputSerialization><CSV/></OutputSerialization>"
+        "</SelectObjectContentRequest>"
+    ).encode()
+    r = cli.request("POST", "/selb/animals.parquet",
+                    query={"select": "", "select-type": "2"}, body=req)
+    assert r.status == 200 and b"4" in r.body
