@@ -229,10 +229,14 @@ class BatchJobPool:
         for raw in self._iter_objects(st, bucket, prefix):
             st.objects_scanned += 1
             try:
-                oi, it = self.store.get_object(bucket, raw)
+                # metadata-only probe first: fetching the body of a skipped
+                # object would abandon a never-started read iterator and
+                # leak its namespace read lock until the TTL
+                oi = self.store.get_object_info(bucket, raw)
                 algo = oi.user_defined.get(ssemod.META_ALGO, "")
                 if algo not in ("SSE-S3", "SSE-KMS"):
                     continue  # SSE-C needs the customer key; plaintext skips
+                oi, it = self.store.get_object(bucket, raw)
                 plain = transforms.decode_full(
                     b"".join(it), oi.user_defined, {}, bucket, raw, self.kms
                 )
@@ -248,7 +252,11 @@ class BatchJobPool:
                 )
                 meta = {
                     k: v for k, v in oi.user_defined.items()
+                    # strip crypto/compression internals (re-derived below)
+                    # but KEEP stored client checksums: the plaintext is
+                    # unchanged by rotation
                     if not k.startswith("x-minio-internal-")
+                    or k.startswith("x-minio-internal-checksum-")
                 }
                 if oi.content_type:
                     meta["content-type"] = oi.content_type

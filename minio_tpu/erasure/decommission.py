@@ -42,6 +42,8 @@ class PoolManager:
         self.decoms: dict[int, DecomStatus] = {}
         self._cancel: set[int] = set()
         self._mu = threading.Lock()
+        self._rebalance_state: dict = {"state": "idle"}
+        self._rebalance_stop = threading.Event()
 
     # -- persistence -------------------------------------------------------
 
@@ -147,6 +149,58 @@ class PoolManager:
                  "usedPct": 0.0 if not total else round(100 * (1 - free / total), 2)}
             )
         return out
+
+    def start_rebalance_continuous(self, threshold_pct: float = 5.0) -> dict:
+        """Run rebalance passes until pool fill spread drops below the
+        threshold (reference StartRebalance,
+        cmd/erasure-server-pool-rebalance.go:936 — continuous with status,
+        not a single pass)."""
+        import threading as _threading
+
+        if len(self.pools.pools) < 2:
+            raise ValueError("rebalance needs multiple pools")
+        with self._mu:  # concurrent POSTs must not start two movers
+            if self._rebalance_state.get("state") == "running":
+                return dict(self._rebalance_state)
+            self._rebalance_stop.clear()
+            self._rebalance_state = {
+                "state": "running", "moved": 0, "passes": 0,
+                "threshold_pct": threshold_pct,
+            }
+
+        def loop():
+            st = self._rebalance_state
+            while not self._rebalance_stop.is_set():
+                usage = self.pool_usage()
+                spread = max(u["usedPct"] for u in usage) - min(
+                    u["usedPct"] for u in usage
+                )
+                st["spread_pct"] = round(spread, 2)
+                if spread <= threshold_pct:
+                    st["state"] = "done"
+                    return
+                try:
+                    out = self.start_rebalance(max_objects=200)
+                except Exception as e:  # noqa: BLE001
+                    st["state"] = "failed"
+                    st["error"] = str(e)
+                    return
+                st["moved"] += out.get("moved", 0)
+                st["passes"] += 1
+                if out.get("moved", 0) == 0:
+                    st["state"] = "done"  # nothing movable: converged
+                    return
+            st["state"] = "stopped"
+
+        _threading.Thread(target=loop, daemon=True, name="rebalance").start()
+        return dict(self._rebalance_state)
+
+    def stop_rebalance(self) -> dict:
+        self._rebalance_stop.set()
+        return dict(self._rebalance_state)
+
+    def rebalance_status(self) -> dict:
+        return dict(self._rebalance_state)
 
     def start_rebalance(self, max_objects: int = 1000) -> dict:
         """Move objects from the fullest pool to the emptiest until counts

@@ -184,6 +184,9 @@ def targets_from_env() -> dict[str, Target]:
             if path:
                 t = FileTarget(ident, path)
                 out[t.arn] = t
+    from .targets import socket_targets_from_env
+
+    out.update(socket_targets_from_env(os.environ))
     return out
 
 

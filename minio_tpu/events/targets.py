@@ -1,0 +1,180 @@
+"""Raw-socket event sink targets: NATS, Redis, MQTT.
+
+The reference ships 11 sink types under /root/reference/internal/event/
+target/ (amqp, kafka, mqtt, nats, nsq, mysql, postgresql, redis,
+elasticsearch, webhook + store). These three cover the lightweight
+wire protocols with zero extra dependencies — each speaks just enough of
+the protocol to publish one event frame, holding a persistent connection
+that reconnects on error (the notifier's retry queue handles transient
+failures).
+
+Env config mirrors the reference's variable naming:
+  MINIO_NOTIFY_NATS_ENABLE_<ID>=on   ..._ADDRESS_<ID>=host:port  ..._SUBJECT_<ID>=subj
+  MINIO_NOTIFY_REDIS_ENABLE_<ID>=on  ..._ADDRESS_<ID>=host:port  ..._KEY_<ID>=key
+  MINIO_NOTIFY_MQTT_ENABLE_<ID>=on   ..._BROKER_<ID>=host:port   ..._TOPIC_<ID>=topic
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+
+from .notify import Target
+
+
+class _SocketTarget(Target):
+    """Shared connect/reconnect plumbing for line-protocol sinks."""
+
+    def __init__(self, host: str, port: int):
+        self.host, self.port = host, port
+        self._sock: socket.socket | None = None
+        self._mu = threading.Lock()
+
+    def _connect(self) -> socket.socket:
+        s = socket.create_connection((self.host, self.port), timeout=5)
+        s.settimeout(5)
+        self._handshake(s)
+        return s
+
+    def _handshake(self, s: socket.socket) -> None:  # pragma: no cover
+        pass
+
+    def send(self, record: dict) -> None:
+        payload = json.dumps(
+            {"EventName": record["eventName"],
+             "Key": f"{record['s3']['bucket']['name']}/{record['s3']['object']['key']}",
+             "Records": [record]}
+        ).encode()
+        with self._mu:
+            try:
+                if self._sock is None:
+                    self._sock = self._connect()
+                self._publish(self._sock, payload)
+            except Exception:
+                # drop the broken conn; one immediate retry on a fresh one,
+                # further failures go to the notifier's retry queue
+                try:
+                    if self._sock is not None:
+                        self._sock.close()
+                finally:
+                    self._sock = None
+                self._sock = self._connect()
+                self._publish(self._sock, payload)
+
+    def _publish(self, s: socket.socket, payload: bytes) -> None:
+        raise NotImplementedError
+
+
+def _parse_addr(addr: str, default_port: int) -> tuple[str, int]:
+    if ":" in addr:
+        h, p = addr.rsplit(":", 1)
+        return h, int(p)
+    return addr, default_port
+
+
+class NATSTarget(_SocketTarget):
+    """NATS text protocol: INFO <- / CONNECT -> / PUB subject len\\r\\n."""
+
+    def __init__(self, ident: str, address: str, subject: str):
+        super().__init__(*_parse_addr(address, 4222))
+        self.arn = f"arn:minio:sqs::{ident}:nats"
+        self.subject = subject
+
+    def _handshake(self, s: socket.socket) -> None:
+        f = s.makefile("rb")
+        line = f.readline()  # INFO {...}
+        if not line.startswith(b"INFO"):
+            raise OSError(f"unexpected NATS greeting: {line[:40]!r}")
+        s.sendall(b'CONNECT {"verbose":false,"pedantic":false,'
+                  b'"name":"minio-tpu"}\r\n')
+
+    def _publish(self, s: socket.socket, payload: bytes) -> None:
+        s.sendall(
+            f"PUB {self.subject} {len(payload)}\r\n".encode()
+            + payload + b"\r\n"
+        )
+
+
+class RedisTarget(_SocketTarget):
+    """RESP RPUSH <key> <event> (the reference's list format)."""
+
+    def __init__(self, ident: str, address: str, key: str):
+        super().__init__(*_parse_addr(address, 6379))
+        self.arn = f"arn:minio:sqs::{ident}:redis"
+        self.key = key
+
+    def _publish(self, s: socket.socket, payload: bytes) -> None:
+        kb = self.key.encode()
+        msg = (
+            b"*3\r\n$5\r\nRPUSH\r\n"
+            + b"$" + str(len(kb)).encode() + b"\r\n" + kb + b"\r\n"
+            + b"$" + str(len(payload)).encode() + b"\r\n" + payload + b"\r\n"
+        )
+        s.sendall(msg)
+        resp = s.recv(64)
+        if resp[:1] == b"-":
+            raise OSError(f"redis error: {resp[:60]!r}")
+
+
+class MQTTTarget(_SocketTarget):
+    """MQTT 3.1.1 CONNECT + QoS0 PUBLISH (minimal client)."""
+
+    def __init__(self, ident: str, broker: str, topic: str):
+        super().__init__(*_parse_addr(broker, 1883))
+        self.arn = f"arn:minio:sqs::{ident}:mqtt"
+        self.topic = topic
+
+    @staticmethod
+    def _varint(n: int) -> bytes:
+        out = bytearray()
+        while True:
+            b = n % 128
+            n //= 128
+            out.append(b | (0x80 if n else 0))
+            if not n:
+                return bytes(out)
+
+    def _handshake(self, s: socket.socket) -> None:
+        client_id = b"minio-tpu"
+        var = (
+            b"\x00\x04MQTT\x04\x02\x00\x3c"  # proto, level 4, clean session
+            + len(client_id).to_bytes(2, "big") + client_id
+        )
+        s.sendall(b"\x10" + self._varint(len(var)) + var)
+        ack = s.recv(4)
+        if len(ack) < 4 or ack[0] != 0x20 or ack[3] != 0:
+            raise OSError(f"MQTT CONNACK refused: {ack!r}")
+
+    def _publish(self, s: socket.socket, payload: bytes) -> None:
+        tb = self.topic.encode()
+        var = len(tb).to_bytes(2, "big") + tb + payload
+        s.sendall(b"\x30" + self._varint(len(var)) + var)
+
+
+def socket_targets_from_env(env) -> dict[str, Target]:
+    out: dict[str, Target] = {}
+    for k, v in env.items():
+        if v not in ("on", "true", "1"):
+            continue
+        ident = k.rsplit("_", 1)[-1]
+        il = ident.lower()
+        if k.startswith("MINIO_NOTIFY_NATS_ENABLE_"):
+            addr = env.get(f"MINIO_NOTIFY_NATS_ADDRESS_{ident}", "")
+            subj = env.get(f"MINIO_NOTIFY_NATS_SUBJECT_{ident}", "minio-events")
+            if addr:
+                t = NATSTarget(il, addr, subj)
+                out[t.arn] = t
+        elif k.startswith("MINIO_NOTIFY_REDIS_ENABLE_"):
+            addr = env.get(f"MINIO_NOTIFY_REDIS_ADDRESS_{ident}", "")
+            key = env.get(f"MINIO_NOTIFY_REDIS_KEY_{ident}", "minio-events")
+            if addr:
+                t = RedisTarget(il, addr, key)
+                out[t.arn] = t
+        elif k.startswith("MINIO_NOTIFY_MQTT_ENABLE_"):
+            broker = env.get(f"MINIO_NOTIFY_MQTT_BROKER_{ident}", "")
+            topic = env.get(f"MINIO_NOTIFY_MQTT_TOPIC_{ident}", "minio-events")
+            if broker:
+                t = MQTTTarget(il, broker, topic)
+                out[t.arn] = t
+    return out

@@ -79,9 +79,25 @@ def write_csv(rows: list[dict], opts: dict) -> bytes:
     return buf.getvalue().encode()
 
 
+def _json_default(v):
+    """Parquet (and future) readers surface datetime/Decimal/bytes values
+    that json.dumps cannot encode natively."""
+    import base64 as _b64
+    import datetime as _dt
+    import decimal as _dec
+
+    if isinstance(v, (_dt.datetime, _dt.date, _dt.time)):
+        return v.isoformat()
+    if isinstance(v, _dec.Decimal):
+        return float(v)
+    if isinstance(v, (bytes, bytearray)):
+        return _b64.b64encode(v).decode()
+    return str(v)
+
+
 def write_json(rows: list[dict], opts: dict) -> bytes:
     rd = opts.get("RecordDelimiter", "\n") or "\n"
-    return "".join(json.dumps(r) + rd for r in rows).encode()
+    return "".join(json.dumps(r, default=_json_default) + rd for r in rows).encode()
 
 
 # -- event-stream framing ----------------------------------------------------

@@ -360,10 +360,16 @@ async def handle_admin(server, request: web.Request, access_key: str, subpath: s
         if op == "pools/rebalance" and m == "POST":
             authz("admin:RebalancePool")
             try:
-                out = await server._run(pm.start_rebalance)
+                out = await server._run(pm.start_rebalance_continuous)
             except ValueError as e:
                 return _json({"error": str(e)}, 400)
             return _json(out)
+        if op == "pools/rebalance/status" and m == "GET":
+            authz("admin:RebalancePool")
+            return _json(pm.rebalance_status())
+        if op == "pools/rebalance/stop" and m == "POST":
+            authz("admin:RebalancePool")
+            return _json(pm.stop_rebalance())
 
     # -- config KV ---------------------------------------------------------
     if op == "get-config" and m == "GET":

@@ -14,7 +14,10 @@ import threading
 SYSTEM_BUCKET = ".minio.sys"
 CONFIG_KEY = "config/settings.json"
 
-# subsystem -> {key: default}  (subset of the reference's 30 subsystems)
+# subsystem -> {key: default}  (mirrors /root/reference/internal/config/
+# subsystem registry). Values persist and are served back; only a subset
+# applies live today (scanner/heal workers) — the rest provide the
+# reference's config surface so tooling round-trips cleanly.
 DEFAULTS: dict[str, dict[str, str]] = {
     "scanner": {"interval": "300", "deep_verify": "off"},
     "compression": {"enable": "off", "extensions": "", "mime_types": ""},
@@ -23,6 +26,23 @@ DEFAULTS: dict[str, dict[str, str]] = {
     "storage_class": {"standard": "", "rrs": ""},
     "replication": {"workers": "2"},
     "batch": {"workers": "1"},
+    "identity_openid": {
+        "config_url": "", "client_id": "", "claim_name": "policy",
+    },
+    "notify_webhook": {"enable": "off", "endpoint": "", "auth_token": ""},
+    "notify_nats": {"enable": "off", "address": "", "subject": "minio-events"},
+    "notify_redis": {"enable": "off", "address": "", "key": "minio-events"},
+    "notify_mqtt": {"enable": "off", "broker": "", "topic": "minio-events"},
+    "logger_webhook": {"enable": "off", "endpoint": ""},
+    "audit_webhook": {"enable": "off", "endpoint": ""},
+    "lambda_webhook": {"enable": "off", "endpoint": ""},
+    "site": {"name": "", "region": "us-east-1"},
+    "etcd": {"endpoints": ""},  # accepted, unused (no etcd federation)
+    "cache": {"enable": "off", "ttl": "300"},
+    "browser": {"enable": "off"},
+    "ilm": {"transition_workers": "1", "expiry_workers": "1"},
+    "drive": {"max_timeout": "30s"},
+    "subnet": {"license": ""},  # accepted for config compat
 }
 
 

@@ -1,0 +1,128 @@
+"""NATS/Redis/MQTT event sinks against in-test protocol servers
+(reference internal/event/target/{nats,redis,mqtt}.go)."""
+
+import json
+import os
+import socket
+import threading
+
+os.environ.setdefault("MINIO_TPU_BACKEND", "numpy")
+
+from minio_tpu.events.targets import (
+    MQTTTarget,
+    NATSTarget,
+    RedisTarget,
+    socket_targets_from_env,
+)
+
+RECORD = {
+    "eventName": "s3:ObjectCreated:Put",
+    "s3": {"bucket": {"name": "bkt"}, "object": {"key": "k.txt"}},
+}
+
+
+def _serve(handler):
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(4)
+    got: list[bytes] = []
+    done = threading.Event()
+
+    def loop():
+        conn, _ = srv.accept()
+        try:
+            handler(conn, got)
+        finally:
+            done.set()
+            conn.close()
+
+    threading.Thread(target=loop, daemon=True).start()
+    return srv, got, done
+
+
+def test_nats_target():
+    def handler(conn, got):
+        conn.sendall(b'INFO {"server_id":"test"}\r\n')
+        f = conn.makefile("rb")
+        assert f.readline().startswith(b"CONNECT")
+        pub = f.readline()  # PUB subj len
+        assert pub.startswith(b"PUB events.minio ")
+        n = int(pub.split()[2])
+        got.append(f.read(n))
+
+    srv, got, done = _serve(handler)
+    t = NATSTarget("n1", f"127.0.0.1:{srv.getsockname()[1]}", "events.minio")
+    t.send(RECORD)
+    assert done.wait(5)
+    rec = json.loads(got[0])
+    assert rec["EventName"] == "s3:ObjectCreated:Put"
+    assert rec["Key"] == "bkt/k.txt"
+
+
+def test_redis_target():
+    def handler(conn, got):
+        f = conn.makefile("rb")
+        assert f.readline() == b"*3\r\n"
+        assert f.readline() == b"$5\r\n"
+        assert f.readline() == b"RPUSH\r\n"
+        klen = int(f.readline()[1:])
+        assert f.read(klen + 2)[:-2] == b"evkey"
+        plen = int(f.readline()[1:])
+        got.append(f.read(plen))
+        conn.sendall(b":1\r\n")
+
+    srv, got, done = _serve(handler)
+    t = RedisTarget("r1", f"127.0.0.1:{srv.getsockname()[1]}", "evkey")
+    t.send(RECORD)
+    assert done.wait(5)
+    assert json.loads(got[0])["Key"] == "bkt/k.txt"
+
+
+def test_mqtt_target():
+    def handler(conn, got):
+        hdr = conn.recv(2)
+        assert hdr[0] == 0x10  # CONNECT
+        rem = hdr[1]
+        conn.recv(rem)
+        conn.sendall(b"\x20\x02\x00\x00")  # CONNACK accepted
+        hdr = conn.recv(1)
+        assert hdr[0] & 0xF0 == 0x30  # PUBLISH
+        # varint remaining length
+        rem, shift = 0, 0
+        while True:
+            b = conn.recv(1)[0]
+            rem |= (b & 0x7F) << shift
+            shift += 7
+            if not b & 0x80:
+                break
+        body = b""
+        while len(body) < rem:
+            body += conn.recv(rem - len(body))
+        tlen = int.from_bytes(body[:2], "big")
+        assert body[2:2 + tlen] == b"minio/events"
+        got.append(body[2 + tlen:])
+
+    srv, got, done = _serve(handler)
+    t = MQTTTarget("m1", f"127.0.0.1:{srv.getsockname()[1]}", "minio/events")
+    t.send(RECORD)
+    assert done.wait(5)
+    assert json.loads(got[0])["EventName"] == "s3:ObjectCreated:Put"
+
+
+def test_env_discovery():
+    env = {
+        "MINIO_NOTIFY_NATS_ENABLE_A": "on",
+        "MINIO_NOTIFY_NATS_ADDRESS_A": "127.0.0.1:4222",
+        "MINIO_NOTIFY_REDIS_ENABLE_B": "on",
+        "MINIO_NOTIFY_REDIS_ADDRESS_B": "127.0.0.1:6379",
+        "MINIO_NOTIFY_MQTT_ENABLE_C": "on",
+        "MINIO_NOTIFY_MQTT_BROKER_C": "127.0.0.1:1883",
+        "MINIO_NOTIFY_MQTT_ENABLE_OFF": "off",
+    }
+    targets = socket_targets_from_env(env)
+    arns = sorted(targets)
+    assert arns == [
+        "arn:minio:sqs::a:nats",
+        "arn:minio:sqs::b:redis",
+        "arn:minio:sqs::c:mqtt",
+    ]
