@@ -10,11 +10,18 @@ grouping and marker pagination mirror ListObjectsV2 semantics.
 
 from __future__ import annotations
 
+import hashlib
 import heapq
+import json
+import os
+import threading
+import time
 from typing import Iterator
 
 from .quorum import ObjectNotFound, QuorumError, VersionNotFound
 from .types import ListObjectsResult, ObjectInfo
+
+SYSTEM_BUCKET = ".minio.sys"
 
 from ..storage.pathutil import (  # noqa: F401 — re-exported API
     DIR_OBJECT_SUFFIX,
@@ -53,6 +60,97 @@ def _merged_keys(es, bucket: str, prefix: str) -> Iterator[str]:
             return
 
 
+# ---- persisted metacache ---------------------------------------------------
+# Without it every continuation page re-walks every drive from scratch
+# (O(pages x full-walk)); the reference caches listing streams as objects
+# under .minio.sys and resumes them by continuation token
+# (/root/reference/cmd/metacache-set.go:319, metacache-server-pool.go:60).
+
+_MC_LOCK = threading.Lock()
+# (store-id, bucket, prefix) -> (created, keys | None); keys=None is the
+# memoized "too big to cache" verdict so huge prefixes don't double-walk
+_MC_MEM: dict[tuple[int, str, str], tuple[float, list[str] | None]] = {}
+_MC_MAX_ENTRIES = 256
+
+
+def _mc_ttl() -> float:
+    return float(os.environ.get("MINIO_TPU_METACACHE_TTL", "15"))
+
+
+def _mc_max_keys() -> int:
+    return int(os.environ.get("MINIO_TPU_METACACHE_MAX_KEYS", "200000"))
+
+
+def invalidate_bucket(bucket: str) -> None:
+    """Drop in-memory cache entries for a (deleted/recreated) bucket."""
+    with _MC_LOCK:
+        for ck in [k for k in _MC_MEM if k[1] == bucket]:
+            del _MC_MEM[ck]
+
+
+def _mc_evict(now: float, ttl: float) -> None:
+    """Caller holds _MC_LOCK: drop expired entries + cap total count."""
+    for ck in [k for k, (at, _) in _MC_MEM.items() if now - at >= ttl]:
+        del _MC_MEM[ck]
+    while len(_MC_MEM) > _MC_MAX_ENTRIES:
+        _MC_MEM.pop(next(iter(_MC_MEM)))
+
+
+def _metacache_keys(es, bucket: str, prefix: str) -> list[str] | None:
+    """Sorted raw keys for (bucket, prefix) from the metacache, building
+    and persisting it on first paginated access. None = stream the walk
+    (cache disabled, stale path, or namespace too big to cache)."""
+    ttl = _mc_ttl()
+    if ttl <= 0 or bucket.startswith(SYSTEM_BUCKET):
+        return None
+    now = time.time()
+    ck = (id(es), bucket, prefix)  # store identity: two stores in one
+    # process (e.g. in-process site pairs) must never share key lists
+    with _MC_LOCK:
+        _mc_evict(now, ttl)
+        hit = _MC_MEM.get(ck)
+    if hit and now - hit[0] < ttl:
+        return hit[1]
+    obj_key = (
+        f"buckets/{bucket}/.metacache/"
+        f"{hashlib.sha1(prefix.encode()).hexdigest()}.json"
+    )
+    # another node of the cluster may have persisted this listing already
+    try:
+        _, it = es.get_object(SYSTEM_BUCKET, obj_key)
+        doc = json.loads(b"".join(it))
+        if now - float(doc.get("created", 0)) < ttl:
+            keys = list(doc.get("keys", []))
+            with _MC_LOCK:
+                _MC_MEM[ck] = (float(doc["created"]), keys)
+            return keys
+        # expired persisted cache: reclaim the space opportunistically
+        try:
+            es.delete_object(SYSTEM_BUCKET, obj_key)
+        except Exception:  # noqa: BLE001
+            pass
+    except Exception:  # noqa: BLE001 — absent/corrupt: rebuild
+        pass
+    keys: list[str] | None = []
+    cap = _mc_max_keys()
+    for raw in _merged_keys(es, bucket, prefix):
+        keys.append(raw)
+        if len(keys) > cap:
+            keys = None  # memoize the verdict: pages stream the walk
+            break
+    with _MC_LOCK:
+        _MC_MEM[ck] = (now, keys)
+    if keys is not None:
+        try:
+            es.put_object(
+                SYSTEM_BUCKET, obj_key,
+                json.dumps({"created": now, "keys": keys}).encode(),
+            )
+        except Exception:  # noqa: BLE001 — persistence is an optimization
+            pass
+    return keys
+
+
 def list_objects(
     es,
     bucket: str,
@@ -77,7 +175,15 @@ def list_objects(
     def full() -> bool:
         return len(out.objects) + len(out.prefixes) >= max_keys
 
-    for raw_key in _merged_keys(es, bucket, prefix):
+    key_source: Iterator[str] | list[str] | None = None
+    if marker:
+        # continuation page: reuse (or build once) the cached key stream
+        # instead of re-walking every drive per page
+        key_source = _metacache_keys(es, bucket, prefix)
+    if key_source is None:
+        key_source = _merged_keys(es, bucket, prefix)
+
+    for raw_key in key_source:
         key = decode_dir_object(raw_key)
         if delimiter:
             rest = key[len(prefix) :]

@@ -95,3 +95,18 @@ class BucketMetadataSys:
             self.store.delete_object(SYSTEM_BUCKET, self._key(bucket))
         except Exception:  # noqa: BLE001
             pass
+        # a deleted (or soon recreated) bucket must not leave listing
+        # caches behind — in memory or persisted
+        from ..erasure import listing as _listing
+
+        _listing.invalidate_bucket(bucket)
+        try:
+            for raw in self.store.walk_objects(
+                SYSTEM_BUCKET, f"{CONFIG_PREFIX}/{bucket}/.metacache/"
+            ):
+                try:
+                    self.store.delete_object(SYSTEM_BUCKET, raw)
+                except Exception:  # noqa: BLE001
+                    pass
+        except Exception:  # noqa: BLE001
+            pass

@@ -1,0 +1,106 @@
+"""Persisted metacache: continuation pages reuse a cached key stream
+instead of re-walking every drive (reference cmd/metacache-set.go:319)."""
+
+import os
+
+os.environ.setdefault("MINIO_TPU_BACKEND", "numpy")
+
+import pytest
+
+from minio_tpu.erasure import listing
+from minio_tpu.erasure.set import ErasureSet
+from minio_tpu.storage.xlstorage import XLStorage
+
+
+@pytest.fixture
+def es(tmp_path, monkeypatch):
+    monkeypatch.setenv("MINIO_TPU_METACACHE_TTL", "30")
+    listing._MC_MEM.clear()
+    disks = [XLStorage(str(tmp_path / f"d{i}")) for i in range(4)]
+    s = ErasureSet(disks)
+    s.make_bucket("mcb")
+    for i in range(25):
+        s.put_object("mcb", f"docs/k{i:03d}", b"x")
+    return s
+
+
+def _page_all(es, page):
+    keys, marker = [], ""
+    for _ in range(50):
+        res = listing.list_objects(es, "mcb", prefix="docs/", marker=marker,
+                                   max_keys=page)
+        keys += [o.name for o in res.objects]
+        if not res.is_truncated:
+            return keys
+        marker = res.next_marker
+    raise AssertionError("did not terminate")
+
+
+def test_pagination_uses_cache_not_rewalk(es, monkeypatch):
+    walks = {"n": 0}
+    orig = XLStorage.walk_dir
+
+    def counting(self, bucket, base):
+        walks["n"] += 1
+        return orig(self, bucket, base)
+
+    monkeypatch.setattr(XLStorage, "walk_dir", counting)
+    keys = _page_all(es, page=4)
+    assert keys == [f"docs/k{i:03d}" for i in range(25)]
+    # page 1 walks all 4 drives; the FIRST continuation builds the cache
+    # with one more full walk; the remaining ~5 pages walk nothing
+    assert walks["n"] <= 8, walks["n"]
+    # cache persisted as an object for cluster peers
+    found = [
+        k for k in es.disks[0].walk_dir(".minio.sys", "buckets/mcb")
+        if ".metacache/" in k
+    ]
+    assert found
+
+
+def test_cache_expires_and_sees_new_objects(es, monkeypatch):
+    _page_all(es, page=4)  # builds cache
+    es.put_object("mcb", "docs/k999", b"new")
+    # fresh cache window: paging may serve the stale stream (allowed);
+    # zero TTL disables the cache and the new key appears immediately
+    monkeypatch.setenv("MINIO_TPU_METACACHE_TTL", "0")
+    keys = _page_all(es, page=4)
+    assert "docs/k999" in keys
+
+
+def test_unpaginated_listing_never_builds_cache(es):
+    listing._MC_MEM.clear()
+    res = listing.list_objects(es, "mcb", prefix="docs/", max_keys=1000)
+    assert len(res.objects) == 25
+    assert not listing._MC_MEM
+
+
+def test_too_big_verdict_memoized(es, monkeypatch):
+    monkeypatch.setenv("MINIO_TPU_METACACHE_MAX_KEYS", "5")
+    listing._MC_MEM.clear()
+    keys = _page_all(es, page=4)
+    assert len(keys) == 25
+    # the negative verdict is cached (no repeated double walks)
+    assert any(v[1] is None for v in listing._MC_MEM.values())
+
+
+def test_two_stores_never_share_cache(tmp_path, monkeypatch):
+    monkeypatch.setenv("MINIO_TPU_METACACHE_TTL", "30")
+    listing._MC_MEM.clear()
+    a = ErasureSet([XLStorage(str(tmp_path / f"a{i}")) for i in range(4)])
+    b = ErasureSet([XLStorage(str(tmp_path / f"b{i}")) for i in range(4)])
+    for s, tag in ((a, "A"), (b, "B")):
+        s.make_bucket("same")
+        for i in range(10):
+            s.put_object("same", f"p/{tag}{i}", b"x")
+    def page(s):
+        keys, marker = [], ""
+        while True:
+            r = listing.list_objects(s, "same", prefix="p/", marker=marker, max_keys=3)
+            keys += [o.name for o in r.objects]
+            if not r.is_truncated:
+                return keys
+            marker = r.next_marker
+    ka, kb = page(a), page(b)
+    assert all(k.startswith("p/A") for k in ka) and len(ka) == 10
+    assert all(k.startswith("p/B") for k in kb) and len(kb) == 10
