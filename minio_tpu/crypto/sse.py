@@ -33,6 +33,7 @@ META_IV = "x-minio-internal-sse-iv"
 META_ACTUAL_SIZE = "x-minio-internal-actual-size"
 META_SSEC_KEY_MD5 = "x-minio-internal-ssec-key-md5"
 META_KMS_KEY_ID = "x-minio-internal-kms-key-id"
+META_PART_SIZES = "x-minio-internal-sse-part-sizes"  # [[part#, plain_size]..]
 
 
 class CryptoError(Exception):
@@ -179,6 +180,28 @@ def _packet_nonce(base_iv: bytes, index: int) -> bytes:
     for i in range(4):
         out[NONCE_SIZE - 4 + i] ^= idx[i]
     return bytes(out)
+
+
+def encrypt_packets_iter(chunks, key: bytes, base_iv: bytes, plain_count: list):
+    """Incrementally seal a chunk iterator into the packet stream; appends
+    the total plaintext size into plain_count[0] when exhausted (streamed
+    SSE parts must never buffer the whole part)."""
+    aes = AESGCM(key)
+    buf = bytearray()
+    idx = 0
+    total = 0
+    for ch in chunks:
+        total += len(ch)
+        buf += ch
+        while len(buf) >= PACKET_SIZE:
+            nonce = _packet_nonce(base_iv, idx)
+            yield nonce + aes.encrypt(nonce, bytes(buf[:PACKET_SIZE]), None)
+            del buf[:PACKET_SIZE]
+            idx += 1
+    if buf:
+        nonce = _packet_nonce(base_iv, idx)
+        yield nonce + aes.encrypt(nonce, bytes(buf), None)
+    plain_count[0] = total
 
 
 def encrypt_stream(data: bytes, key: bytes, base_iv: bytes) -> bytes:

@@ -16,6 +16,7 @@ import json
 import os
 import threading
 import time
+import weakref
 from typing import Iterator
 
 from .quorum import ObjectNotFound, QuorumError, VersionNotFound
@@ -67,9 +68,11 @@ def _merged_keys(es, bucket: str, prefix: str) -> Iterator[str]:
 # (/root/reference/cmd/metacache-set.go:319, metacache-server-pool.go:60).
 
 _MC_LOCK = threading.Lock()
-# (store-id, bucket, prefix) -> (created, keys | None); keys=None is the
-# memoized "too big to cache" verdict so huge prefixes don't double-walk
-_MC_MEM: dict[tuple[int, str, str], tuple[float, list[str] | None]] = {}
+# (store-id, bucket, prefix) -> (created, keys | None, store-weakref);
+# keys=None is the memoized "too big to cache" verdict so huge prefixes
+# don't double-walk. The weakref guards against CPython id() reuse after
+# a store is garbage-collected.
+_MC_MEM: dict[tuple[int, str, str], tuple[float, list[str] | None, object]] = {}
 _MC_MAX_ENTRIES = 256
 
 
@@ -90,7 +93,7 @@ def invalidate_bucket(bucket: str) -> None:
 
 def _mc_evict(now: float, ttl: float) -> None:
     """Caller holds _MC_LOCK: drop expired entries + cap total count."""
-    for ck in [k for k, (at, _) in _MC_MEM.items() if now - at >= ttl]:
+    for ck in [k for k, entry in _MC_MEM.items() if now - entry[0] >= ttl]:
         del _MC_MEM[ck]
     while len(_MC_MEM) > _MC_MAX_ENTRIES:
         _MC_MEM.pop(next(iter(_MC_MEM)))
@@ -109,7 +112,7 @@ def _metacache_keys(es, bucket: str, prefix: str) -> list[str] | None:
     with _MC_LOCK:
         _mc_evict(now, ttl)
         hit = _MC_MEM.get(ck)
-    if hit and now - hit[0] < ttl:
+    if hit and now - hit[0] < ttl and hit[2]() is es:
         return hit[1]
     obj_key = (
         f"buckets/{bucket}/.metacache/"
@@ -122,7 +125,7 @@ def _metacache_keys(es, bucket: str, prefix: str) -> list[str] | None:
         if now - float(doc.get("created", 0)) < ttl:
             keys = list(doc.get("keys", []))
             with _MC_LOCK:
-                _MC_MEM[ck] = (float(doc["created"]), keys)
+                _MC_MEM[ck] = (float(doc["created"]), keys, weakref.ref(es))
             return keys
         # expired persisted cache: reclaim the space opportunistically
         try:
@@ -139,7 +142,7 @@ def _metacache_keys(es, bucket: str, prefix: str) -> list[str] | None:
             keys = None  # memoize the verdict: pages stream the walk
             break
     with _MC_LOCK:
-        _MC_MEM[ck] = (now, keys)
+        _MC_MEM[ck] = (now, keys, weakref.ref(es))
     if keys is not None:
         try:
             es.put_object(

@@ -47,8 +47,9 @@ class PartRecord:
 
 
 class MultipartManager:
-    def __init__(self, es: ErasureSet):
+    def __init__(self, es: ErasureSet, part_transform=None):
         self.es = es
+        self.part_transform = part_transform
 
     def _upload_key(self, bucket: str, obj: str, upload_id: str) -> str:
         return f"{bucket}/{obj}/uploads/{upload_id}"
@@ -100,14 +101,34 @@ class MultipartManager:
         up = self._upload_meta(bucket, obj, upload_id)
         dist = [int(x) for x in up.user_defined["__distribution"].split(",")]
         parity = int(up.user_defined.get("__parity", self.es.default_parity))
+        part_meta: dict[str, str] | None = None
+        plain_after = None  # streamed transforms know the size only at EOF
+        if self.part_transform is not None:
+            transformed = self.part_transform(
+                bucket, obj, up.user_defined, part_number, data
+            )
+            if transformed is not None:
+                data, plain = transformed
+                if callable(plain):
+                    plain_after = plain
+                else:
+                    part_meta = {"__plain_size": str(plain)}
+        pkey = self._part_key(bucket, obj, upload_id, part_number)
         oi = self.es.put_object(
             MP_VOLUME,
-            self._part_key(bucket, obj, upload_id, part_number),
+            pkey,
             data,  # bytes or a chunk iterator (streamed parts)
+            user_defined=part_meta,
             parity=parity,
             distribution=dist,
             allow_inline=False,
         )
+        if plain_after is not None:
+            size = str(plain_after())
+            self.es.update_object_metadata(
+                MP_VOLUME, pkey, "",
+                lambda md: md.__setitem__("__plain_size", size),
+            )
         return oi.etag
 
     def list_parts(
@@ -205,6 +226,19 @@ class MultipartManager:
             k: v for k, v in up.user_defined.items() if not k.startswith("__")
         }
         fi.metadata["etag"] = final_etag
+        from ..crypto import sse as ssemod
+
+        if ssemod.META_ALGO in fi.metadata:
+            # per-part plaintext sizes: the decode path maps ranges to the
+            # overlapping parts' packet streams
+            import json as _json
+
+            sizes = [
+                [n, int(pfi.metadata.get("__plain_size", pfi.size))]
+                for (n, _), pfi in zip(parts, part_fis)
+            ]
+            fi.metadata[ssemod.META_PART_SIZES] = _json.dumps(sizes)
+            fi.metadata[ssemod.META_ACTUAL_SIZE] = str(sum(s for _, s in sizes))
         fi.erasure = part_fis[0].erasure
         fi.erasure.distribution = dist
         fi.erasure.parity_blocks = parity
@@ -276,8 +310,11 @@ class MultipartRouter:
     the pool that started it (the reference tracks this server-side).
     """
 
-    def __init__(self, store):
+    def __init__(self, store, part_transform=None):
         self.store = store  # ServerPools or anything with .pools/.get_hashed_set
+        # optional hook(bucket, obj, upload_meta, part#, data) ->
+        # (stored_bytes, plain_size) | None — the server wires SSE here
+        self.part_transform = part_transform
 
     def _pools(self):
         return getattr(self.store, "pools", [self.store])
@@ -289,7 +326,7 @@ class MultipartRouter:
         pool = pools[pool_idx]
         # plain ErasureSet stores have no set routing
         es = pool.get_hashed_set(obj) if hasattr(pool, "get_hashed_set") else pool
-        return MultipartManager(es)
+        return MultipartManager(es, part_transform=self.part_transform)
 
     @staticmethod
     def _split(upload_id: str) -> tuple[int, str]:

@@ -277,7 +277,7 @@ class S3Server:
         from ..iam.sys import IAMSys
 
         self.buckets = BucketMetadataSys(store)
-        self.mp = MultipartRouter(store)
+        self.mp = MultipartRouter(store, part_transform=self._mp_part_transform)
         self.iam = IAMSys(store, self.root_user, self.root_pass)
         # a real load error must abort boot: running with silently-empty IAM
         # would wipe stored identities on the next persist (first boot is
@@ -345,6 +345,27 @@ class S3Server:
             self.background.start()
 
     # -- plumbing ------------------------------------------------------------
+
+    def _mp_part_transform(self, bucket, obj, up_meta, part_number, data):
+        """SSE hook for multipart parts: encrypt each part as its own
+        packet stream under the upload's OEK. None = no transform.
+        Returns (stored, plain_size | size_getter): streamed parts encrypt
+        packet-by-packet and report their plaintext size after the fact."""
+        from ..crypto import sse as ssemod
+        from . import transforms
+
+        if ssemod.META_ALGO not in up_meta:
+            return None
+        if isinstance(data, (bytes, bytearray)):
+            enc = transforms.encrypt_part(
+                bytes(data), up_meta, part_number, self.kms, bucket, obj
+            )
+            return enc, len(data)
+        count = [0]
+        gen = transforms.encrypt_part_iter(
+            data, up_meta, part_number, self.kms, bucket, obj, count
+        )
+        return gen, (lambda: count[0])
 
     def _queue_repl(self, request, bucket, key, version_id, op) -> None:
         """Queue a bucket-replication task unless this write IS a replica
@@ -1807,16 +1828,10 @@ class S3Server:
     # -- multipart -------------------------------------------------------------
 
     async def new_multipart(self, request, bucket, key) -> web.Response:
-        # encryption for multipart needs per-part packet sequencing that the
-        # transform pipeline doesn't implement yet — refuse loudly rather
-        # than silently storing plaintext against the bucket's policy
+        from ..crypto.sse import CryptoError
+        from . import transforms
+
         bm = self.buckets.get(bucket)
-        if (
-            request.headers.get("x-amz-server-side-encryption")
-            or request.headers.get("x-amz-server-side-encryption-customer-algorithm")
-            or _bucket_sse_algo(bm.encryption)
-        ):
-            raise s3err.NotImplemented_
         key = listing.encode_dir_object(key)
         user_defined = {}
         if request.headers.get("Content-Type"):
@@ -1824,6 +1839,20 @@ class S3Server:
         for k, v in request.headers.items():
             if k.lower().startswith("x-amz-meta-"):
                 user_defined[k.lower()] = v
+        sse_resp: dict[str, str] = {}
+        try:
+            req_headers = {k.lower(): v for k, v in request.headers.items()}
+            sse = transforms.multipart_sse_init(
+                req_headers, _bucket_sse_algo(bm.encryption), self.kms,
+                bucket, key,
+            )
+        except CryptoError:
+            # SSE-C multipart needs the customer key on every part read —
+            # refuse loudly rather than silently storing plaintext
+            raise s3err.NotImplemented_ from None
+        if sse is not None:
+            sse_meta, sse_resp = sse
+            user_defined.update(sse_meta)
         upload_id = await self._run(
             self.mp.new_upload, bucket, key, user_defined,
             self._parity_for_storage_class(request)
@@ -1834,7 +1863,9 @@ class S3Server:
             f"<Bucket>{escape(bucket)}</Bucket><Key>{escape(key)}</Key>"
             f"<UploadId>{upload_id}</UploadId></InitiateMultipartUploadResult>"
         )
-        return web.Response(body=xml.encode(), content_type="application/xml")
+        return web.Response(
+            body=xml.encode(), content_type="application/xml", headers=sse_resp
+        )
 
     async def put_object_part(self, request, bucket, key, body) -> web.Response:
         from ..erasure import multipart as mp_mod
@@ -1882,8 +1913,14 @@ class S3Server:
         oi, handle = await self._run(
             self.store.open_object, src_bucket, src_key, src_vid
         )
+        from . import transforms
+
         try:
-            offset, length = 0, oi.size
+            # transformed (SSE/compressed) sources must decode to logical
+            # bytes: ranges apply to plaintext, and the destination part
+            # re-transforms for its own upload
+            logical = transforms.logical_size(oi.user_defined, oi.size)
+            offset, length = 0, logical
             crange = request.headers.get("x-amz-copy-source-range", "")
             if crange.startswith("bytes="):
                 try:
@@ -1892,9 +1929,23 @@ class S3Server:
                     length = int(b) - offset + 1
                 except ValueError:
                     raise s3err.InvalidArgument from None
-                if offset < 0 or length <= 0 or offset + length > oi.size:
+                if offset < 0 or length <= 0 or offset + length > logical:
                     raise s3err.InvalidRange
-            data = await self._run(lambda: b"".join(handle.read(offset, length)))
+            if transforms.is_transformed(oi.user_defined):
+                req_headers = {k.lower(): v for k, v in request.headers.items()}
+
+                def read_fn(off, ln):
+                    return b"".join(handle.read(off, ln))
+
+                data = await self._run(
+                    transforms.decode_range, read_fn, oi.size,
+                    oi.user_defined, req_headers, src_bucket, src_key,
+                    self.kms, offset, length,
+                )
+            else:
+                data = await self._run(
+                    lambda: b"".join(handle.read(offset, length))
+                )
         finally:
             handle.close()
         try:

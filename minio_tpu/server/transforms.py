@@ -127,19 +127,9 @@ def encode_for_store(
                 ssemod.META_SSEC_KEY_MD5
             ]
         else:
-            oek, sealed = kms.generate_key(context)
-            if sse_algo == "aws:kms":
-                meta[ssemod.META_ALGO] = "SSE-KMS"
-                meta[ssemod.META_KMS_KEY_ID] = headers.get(
-                    "x-amz-server-side-encryption-aws-kms-key-id", kms.key_id
-                )
-                resp["x-amz-server-side-encryption"] = "aws:kms"
-                resp["x-amz-server-side-encryption-aws-kms-key-id"] = meta[
-                    ssemod.META_KMS_KEY_ID
-                ]
-            else:
-                meta[ssemod.META_ALGO] = "SSE-S3"
-                resp["x-amz-server-side-encryption"] = "AES256"
+            oek, sealed, m2, r2 = _sse_s3_kms_setup(sse_algo, headers, kms, context)
+            meta.update(m2)
+            resp.update(r2)
         meta.setdefault(ssemod.META_ACTUAL_SIZE, str(len(body)))
         meta[ssemod.META_SEALED_KEY] = sealed.hex()
         meta[ssemod.META_IV] = base_iv.hex()
@@ -147,8 +137,137 @@ def encode_for_store(
     return TransformResult(data, meta, resp)
 
 
+META_PART_SIZES = ssemod.META_PART_SIZES
+
+
 def is_transformed(user_defined: dict) -> bool:
     return ssemod.META_ALGO in user_defined or META_COMPRESSION in user_defined
+
+
+def part_iv(base_iv: bytes, part_number: int) -> bytes:
+    """Per-part base IV: parts encrypt as independent packet streams under
+    one OEK, so each needs a distinct IV (nonce reuse across parts would
+    be catastrophic) bound to its part number (no part swapping)."""
+    import hashlib as _hashlib
+
+    return _hashlib.sha256(
+        base_iv + part_number.to_bytes(4, "big")
+    ).digest()[: ssemod.NONCE_SIZE]
+
+
+def _sse_s3_kms_setup(
+    sse_algo: str, headers, kms: ssemod.KMS, context: str
+) -> tuple[bytes, bytes, dict, dict]:
+    """Shared SSE-S3/SSE-KMS key generation + metadata/response headers —
+    single source of truth for single PUTs and multipart initiation."""
+    oek, sealed = kms.generate_key(context)
+    meta: dict[str, str] = {}
+    resp: dict[str, str] = {}
+    if sse_algo == "aws:kms":
+        meta[ssemod.META_ALGO] = "SSE-KMS"
+        meta[ssemod.META_KMS_KEY_ID] = headers.get(
+            "x-amz-server-side-encryption-aws-kms-key-id", kms.key_id
+        )
+        resp["x-amz-server-side-encryption"] = "aws:kms"
+        resp["x-amz-server-side-encryption-aws-kms-key-id"] = meta[
+            ssemod.META_KMS_KEY_ID
+        ]
+    else:
+        meta[ssemod.META_ALGO] = "SSE-S3"
+        resp["x-amz-server-side-encryption"] = "AES256"
+    return oek, sealed, meta, resp
+
+
+def multipart_sse_init(
+    headers, bucket_encryption_algo: str | None, kms: ssemod.KMS,
+    bucket: str, key: str,
+) -> tuple[dict, dict] | None:
+    """SSE setup at CreateMultipartUpload (reference encrypts multipart
+    per part under one object key, cmd/encryption-v1.go + multipart
+    handlers). Returns (upload metadata, response headers) or None when
+    no encryption applies. SSE-C multipart stays unsupported."""
+    if ssemod.parse_ssec_headers(headers):
+        raise ssemod.CryptoError("SSE-C multipart is not supported")
+    sse_algo = headers.get("x-amz-server-side-encryption", "")
+    if not sse_algo and bucket_encryption_algo:
+        sse_algo = bucket_encryption_algo
+    if not sse_algo:
+        return None
+    import secrets as _secrets
+
+    base_iv = _secrets.token_bytes(ssemod.NONCE_SIZE)
+    oek, sealed, meta, resp = _sse_s3_kms_setup(
+        sse_algo, headers, kms, f"{bucket}/{key}"
+    )
+    del oek  # re-unsealed per part
+    meta[ssemod.META_SEALED_KEY] = sealed.hex()
+    meta[ssemod.META_IV] = base_iv.hex()
+    return meta, resp
+
+
+def encrypt_part(
+    data: bytes, upload_meta: dict, part_number: int, kms: ssemod.KMS,
+    bucket: str, key: str,
+) -> bytes:
+    oek = _unseal_oek(upload_meta, {}, bucket, key, kms)
+    base_iv = bytes.fromhex(upload_meta[ssemod.META_IV])
+    return ssemod.encrypt_stream(data, oek, part_iv(base_iv, part_number))
+
+
+def encrypt_part_iter(
+    chunks, upload_meta: dict, part_number: int, kms: ssemod.KMS,
+    bucket: str, key: str, plain_count: list,
+):
+    """Streaming variant: yields sealed packets; plain_count[0] gets the
+    plaintext size when the source is exhausted (5 GiB parts must not
+    buffer in RAM)."""
+    oek = _unseal_oek(upload_meta, {}, bucket, key, kms)
+    base_iv = bytes.fromhex(upload_meta[ssemod.META_IV])
+    return ssemod.encrypt_packets_iter(
+        chunks, oek, part_iv(base_iv, part_number), plain_count
+    )
+
+
+def _part_layout(user_defined: dict) -> list[tuple[int, int, int, int]]:
+    """[(part#, plain_size, plain_off, stored_off)] per completed part."""
+    import json as _json
+
+    entries = _json.loads(user_defined[META_PART_SIZES])
+    out = []
+    plain_off = stored_off = 0
+    for num, psize in entries:
+        out.append((int(num), int(psize), plain_off, stored_off))
+        plain_off += int(psize)
+        stored_off += ssemod.stored_size(int(psize))
+    return out
+
+
+def decode_range_multipart(
+    read_fn, user_defined: dict, headers, bucket: str, key: str,
+    kms: ssemod.KMS, start: int, length: int,
+) -> bytes:
+    """Ranged decrypt of an SSE multipart object: each part is its own
+    packet stream; a range maps to the overlapping parts' packet runs."""
+    oek = _unseal_oek(user_defined, headers, bucket, key, kms)
+    base_iv = bytes.fromhex(user_defined[ssemod.META_IV])
+    out = bytearray()
+    end = start + length
+    for num, psize, plain_off, stored_off in _part_layout(user_defined):
+        if plain_off + psize <= start:
+            continue
+        if plain_off >= end:
+            break
+        lo = max(start - plain_off, 0)
+        hi = min(end - plain_off, psize)
+        s_off, s_len, skip = ssemod.stored_range(lo, hi - lo)
+        s_len = min(s_len, ssemod.stored_size(psize) - s_off)
+        stored = read_fn(stored_off + s_off, s_len)
+        plain = ssemod.decrypt_packets(
+            stored, oek, part_iv(base_iv, num),
+            s_off // ssemod.STORED_PACKET,
+        )
+        out += plain[skip : skip + (hi - lo)]
+    return bytes(out)
 
 
 def logical_size(user_defined: dict, stored: int) -> int:
@@ -185,6 +304,16 @@ def decode_full(
 ) -> bytes:
     """Invert the full pipeline (decrypt then decompress)."""
     data = stored
+    if META_PART_SIZES in user_defined:
+        layout = _part_layout(user_defined)
+        total = sum(p[1] for p in layout)
+
+        def rf(off, ln):
+            return stored[off : off + ln]
+
+        return decode_range_multipart(
+            rf, user_defined, headers, bucket, key, kms, 0, total
+        )
     if ssemod.META_ALGO in user_defined:
         oek = _unseal_oek(user_defined, headers, bucket, key, kms)
         base_iv = bytes.fromhex(user_defined[ssemod.META_IV])
@@ -212,6 +341,10 @@ def decode_range(
     if user_defined.get(META_COMPRESSION) == "zlib/v1":
         full = decode_full(read_fn(0, stored_size), user_defined, headers, bucket, key, kms)
         return full[start : start + length]
+    if META_PART_SIZES in user_defined:
+        return decode_range_multipart(
+            read_fn, user_defined, headers, bucket, key, kms, start, length
+        )
     if ssemod.META_ALGO in user_defined:
         oek = _unseal_oek(user_defined, headers, bucket, key, kms)
         base_iv = bytes.fromhex(user_defined[ssemod.META_IV])

@@ -157,9 +157,59 @@ def test_copy_of_encrypted_object_readable(cli):
     assert g.status == 200 and g.body == body
 
 
-def test_multipart_sse_refused(cli):
+def test_multipart_sse_roundtrip(server, cli):
+    """SSE-S3 multipart: parts encrypt as independent packet streams
+    under one OEK (reference cmd/encryption-v1.go multipart path)."""
     r = cli.request("POST", "/secure/mp-enc", query={"uploads": ""},
                     headers={"x-amz-server-side-encryption": "AES256"})
+    assert r.status == 200, r.body
+    assert r.headers.get("x-amz-server-side-encryption") == "AES256"
+    upload_id = r.body.decode().split("<UploadId>")[1].split("<")[0]
+    p1 = os.urandom(200 * 1024)
+    p2 = os.urandom(131 * 1024 + 17)
+    etags = []
+    for i, p in enumerate((p1, p2), 1):
+        r = cli.request("PUT", "/secure/mp-enc",
+                        query={"partNumber": str(i), "uploadId": upload_id},
+                        body=p)
+        assert r.status == 200, r.body
+        etags.append(r.headers["etag"].strip('"'))
+    xml = "<CompleteMultipartUpload>" + "".join(
+        f"<Part><PartNumber>{i}</PartNumber><ETag>{e}</ETag></Part>"
+        for i, e in enumerate(etags, 1)) + "</CompleteMultipartUpload>"
+    r = cli.request("POST", "/secure/mp-enc", query={"uploadId": upload_id},
+                    body=xml.encode())
+    assert r.status == 200, r.body
+    body = p1 + p2
+    g = cli.get_object("secure", "mp-enc")
+    assert g.status == 200 and g.body == body
+    assert g.headers.get("x-amz-server-side-encryption") == "AES256"
+    # logical size reported, not ciphertext size
+    h = cli.head_object("secure", "mp-enc")
+    assert int(h.headers["content-length"]) == len(body)
+    # ranges crossing the part boundary
+    for off, ln in [(0, 10), (200 * 1024 - 5, 20), (len(body) - 9, 9),
+                    (65536 - 3, 131072)]:
+        r = cli.get_object("secure", "mp-enc",
+                           headers={"Range": f"bytes={off}-{off + ln - 1}"})
+        assert r.status == 206 and r.body == body[off:off + ln], (off, ln)
+    # ciphertext at rest
+    probe = body[1000:1032]
+    for part in glob.glob(f"{server.base}/d*/secure/mp-enc/*/part.*"):
+        assert probe not in open(part, "rb").read()
+
+
+def test_multipart_ssec_still_refused(cli):
+    import base64 as _b64
+    import hashlib as _hashlib
+
+    key = os.urandom(32)
+    r = cli.request("POST", "/secure/mp-ssec", query={"uploads": ""}, headers={
+        "x-amz-server-side-encryption-customer-algorithm": "AES256",
+        "x-amz-server-side-encryption-customer-key": _b64.b64encode(key).decode(),
+        "x-amz-server-side-encryption-customer-key-md5": _b64.b64encode(
+            _hashlib.md5(key).digest()).decode(),
+    })
     assert r.status == 501
 
 
