@@ -35,6 +35,14 @@ BUCKETS_META_DIR = f"{SYS_DIR}/buckets"
 META_FILE = "xl.meta"
 
 _FSYNC = os.environ.get("MINIO_TPU_FSYNC", "0") == "1"
+# O_DIRECT for large shard writes (reference cmd/xl-storage.go:316);
+# off by default: tmpfs/test dirs refuse it and benchmarks on page-cached
+# local disks are faster without it — enable for production spinning/NVMe
+_ODIRECT = (
+    os.environ.get("MINIO_TPU_ODIRECT", "off") in ("on", "true", "1")
+    and hasattr(os, "O_DIRECT")
+)
+_ODIRECT_MIN = 1 << 20  # small files stay buffered
 
 
 def _clean_rel(path: str) -> str:
@@ -272,6 +280,13 @@ class XLStorage(StorageAPI):
     def create_file(self, volume: str, path: str, data: bytes | BinaryIO) -> None:
         full = self._file_path(volume, path)
         os.makedirs(os.path.dirname(full), exist_ok=True)
+        if (
+            _ODIRECT
+            and isinstance(data, (bytes, bytearray, memoryview))
+            and len(data) >= _ODIRECT_MIN
+        ):
+            if self._create_file_direct(full, data):
+                return
         with open(full, "wb") as f:
             if isinstance(data, (bytes, bytearray, memoryview)):
                 f.write(data)
@@ -280,6 +295,61 @@ class XLStorage(StorageAPI):
             if _FSYNC:
                 f.flush()
                 os.fsync(f.fileno())
+
+    @staticmethod
+    def _create_file_direct(full: str, data: bytes) -> bool:
+        """O_DIRECT shard write: the aligned body bypasses the page cache
+        (large sequential shard files would otherwise evict hot data —
+        the reference's odirectWriter, cmd/xl-storage.go:316,452-489);
+        the unaligned tail lands through a normal buffered append. Returns
+        False when the filesystem refuses O_DIRECT (tmpfs etc.) so the
+        caller falls back to buffered IO."""
+        align = 4096
+        view = memoryview(data)
+        body = len(data) // align * align
+        try:
+            fd = os.open(
+                full, os.O_WRONLY | os.O_CREAT | os.O_TRUNC | os.O_DIRECT,
+                0o644,
+            )
+        except OSError:
+            return False  # filesystem without O_DIRECT support
+        try:
+            if body:
+                import mmap
+
+                # fixed-size page-aligned bounce buffer, reused per chunk:
+                # a body-sized buffer (+ slice copies) would triple memory
+                # for GiB-scale shards
+                chunk = min(body, 4 << 20)
+                buf = mmap.mmap(-1, chunk)
+                try:
+                    off = 0
+                    while off < body:
+                        n = min(chunk, body - off)
+                        buf[:n] = view[off : off + n]
+                        w = 0
+                        while w < n:
+                            w += os.write(fd, memoryview(buf)[w:n])
+                        off += n
+                finally:
+                    buf.close()
+        except OSError:
+            os.close(fd)
+            return False
+        else:
+            os.close(fd)
+        if body < len(data):
+            with open(full, "r+b") as f:
+                f.seek(body)
+                f.write(view[body:])
+        if _FSYNC:
+            fd2 = os.open(full, os.O_RDONLY)
+            try:
+                os.fsync(fd2)
+            finally:
+                os.close(fd2)
+        return True
 
     def append_file(self, volume: str, path: str, data: bytes) -> None:
         full = self._file_path(volume, path)
