@@ -38,7 +38,16 @@ class TpuDispatcher:
             if window_s is None
             else window_s
         )
-        self.max_blocks = max(1, max_shards // (codec.data_shards + codec.parity_shards))
+        # clamp to a power of two so _bucket padding can never overshoot
+        # the HBM shard cap _collect enforces
+        mb = max(1, max_shards // (codec.data_shards + codec.parity_shards))
+        p2 = 1
+        while p2 * 2 <= mb:
+            p2 *= 2
+        self.max_blocks = p2
+        self._fused_enabled = (
+            os.environ.get("MINIO_TPU_FUSED_CM", "1") != "0"
+        )
         self._encode_and_hash = encode_and_hash
         self._q: queue.Queue = queue.Queue()
         self._carry: tuple | None = None
@@ -94,6 +103,33 @@ class TpuDispatcher:
             b <<= 1
         return b
 
+    def _fused_cm(self, all_blocks: np.ndarray):
+        """Chunk-major mega-kernel dispatch when shapes allow (ops/
+        fused_pallas.py): one kernel, data read from HBM once. Returns
+        None to fall back to the row-major XLA path (non-TPU backend,
+        unsupported shape, MINIO_TPU_FUSED_CM=0, or a kernel failure —
+        the fallback must be real, not just a shape gate)."""
+        if not self._fused_enabled:
+            return None
+        from ..ops import fused_pallas as fp
+
+        b, d, n = all_blocks.shape
+        p = self.codec.parity_shards
+        if not fp.supports(d, p, b, n):
+            return None
+        try:
+            parity_cm, digests = fp.fused_encode_hash_cm(
+                fp.pack_chunk_major(all_blocks), d, p
+            )
+            return (
+                fp.unpack_chunk_major(np.asarray(parity_cm)),
+                np.asarray(digests),
+            )
+        except Exception:  # noqa: BLE001 — lowering/device failure: XLA path
+            self._fused_enabled = False  # don't retry a broken kernel per batch
+            self.stats["fused_disabled"] = True
+            return None
+
     def _loop(self) -> None:
         while True:
             batch = self._collect()
@@ -106,7 +142,10 @@ class TpuDispatcher:
                         (bucket - k, *all_blocks.shape[1:]), dtype=np.uint8
                     )
                     all_blocks = np.concatenate([all_blocks, pad], axis=0)
-                parity, digests = self._encode_and_hash(self.codec, all_blocks)
+                fused = self._fused_cm(all_blocks)
+                if fused is None:
+                    fused = self._encode_and_hash(self.codec, all_blocks)
+                parity, digests = fused
                 parity = np.asarray(parity)[:k]
                 digests = np.asarray(digests)[:k]
                 shards = np.concatenate(
