@@ -57,16 +57,35 @@ class Server:
         self.proc.wait()
 
 
-def best_of(n, fn):
-    best = float("inf")
+def _settle():
+    """Flush dirty pages so writeback from a previous phase doesn't steal
+    the single core from the phase being timed."""
+    subprocess.run(["sync"], check=False)
+    time.sleep(1.0)
+
+
+def median_of(n, fn):
+    """(median, spread) over n timed runs — VERDICT r2: best-of-N
+    overstates; report median with the min..max spread."""
+    times = []
     for _ in range(n):
         t0 = time.perf_counter()
         fn()
-        best = min(best, time.perf_counter() - t0)
-    return best
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    return times[len(times) // 2], (times[0], times[-1])
 
 
-def bench_put_get(c, bucket, size, label, rows, repeats=3):
+def best_of(n, fn):
+    return median_of(n, fn)[0]
+
+
+def _fmt(size, t, spread):
+    lo, hi = spread
+    return f"{size / MIB / t:.0f} MiB/s (spread {size / MIB / hi:.0f}-{size / MIB / lo:.0f})"
+
+
+def bench_put_get(c, bucket, size, label, rows, repeats=5):
     body = np.random.default_rng(1).integers(0, 256, size=size, dtype=np.uint8).tobytes()
 
     def put():
@@ -77,10 +96,12 @@ def bench_put_get(c, bucket, size, label, rows, repeats=3):
         g = c.get_object(bucket, "bench-obj")
         assert g.status == 200 and len(g.body) == size
 
-    tp = best_of(repeats, put)
-    tg = best_of(repeats, get)
-    rows.append((f"{label} PUT", f"{size / MIB / tp:.0f} MiB/s"))
-    rows.append((f"{label} GET", f"{size / MIB / tg:.0f} MiB/s"))
+    _settle()
+    tp, sp = median_of(repeats, put)
+    _settle()
+    tg, sg = median_of(repeats, get)
+    rows.append((f"{label} PUT", _fmt(size, tp, sp)))
+    rows.append((f"{label} GET", _fmt(size, tg, sg)))
 
 
 def main():

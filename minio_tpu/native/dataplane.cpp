@@ -1,0 +1,626 @@
+// Native streaming data plane: the PUT/GET hot path as single GIL-releasing
+// passes (reference: cmd/erasure-encode.go:76-108 + cmd/bitrot-streaming.go:
+// 108-133 compose the same pipeline from Go goroutines; here it is one
+// C++ pass per stripe block, called via ctypes which drops the GIL).
+//
+// PUT:  raw stream -> md5 (etag) -> stripe split -> GF(2^8) parity (GFNI)
+//       -> HighwayHash-256 per shard -> digest||block framing -> writev
+// GET:  preadv shard frames -> HighwayHash verify -> window copy to output
+//
+// Python keeps control flow only: staged-file creation, quorum judgment,
+// rename/commit, metadata. Per-drive write failures mark the shard dead and
+// the pass continues (the reference's multiWriter tolerates failures down to
+// write quorum, cmd/erasure-encode.go:59-65); Python reads the dead mask and
+// applies quorum rules.
+//
+// Core scaling: every stripe block is independent (parity+hash+write), so
+// the pass parallelizes by handing blocks round-robin to a small thread
+// pool; md5 is inherently serial and stays on the feeding thread. The bench
+// host is single-core, so the pool defaults to inline execution
+// (MINIO_TPU_NATIVE_THREADS to override on real hardware).
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <new>
+#include <thread>
+#include <vector>
+
+#include <dlfcn.h>
+#include <fcntl.h>
+#include <sys/uio.h>
+#include <unistd.h>
+
+// from gfhash.cpp (same shared object)
+extern "C" void gf_apply_strided(const uint8_t* mat, int rows, int cols,
+                                 const uint8_t* in, long in_stride,
+                                 uint8_t* out, long out_stride, long n);
+extern "C" void hh256(const uint8_t* key32, const uint8_t* data, long n,
+                      uint8_t* out32);
+
+// ----------------------------------------------------------------- MD5
+// libcrypto's asm MD5 via dlopen (no headers needed: EVP is all-opaque);
+// portable fallback below implements RFC 1321 directly.
+
+namespace md5impl {
+
+typedef void* (*fn_ctx_new)();
+typedef void (*fn_ctx_free)(void*);
+typedef const void* (*fn_md5)();
+typedef int (*fn_init)(void*, const void*, void*);
+typedef int (*fn_update)(void*, const void*, size_t);
+typedef int (*fn_final)(void*, unsigned char*, unsigned*);
+
+static fn_ctx_new evp_new;
+static fn_ctx_free evp_free;
+static fn_md5 evp_md5;
+static fn_init evp_init;
+static fn_update evp_update;
+static fn_final evp_final;
+static int evp_ready = -1;  // -1 unprobed, 0 no, 1 yes
+
+static bool evp_probe() {
+    if (evp_ready >= 0) return evp_ready == 1;
+    evp_ready = 0;
+    void* h = dlopen("libcrypto.so.3", RTLD_LAZY | RTLD_GLOBAL);
+    if (!h) h = dlopen("libcrypto.so", RTLD_LAZY | RTLD_GLOBAL);
+    if (!h) return false;
+    evp_new = (fn_ctx_new)dlsym(h, "EVP_MD_CTX_new");
+    evp_free = (fn_ctx_free)dlsym(h, "EVP_MD_CTX_free");
+    evp_md5 = (fn_md5)dlsym(h, "EVP_md5");
+    evp_init = (fn_init)dlsym(h, "EVP_DigestInit_ex");
+    evp_update = (fn_update)dlsym(h, "EVP_DigestUpdate");
+    evp_final = (fn_final)dlsym(h, "EVP_DigestFinal_ex");
+    if (evp_new && evp_free && evp_md5 && evp_init && evp_update && evp_final)
+        evp_ready = 1;
+    return evp_ready == 1;
+}
+
+// RFC 1321 fallback
+struct Fallback {
+    uint32_t a, b, c, d;
+    uint64_t len;
+    uint8_t tail[64];
+    int ntail;
+};
+
+static const uint32_t K[64] = {
+    0xd76aa478, 0xe8c7b756, 0x242070db, 0xc1bdceee, 0xf57c0faf, 0x4787c62a,
+    0xa8304613, 0xfd469501, 0x698098d8, 0x8b44f7af, 0xffff5bb1, 0x895cd7be,
+    0x6b901122, 0xfd987193, 0xa679438e, 0x49b40821, 0xf61e2562, 0xc040b340,
+    0x265e5a51, 0xe9b6c7aa, 0xd62f105d, 0x02441453, 0xd8a1e681, 0xe7d3fbc8,
+    0x21e1cde6, 0xc33707d6, 0xf4d50d87, 0x455a14ed, 0xa9e3e905, 0xfcefa3f8,
+    0x676f02d9, 0x8d2a4c8a, 0xfffa3942, 0x8771f681, 0x6d9d6122, 0xfde5380c,
+    0xa4beea44, 0x4bdecfa9, 0xf6bb4b60, 0xbebfbc70, 0x289b7ec6, 0xeaa127fa,
+    0xd4ef3085, 0x04881d05, 0xd9d4d039, 0xe6db99e5, 0x1fa27cf8, 0xc4ac5665,
+    0xf4292244, 0x432aff97, 0xab9423a7, 0xfc93a039, 0x655b59c3, 0x8f0ccc92,
+    0xffeff47d, 0x85845dd1, 0x6fa87e4f, 0xfe2ce6e0, 0xa3014314, 0x4e0811a1,
+    0xf7537e82, 0xbd3af235, 0x2ad7d2bb, 0xeb86d391};
+static const int S[64] = {7, 12, 17, 22, 7, 12, 17, 22, 7, 12, 17, 22,
+                          7, 12, 17, 22, 5, 9,  14, 20, 5, 9,  14, 20,
+                          5, 9,  14, 20, 5, 9,  14, 20, 4, 11, 16, 23,
+                          4, 11, 16, 23, 4, 11, 16, 23, 4, 11, 16, 23,
+                          6, 10, 15, 21, 6, 10, 15, 21, 6, 10, 15, 21,
+                          6, 10, 15, 21};
+
+static void fb_block(Fallback& s, const uint8_t* p) {
+    uint32_t m[16];
+    std::memcpy(m, p, 64);
+    uint32_t a = s.a, b = s.b, c = s.c, d = s.d;
+    for (int i = 0; i < 64; i++) {
+        uint32_t f;
+        int g;
+        if (i < 16) {
+            f = (b & c) | (~b & d);
+            g = i;
+        } else if (i < 32) {
+            f = (d & b) | (~d & c);
+            g = (5 * i + 1) & 15;
+        } else if (i < 48) {
+            f = b ^ c ^ d;
+            g = (3 * i + 5) & 15;
+        } else {
+            f = c ^ (b | ~d);
+            g = (7 * i) & 15;
+        }
+        uint32_t t = d;
+        d = c;
+        c = b;
+        uint32_t x = a + f + K[i] + m[g];
+        b = b + ((x << S[i]) | (x >> (32 - S[i])));
+        a = t;
+    }
+    s.a += a;
+    s.b += b;
+    s.c += c;
+    s.d += d;
+}
+
+static void fb_init(Fallback& s) {
+    s.a = 0x67452301;
+    s.b = 0xefcdab89;
+    s.c = 0x98badcfe;
+    s.d = 0x10325476;
+    s.len = 0;
+    s.ntail = 0;
+}
+
+static void fb_update(Fallback& s, const uint8_t* p, size_t n) {
+    s.len += n;
+    if (s.ntail) {
+        size_t take = 64 - s.ntail;
+        if (take > n) take = n;
+        std::memcpy(s.tail + s.ntail, p, take);
+        s.ntail += (int)take;
+        p += take;
+        n -= take;
+        if (s.ntail == 64) {
+            fb_block(s, s.tail);
+            s.ntail = 0;
+        }
+    }
+    while (n >= 64) {
+        fb_block(s, p);
+        p += 64;
+        n -= 64;
+    }
+    if (n) {
+        std::memcpy(s.tail, p, n);
+        s.ntail = (int)n;
+    }
+}
+
+static void fb_final(Fallback& s, uint8_t* out16) {
+    uint64_t bits = s.len * 8;
+    uint8_t pad[72] = {0x80};
+    size_t padlen = (s.ntail < 56) ? (size_t)(56 - s.ntail) : (size_t)(120 - s.ntail);
+    fb_update(s, pad, padlen);
+    uint8_t lenb[8];
+    std::memcpy(lenb, &bits, 8);
+    s.len -= padlen;  // fb_update bumped it; harmless but keep exact
+    fb_update(s, lenb, 8);
+    std::memcpy(out16, &s.a, 4);
+    std::memcpy(out16 + 4, &s.b, 4);
+    std::memcpy(out16 + 8, &s.c, 4);
+    std::memcpy(out16 + 12, &s.d, 4);
+}
+
+struct MD5 {
+    void* evp = nullptr;
+    Fallback fb;
+
+    void init() {
+        if (evp_probe()) {
+            evp = evp_new();
+            if (evp && evp_init(evp, evp_md5(), nullptr) == 1) return;
+            if (evp) evp_free(evp);
+            evp = nullptr;
+        }
+        fb_init(fb);
+    }
+    void update(const uint8_t* p, size_t n) {
+        if (evp)
+            evp_update(evp, p, n);
+        else
+            fb_update(fb, p, n);
+    }
+    void final_(uint8_t* out16) {
+        if (evp) {
+            unsigned ln = 16;
+            evp_final(evp, out16, &ln);
+            evp_free(evp);
+            evp = nullptr;
+        } else {
+            fb_final(fb, out16);
+        }
+    }
+    void abort_() {
+        if (evp) {
+            evp_free(evp);
+            evp = nullptr;
+        }
+    }
+};
+
+}  // namespace md5impl
+
+extern "C" void dp_md5(const uint8_t* data, long n, uint8_t* out16) {
+    md5impl::MD5 m;
+    m.init();
+    m.update(data, (size_t)n);
+    m.final_(out16);
+}
+
+// ----------------------------------------------------------------- PUT
+
+static const int DIGEST = 32;
+
+// Worker slot for the optional multi-core pipeline: one stripe block's
+// padded input plus per-slot parity/digest scratch.
+struct DpSlot {
+    uint8_t* stripe;   // [d*per_max]
+    uint8_t* parity;   // [p][per_max]
+    uint8_t* digests;  // [t][32]
+    long per, blockno;
+    int state;  // 0 free, 1 filled, 2 stop
+};
+
+struct DpPut {
+    int d, p, t;
+    long block_size, per;
+    uint8_t* parity_mat;  // [p][d]
+    uint8_t key[32];
+    int* fds;                   // [t], -1 = dead
+    std::atomic<uint64_t> dead;  // bitmask by shard index
+    uint8_t* buf;    // [block_size] partial-block carry
+    long buffered;
+    long blockno;  // next stripe block ordinal (determines file offsets)
+    md5impl::MD5 md5;
+    uint64_t total;
+    // multi-core pipeline (MINIO_TPU_NATIVE_THREADS > 1)
+    int nthreads;
+    std::vector<std::thread> workers;
+    std::vector<DpSlot> slots;
+    std::mutex mu;
+    std::condition_variable cv_work, cv_free;
+    bool stopping;
+};
+
+static void dp_mark_dead(DpPut* c, int i) {
+    uint64_t bit = 1ULL << i;
+    if (c->dead.fetch_or(bit) & bit) return;
+    // fd closed at free time (workers may race on close otherwise)
+}
+
+// pwrite the digest||shard frame for stripe block `blockno` of shard i.
+// Offsets are deterministic, so blocks can complete out of order.
+static void dp_write_shard(DpPut* c, int i, long blockno, const uint8_t* digest,
+                           const uint8_t* shard, long n) {
+    if (c->fds[i] < 0 || (c->dead.load() >> i) & 1) return;
+    struct iovec iov[2];
+    iov[0].iov_base = (void*)digest;
+    iov[0].iov_len = DIGEST;
+    iov[1].iov_base = (void*)shard;
+    iov[1].iov_len = (size_t)n;
+    // full blocks all share c->per; only the final tail differs
+    off_t off = (off_t)blockno * (DIGEST + c->per);
+    size_t want = DIGEST + (size_t)n;
+    size_t done = 0;
+    while (done < want) {
+        ssize_t w = pwritev(c->fds[i], iov, 2, off + (off_t)done);
+        if (w < 0) {
+            dp_mark_dead(c, i);
+            return;
+        }
+        done += (size_t)w;
+        if (done >= want) break;
+        size_t adv = (size_t)w;
+        for (int k = 0; k < 2; k++) {
+            if (adv >= iov[k].iov_len) {
+                adv -= iov[k].iov_len;
+                iov[k].iov_len = 0;
+            } else {
+                iov[k].iov_base = (uint8_t*)iov[k].iov_base + adv;
+                iov[k].iov_len -= adv;
+                adv = 0;
+            }
+        }
+    }
+}
+
+// parity + hash + frame-write for one padded stripe held in `stripe`.
+static void dp_process_stripe(DpPut* c, const uint8_t* stripe, long per,
+                              long blockno, uint8_t* parity, uint8_t* digests) {
+    gf_apply_strided(c->parity_mat, c->p, c->d, stripe, per, parity, per, per);
+    for (int i = 0; i < c->d; i++)
+        hh256(c->key, stripe + (long)i * per, per, digests + (long)i * DIGEST);
+    for (int i = 0; i < c->p; i++)
+        hh256(c->key, parity + (long)i * per, per,
+              digests + (long)(c->d + i) * DIGEST);
+    for (int i = 0; i < c->d; i++)
+        dp_write_shard(c, i, blockno, digests + (long)i * DIGEST,
+                       stripe + (long)i * per, per);
+    for (int i = 0; i < c->p; i++)
+        dp_write_shard(c, c->d + i, blockno,
+                       digests + (long)(c->d + i) * DIGEST,
+                       parity + (long)i * per, per);
+}
+
+static void dp_worker(DpPut* c) {
+    for (;;) {
+        DpSlot* s = nullptr;
+        {
+            std::unique_lock<std::mutex> lk(c->mu);
+            c->cv_work.wait(lk, [&] {
+                if (c->stopping) return true;
+                for (auto& sl : c->slots)
+                    if (sl.state == 1) return true;
+                return false;
+            });
+            for (auto& sl : c->slots)
+                if (sl.state == 1) {
+                    sl.state = 3;  // claimed
+                    s = &sl;
+                    break;
+                }
+            if (!s) {
+                if (c->stopping) return;
+                continue;
+            }
+        }
+        dp_process_stripe(c, s->stripe, s->per, s->blockno, s->parity,
+                          s->digests);
+        {
+            std::lock_guard<std::mutex> lk(c->mu);
+            s->state = 0;
+        }
+        c->cv_free.notify_one();
+    }
+}
+
+// Encode + hash + write one stripe block: `data` holds `dlen` real bytes.
+static void dp_put_block(DpPut* c, const uint8_t* data, long dlen, long per) {
+    long blockno = c->blockno++;
+    if (c->nthreads > 1) {
+        DpSlot* s = nullptr;
+        {
+            std::unique_lock<std::mutex> lk(c->mu);
+            c->cv_free.wait(lk, [&] {
+                for (auto& sl : c->slots)
+                    if (sl.state == 0) return true;
+                return false;
+            });
+            for (auto& sl : c->slots)
+                if (sl.state == 0) {
+                    s = &sl;
+                    break;
+                }
+        }
+        std::memcpy(s->stripe, data, (size_t)dlen);
+        if ((long)c->d * per != dlen)
+            std::memset(s->stripe + dlen, 0, (size_t)((long)c->d * per - dlen));
+        s->per = per;
+        s->blockno = blockno;
+        {
+            std::lock_guard<std::mutex> lk(c->mu);
+            s->state = 1;
+        }
+        c->cv_work.notify_one();
+        return;
+    }
+    DpSlot& s = c->slots[0];
+    const uint8_t* stripe = data;
+    if ((long)c->d * per != dlen) {  // needs zero padding -> scratch copy
+        std::memcpy(s.stripe, data, (size_t)dlen);
+        std::memset(s.stripe + dlen, 0, (size_t)((long)c->d * per - dlen));
+        stripe = s.stripe;
+    }
+    dp_process_stripe(c, stripe, per, blockno, s.parity, s.digests);
+}
+
+static void dp_drain(DpPut* c) {
+    if (c->nthreads <= 1) return;
+    std::unique_lock<std::mutex> lk(c->mu);
+    c->cv_free.wait(lk, [&] {
+        for (auto& sl : c->slots)
+            if (sl.state != 0) return false;
+        return true;
+    });
+}
+
+extern "C" void* dp_put_open(int d, int p, long block_size,
+                             const uint8_t* parity_mat, const uint8_t* key32,
+                             const char** paths) {
+    DpPut* c = new (std::nothrow) DpPut();
+    if (!c) return nullptr;
+    c->d = d;
+    c->p = p;
+    c->t = d + p;
+    c->block_size = block_size;
+    c->per = (block_size + d - 1) / d;
+    const char* nt = getenv("MINIO_TPU_NATIVE_THREADS");
+    c->nthreads = nt ? atoi(nt) : 1;
+    if (c->nthreads < 1) c->nthreads = 1;
+    if (c->nthreads > 16) c->nthreads = 16;
+    c->stopping = false;
+    c->parity_mat = (uint8_t*)malloc((size_t)p * d);
+    c->fds = (int*)malloc(sizeof(int) * c->t);
+    c->buf = (uint8_t*)malloc((size_t)block_size);
+    int nslots = c->nthreads > 1 ? 2 * c->nthreads : 1;
+    bool ok = c->parity_mat && c->fds && c->buf;
+    if (ok) {
+        c->slots.resize(nslots);
+        for (auto& s : c->slots) {
+            s.stripe = (uint8_t*)malloc((size_t)d * c->per);
+            s.parity = (uint8_t*)malloc((size_t)p * c->per);
+            s.digests = (uint8_t*)malloc((size_t)c->t * DIGEST);
+            s.state = 0;
+            if (!s.stripe || !s.parity || !s.digests) ok = false;
+        }
+    }
+    if (!ok) {
+        for (auto& s : c->slots) {
+            free(s.stripe); free(s.parity); free(s.digests);
+        }
+        free(c->parity_mat); free(c->fds); free(c->buf);
+        delete c;
+        return nullptr;
+    }
+    std::memcpy(c->parity_mat, parity_mat, (size_t)p * d);
+    std::memcpy(c->key, key32, 32);
+    c->dead.store(0);
+    c->buffered = 0;
+    c->blockno = 0;
+    c->total = 0;
+    c->md5.init();
+    for (int i = 0; i < c->t; i++) {
+        c->fds[i] = open(paths[i], O_WRONLY | O_CREAT, 0644);
+        if (c->fds[i] < 0) c->dead.fetch_or(1ULL << i);
+    }
+    if (c->nthreads > 1)
+        for (int i = 0; i < c->nthreads; i++)
+            c->workers.emplace_back(dp_worker, c);
+    return c;
+}
+
+extern "C" int dp_put_feed(void* ctx, const uint8_t* data, long n) {
+    DpPut* c = (DpPut*)ctx;
+    c->md5.update(data, (size_t)n);
+    c->total += (uint64_t)n;
+    // drain carry buffer first
+    if (c->buffered) {
+        long take = c->block_size - c->buffered;
+        if (take > n) take = n;
+        std::memcpy(c->buf + c->buffered, data, (size_t)take);
+        c->buffered += take;
+        data += take;
+        n -= take;
+        if (c->buffered == c->block_size) {
+            dp_put_block(c, c->buf, c->block_size, c->per);
+            c->buffered = 0;
+        }
+    }
+    while (n >= c->block_size) {
+        dp_put_block(c, data, c->block_size, c->per);
+        data += c->block_size;
+        n -= c->block_size;
+    }
+    if (n) {
+        std::memcpy(c->buf, data, (size_t)n);
+        c->buffered = n;
+    }
+    return 0;
+}
+
+extern "C" int dp_put_alive(void* ctx) {
+    DpPut* c = (DpPut*)ctx;
+    uint64_t dead = c->dead.load();
+    int alive = 0;
+    for (int i = 0; i < c->t; i++)
+        if (c->fds[i] >= 0 && !((dead >> i) & 1)) alive++;
+    return alive;
+}
+
+static void dp_put_free(DpPut* c) {
+    if (c->nthreads > 1) {
+        {
+            std::lock_guard<std::mutex> lk(c->mu);
+            c->stopping = true;
+        }
+        c->cv_work.notify_all();
+        for (auto& w : c->workers) w.join();
+    }
+    for (int i = 0; i < c->t; i++)
+        if (c->fds[i] >= 0) close(c->fds[i]);
+    for (auto& s : c->slots) {
+        free(s.stripe); free(s.parity); free(s.digests);
+    }
+    free(c->parity_mat); free(c->fds); free(c->buf);
+    delete c;
+}
+
+// Flush the tail block, fsync nothing (rename commit handles durability
+// semantics like the reference), emit md5 + dead mask. Frees the context.
+extern "C" int dp_put_finish(void* ctx, uint8_t* md5_out16,
+                             uint64_t* dead_mask) {
+    DpPut* c = (DpPut*)ctx;
+    if (c->buffered) {
+        long per = (c->buffered + c->d - 1) / c->d;
+        dp_put_block(c, c->buf, c->buffered, per);
+        c->buffered = 0;
+    }
+    dp_drain(c);
+    c->md5.final_(md5_out16);
+    *dead_mask = c->dead.load();
+    dp_put_free(c);
+    return 0;
+}
+
+extern "C" void dp_put_abort(void* ctx) {
+    DpPut* c = (DpPut*)ctx;
+    dp_drain(c);
+    c->md5.abort_();
+    dp_put_free(c);
+}
+
+// ----------------------------------------------------------------- GET
+
+// Read + verify + assemble a span of stripe blocks from the d data-shard
+// files. Per block k: frame at f_off[k], shard width per[k], output window
+// [lo[k], hi[k]) of the concatenated data shards. Returns bytes written to
+// `out`, -(k*64 + shard + 1) on the first read/verify failure (Python
+// falls back and marks the shard bad), or DP_GET_ENOMEM for a resource
+// failure that blames no shard.
+static const long DP_GET_ENOMEM = -(1L << 40);
+extern "C" long dp_get_span(const char** paths, int d, const uint8_t* key32,
+                            long nblocks, const long* f_off, const long* per,
+                            const long* lo, const long* hi, uint8_t* out) {
+    int fds[64];
+    for (int j = 0; j < d; j++) {
+        fds[j] = open(paths[j], O_RDONLY);
+        if (fds[j] < 0) {
+            for (int k = 0; k < j; k++) close(fds[k]);
+            return -(0 * 64 + j + 1);
+        }
+    }
+    long written = 0;
+    long rc = 0;
+    long scratch_cap = 0;
+    uint8_t* scratch = nullptr;
+    uint8_t digest[DIGEST], want[DIGEST];
+    for (long k = 0; k < nblocks && rc == 0; k++) {
+        long pw = per[k];
+        if (pw > scratch_cap) {
+            free(scratch);
+            scratch_cap = pw;
+            scratch = (uint8_t*)malloc((size_t)scratch_cap);
+            if (!scratch) { rc = DP_GET_ENOMEM; break; }  // no shard blamed
+        }
+        for (int j = 0; j < d; j++) {
+            long s_lo = (long)j * pw, s_hi = s_lo + pw;  // shard's data window
+            long c_lo = lo[k] > s_lo ? lo[k] : s_lo;
+            long c_hi = hi[k] < s_hi ? hi[k] : s_hi;
+            if (c_lo >= c_hi) continue;  // outside requested window
+            uint8_t* dest = out + written + (c_lo - lo[k]);
+            bool full = (c_lo == s_lo && c_hi == s_hi);
+            struct iovec iov[2];
+            iov[0].iov_base = digest;
+            iov[0].iov_len = DIGEST;
+            iov[1].iov_base = full ? dest : scratch;
+            iov[1].iov_len = (size_t)pw;
+            size_t want_n = DIGEST + (size_t)pw;
+            size_t got = 0;
+            off_t pos = (off_t)f_off[k];
+            while (got < want_n) {
+                ssize_t r = preadv(fds[j], iov, 2, pos + (off_t)got);
+                if (r <= 0) { rc = -(k * 64 + j + 1); break; }
+                got += (size_t)r;
+                size_t adv = (size_t)r;
+                for (int m = 0; m < 2; m++) {
+                    if (adv >= iov[m].iov_len) {
+                        adv -= iov[m].iov_len;
+                        iov[m].iov_len = 0;
+                    } else {
+                        iov[m].iov_base = (uint8_t*)iov[m].iov_base + adv;
+                        iov[m].iov_len -= adv;
+                        adv = 0;
+                    }
+                }
+            }
+            if (rc) break;
+            hh256(key32, full ? dest : scratch, pw, want);
+            if (std::memcmp(want, digest, DIGEST) != 0) {
+                rc = -(k * 64 + j + 1);
+                break;
+            }
+            if (!full) std::memcpy(dest, scratch + (c_lo - s_lo), (size_t)(c_hi - c_lo));
+        }
+        if (rc == 0) written += hi[k] - lo[k];
+    }
+    free(scratch);
+    for (int j = 0; j < d; j++) close(fds[j]);
+    return rc ? rc : written;
+}

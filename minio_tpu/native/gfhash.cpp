@@ -15,12 +15,39 @@
 #include <cstdint>
 #include <cstring>
 
+#include <cpuid.h>
 #include <immintrin.h>
 
 // ---------------------------------------------------------------- GF(2^8)
 
-static uint8_t MUL[256][256];
+uint8_t MUL[256][256];
+// GF2P8AFFINEQB matrix encoding of multiply-by-constant: matrix byte [7-i]
+// holds the input-bit coefficients of output bit i (Intel SDM bit order).
+uint64_t GF_AFF[256];
 static bool gf_ready = false;
+static bool gfni_ok = false;
+
+static bool cpu_has_gfni() {
+    unsigned a, b, c, d;
+    if (!__get_cpuid_count(7, 0, &a, &b, &c, &d)) return false;
+    return (c & (1u << 8)) && (b & (1u << 5));  // GFNI + AVX2
+}
+
+#if defined(__x86_64__)
+__attribute__((target("gfni,avx2")))
+static bool gfni_selftest() {
+    // Validate the affine-matrix bit order against the table once at init;
+    // any mismatch (exotic encoding quirks) silently falls back to VPSHUFB.
+    alignas(32) uint8_t in[32], out[32];
+    for (int i = 0; i < 32; i++) in[i] = (uint8_t)(i * 7 + 3);
+    __m256i v = _mm256_load_si256((const __m256i*)in);
+    __m256i m = _mm256_set1_epi64x((long long)GF_AFF[0x1D]);
+    _mm256_store_si256((__m256i*)out, _mm256_gf2p8affine_epi64_epi8(v, m, 0));
+    for (int i = 0; i < 32; i++)
+        if (out[i] != MUL[0x1D][in[i]]) return false;
+    return true;
+}
+#endif
 
 static void gf_init() {
     if (gf_ready) return;
@@ -38,21 +65,73 @@ static void gf_init() {
     for (int a = 1; a < 256; a++)
         for (int b = 1; b < 256; b++)
             MUL[a][b] = exp_t[log_t[a] + log_t[b]];
+    for (int c = 0; c < 256; c++) {
+        uint64_t m = 0;
+        for (int i = 0; i < 8; i++) {
+            uint8_t row = 0;
+            for (int j = 0; j < 8; j++)
+                if ((MUL[c][1 << j] >> i) & 1) row |= (uint8_t)(1 << j);
+            m |= (uint64_t)row << (8 * (7 - i));
+        }
+        GF_AFF[c] = m;
+    }
+#if defined(__x86_64__)
+    if (cpu_has_gfni()) gfni_ok = gfni_selftest();
+#endif
     gf_ready = true;
 }
 
-extern "C" void gf_apply(const uint8_t* mat, int rows, int cols,
-                         const uint8_t* in, uint8_t* out, long n) {
-    // in: [cols][n] contiguous; out: [rows][n]; out = mat (*) in over GF.
+extern "C" int gf_has_gfni() { gf_init(); return gfni_ok ? 1 : 0; }
+
+#if defined(__x86_64__)
+// One output row over all columns with GFNI: dst ^= mat[c]*src_c, 32 B/insn.
+__attribute__((target("gfni,avx2")))
+static void gf_row_gfni(const uint8_t* mat_row, int cols, const uint8_t* in,
+                        long in_stride, uint8_t* dst, long n) {
+    long i = 0;
+    for (; i + 32 <= n; i += 32) {
+        __m256i acc = _mm256_setzero_si256();
+        for (int c = 0; c < cols; c++) {
+            uint8_t coef = mat_row[c];
+            if (!coef) continue;
+            __m256i v = _mm256_loadu_si256((const __m256i*)(in + (long)c * in_stride + i));
+            acc = _mm256_xor_si256(acc, _mm256_gf2p8affine_epi64_epi8(
+                v, _mm256_set1_epi64x((long long)GF_AFF[coef]), 0));
+        }
+        _mm256_storeu_si256((__m256i*)(dst + i), acc);
+    }
+    if (i < n) {
+        for (long k = i; k < n; k++) dst[k] = 0;
+        for (int c = 0; c < cols; c++) {
+            const uint8_t* T = MUL[mat_row[c]];
+            const uint8_t* src = in + (long)c * in_stride;
+            for (long k = i; k < n; k++) dst[k] ^= T[src[k]];
+        }
+    }
+}
+#endif
+
+// Strided GF matrix apply: out[r] = XOR_c mat[r,c]*in[c], rows independent.
+// in rows are in_stride apart; out rows out_stride apart (contiguous shards).
+extern "C" void gf_apply_strided(const uint8_t* mat, int rows, int cols,
+                                 const uint8_t* in, long in_stride,
+                                 uint8_t* out, long out_stride, long n) {
     gf_init();
+#if defined(__x86_64__)
+    if (gfni_ok) {
+        for (int r = 0; r < rows; r++)
+            gf_row_gfni(mat + (long)r * cols, cols, in, in_stride,
+                        out + (long)r * out_stride, n);
+        return;
+    }
+#endif
     for (int r = 0; r < rows; r++) {
-        uint8_t* dst = out + (long)r * n;
+        uint8_t* dst = out + (long)r * out_stride;
         std::memset(dst, 0, (size_t)n);
         for (int c = 0; c < cols; c++) {
             uint8_t coef = mat[r * cols + c];
             if (coef == 0) continue;
-            const uint8_t* src = in + (long)c * n;
-            // nibble tables for this coefficient
+            const uint8_t* src = in + (long)c * in_stride;
             alignas(32) uint8_t lo_t[16], hi_t[16];
             for (int v = 0; v < 16; v++) {
                 lo_t[v] = MUL[coef][v];
@@ -80,6 +159,12 @@ extern "C" void gf_apply(const uint8_t* mat, int rows, int cols,
             for (; i < n; i++) dst[i] ^= T[src[i]];
         }
     }
+}
+
+extern "C" void gf_apply(const uint8_t* mat, int rows, int cols,
+                         const uint8_t* in, uint8_t* out, long n) {
+    // in: [cols][n] contiguous; out: [rows][n]; out = mat (*) in over GF.
+    gf_apply_strided(mat, rows, cols, in, n, out, n, n);
 }
 
 // ------------------------------------------------------------ HighwayHash
@@ -178,11 +263,47 @@ static void modular_reduction(uint64_t a3u, uint64_t a2, uint64_t a1,
     m0 = a0 ^ (a2 << 1) ^ (a2 << 2);
 }
 
+#ifdef __AVX2__
+// Vectorized bulk update: the four u64 lanes of each state vector map to one
+// ymm register; zipper_merge is a per-128-bit-lane byte shuffle. Bit-exact
+// with the scalar path (the golden chain digests cover both).
+static long hh_bulk_avx2(HHState& s, const uint8_t* data, long n) {
+    if (n < 32) return 0;
+    __m256i v0 = _mm256_loadu_si256((const __m256i*)s.v0);
+    __m256i v1 = _mm256_loadu_si256((const __m256i*)s.v1);
+    __m256i mul0 = _mm256_loadu_si256((const __m256i*)s.mul0);
+    __m256i mul1 = _mm256_loadu_si256((const __m256i*)s.mul1);
+    const __m256i zmask = _mm256_setr_epi8(
+        3, 12, 2, 5, 14, 1, 15, 0, 11, 4, 10, 13, 9, 6, 8, 7,
+        3, 12, 2, 5, 14, 1, 15, 0, 11, 4, 10, 13, 9, 6, 8, 7);
+    long off = 0;
+    for (; off + 32 <= n; off += 32) {
+        __m256i a = _mm256_loadu_si256((const __m256i*)(data + off));
+        v1 = _mm256_add_epi64(v1, _mm256_add_epi64(mul0, a));
+        mul0 = _mm256_xor_si256(
+            mul0, _mm256_mul_epu32(v1, _mm256_srli_epi64(v0, 32)));
+        v0 = _mm256_add_epi64(v0, mul1);
+        mul1 = _mm256_xor_si256(
+            mul1, _mm256_mul_epu32(v0, _mm256_srli_epi64(v1, 32)));
+        v0 = _mm256_add_epi64(v0, _mm256_shuffle_epi8(v1, zmask));
+        v1 = _mm256_add_epi64(v1, _mm256_shuffle_epi8(v0, zmask));
+    }
+    _mm256_storeu_si256((__m256i*)s.v0, v0);
+    _mm256_storeu_si256((__m256i*)s.v1, v1);
+    _mm256_storeu_si256((__m256i*)s.mul0, mul0);
+    _mm256_storeu_si256((__m256i*)s.mul1, mul1);
+    return off;
+}
+#endif
+
 extern "C" void hh256(const uint8_t* key32, const uint8_t* data, long n,
                       uint8_t* out32) {
     HHState s;
     hh_reset(s, key32);
     long off = 0;
+#ifdef __AVX2__
+    off = hh_bulk_avx2(s, data, n);
+#endif
     for (; off + 32 <= n; off += 32) hh_update(s, data + off);
     if (n - off) hh_update_remainder(s, data + off, (size_t)(n - off));
     for (int i = 0; i < 10; i++) hh_permute_update(s);

@@ -564,14 +564,15 @@ class S3Server:
 
     async def _run_streaming_put(self, request: web.Request, consume):
         """Run consume(chunk_iterator) in the io pool while pumping the
-        request body into it through a bounded queue (8 x 1 MiB): the
-        async HTTP read and the sync erasure encode/write overlap, and a
-        part is never fully resident. A short body (client hung up) or
+        request body into it through a bounded queue (~8 MiB of chunks):
+        the async HTTP read and the sync erasure encode/write overlap, and
+        a part is never fully resident. A short body (client hung up) or
         pump failure raises into the consumer so the put aborts cleanly.
         """
         import queue as _queue
 
-        q: _queue.Queue = _queue.Queue(maxsize=8)
+        chunk_sz = int(os.environ.get("MINIO_TPU_PUT_CHUNK_MB", "4")) << 20
+        q: _queue.Queue = _queue.Queue(maxsize=max(2, (8 << 20) // chunk_sz))
 
         def gen():
             while True:
@@ -614,7 +615,7 @@ class S3Server:
         got = 0
         try:
             while True:
-                chunk = await request.content.read(1 << 20)
+                chunk = await request.content.read(chunk_sz)
                 if not chunk:
                     if got != expect:
                         await loop.run_in_executor(
@@ -624,7 +625,11 @@ class S3Server:
                         await loop.run_in_executor(self._pump_pool, put_item, None)
                     break
                 got += len(chunk)
-                await loop.run_in_executor(self._pump_pool, put_item, chunk)
+                try:
+                    # fast path: skip the executor hop when there's room
+                    q.put_nowait(chunk)
+                except _queue.Full:
+                    await loop.run_in_executor(self._pump_pool, put_item, chunk)
         except _ConsumerDone:
             pass  # consumer already finished/failed; its result surfaces below
         except BaseException as e:
@@ -2682,7 +2687,36 @@ def main(argv: list[str] | None = None) -> None:
         app["bootstrap"] = asyncio.create_task(boot_then_gateways())
 
     srv.app.on_startup.append(on_start)
-    web.run_app(srv.app, host=host or "0.0.0.0", port=my_port, print=None)
+    # explicit runner instead of run_app: read_bufsize lifts aiohttp's
+    # 64 KiB StreamReader watermark, which otherwise pause/resumes the
+    # transport 16x per MiB on large streaming PUTs (hot-path cost on the
+    # single-core bench host)
+    import asyncio as _asyncio
+    import signal as _signal
+
+    async def _serve():
+        runner = web.AppRunner(
+            srv.app, read_bufsize=int(
+                os.environ.get("MINIO_TPU_HTTP_READBUF", str(4 << 20))
+            ),
+        )
+        await runner.setup()
+        site = web.TCPSite(runner, host or "0.0.0.0", my_port)
+        await site.start()
+        stop = _asyncio.Event()
+        loop = _asyncio.get_running_loop()
+        for sig in (_signal.SIGINT, _signal.SIGTERM):
+            try:
+                loop.add_signal_handler(sig, stop.set)
+            except NotImplementedError:  # non-unix
+                pass
+        await stop.wait()
+        await runner.cleanup()  # close listeners, drain in-flight requests
+
+    try:
+        _asyncio.run(_serve())
+    except KeyboardInterrupt:
+        pass
 
 
 if __name__ == "__main__":

@@ -134,6 +134,10 @@ class HealthCheckedDisk(StorageAPI):
         self._ok(time.monotonic() - t0)
         return out
 
+    def local_path(self, volume: str, path: str) -> str | None:
+        # pure path math — no I/O, so no circuit involvement
+        return self._inner.local_path(volume, path)
+
     def walk_dir(self, volume, base=""):
         # generator: account the iteration, not just construction
         if not self._enter():

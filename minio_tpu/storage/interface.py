@@ -17,6 +17,13 @@ from .datatypes import DiskInfo, FileInfo, VolInfo
 class StorageAPI(ABC):
     endpoint: str
 
+    def local_path(self, volume: str, path: str) -> str | None:
+        """Absolute filesystem path of a file on this drive, or None when
+        the drive is remote. Lets the native data plane (native/dataplane
+        .cpp) read/write shard files directly in one GIL-releasing pass;
+        remote drives return None and take the RPC path."""
+        return None
+
     @abstractmethod
     def disk_info(self) -> DiskInfo: ...
 

@@ -77,6 +77,9 @@ class XLStorage(StorageAPI):
     def _file_path(self, volume: str, path: str) -> str:
         return os.path.join(self._vol_path(volume), _clean_rel(path))
 
+    def local_path(self, volume: str, path: str) -> str | None:
+        return self._file_path(volume, path)
+
     def _check_vol(self, volume: str) -> str:
         p = self._vol_path(volume)
         if not os.path.isdir(p):

@@ -1,0 +1,231 @@
+"""Native streaming data plane (native/dataplane.cpp).
+
+Covers: byte-identical shard files vs the Python encode path, md5 etags,
+span reads (full/odd ranges), bitrot detection, end-to-end ErasureSet
+round-trips with the plane on/off, degraded fallback mid-read, and dead
+shard accounting on write failure. Mirrors the reference's encode/decode
+pipeline tests (cmd/erasure-encode_test.go, cmd/erasure-decode_test.go).
+"""
+
+import hashlib
+import os
+import shutil
+import tempfile
+
+import numpy as np
+import pytest
+
+from minio_tpu import native
+from minio_tpu.erasure import bitrot_io
+from minio_tpu.erasure.coder import ErasureCoder
+from minio_tpu.erasure.set import ErasureSet
+from minio_tpu.ops.highwayhash import MINIO_KEY
+from minio_tpu.ops.rs import get_codec
+from minio_tpu.storage.xlstorage import XLStorage
+
+pytestmark = pytest.mark.skipif(
+    not native.dataplane_available(), reason="native dataplane unavailable"
+)
+
+
+def _arr(x):
+    return np.asarray(x, dtype=np.int64)
+
+
+@pytest.fixture()
+def tmp(tmp_path):
+    return str(tmp_path)
+
+
+def _plan_full(coder, size):
+    f_off, per, lo, hi = [], [], [], []
+    for bi, (dlen, pw) in enumerate(coder.shard_sizes_for(size)):
+        f_off.append(bitrot_io.block_offset(coder.shard_size, bi))
+        per.append(pw)
+        lo.append(0)
+        hi.append(dlen)
+    return _arr(f_off), _arr(per), _arr(lo), _arr(hi)
+
+
+@pytest.mark.parametrize("d,p", [(2, 2), (8, 8), (12, 4)])
+def test_put_matches_python_encoder(tmp, d, p):
+    coder = ErasureCoder(d, p)
+    data = np.random.default_rng(d).integers(
+        0, 256, size=3 * coder.block_size + 54321, dtype=np.uint8
+    ).tobytes()
+    paths = [os.path.join(tmp, f"s{i}") for i in range(d + p)]
+    ctx = native.DataplanePut(
+        d, p, coder.block_size, coder._np.parity_matrix, MINIO_KEY, paths
+    )
+    for off in range(0, len(data), 700_001):  # odd chunks exercise the carry
+        ctx.feed(data[off : off + 700_001])
+    etag, dead = ctx.finish()
+    assert dead == 0
+    assert etag == hashlib.md5(data).hexdigest()
+    enc = coder.encode_part(data)
+    for i, path in enumerate(paths):
+        with open(path, "rb") as f:
+            assert f.read() == enc.shard_files[i], f"shard {i}"
+
+
+def test_get_span_full_and_ranges(tmp):
+    d, p = 4, 2
+    coder = ErasureCoder(d, p)
+    size = 2 * coder.block_size + 999
+    data = np.random.default_rng(7).integers(0, 256, size=size, dtype=np.uint8).tobytes()
+    paths = [os.path.join(tmp, f"s{i}") for i in range(d + p)]
+    ctx = native.DataplanePut(
+        d, p, coder.block_size, coder._np.parity_matrix, MINIO_KEY, paths
+    )
+    ctx.feed(data)
+    ctx.finish()
+    f_off, per, lo, hi = _plan_full(coder, size)
+    out = np.empty(size, dtype=np.uint8)
+    assert native.dp_get_span(paths, d, MINIO_KEY, f_off, per, lo, hi, out) == size
+    assert out.tobytes() == data
+    # odd range crossing a block boundary
+    start, ln = coder.block_size - 17, 40_000
+    pos, rem = 0, ln
+    fo, pw_, lo2, hi2 = [], [], [], []
+    for bi, (dlen, pw) in enumerate(coder.shard_sizes_for(size)):
+        if pos + dlen <= start:
+            pos += dlen
+            continue
+        if rem <= 0:
+            break
+        lo_b = max(start - pos, 0)
+        hi_b = min(lo_b + rem, dlen)
+        fo.append(bitrot_io.block_offset(coder.shard_size, bi))
+        pw_.append(pw)
+        lo2.append(lo_b)
+        hi2.append(hi_b)
+        rem -= hi_b - lo_b
+        pos += dlen
+    out2 = np.empty(ln, dtype=np.uint8)
+    rc = native.dp_get_span(paths, d, MINIO_KEY, _arr(fo), _arr(pw_), _arr(lo2), _arr(hi2), out2)
+    assert rc == ln
+    assert out2.tobytes() == data[start : start + ln]
+
+
+def test_get_span_detects_bitrot(tmp):
+    d, p = 4, 2
+    coder = ErasureCoder(d, p)
+    size = coder.block_size
+    data = b"\x5a" * size
+    paths = [os.path.join(tmp, f"s{i}") for i in range(d + p)]
+    ctx = native.DataplanePut(
+        d, p, coder.block_size, coder._np.parity_matrix, MINIO_KEY, paths
+    )
+    ctx.feed(data)
+    ctx.finish()
+    blob = bytearray(open(paths[2], "rb").read())
+    blob[100] ^= 1
+    open(paths[2], "wb").write(bytes(blob))
+    f_off, per, lo, hi = _plan_full(coder, size)
+    out = np.empty(size, dtype=np.uint8)
+    rc = native.dp_get_span(paths, d, MINIO_KEY, f_off, per, lo, hi, out)
+    assert rc == -(0 * 64 + 2 + 1)
+
+
+def test_dead_shard_mask_on_write_failure(tmp):
+    d, p = 2, 2
+    coder = ErasureCoder(d, p)
+    paths = [os.path.join(tmp, f"s{i}") for i in range(d + p)]
+    paths[3] = os.path.join(tmp, "no-such-dir", "s3")  # open() fails
+    ctx = native.DataplanePut(
+        d, p, coder.block_size, coder._np.parity_matrix, MINIO_KEY, paths
+    )
+    data = b"x" * (coder.block_size + 5)
+    ctx.feed(data)
+    assert ctx.alive() == 3
+    etag, dead = ctx.finish()
+    assert dead == 1 << 3
+    assert etag == hashlib.md5(data).hexdigest()
+
+
+def _mkset(tmp, n, parity):
+    disks = [XLStorage(os.path.join(tmp, f"d{i}")) for i in range(n)]
+    return ErasureSet(disks, default_parity=parity)
+
+
+def _stream(data, chunk=1 << 20):
+    for off in range(0, len(data), chunk):
+        yield data[off : off + chunk]
+
+
+def test_erasure_set_native_roundtrip(tmp):
+    es = _mkset(tmp, 6, 2)
+    es.make_bucket("b")
+    size = 5 * (1 << 20) + 12345
+    data = np.random.default_rng(1).integers(0, 256, size=size, dtype=np.uint8).tobytes()
+    oi = es.put_object("b", "obj", _stream(data))  # iterator -> streaming path
+    assert oi.etag == hashlib.md5(data).hexdigest()
+    _, it = es.get_object("b", "obj")
+    assert b"".join(bytes(c) for c in it) == data
+    # ranged read via the native span path
+    _, it = es.get_object("b", "obj", offset=(1 << 20) - 3, length=2_000_000)
+    got = b"".join(bytes(c) for c in it)
+    assert got == data[(1 << 20) - 3 : (1 << 20) - 3 + 2_000_000]
+
+
+def test_native_matches_python_plane(tmp):
+    """Shard files and etags are identical with the plane on and off."""
+    size = 2 * (1 << 20) + 777
+    data = np.random.default_rng(2).integers(0, 256, size=size, dtype=np.uint8).tobytes()
+    etags = {}
+    for mode in ("1", "0"):
+        os.environ["MINIO_TPU_NATIVE_PLANE"] = mode
+        try:
+            base = os.path.join(tmp, f"mode{mode}")
+            es = _mkset(base, 4, 2)
+            es.make_bucket("b")
+            oi = es.put_object("b", "obj", _stream(data))
+            etags[mode] = oi.etag
+            _, it = es.get_object("b", "obj")
+            assert b"".join(bytes(c) for c in it) == data
+        finally:
+            os.environ.pop("MINIO_TPU_NATIVE_PLANE", None)
+    assert etags["1"] == etags["0"] == hashlib.md5(data).hexdigest()
+
+
+def test_native_get_falls_back_on_corruption(tmp):
+    """Bitrot in a data shard mid-object: native span fails, the
+    reconstructing path serves the bytes from parity."""
+    es = _mkset(tmp, 4, 2)
+    es.make_bucket("b")
+    size = 3 * (1 << 20)
+    data = np.random.default_rng(3).integers(0, 256, size=size, dtype=np.uint8).tobytes()
+    es.put_object("b", "obj", _stream(data))
+    fi, metas, _, _ = es._quorum_fileinfo("b", "obj", "", read_data=True)
+    src = es._shard_sources(fi, metas)
+    disk, m = src[0]  # erasure index 0 = first data shard
+    path = disk.local_path("b", f"obj/{fi.data_dir}/part.1")
+    blob = bytearray(open(path, "rb").read())
+    blob[40] ^= 0xFF  # corrupt inside the first block's payload
+    open(path, "wb").write(bytes(blob))
+    _, it = es.get_object("b", "obj")
+    assert b"".join(bytes(c) for c in it) == data
+
+
+def test_native_put_quorum_failure_cleans_up(tmp):
+    """More than parity drives failing mid-write raises QuorumError and
+    leaves no durable object."""
+    from minio_tpu.erasure.quorum import ObjectNotFound, QuorumError
+
+    es = _mkset(tmp, 4, 1)
+    es.make_bucket("b")
+    # wipe three drive roots' tmp dirs after staging begins is racy; instead
+    # make three staged paths unwritable by replacing the drive dir with a file
+    data = b"y" * (2 << 20)
+
+    def reader():
+        # after the first chunk, remove 2 of 4 drives (parity=1 -> quorum 3)
+        yield data[: 1 << 20]
+        for i in (1, 2):
+            shutil.rmtree(os.path.join(tmp, f"d{i}"))
+        yield data[1 << 20 :]
+
+    with pytest.raises(QuorumError):
+        es.put_object("b", "obj", reader())
+    with pytest.raises((ObjectNotFound, QuorumError)):
+        es.get_object_info("b", "obj")

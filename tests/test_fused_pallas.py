@@ -29,6 +29,7 @@ def test_supports_gates():
     assert not fp.supports(8, 8, 192, 1000)       # n not chunk-aligned
 
 
+@pytest.mark.tpu
 @pytest.mark.skipif(
     __import__("jax").default_backend() != "tpu",
     reason="Mosaic mega-kernel needs a TPU backend",
