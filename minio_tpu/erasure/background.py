@@ -13,6 +13,8 @@ Mirrors the reference's background subsystems:
 
 from __future__ import annotations
 
+import json
+import os
 import queue
 import threading
 import time
@@ -112,9 +114,183 @@ class BackgroundOps:
             )
             t.start()
             self._threads.append(t)
+        t = threading.Thread(
+            target=self._disk_monitor_loop, daemon=True, name="fresh-disk"
+        )
+        t.start()
+        self._threads.append(t)
 
     def stop(self) -> None:
         self._stop.set()
+
+    # -- fresh-disk heal monitor -------------------------------------------
+    # A wiped/replaced local drive is detected by its missing format.json
+    # (while set peers still carry the layout) and drain-healed set-wide
+    # with a resumable tracker persisted ON the healing drive. Mirrors the
+    # reference's dedicated monitor + healing tracker
+    # (cmd/background-newdisks-heal-ops.go:415 healFreshDisk, :559
+    # monitorLocalDisksAndHeal) instead of waiting for scanner cycles.
+
+    HEALING_TRACKER = "healing.json"
+
+    def _iter_sets(self):
+        for p in getattr(self.store, "pools", [self.store]):
+            for s in getattr(p, "sets", [p]):
+                yield s
+
+    def _disk_monitor_loop(self) -> None:
+        interval = float(os.environ.get("MINIO_TPU_DISK_MONITOR_INTERVAL", "10"))
+        if interval <= 0:
+            return
+        while not self._stop.is_set():
+            try:
+                self.check_fresh_disks()
+            except Exception:  # noqa: BLE001 — monitor must never die
+                pass
+            self._stop.wait(interval)
+
+    @staticmethod
+    def _drive_root(disk) -> str | None:
+        lp = disk.local_path(".minio.sys", "x")
+        return os.path.dirname(os.path.dirname(lp)) if lp else None
+
+    def _unmounted_guard(self, es, disk) -> bool:
+        """True when healing `disk` must be SKIPPED: its root now sits on
+        the OS filesystem while healthy set peers are on real mounts — the
+        signature of an unmounted drive, where a drain would fill the OS
+        disk and shadow the real data on remount (reference errDriveIsRoot,
+        cmd/xl-storage.go root-disk detection). Single-filesystem
+        deployments (all drives on one device) heal normally."""
+        root = self._drive_root(disk)
+        try:
+            dev = os.stat(root).st_dev
+            os_dev = os.stat("/").st_dev
+        except OSError:
+            return True  # root path gone entirely: nothing sane to heal into
+        if dev != os_dev:
+            return False  # on its own mount: safe
+        peer_devs = set()
+        for other in es.disks:
+            if other is disk or other is None:
+                continue
+            proot = self._drive_root(other)
+            if proot is None:
+                continue
+            try:
+                peer_devs.add(os.stat(proot).st_dev)
+            except OSError:
+                continue
+        # all peers also on the OS device -> dev/test layout, heal away
+        return bool(peer_devs) and peer_devs != {os_dev}
+
+    def check_fresh_disks(self) -> int:
+        """One monitor pass: detect + drain-heal wiped local drives.
+        Returns the number of drives healed (also driven by tests/admin)."""
+        healed = 0
+        for es in self._iter_sets():
+            for disk in es.disks:
+                if disk is None or disk.local_path(".minio.sys", "x") is None:
+                    continue  # remote drives are monitored by their node
+                if self._unmounted_guard(es, disk):
+                    continue
+                try:
+                    if self._fresh_disk_state(es, disk):
+                        self._drain_heal(es, disk)
+                        healed += 1
+                        self.stats["fresh_disks_healed"] = (
+                            self.stats.get("fresh_disks_healed", 0) + 1
+                        )
+                except Exception:  # noqa: BLE001 — retry next pass
+                    pass
+        return healed
+
+    def _fresh_disk_state(self, es, disk) -> bool:
+        """True when `disk` needs a set-wide drain heal: wiped (format
+        gone while peers keep the layout) or carrying an interrupted
+        healing tracker."""
+        from ..storage import errors as serr
+        from ..storage import format_erasure as fe
+        from ..storage.xlstorage import SYS_DIR
+
+        try:
+            disk.read_file(SYS_DIR, fe.FORMAT_FILE)
+        except (serr.FileNotFound, serr.VolumeNotFound, serr.DiskNotFound):
+            # wiped at runtime: peers must still agree on the layout and
+            # this drive must still know its identity (disk_id in memory)
+            ref = None
+            for other in es.disks:
+                if other is disk or other is None:
+                    continue
+                try:
+                    ref = fe.FormatErasure.from_json(
+                        other.read_file(SYS_DIR, fe.FORMAT_FILE)
+                    )
+                    break
+                except Exception:  # noqa: BLE001
+                    continue
+            my_uuid = getattr(disk, "disk_id", "")
+            if ref is None or not my_uuid:
+                return False
+            fmt = fe.FormatErasure(id=ref.id, this=my_uuid, sets=ref.sets)
+            disk.create_file(SYS_DIR, fe.FORMAT_FILE, fmt.to_json())
+            disk.create_file(
+                SYS_DIR, self.HEALING_TRACKER,
+                json.dumps({"started": time.time(), "buckets_done": []}).encode(),
+            )
+            return True
+        # format intact: resume an interrupted drain if a tracker remains
+        try:
+            disk.read_file(SYS_DIR, self.HEALING_TRACKER)
+            return True
+        except Exception:  # noqa: BLE001
+            return False
+
+    def _drain_heal(self, es, disk) -> None:
+        """Set-wide drain onto one healing drive, checkpointed by bucket.
+
+        heal_object is idempotent per object, so replaying the in-progress
+        bucket after a crash converges; completed buckets are skipped via
+        the tracker (the reference's healingTracker object/byte counters
+        serve the same resume purpose)."""
+        from ..storage.xlstorage import SYS_DIR
+
+        def load_tracker() -> dict:
+            try:
+                return json.loads(disk.read_file(SYS_DIR, self.HEALING_TRACKER))
+            except Exception:  # noqa: BLE001
+                return {"buckets_done": []}
+
+        tracker = load_tracker()
+        done = set(tracker.get("buckets_done", []))
+        # system metadata first (bucket configs, IAM, tier config live as
+        # objects under .minio.sys — the reference heals the meta bucket
+        # ahead of user data in healFreshDisk)
+        buckets = [".minio.sys"] + sorted(b.name for b in es.list_buckets())
+        for bname in buckets:
+            if self._stop.is_set():
+                return  # tracker stays: next pass resumes
+            if bname in done:
+                continue
+            try:
+                disk.make_vol(bname)
+            except Exception:  # noqa: BLE001 — may exist
+                pass
+            for obj in es.walk_objects(bname):
+                if self._stop.is_set():
+                    return
+                try:
+                    es.heal_object(bname, obj)
+                    self.stats["heals_done"] = self.stats.get("heals_done", 0) + 1
+                except Exception:  # noqa: BLE001
+                    self.stats["heals_failed"] = (
+                        self.stats.get("heals_failed", 0) + 1
+                    )
+            done.add(bname)
+            tracker["buckets_done"] = sorted(done)
+            disk.create_file(
+                SYS_DIR, self.HEALING_TRACKER, json.dumps(tracker).encode()
+            )
+        disk.delete(SYS_DIR, self.HEALING_TRACKER)
 
     # -- scanner -----------------------------------------------------------
 
@@ -168,6 +344,13 @@ class BackgroundOps:
         self.usage.last_update = time.time()
         self.usage.cycles += 1
         self.stats["scans"] += 1
+        if self.tiers is not None:
+            from ..ilm import tier as tiermod
+
+            try:  # retry journaled warm-tier sweeps (tier GC backstop)
+                tiermod.retry_journal(self.tiers)
+            except Exception:  # noqa: BLE001 — next cycle retries
+                pass
         return self.usage
 
     def _inspect(self, bucket: str, obj: str, acc: dict) -> bool:
@@ -230,11 +413,15 @@ class BackgroundOps:
                     self.stats["ilm_expired"] = self.stats.get("ilm_expired", 0) + 1
                     self.store.delete_object(bucket, obj, versioned=versioned)
                     expired_current = not versioned
+                    if not versioned:
+                        self._sweep_tier(oi)  # data gone: free the warm tier
                 elif act in (ilm.ACTION_DELETE_VERSION, ilm.ACTION_DELETE_MARKER):
                     self.stats["ilm_expired"] = self.stats.get("ilm_expired", 0) + 1
                     self.store.delete_object(
                         bucket, obj, version_id=oi.version_id or ""
                     )
+                    if act == ilm.ACTION_DELETE_VERSION:
+                        self._sweep_tier(oi)
                 elif act == ilm.ACTION_TRANSITION and oi.is_latest:
                     tier_name = ilm.transition_tier_for(rules, st)
                     self._transition(bucket, obj, oi, tier_name)
@@ -253,6 +440,17 @@ class BackgroundOps:
             except Exception:  # noqa: BLE001
                 pass
         return expired_current
+
+    def _sweep_tier(self, oi) -> None:
+        """Tier GC for an expired transitioned version (reference
+        cmd/tier-sweeper.go): the stub is gone, sweep the remote data."""
+        if self.tiers is None:
+            return
+        from ..ilm import tier as tiermod
+
+        ud = getattr(oi, "user_defined", None) or {}
+        if tiermod.is_transitioned(ud):
+            tiermod.sweep_remote(self.tiers, ud)
 
     def _transition(self, bucket: str, obj: str, oi, tier_name: str) -> None:
         """Move one object's data to a warm tier and stub it locally

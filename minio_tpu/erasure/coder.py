@@ -59,6 +59,21 @@ class ErasureCoder:
 
             self._jax = rs_jax.get_tpu_codec(self.d, self.p)
 
+    @property
+    def device_active(self) -> bool:
+        """True when writes should route through the device dispatcher:
+        either a real accelerator backend is live, or the operator
+        explicitly forced MINIO_TPU_BACKEND=jax (CI exercises the device
+        plane on virtual CPU devices that way). A merely-importable jax on
+        a CPU-only host must NOT disable the native C++ plane."""
+        if self._jax is None:
+            return False
+        if os.environ.get("MINIO_TPU_BACKEND") == "jax":
+            return True
+        import jax
+
+        return jax.default_backend() != "cpu"
+
     # -- encode ------------------------------------------------------------
 
     def _encode_block_np(self, block: bytes) -> tuple[np.ndarray, np.ndarray]:
@@ -225,9 +240,15 @@ class ErasureCoder:
             self._jax is not None
             and w * self.t >= int(os.environ.get("MINIO_TPU_DECODE_MIN_SHARDS", "64"))
         ):
-            out = self._jax.reconstruct_blocks(
-                survivors.transpose(1, 0, 2), present, missing
-            )
+            from ..ops.bitrot_jax import _try_fused_decode
+            from ..ops.highwayhash import MINIO_KEY
+
+            arr = survivors.transpose(1, 0, 2)  # [W, d, per]
+            # degraded GET rides the decode mega-kernel when shapes allow
+            fused = _try_fused_decode(self._jax, arr, present, missing, MINIO_KEY)
+            if fused is not None:
+                return fused[0].transpose(1, 0, 2)
+            out = self._jax.reconstruct_blocks(arr, present, missing)
             return np.asarray(out).transpose(1, 0, 2)
         mat = self._decode_rows(present, missing)
         flat = survivors.reshape(self.d, w * per)

@@ -49,12 +49,21 @@ TMP_VOLUME = ".minio.sys/tmp"
 DIGEST = bitrot_io.DIGEST_SIZE
 
 
-def _native_plane_enabled() -> bool:
+def _native_plane_enabled(device_active: bool = False) -> bool:
     """Native C++ streaming data plane (native/dataplane.cpp): used for the
     PUT/GET hot path whenever every target drive is local. One GIL-releasing
     pass replaces the per-block Python loop (VERDICT r2: the ~1000x
-    kernel-to-server gap lived in this plumbing)."""
-    if os.environ.get("MINIO_TPU_NATIVE_PLANE", "1") != "1":
+    kernel-to-server gap lived in this plumbing).
+
+    MINIO_TPU_NATIVE_PLANE: "auto" (default) = take the native pass unless
+    a device codec is active for this write (the TPU batching dispatcher is
+    the accelerator plane; the native pass is the CPU plane); "1" = always;
+    "0" = never.
+    """
+    mode = os.environ.get("MINIO_TPU_NATIVE_PLANE", "auto")
+    if mode == "0":
+        return False
+    if mode != "1" and device_active:
         return False
     from .. import native
 
@@ -267,10 +276,12 @@ class ErasureSet:
                 bucket, obj, data, user_defined, version_id, versioned,
                 parity, distribution, lock=lock,
             )
+        p = self.default_parity if parity is None else parity
+        d = self.n - p
         if (
             len(data) > INLINE_DATA_THRESHOLD
-            and _native_plane_enabled()
-            and all(d.local_path(TMP_VOLUME, "x") is not None for d in self.disks)
+            and _native_plane_enabled(self.coder(d, p).device_active)
+            and all(dk.local_path(TMP_VOLUME, "x") is not None for dk in self.disks)
         ):
             # large buffered bodies (signed-payload PUTs) also take the
             # native C++ pass; small ones keep the inline fast path
@@ -278,8 +289,6 @@ class ErasureSet:
                 bucket, obj, iter([data]), user_defined, version_id, versioned,
                 parity, distribution, lock=lock,
             )
-        p = self.default_parity if parity is None else parity
-        d = self.n - p
         write_q = d + 1 if d == p else d
 
         fi = FileInfo(volume=bucket, name=obj)
@@ -411,7 +420,9 @@ class ErasureSet:
         stream_cap = int(os.environ.get("MINIO_TPU_STREAM_BATCH_MB", "64")) << 20
         # native C++ single-pass plane when every drive is local + healthy
         native_paths: list[str] | None = None
-        if _native_plane_enabled() and all(e is None for e in errs):
+        if _native_plane_enabled(coder.device_active) and all(
+            e is None for e in errs
+        ):
             native_paths = [""] * self.n
             for i, disk in enumerate(self.disks):
                 lp = disk.local_path(TMP_VOLUME, stage)

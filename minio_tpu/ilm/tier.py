@@ -103,3 +103,78 @@ class TierRegistry:
     def list(self) -> list[Tier]:
         self._load()
         return list(self._tiers.values())
+
+
+# ---- tier garbage collection (reference cmd/tier-sweeper.go + the tier
+# journal): when a transitioned version's local stub is deleted or
+# overwritten, its warm-tier data must be swept or it is orphaned forever.
+
+JOURNAL_KEY = "config/tier-journal.json"
+_journal_mu = threading.Lock()
+
+
+def _journal_load(store) -> list[dict]:
+    from ..erasure.quorum import BucketNotFound, ObjectNotFound
+
+    try:
+        _, it = store.get_object(SYSTEM_BUCKET, JOURNAL_KEY)
+        return json.loads(b"".join(it))
+    except (ObjectNotFound, BucketNotFound, ValueError):
+        return []
+
+
+def _journal_save(store, entries: list[dict]) -> None:
+    store.put_object(SYSTEM_BUCKET, JOURNAL_KEY, json.dumps(entries).encode())
+
+
+def journal_add(store, tier_name: str, remote_key: str) -> None:
+    """Persist a failed sweep for retry (the reference's tierJournal)."""
+    with _journal_mu:
+        entries = _journal_load(store)
+        entries.append({"tier": tier_name, "key": remote_key})
+        _journal_save(store, entries)
+
+
+def retry_journal(tiers: "TierRegistry") -> int:
+    """Retry journaled sweeps (scanner-driven). Returns entries remaining."""
+    with _journal_mu:
+        entries = _journal_load(tiers.store)
+        if not entries:
+            return 0
+        left = []
+        for e in entries:
+            t = tiers.get(e.get("tier", ""))
+            if t is None:
+                continue  # tier deconfigured: nothing to sweep anymore
+            try:
+                r = t.client().delete_object(t.bucket, e["key"])
+                if r.status not in (200, 204, 404):
+                    raise OSError(f"tier delete status {r.status}")
+            except Exception:  # noqa: BLE001 — keep for the next cycle
+                left.append(e)
+        _journal_save(tiers.store, left)
+        return len(left)
+
+
+def sweep_remote(tiers: "TierRegistry", user_defined: dict | None) -> None:
+    """Delete a removed version's data from its warm tier. Best-effort
+    direct delete; failures land in the persisted journal and are retried
+    by the scanner (reference deletes via the tier journal exclusively —
+    we inline the common case and journal only failures)."""
+    ud = user_defined or {}
+    name = ud.get(TRANSITION_TIER_META, "")
+    rkey = ud.get(TRANSITION_KEY_META, "")
+    if not name or not rkey:
+        return
+    t = tiers.get(name)
+    if t is None:
+        return
+    try:
+        r = t.client().delete_object(t.bucket, rkey)
+        if r.status not in (200, 204, 404):
+            raise OSError(f"tier delete status {r.status}")
+    except Exception:  # noqa: BLE001 — journal for scanner retry
+        try:
+            journal_add(tiers.store, name, rkey)
+        except Exception:  # noqa: BLE001 — journaling is best-effort too
+            pass

@@ -284,6 +284,52 @@ def _select_hash_fn():
     return hash256_blocks
 
 
+# decode mega-kernel fallback discipline: transient failures back off
+# exponentially and re-probe (same policy as the encode dispatcher)
+_fused_dec_cooldown = 0
+_fused_dec_backoff = 8
+
+
+def _try_fused_decode(codec, survivors, present, missing, key):
+    """Chunk-major fused reconstruct+verify+hash when shapes allow.
+
+    Returns (rebuilt [B, m, n], rebuilt_digests [B, m, 32], survivor_
+    digests [B, d, 32]) as numpy, or None for the XLA path."""
+    global _fused_dec_cooldown, _fused_dec_backoff
+    import os
+
+    if os.environ.get("MINIO_TPU_FUSED_CM", "1") == "0":
+        return None
+    if _fused_dec_cooldown > 0:
+        _fused_dec_cooldown -= 1
+        return None
+    from . import fused_pallas as fp
+
+    surv = np.asarray(survivors, dtype=np.uint8)
+    b, d, n = surv.shape
+    m = len(missing)
+    bpad = -(-b // 16) * 16
+    if not fp.supports(d, m, bpad, n):
+        return None
+    try:
+        if bpad != b:
+            surv = np.concatenate(
+                [surv, np.zeros((bpad - b, d, n), dtype=np.uint8)], axis=0
+            )
+        rebuilt_cm, digests = fp.fused_decode_hash_cm(
+            fp.pack_chunk_major(surv), d, codec.parity_shards,
+            tuple(present), tuple(missing), key,
+        )
+        rebuilt = fp.unpack_chunk_major(np.asarray(rebuilt_cm))[:b]
+        digs = np.asarray(digests)[:b]
+        _fused_dec_backoff = 8
+        return rebuilt, digs[:, d:, :], digs[:, :d, :]
+    except Exception:  # noqa: BLE001 — lowering/device failure: XLA path
+        _fused_dec_cooldown = _fused_dec_backoff
+        _fused_dec_backoff = min(_fused_dec_backoff * 2, 1024)
+        return None
+
+
 def reconstruct_and_hash(
     codec,
     survivors: jax.Array,
@@ -297,9 +343,17 @@ def reconstruct_and_hash(
     rebuilt shards in separate CPU passes
     (/root/reference/cmd/erasure-decode.go:317 + cmd/bitrot-streaming.go).
 
+    On TPU with mega-kernel-compatible shapes this runs the chunk-major
+    fused decode kernel (ops/fused_pallas.fused_decode_hash_cm); otherwise
+    the XLA bit-plane path below.
+
     survivors: [B, d, n] (shards at indices present[:d]); returns
     (rebuilt [B, m, n], digests [B, m, 32]).
     """
+    fused = _try_fused_decode(codec, survivors, present, missing, key)
+    if fused is not None:
+        rebuilt, rdig, _sdig = fused
+        return rebuilt, rdig
     survivors = jnp.asarray(survivors, dtype=jnp.uint8)
     b, _, n = survivors.shape
     m = len(missing)

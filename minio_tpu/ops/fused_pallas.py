@@ -41,6 +41,7 @@ from .highwayhash import MINIO_KEY
 __all__ = [
     "supports",
     "fused_encode_hash_cm",
+    "fused_decode_hash_cm",
     "pack_chunk_major",
     "unpack_chunk_major",
     "CHUNK_BYTES",
@@ -51,10 +52,15 @@ CHUNK_BYTES = CHUNK * 32    # bytes per shard per chunk (CB)
 
 
 def supports(d: int, p: int, batch: int, n: int) -> bool:
-    """Whether the mega-kernel handles this shape (else use the XLA path)."""
+    """Whether the mega-kernel handles this shape (else use the XLA path).
+
+    Identical gates for encode (p = parity count) and decode (p = missing
+    count): the pipeline is one [128, 128] paired bit-plane matmul plus a
+    hash chain over the d + p resident shards either way.
+    """
     if jax.default_backend() != "tpu":
         return False
-    if d > 8 or p > 8:      # pair-packed W is [2*8p, 2*8d] <= [128, 128]
+    if d > 8 or p > 8 or p < 1:  # pair-packed W is [2*8p, 2*8d] <= [128, 128]
         return False
     if batch < 16 or batch % 16 != 0:   # pairs + 8-row shard groups
         return False
@@ -116,13 +122,22 @@ def _paired_weight(w_encode: np.ndarray, d: int, p: int) -> np.ndarray:
 
 @functools.lru_cache(maxsize=64)
 def _build(d: int, p: int, batch: int, nc: int, key: bytes):
-    """Compiled mega pipeline for one (d, p, B, nc) shape."""
+    """Compiled mega pipeline for one (d, p, B, nc) shape.
+
+    The same kernel serves encode (w3 from the parity matrix, p = parity
+    shards) and decode (w3 from the per-failure-pattern reconstruction
+    matrix, p = missing shards): in both cases d input shards produce p
+    output shards via one paired bit-plane matmul, and all d+p shards are
+    HighwayHashed while VMEM-resident. The [128, 128] paired weight is a
+    RUNTIME input to the compiled pipeline, so the hundreds of possible
+    decode failure patterns share one compilation per shape (and encode/
+    decode share when p == missing count).
+    """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     from . import bitrot_jax as bj
     from .bitrot_jax import _St, _init_state, _update
-    from .rs_jax import get_tpu_codec
 
     t = d + p
     B = batch
@@ -132,8 +147,6 @@ def _build(d: int, p: int, batch: int, nc: int, key: bytes):
     NG = _pick_ng(B // 2, CB)
     PPG = B // 2 // NG
     SUB = _pick_sub(S8)
-    codec = get_tpu_codec(d, p)
-    w3 = _paired_weight(np.asarray(codec.w_encode), d, p)
 
     def kern(w_ref, x_ref, init_ref, pout_ref, dig_ref, st_ref, par_ref):
         c = pl.program_id(0)
@@ -206,7 +219,7 @@ def _build(d: int, p: int, batch: int, nc: int, key: bytes):
     CP = pltpu.CompilerParams(vmem_limit_bytes=110 * 1024 * 1024)
 
     @jax.jit
-    def run(x):
+    def run(x, w3):
         s = _init_state(B * t, key)
         init = jnp.concatenate(
             [jnp.stack(s.v0h), jnp.stack(s.v0l), jnp.stack(s.v1h),
@@ -235,7 +248,7 @@ def _build(d: int, p: int, batch: int, nc: int, key: bytes):
             scratch_shapes=[pltpu.VMEM((32, 8, S8), jnp.uint32),
                             pltpu.VMEM((B, p, CB), jnp.uint8)],
             compiler_params=CP,
-        )(jnp.asarray(w3), x, init)
+        )(w3, x, init)
         rows = [out[i].reshape(B * t) for i in range(32)]
         fields = [[rows[4 * i + j] for j in range(4)] for i in range(8)]
         s2 = _St()
@@ -245,6 +258,26 @@ def _build(d: int, p: int, batch: int, nc: int, key: bytes):
         return parity, dig.reshape(B, t, 32)
 
     return run
+
+
+@functools.lru_cache(maxsize=32)
+def _encode_w3(d: int, p: int) -> np.ndarray:
+    from .rs_jax import get_tpu_codec
+
+    return _paired_weight(np.asarray(get_tpu_codec(d, p).w_encode), d, p)
+
+
+@functools.lru_cache(maxsize=256)
+def _decode_w3(d: int, p: int, present: tuple, missing: tuple) -> np.ndarray:
+    """Paired weight for a failure pattern: rows map the first d present
+    shards onto the missing ones (inverse-matrix rows for missing data,
+    parity-composed rows for missing parity — ops/rs.py
+    reconstruct_rows_for, mirroring klauspost's Reconstruct)."""
+    from .rs import get_codec
+    from .rs_jax import gf_matrix_to_bitplanes
+
+    m = get_codec(d, p).reconstruct_rows_for(list(present), list(missing))
+    return _paired_weight(gf_matrix_to_bitplanes(m), d, len(missing))
 
 
 def fused_encode_hash_cm(
@@ -258,4 +291,30 @@ def fused_encode_hash_cm(
     """
     nc, B, d_, cb = data_cm.shape
     assert d_ == d and cb == CHUNK_BYTES
-    return _build(d, p, B, nc, key)(data_cm)
+    return _build(d, p, B, nc, key)(data_cm, jnp.asarray(_encode_w3(d, p)))
+
+
+def fused_decode_hash_cm(
+    survivors_cm: jax.Array | np.ndarray,
+    d: int,
+    p: int,
+    present: tuple,
+    missing: tuple,
+    key: bytes = MINIO_KEY,
+):
+    """Chunk-major fused reconstruct + hash — the decode mega-kernel
+    (reference: cmd/erasure-decode.go:239-315 reconstructs, then
+    cmd/bitrot-streaming.go hashes in separate CPU passes; here both
+    happen in one dispatch while shards are VMEM-resident).
+
+    survivors_cm: [nc, B, d, CB] u8 — the first d present shards in
+    present[:d] order. Returns (rebuilt_cm [nc, B, m, CB] u8, digests
+    [B, d+m, 32] u8): digests[:, :d] are the survivors' (the verify
+    verdicts — compare against the stored frame digests), digests[:, d:]
+    the rebuilt shards' (ready for heal frames).
+    """
+    nc, B, d_, cb = survivors_cm.shape
+    assert d_ == d and cb == CHUNK_BYTES
+    m = len(missing)
+    w3 = _decode_w3(d, p, tuple(present[:d]), tuple(missing))
+    return _build(d, m, B, nc, key)(survivors_cm, jnp.asarray(w3))

@@ -78,6 +78,35 @@ async def handle_admin(server, request: web.Request, access_key: str, subpath: s
         await server._run(server.tiers.remove, q.get("name", ""))
         return _json({"success": True})
 
+    # -- bucket quota (reference cmd/admin-bucket-handlers.go
+    # SetBucketQuotaConfigHandler; enforced in server/app.py) --------------
+    if op == "set-bucket-quota" and m == "PUT":
+        authz("admin:SetBucketQuota")
+        bucket = q.get("bucket", "")
+        if not bucket or not await server._run(server.store.bucket_exists, bucket):
+            raise s3err.NoSuchBucket
+        try:
+            d = json.loads(body) if body else {}
+            size = int(d.get("quota", d.get("size", 0)) or 0)
+        except (ValueError, TypeError):
+            raise s3err.InvalidArgument from None
+
+        def setq():
+            bm = server.buckets.get(bucket)
+            bm.quota = size
+            server.buckets.set(bucket, bm)
+
+        await server._run(setq)
+        return _json({"success": True})
+    if op == "get-bucket-quota" and m == "GET":
+        authz("admin:GetBucketQuota")
+        bucket = q.get("bucket", "")
+        if not bucket or not await server._run(server.store.bucket_exists, bucket):
+            raise s3err.NoSuchBucket
+        bm = server.buckets.get(bucket)
+        return _json({"quota": bm.quota, "size": bm.quota,
+                      "quotatype": "hard" if bm.quota else ""})
+
     # -- site replication (reference cmd/site-replication.go) --------------
     if op == "site-replication/info" and m == "GET":
         authz("admin:SiteReplicationInfo")

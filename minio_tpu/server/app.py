@@ -1383,11 +1383,54 @@ class S3Server:
             except (ValueError, TypeError):
                 pass
 
+    @staticmethod
+    def _incoming_size(request, body: bytes | None) -> int:
+        """Logical size of an incoming write for quota purposes: buffered
+        body length, else the decoded payload length for aws-chunked
+        streams (the wire Content-Length includes chunk framing), else
+        Content-Length."""
+        if body is not None:
+            return len(body)
+        dec = request.headers.get("x-amz-decoded-content-length")
+        if dec:
+            try:
+                return int(dec)
+            except ValueError:
+                pass
+        try:
+            return int(request.headers.get("Content-Length", "0") or 0)
+        except ValueError:
+            return 0
+
+    def _enforce_quota(self, bucket: str, size: int) -> None:
+        """Hard bucket quota on the write path (reference
+        cmd/bucket-quota.go:103-139 enforceBucketQuotaHard): the incoming
+        size plus the scanner-accounted bucket usage must stay under the
+        configured quota. Usage freshness matches the reference: the data
+        scanner's last crawl."""
+        if size < 0:
+            return
+        q = int(self.buckets.get(bucket).quota or 0)
+        if q <= 0:
+            return
+        if size >= q:
+            raise s3err.AdminBucketQuotaExceeded
+        bg = getattr(self, "background", None)
+        usage = bg.usage.buckets.get(bucket) if bg is not None else None
+        if usage and usage.get("size", 0) > 0 and usage["size"] + size >= q:
+            raise s3err.AdminBucketQuotaExceeded
+
     async def put_object(
         self, request, bucket: str, key: str, body: bytes | None
     ) -> web.Response:
         key = listing.encode_dir_object(key)
         bm = self.buckets.get(bucket)
+        self._enforce_quota(bucket, self._incoming_size(request, body))
+        # overwriting an unversioned transitioned object orphans its warm-
+        # tier data unless swept (reference enforces this via objSweeper)
+        sweep_ud = None if bm.versioning else await self._run(
+            self._tier_sweep_snapshot, bucket, key, ""
+        )
         from . import transforms
 
         ct = request.headers.get("Content-Type")
@@ -1435,6 +1478,7 @@ class S3Server:
                 oi.size, oi.etag, oi.version_id, request.get("access_key", ""),
             )
             self._queue_repl(request, bucket, key, oi.version_id, "put")
+            await self._tier_sweep(sweep_ud)
             return web.Response(status=200, headers=headers)
         # transparent compression + server-side encryption
         req_headers = {k.lower(): v for k, v in request.headers.items()}
@@ -1476,7 +1520,33 @@ class S3Server:
             oi.size, oi.etag, oi.version_id, request.get("access_key", ""),
         )
         self._queue_repl(request, bucket, key, oi.version_id, "put")
+        await self._tier_sweep(sweep_ud)
         return web.Response(status=200, headers=headers)
+
+    def _tier_sweep_snapshot(self, bucket: str, key: str, vid: str) -> dict | None:
+        """Pre-delete/overwrite snapshot of a transitioned version's tier
+        pointers (reference cmd/tier-sweeper.go newObjSweeper +
+        SetTransitionState): returns the metadata needed to sweep the
+        warm tier after the local version goes away, or None."""
+        from ..ilm import tier as tiermod
+
+        if not self.tiers.list():
+            return None  # no tiers configured: nothing to sweep, zero cost
+        try:
+            oi = self.store.get_object_info(bucket, key, vid)
+        except Exception:  # noqa: BLE001 — no prior version
+            return None
+        if getattr(oi, "delete_marker", False) or not tiermod.is_transitioned(
+            oi.user_defined
+        ):
+            return None
+        return dict(oi.user_defined)
+
+    async def _tier_sweep(self, sweep_ud: dict | None) -> None:
+        if sweep_ud:
+            from ..ilm import tier as tiermod
+
+            await self._run(tiermod.sweep_remote, self.tiers, sweep_ud)
 
     def _parse_copy_source(self, request, access_key: str) -> tuple[str, str, str]:
         """Parse x-amz-copy-source and AUTHORIZE the read on it — the
@@ -1508,6 +1578,9 @@ class S3Server:
         oi, it = await self._run(
             self.store.get_object, src_bucket, src_key, src_vid
         )
+        from .transforms import logical_size as _logical
+
+        self._enforce_quota(bucket, _logical(oi.user_defined, oi.size))
         data = b"".join(it)
         req_headers = {k.lower(): v for k, v in request.headers.items()}
         # decode the SOURCE pipeline: sealed keys are bound to the source
@@ -1728,10 +1801,17 @@ class S3Server:
         bm = self.buckets.get(bucket)
         headers = {}
         await self._run(self._check_object_lock, bucket, key, vid)
+        # deleting a version (or the sole unversioned copy) of a
+        # transitioned object must sweep its warm-tier data (tier GC)
+        sweep_ud = None
+        if vid or not bm.versioning:
+            sweep_ud = await self._run(self._tier_sweep_snapshot, bucket, key, vid)
         try:
             oi = await self._run(
                 self.store.delete_object, bucket, key, vid, bm.versioning
             )
+            if not oi.delete_marker:
+                await self._tier_sweep(sweep_ud)
             if oi.delete_marker:
                 headers["x-amz-delete-marker"] = "true"
             if oi.version_id:
@@ -1794,13 +1874,22 @@ class S3Server:
                     self._check_object_lock, bucket,
                     listing.encode_dir_object(k), "" if v == "null" else v,
                 )
+                vv = "" if v == "null" else v
+                sweep_ud = None
+                if vv or not bm.versioning:  # this delete removes data
+                    sweep_ud = await self._run(
+                        self._tier_sweep_snapshot, bucket,
+                        listing.encode_dir_object(k), vv,
+                    )
                 oi = await self._run(
                     self.store.delete_object,
                     bucket,
                     listing.encode_dir_object(k),
-                    "" if v == "null" else v,
+                    vv,
                     bm.versioning,
                 )
+                if not oi.delete_marker:
+                    await self._tier_sweep(sweep_ud)
                 results.append((k, v, None, oi))
             except (quorum.ObjectNotFound, quorum.VersionNotFound):
                 results.append((k, v, None, None))
@@ -1882,6 +1971,7 @@ class S3Server:
         except (KeyError, ValueError):
             raise s3err.InvalidArgument from None
         upload_id = q.get("uploadId", "")
+        self._enforce_quota(bucket, self._incoming_size(request, body))
         try:
             if body is None:
                 # streaming part upload (multipart is how huge objects
@@ -1919,6 +2009,10 @@ class S3Server:
             self.store.open_object, src_bucket, src_key, src_vid
         )
         from . import transforms
+
+        self._enforce_quota(
+            bucket, transforms.logical_size(oi.user_defined, oi.size)
+        )
 
         try:
             # transformed (SSE/compressed) sources must decode to logical

@@ -73,3 +73,6 @@ ServerSideEncryptionConfigurationNotFoundError = APIError("ServerSideEncryptionC
 NoSuchCORSConfiguration = APIError("NoSuchCORSConfiguration", "The CORS configuration does not exist", 404)
 ReplicationConfigurationNotFoundError = APIError("ReplicationConfigurationNotFoundError", "The replication configuration was not found", 404)
 NotificationNotFound = APIError("NoSuchConfiguration", "The specified configuration does not exist.", 404)
+AdminBucketQuotaExceeded = APIError(
+    "XMinioAdminBucketQuotaExceeded", "Bucket quota exceeded", 400
+)

@@ -139,3 +139,90 @@ def test_trace_stream(server, cli):
     rec = json.loads(line)
     assert rec["type"] == "s3" and "method" in rec
     conn.close()
+
+
+def test_fresh_disk_monitor_drain_heals_wiped_drive(tmp_path):
+    """Wipe one drive's entire root; the dedicated monitor re-formats it
+    and drain-heals the whole set onto it without scanner cycles
+    (reference cmd/background-newdisks-heal-ops.go:415,559)."""
+    import shutil
+
+    import numpy as np
+
+    from minio_tpu.erasure.background import BackgroundOps
+    from minio_tpu.erasure.set import ErasureSet
+    from minio_tpu.storage import format_erasure as fe
+    from minio_tpu.storage.xlstorage import SYS_DIR, XLStorage
+
+    roots = [str(tmp_path / f"d{i}") for i in range(4)]
+    disks = [XLStorage(r) for r in roots]
+    _dep, grouped = fe.init_or_load_formats(disks, 4)
+    es = ErasureSet(grouped[0], default_parity=2)
+    es.make_bucket("fresh-bkt")
+    bodies = {}
+    for i in range(5):
+        body = np.random.default_rng(i).integers(
+            0, 256, size=200_000 + i, dtype=np.uint8
+        ).tobytes()
+        es.put_object("fresh-bkt", f"obj-{i}", body)
+        bodies[f"obj-{i}"] = body
+
+    # wipe drive 2 completely (replaced disk), keep its in-memory identity
+    shutil.rmtree(roots[2])
+    os.makedirs(roots[2])
+
+    bg = BackgroundOps(es, scan_interval=0)
+    healed = bg.check_fresh_disks()
+    assert healed == 1
+    # tracker removed once the drain completed
+    import pytest as _pytest
+
+    from minio_tpu.storage import errors as serr
+
+    with _pytest.raises((serr.FileNotFound, serr.VolumeNotFound)):
+        grouped[0][2].read_file(SYS_DIR, bg.HEALING_TRACKER)
+    # format restored with the same drive uuid
+    fmt = fe.read_format(disks[2])
+    assert fmt.this == disks[2].disk_id
+    # every object's shard is back on the wiped drive
+    for name, body in bodies.items():
+        fi, metas, _, _ = es._quorum_fileinfo("fresh-bkt", name, "", read_data=True)
+        src = es._shard_sources(fi, metas)
+        assert len(src) == 4, f"{name}: {sorted(src)}"
+        _, it = es.get_object("fresh-bkt", name)
+        assert b"".join(bytes(c) for c in it) == body
+    # a second pass is a no-op
+    assert bg.check_fresh_disks() == 0
+
+
+def test_fresh_disk_monitor_resumes_interrupted_drain(tmp_path):
+    """An interrupted drain (tracker left on the drive) resumes on the
+    next monitor pass and completes."""
+    import json as _json
+    import shutil
+
+    from minio_tpu.erasure.background import BackgroundOps
+    from minio_tpu.erasure.set import ErasureSet
+    from minio_tpu.storage import format_erasure as fe
+    from minio_tpu.storage.xlstorage import SYS_DIR, XLStorage
+
+    roots = [str(tmp_path / f"d{i}") for i in range(4)]
+    disks = [XLStorage(r) for r in roots]
+    _dep, grouped = fe.init_or_load_formats(disks, 4)
+    es = ErasureSet(grouped[0], default_parity=2)
+    for b in ("bkt-a", "bkt-b"):
+        es.make_bucket(b)
+        es.put_object(b, "k", b"v" * 50_000)
+
+    # simulate: drive wiped, format restored, tracker says bkt-a done
+    shutil.rmtree(f"{roots[1]}/bkt-a")
+    shutil.rmtree(f"{roots[1]}/bkt-b")
+    disks[1].create_file(
+        SYS_DIR, BackgroundOps.HEALING_TRACKER,
+        _json.dumps({"buckets_done": []}).encode(),
+    )
+    bg = BackgroundOps(es, scan_interval=0)
+    assert bg.check_fresh_disks() == 1
+    for b in ("bkt-a", "bkt-b"):
+        fi, metas, _, _ = es._quorum_fileinfo(b, "k", "", read_data=True)
+        assert len(es._shard_sources(fi, metas)) == 4

@@ -54,3 +54,81 @@ def test_fused_mega_matches_reference():
         ref.encode(shards)
         assert (shards[d:] == parity[b]).all(), f"parity b={b}"
         assert (hash256_batch_numpy(shards) == np.asarray(digests)[b]).all(), f"digest b={b}"
+
+
+@pytest.mark.tpu
+@pytest.mark.skipif(
+    __import__("jax").default_backend() != "tpu",
+    reason="Mosaic mega-kernel needs a TPU backend",
+)
+def test_fused_decode_matches_reference():
+    """Decode mega-kernel golden test: rebuilt shards byte-identical to the
+    numpy codec's reconstruction, survivor digests usable as verify
+    verdicts, rebuilt digests match numpy HighwayHash."""
+    import jax
+
+    from minio_tpu.ops.highwayhash import hash256_batch_numpy
+    from minio_tpu.ops.rs import get_codec
+
+    d, p, B = 4, 2, 16
+    n = 2 * fp.CHUNK_BYTES
+    rng = np.random.default_rng(7)
+    blocks = rng.integers(0, 256, size=(B, d, n), dtype=np.uint8)
+    ref = get_codec(d, p)
+    # full encoded shards per block
+    full = []
+    for b in range(B):
+        shards = ref.split(blocks[b].tobytes())
+        ref.encode(shards)
+        full.append(shards)
+    # lose data shard 1 and parity shard 4 -> survivors 0,2,3,5
+    present, missing = (0, 2, 3, 5), (1, 4)
+    surv = np.stack([np.stack([full[b][i] for i in present]) for b in range(B)])
+    rebuilt_cm, digests = fp.fused_decode_hash_cm(
+        jax.device_put(fp.pack_chunk_major(surv)), d, p, present, missing
+    )
+    rebuilt = fp.unpack_chunk_major(np.asarray(rebuilt_cm))
+    digs = np.asarray(digests)
+    for b in range(B):
+        for mi, idx in enumerate(missing):
+            assert (rebuilt[b, mi] == full[b][idx]).all(), f"rebuilt b={b} idx={idx}"
+        want = hash256_batch_numpy(np.stack([full[b][i] for i in present]))
+        assert (digs[b, :d] == want).all(), f"survivor digests b={b}"
+        want_m = hash256_batch_numpy(np.stack([full[b][i] for i in missing]))
+        assert (digs[b, d:] == want_m).all(), f"rebuilt digests b={b}"
+
+
+@pytest.mark.tpu
+@pytest.mark.skipif(
+    __import__("jax").default_backend() != "tpu",
+    reason="Mosaic mega-kernel needs a TPU backend",
+)
+def test_reconstruct_and_hash_uses_fused_path():
+    """reconstruct_and_hash rides the decode mega-kernel on TPU (pad-to-16)
+    and stays byte-identical with the numpy reconstruction."""
+    from minio_tpu.ops.bitrot_jax import reconstruct_and_hash
+    from minio_tpu.ops.highwayhash import hash256_batch_numpy
+    from minio_tpu.ops.rs import get_codec
+    from minio_tpu.ops.rs_jax import get_tpu_codec
+
+    d, p, B = 8, 8, 5  # B=5 exercises zero-padding to 16
+    n = fp.CHUNK_BYTES
+    rng = np.random.default_rng(9)
+    blocks = rng.integers(0, 256, size=(B, d, n), dtype=np.uint8)
+    ref = get_codec(d, p)
+    full = []
+    for b in range(B):
+        shards = ref.split(blocks[b].tobytes())
+        ref.encode(shards)
+        full.append(shards)
+    present = (0, 1, 3, 4, 5, 8, 9, 15)
+    missing = (2, 6)
+    surv = np.stack([np.stack([full[b][i] for i in present]) for b in range(B)])
+    rebuilt, digs = reconstruct_and_hash(get_tpu_codec(d, p), surv, present, missing)
+    rebuilt = np.asarray(rebuilt)
+    digs = np.asarray(digs)
+    for b in range(B):
+        for mi, idx in enumerate(missing):
+            assert (rebuilt[b, mi] == full[b][idx]).all()
+        want = hash256_batch_numpy(np.stack([full[b][i] for i in missing]))
+        assert (digs[b] == want).all()
