@@ -131,7 +131,7 @@ class BackgroundOps:
     # (cmd/background-newdisks-heal-ops.go:415 healFreshDisk, :559
     # monitorLocalDisksAndHeal) instead of waiting for scanner cycles.
 
-    HEALING_TRACKER = "healing.json"
+    from ..storage.format_erasure import HEALING_TRACKER  # shared with boot heal
 
     def _iter_sets(self):
         for p in getattr(self.store, "pools", [self.store]):
@@ -231,12 +231,14 @@ class BackgroundOps:
             my_uuid = getattr(disk, "disk_id", "")
             if ref is None or not my_uuid:
                 return False
-            fmt = fe.FormatErasure(id=ref.id, this=my_uuid, sets=ref.sets)
-            disk.create_file(SYS_DIR, fe.FORMAT_FILE, fmt.to_json())
+            # tracker BEFORE format: a crash in between must leave the
+            # drive detectable on the next pass
             disk.create_file(
                 SYS_DIR, self.HEALING_TRACKER,
                 json.dumps({"started": time.time(), "buckets_done": []}).encode(),
             )
+            fmt = fe.FormatErasure(id=ref.id, this=my_uuid, sets=ref.sets)
+            disk.create_file(SYS_DIR, fe.FORMAT_FILE, fmt.to_json())
             return True
         # format intact: resume an interrupted drain if a tracker remains
         try:
@@ -279,7 +281,14 @@ class BackgroundOps:
                 if self._stop.is_set():
                     return
                 try:
-                    es.heal_object(bname, obj)
+                    # heal EVERY version: the latest alone would leave
+                    # older versions one shard short on this drive
+                    versions = es.list_object_versions(bname, obj)
+                    for v in versions or [None]:
+                        es.heal_object(
+                            bname, obj,
+                            getattr(v, "version_id", "") or "",
+                        )
                     self.stats["heals_done"] = self.stats.get("heals_done", 0) + 1
                 except Exception:  # noqa: BLE001
                     self.stats["heals_failed"] = (

@@ -1,0 +1,178 @@
+"""Kafka event sink: a minimal produce-only client on the raw protocol.
+
+The reference's target (internal/event/target/kafka.go:176) uses sarama;
+this speaks the modern wire format directly: Produce v3 requests carrying
+a v2 record batch (varint records, CRC32C over the batch body) with
+acks=1, so any Kafka >= 0.11 broker accepts it — including 4.x brokers
+that dropped the legacy message formats.
+
+Scope: events go to partition 0 of the configured topic on the configured
+broker (single-broker deployments; no metadata-driven leader discovery —
+a multi-broker cluster where partition 0's leader is elsewhere will
+reject with NOT_LEADER, surfaced as an error into the notifier's retry
+queue).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+import time
+
+from .notify import Target
+
+# ---- CRC32C (Castagnoli), table-driven ------------------------------------
+
+_CRC32C_TABLE = []
+
+
+def _crc32c_init() -> None:
+    if _CRC32C_TABLE:
+        return
+    poly = 0x82F63B78
+    for i in range(256):
+        c = i
+        for _ in range(8):
+            c = (c >> 1) ^ (poly if c & 1 else 0)
+        _CRC32C_TABLE.append(c)
+
+
+def crc32c(data: bytes) -> int:
+    _crc32c_init()
+    c = 0xFFFFFFFF
+    for b in data:
+        c = _CRC32C_TABLE[(c ^ b) & 0xFF] ^ (c >> 8)
+    return c ^ 0xFFFFFFFF
+
+
+# ---- varints (zigzag, protobuf-style) --------------------------------------
+
+
+def _zigzag(n: int) -> int:
+    return (n << 1) ^ (n >> 63)
+
+
+def varint(n: int) -> bytes:
+    u = _zigzag(n) & 0xFFFFFFFFFFFFFFFF
+    out = bytearray()
+    while True:
+        b = u & 0x7F
+        u >>= 7
+        out.append(b | (0x80 if u else 0))
+        if not u:
+            return bytes(out)
+
+
+def record_batch(value: bytes, timestamp_ms: int) -> bytes:
+    """One v2 record batch holding a single record (null key, no headers)."""
+    rec_body = (
+        b"\x00"                      # attributes
+        + varint(0)                  # timestamp delta
+        + varint(0)                  # offset delta
+        + varint(-1)                 # key length (null)
+        + varint(len(value)) + value
+        + varint(0)                  # headers count
+    )
+    record = varint(len(rec_body)) + rec_body
+    # batch body from `attributes` onward is CRC'd
+    body = (
+        struct.pack(">hiqqqhii", 0, 0, timestamp_ms, timestamp_ms,
+                    -1, -1, -1, 1)   # attrs, lastOffsetDelta, firstTs, maxTs,
+                                     # producerId, producerEpoch, baseSeq, count
+        + record
+    )
+    head = (
+        struct.pack(">q", 0)                       # baseOffset
+        + struct.pack(">i", len(body) + 4 + 1 + 4)  # batchLength (from PLE on)
+        + struct.pack(">i", -1)                    # partitionLeaderEpoch
+        + b"\x02"                                  # magic = 2
+        + struct.pack(">I", crc32c(body))
+    )
+    return head + body
+
+
+def _kstr(s: str) -> bytes:
+    b = s.encode()
+    return struct.pack(">h", len(b)) + b
+
+
+class KafkaTarget(Target):
+    """Produce v3 / acks=1 to partition 0 of one topic."""
+
+    def __init__(self, ident: str, broker: str, topic: str):
+        host, _, port = broker.partition(":")
+        self.host, self.port = host, int(port or 9092)
+        self.arn = f"arn:minio:sqs::{ident}:kafka"
+        self.topic = topic
+        self._sock: socket.socket | None = None
+        self._corr = 0
+        self._mu = threading.Lock()
+
+    def _connect(self) -> socket.socket:
+        s = socket.create_connection((self.host, self.port), timeout=5)
+        s.settimeout(5)
+        return s
+
+    def _produce(self, s: socket.socket, value: bytes) -> None:
+        self._corr += 1
+        batch = record_batch(value, int(time.time() * 1000))
+        partition_data = struct.pack(">i", 0) + struct.pack(">i", len(batch)) + batch
+        topic_data = _kstr(self.topic) + struct.pack(">i", 1) + partition_data
+        body = (
+            struct.pack(">h", -1)        # transactional_id = null
+            + struct.pack(">h", 1)       # acks = 1
+            + struct.pack(">i", 10000)   # timeout ms
+            + struct.pack(">i", 1)       # 1 topic
+            + topic_data
+        )
+        header = (
+            struct.pack(">hhi", 0, 3, self._corr)  # Produce, v3, correlation
+            + _kstr("minio-tpu")
+        )
+        msg = header + body
+        s.sendall(struct.pack(">i", len(msg)) + msg)
+        # response: size, correlation, [topics: name, [part, err(2), offset(8),
+        # logAppendTime(8)]], throttle
+        size = struct.unpack(">i", self._recv(s, 4))[0]
+        resp = self._recv(s, size)
+        corr = struct.unpack(">i", resp[:4])[0]
+        if corr != self._corr:
+            raise OSError(f"kafka correlation mismatch {corr} != {self._corr}")
+        off = 4 + 4  # correlation + topic array count
+        tlen = struct.unpack(">h", resp[off:off + 2])[0]
+        off += 2 + tlen + 4 + 4  # topic name + partition array count + index
+        err = struct.unpack(">h", resp[off:off + 2])[0]
+        if err != 0:
+            raise OSError(f"kafka produce error code {err}")
+
+    @staticmethod
+    def _recv(s: socket.socket, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = s.recv(n - len(buf))
+            if not chunk:
+                raise OSError("kafka connection closed")
+            buf += chunk
+        return buf
+
+    def send(self, record: dict) -> None:
+        payload = json.dumps(
+            {"EventName": record["eventName"],
+             "Key": f"{record['s3']['bucket']['name']}/{record['s3']['object']['key']}",
+             "Records": [record]}
+        ).encode()
+        with self._mu:
+            try:
+                if self._sock is None:
+                    self._sock = self._connect()
+                self._produce(self._sock, payload)
+            except Exception:
+                try:
+                    if self._sock is not None:
+                        self._sock.close()
+                finally:
+                    self._sock = None
+                self._sock = self._connect()
+                self._produce(self._sock, payload)

@@ -136,22 +136,32 @@ def journal_add(store, tier_name: str, remote_key: str) -> None:
 
 
 def retry_journal(tiers: "TierRegistry") -> int:
-    """Retry journaled sweeps (scanner-driven). Returns entries remaining."""
+    """Retry journaled sweeps (scanner-driven). Returns entries remaining.
+
+    The journal lock is NOT held across the remote deletes — a down tier
+    endpoint means minutes of cumulative timeouts, and journal_add sits on
+    the client write path."""
     with _journal_mu:
         entries = _journal_load(tiers.store)
-        if not entries:
-            return 0
-        left = []
-        for e in entries:
-            t = tiers.get(e.get("tier", ""))
-            if t is None:
-                continue  # tier deconfigured: nothing to sweep anymore
-            try:
-                r = t.client().delete_object(t.bucket, e["key"])
-                if r.status not in (200, 204, 404):
-                    raise OSError(f"tier delete status {r.status}")
-            except Exception:  # noqa: BLE001 — keep for the next cycle
-                left.append(e)
+    if not entries:
+        return 0
+    resolved = []  # entries to drop: swept, or tier deconfigured
+    for e in entries:
+        t = tiers.get(e.get("tier", ""))
+        if t is None:
+            resolved.append(e)  # tier gone: nothing to sweep anymore
+            continue
+        try:
+            r = t.client().delete_object(t.bucket, e["key"])
+            if r.status not in (200, 204, 404):
+                raise OSError(f"tier delete status {r.status}")
+            resolved.append(e)
+        except Exception:  # noqa: BLE001 — keep for the next cycle
+            pass
+    with _journal_mu:
+        # re-read: new failures may have been journaled while we swept
+        current = _journal_load(tiers.store)
+        left = [e for e in current if e not in resolved]
         _journal_save(tiers.store, left)
         return len(left)
 

@@ -103,7 +103,7 @@ async def handle_admin(server, request: web.Request, access_key: str, subpath: s
         bucket = q.get("bucket", "")
         if not bucket or not await server._run(server.store.bucket_exists, bucket):
             raise s3err.NoSuchBucket
-        bm = server.buckets.get(bucket)
+        bm = await server._run(server.buckets.get, bucket)
         return _json({"quota": bm.quota, "size": bm.quota,
                       "quotatype": "hard" if bm.quota else ""})
 

@@ -1527,13 +1527,27 @@ class S3Server:
         """Pre-delete/overwrite snapshot of a transitioned version's tier
         pointers (reference cmd/tier-sweeper.go newObjSweeper +
         SetTransitionState): returns the metadata needed to sweep the
-        warm tier after the local version goes away, or None."""
+        warm tier after the local version goes away, or None.
+
+        vid == "" means the NULL version (what an unversioned/suspended
+        write or delete actually replaces) — NOT the latest: on a
+        versioning-suspended bucket the latest may be a surviving named
+        version whose warm data must not be swept."""
         from ..ilm import tier as tiermod
 
         if not self.tiers.list():
             return None  # no tiers configured: nothing to sweep, zero cost
         try:
-            oi = self.store.get_object_info(bucket, key, vid)
+            if vid:
+                oi = self.store.get_object_info(bucket, key, vid)
+            else:
+                oi = next(
+                    (v for v in self.store.list_object_versions(bucket, key)
+                     if not v.version_id),
+                    None,
+                )
+                if oi is None:
+                    return None  # no null version to replace
         except Exception:  # noqa: BLE001 — no prior version
             return None
         if getattr(oi, "delete_marker", False) or not tiermod.is_transitioned(
@@ -1543,10 +1557,16 @@ class S3Server:
         return dict(oi.user_defined)
 
     async def _tier_sweep(self, sweep_ud: dict | None) -> None:
+        """Fire-and-forget: the remote delete (5s timeouts when the tier is
+        down) must not hold up the S3 response; failures land in the
+        persisted journal the scanner retries (the reference routes all
+        sweeps through its async tier journal for the same reason)."""
         if sweep_ud:
             from ..ilm import tier as tiermod
 
-            await self._run(tiermod.sweep_remote, self.tiers, sweep_ud)
+            asyncio.get_running_loop().run_in_executor(
+                self._io_pool, tiermod.sweep_remote, self.tiers, sweep_ud
+            )
 
     def _parse_copy_source(self, request, access_key: str) -> tuple[str, str, str]:
         """Parse x-amz-copy-source and AUTHORIZE the read on it — the

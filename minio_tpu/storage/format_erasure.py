@@ -18,6 +18,10 @@ from .xlstorage import SYS_DIR, XLStorage
 
 FORMAT_FILE = "format.json"
 DISTRIBUTION_ALGO = "SIPMOD+PARITY"  # reference formatErasureVersionV3DistributionAlgoV3
+# marker left on a freshly-formatted replacement drive so the fresh-disk
+# monitor (erasure/background.py) drain-heals it; removed when the drain
+# completes (reference healingTracker, cmd/background-newdisks-heal-ops.go)
+HEALING_TRACKER = "healing.json"
 
 
 @dataclass
@@ -162,6 +166,18 @@ def init_or_load_formats(
                 row.append(by_uuid[u])
             elif fresh:
                 disk = fresh.pop(0)
+                # tracker FIRST: a crash between the two writes must leave
+                # the drive detectable (format-without-tracker would look
+                # healthy forever while holding no data)
+                import json as _json
+                import time as _time
+
+                disk.create_file(
+                    SYS_DIR, HEALING_TRACKER,
+                    _json.dumps(
+                        {"started": _time.time(), "buckets_done": []}
+                    ).encode(),
+                )
                 fmt = FormatErasure(id=ref.id, this=u, sets=ref.sets)
                 write_format(disk, fmt)
                 disk.disk_id = u

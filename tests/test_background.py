@@ -226,3 +226,35 @@ def test_fresh_disk_monitor_resumes_interrupted_drain(tmp_path):
     for b in ("bkt-a", "bkt-b"):
         fi, metas, _, _ = es._quorum_fileinfo(b, "k", "", read_data=True)
         assert len(es._shard_sources(fi, metas)) == 4
+
+
+def test_fresh_disk_replaced_while_down_heals_at_boot(tmp_path):
+    """A drive swapped while the server was down: boot-time format healing
+    leaves a healing tracker, so the monitor drains onto it without any
+    runtime wipe detection."""
+    import shutil
+
+    from minio_tpu.erasure.background import BackgroundOps
+    from minio_tpu.erasure.set import ErasureSet
+    from minio_tpu.storage import format_erasure as fe
+    from minio_tpu.storage.xlstorage import SYS_DIR, XLStorage
+
+    roots = [str(tmp_path / f"d{i}") for i in range(4)]
+    disks = [XLStorage(r) for r in roots]
+    _dep, grouped = fe.init_or_load_formats(disks, 4)
+    es = ErasureSet(grouped[0], default_parity=2)
+    es.make_bucket("boot-bkt")
+    es.put_object("boot-bkt", "k", b"z" * 120_000)
+
+    # "server stops"; drive 3 replaced with a blank one; "server boots"
+    shutil.rmtree(roots[3])
+    os.makedirs(roots[3])
+    disks2 = [XLStorage(r) for r in roots]
+    _dep2, grouped2 = fe.init_or_load_formats(disks2, 4)
+    es2 = ErasureSet(grouped2[0], default_parity=2)
+    # boot healing must have left the tracker on the fresh drive
+    assert disks2[3].read_file(SYS_DIR, fe.HEALING_TRACKER)
+    bg = BackgroundOps(es2, scan_interval=0)
+    assert bg.check_fresh_disks() == 1
+    fi, metas, _, _ = es2._quorum_fileinfo("boot-bkt", "k", "", read_data=True)
+    assert len(es2._shard_sources(fi, metas)) == 4

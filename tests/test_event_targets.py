@@ -126,3 +126,248 @@ def test_env_discovery():
         "arn:minio:sqs::b:redis",
         "arn:minio:sqs::c:mqtt",
     ]
+
+
+# ---- PostgreSQL / MySQL / Kafka sinks (round 3, VERDICT #7) ---------------
+
+
+def test_postgres_target_md5_auth():
+    """Fake pg server: md5 auth challenge, CREATE TABLE + INSERT queries
+    arrive with properly escaped payload (internal/event/target/
+    postgresql.go behavior)."""
+    import hashlib
+    import struct
+
+    from minio_tpu.events.dbsinks import PostgresTarget
+
+    def handler(conn, got):
+        # startup message
+        ln = struct.unpack(">I", conn.recv(4))[0]
+        startup = conn.recv(ln - 4)
+        assert b"user\x00eventwriter\x00" in startup
+        # md5 challenge
+        conn.sendall(b"R" + struct.pack(">II", 12, 5) + b"SALT")
+        # password response
+        t = conn.recv(1)
+        assert t == b"p"
+        ln = struct.unpack(">I", conn.recv(4))[0]
+        got_pw = conn.recv(ln - 4).rstrip(b"\x00")
+        inner = hashlib.md5(b"sekret" + b"eventwriter").hexdigest().encode()
+        want = b"md5" + hashlib.md5(inner + b"SALT").hexdigest().encode()
+        assert got_pw == want, (got_pw, want)
+        conn.sendall(b"R" + struct.pack(">II", 8, 0))  # AuthenticationOk
+        conn.sendall(b"Z" + struct.pack(">I", 5) + b"I")  # ReadyForQuery
+        for _ in range(2):  # CREATE TABLE, INSERT
+            t = conn.recv(1)
+            assert t == b"Q"
+            ln = struct.unpack(">I", conn.recv(4))[0]
+            sql = b""
+            while len(sql) < ln - 4:
+                sql += conn.recv(ln - 4 - len(sql))
+            got.append(sql)
+            conn.sendall(b"C" + struct.pack(">I", 8) + b"OK\x00\x00")
+            conn.sendall(b"Z" + struct.pack(">I", 5) + b"I")
+
+    srv, got, done = _serve(handler)
+    t = PostgresTarget("t1", "127.0.0.1", srv.getsockname()[1],
+                       "eventwriter", "sekret", "events", "minio_events")
+    t.send(RECORD)
+    assert done.wait(5)
+    assert b"CREATE TABLE IF NOT EXISTS minio_events" in got[0]
+    assert b"INSERT INTO minio_events" in got[1]
+    assert b"s3:ObjectCreated:Put" in got[1]
+
+
+def test_mysql_target_native_auth():
+    """Fake mysql server: HandshakeV10 with native-password auth; table
+    create + insert queries arrive (internal/event/target/mysql.go)."""
+    import hashlib
+    import struct
+
+    from minio_tpu.events.dbsinks import MySQLTarget
+
+    salt = b"ABCDEFGH12345678IJKL"  # 20 bytes
+
+    def _packet(seq, body):
+        ln = len(body)
+        return bytes((ln & 0xFF, (ln >> 8) & 0xFF, (ln >> 16) & 0xFF, seq)) + body
+
+    def read_packet(conn):
+        head = b""
+        while len(head) < 4:
+            head += conn.recv(4 - len(head))
+        ln = head[0] | (head[1] << 8) | (head[2] << 16)
+        body = b""
+        while len(body) < ln:
+            body += conn.recv(ln - len(body))
+        return body
+
+    def handler(conn, got):
+        greet = (
+            b"\x0a" + b"8.0.0-fake\x00"
+            + struct.pack("<I", 99)       # thread id
+            + salt[:8] + b"\x00"
+            + struct.pack("<H", 0xFFFF)   # cap low
+            + b"\x2d"                     # charset
+            + struct.pack("<H", 2)        # status
+            + struct.pack("<H", 0xFFFF)   # cap high
+            + bytes((21,)) + b"\x00" * 10
+            + salt[8:] + b"\x00"
+        )
+        conn.sendall(_packet(0, greet))
+        resp = read_packet(conn)
+        # verify native auth: SHA1(pass) XOR SHA1(salt + SHA1(SHA1(pass)))
+        p1 = hashlib.sha1(b"mypass").digest()
+        want = bytes(a ^ b for a, b in zip(
+            p1, hashlib.sha1(salt + hashlib.sha1(p1).digest()).digest()))
+        assert want in resp, "auth token missing/incorrect"
+        assert b"eventuser\x00" in resp
+        conn.sendall(_packet(2, b"\x00\x00\x00\x02\x00\x00\x00"))  # OK
+        for _ in range(2):
+            q = read_packet(conn)
+            assert q[:1] == b"\x03"
+            got.append(q[1:])
+            conn.sendall(_packet(1, b"\x00\x00\x00\x02\x00\x00\x00"))
+
+    srv, got, done = _serve(handler)
+    t = MySQLTarget("t1", "127.0.0.1", srv.getsockname()[1],
+                    "eventuser", "mypass", "events", "minio_events")
+    t.send(RECORD)
+    assert done.wait(5)
+    assert b"CREATE TABLE IF NOT EXISTS minio_events" in got[0]
+    assert b"INSERT INTO minio_events" in got[1]
+
+
+def test_kafka_target_produce_v3():
+    """Fake broker: parse the Produce v3 request, validate the record
+    batch CRC32C, and extract the event payload from the v2 record."""
+    import struct
+
+    from minio_tpu.events.kafka import KafkaTarget, crc32c
+
+    def handler(conn, got):
+        size = struct.unpack(">i", conn.recv(4))[0]
+        req = b""
+        while len(req) < size:
+            req += conn.recv(size - len(req))
+        api, ver, corr = struct.unpack(">hhi", req[:8])
+        assert (api, ver) == (0, 3)
+        off = 8
+        cl = struct.unpack(">h", req[off:off + 2])[0]
+        off += 2 + cl          # client id
+        off += 2               # transactional id (null)
+        acks, timeout, ntopics = struct.unpack(">hii", req[off:off + 10])
+        assert acks == 1 and ntopics == 1
+        off += 10
+        tl = struct.unpack(">h", req[off:off + 2])[0]
+        topic = req[off + 2:off + 2 + tl].decode()
+        off += 2 + tl
+        nparts = struct.unpack(">i", req[off:off + 4])[0]
+        assert nparts == 1
+        off += 4
+        part, setsize = struct.unpack(">ii", req[off:off + 8])
+        off += 8
+        batch = req[off:off + setsize]
+        # crc32c over the batch from `attributes` (offset 21) to end
+        crc = struct.unpack(">I", batch[17:21])[0]
+        assert crc == crc32c(batch[21:]), "record batch CRC mismatch"
+        assert batch[16] == 2  # magic v2
+        got.append((topic, part, batch))
+        resp = (
+            struct.pack(">i", corr)
+            + struct.pack(">i", 1)           # topics
+            + struct.pack(">h", tl) + topic.encode()
+            + struct.pack(">i", 1)           # partitions
+            + struct.pack(">i", 0)           # index
+            + struct.pack(">h", 0)           # error code
+            + struct.pack(">q", 0)           # base offset
+            + struct.pack(">q", -1)          # log append time
+            + struct.pack(">i", 0)           # throttle
+        )
+        conn.sendall(struct.pack(">i", len(resp)) + resp)
+
+    srv, got, done = _serve(handler)
+    t = KafkaTarget("t1", f"127.0.0.1:{srv.getsockname()[1]}", "bucket-events")
+    t.send(RECORD)
+    assert done.wait(5)
+    topic, part, batch = got[0]
+    assert topic == "bucket-events" and part == 0
+    assert b"s3:ObjectCreated:Put" in batch  # record value carries the event
+
+
+def test_db_and_kafka_env_registration():
+    env = {
+        "MINIO_NOTIFY_POSTGRES_ENABLE_PG1": "on",
+        "MINIO_NOTIFY_POSTGRES_CONNECTION_STRING_PG1":
+            "host=10.0.0.5 port=5433 user=mn password=pw dbname=evts",
+        "MINIO_NOTIFY_MYSQL_ENABLE_MY1": "on",
+        "MINIO_NOTIFY_MYSQL_DSN_STRING_MY1": "root:secret@tcp(db.local:3307)/events",
+        "MINIO_NOTIFY_KAFKA_ENABLE_K1": "on",
+        "MINIO_NOTIFY_KAFKA_BROKERS_K1": "broker1:9092,broker2:9092",
+        "MINIO_NOTIFY_KAFKA_TOPIC_K1": "tp",
+    }
+    out = socket_targets_from_env(env)
+    assert "arn:minio:sqs::pg1:postgresql" in out
+    assert "arn:minio:sqs::my1:mysql" in out
+    assert "arn:minio:sqs::k1:kafka" in out
+    pg = out["arn:minio:sqs::pg1:postgresql"]
+    assert (pg.host, pg.port, pg.user, pg.database) == ("10.0.0.5", 5433, "mn", "evts")
+    my = out["arn:minio:sqs::my1:mysql"]
+    assert (my.host, my.port, my.user, my.password, my.database) == (
+        "db.local", 3307, "root", "secret", "events")
+    kf = out["arn:minio:sqs::k1:kafka"]
+    assert (kf.host, kf.port, kf.topic) == ("broker1", 9092, "tp")
+
+
+def test_nsq_target():
+    """Fake nsqd: magic + PUB frame with size-prefixed body
+    (internal/event/target/nsq.go)."""
+    def handler(conn, got):
+        assert conn.recv(4) == b"  V2"
+        f = conn.makefile("rb")
+        line = f.readline()
+        assert line == b"PUB tasks.events\n", line
+        n = int.from_bytes(f.read(4), "big")
+        got.append(f.read(n))
+        conn.sendall((6).to_bytes(4, "big") + (0).to_bytes(4, "big") + b"OK")
+
+    from minio_tpu.events.targets import NSQTarget
+
+    srv, got, done = _serve(handler)
+    t = NSQTarget("n1", f"127.0.0.1:{srv.getsockname()[1]}", "tasks.events")
+    t.send(RECORD)
+    assert done.wait(5)
+    assert b"s3:ObjectCreated:Put" in got[0]
+
+
+def test_elasticsearch_target():
+    """Fake ES: HTTP POST /index/_doc with the event document
+    (internal/event/target/elasticsearch.go)."""
+    import http.server
+
+    got = []
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", "0"))
+            got.append((self.path, self.rfile.read(n)))
+            self.send_response(201)
+            self.send_header("Content-Length", "2")
+            self.end_headers()
+            self.wfile.write(b"{}")
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.HTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.handle_request, daemon=True).start()
+
+    from minio_tpu.events.targets import ElasticsearchTarget
+
+    t = ElasticsearchTarget(
+        "e1", f"http://127.0.0.1:{srv.server_port}", "minio-idx"
+    )
+    t.send(RECORD)
+    path, body = got[0]
+    assert path == "/minio-idx/_doc"
+    assert b"s3:ObjectCreated:Put" in body

@@ -148,7 +148,11 @@ def test_tier_gc_on_delete(rig):
     listed = cw.list_objects_v2("tier-data", prefix="hot1/gcdelete/")
     assert b"<Key>" in listed.body  # transitioned
     assert ch.delete_object("gcdelete", "x/y.bin").status == 204
-    listed = cw.list_objects_v2("tier-data", prefix="hot1/gcdelete/")
+    for _ in range(40):  # sweep is fire-and-forget off the response path
+        listed = cw.list_objects_v2("tier-data", prefix="hot1/gcdelete/")
+        if b"<Key>" not in listed.body:
+            break
+        time.sleep(0.25)
     assert b"<Key>" not in listed.body, listed.body  # swept
 
 
@@ -167,7 +171,11 @@ def test_tier_gc_on_overwrite(rig):
     # remove the lifecycle so the overwrite stays local, then overwrite
     assert ch.request("DELETE", "/gcover", query={"lifecycle": ""}).status in (200, 204)
     assert ch.put_object("gcover", "o.bin", b"fresh bytes").status == 200
-    listed = cw.list_objects_v2("tier-data", prefix="hot1/gcover/")
+    for _ in range(40):
+        listed = cw.list_objects_v2("tier-data", prefix="hot1/gcover/")
+        if b"<Key>" not in listed.body:
+            break
+        time.sleep(0.25)
     assert b"<Key>" not in listed.body, listed.body
     g = ch.get_object("gcover", "o.bin")
     assert g.status == 200 and g.body == b"fresh bytes"
