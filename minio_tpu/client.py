@@ -56,7 +56,12 @@ class S3Client:
         qs = urllib.parse.urlencode(query or {})
         enc_path = urllib.parse.quote(path, safe="/~-._")
         url = f"http://{self.host}:{self.port}{enc_path}" + (f"?{qs}" if qs else "")
-        payload = UNSIGNED_PAYLOAD if unsigned_payload else body
+        hdrs_lower = {k.lower(): v for k, v in (headers or {}).items()}
+        # an explicit content-sha256 (e.g. STREAMING-UNSIGNED-PAYLOAD-TRAILER)
+        # is the payload hash to sign with, not something to clobber
+        payload = hdrs_lower.get("x-amz-content-sha256") or (
+            UNSIGNED_PAYLOAD if unsigned_payload else body
+        )
         signed = sign_request(
             method, url, headers or {}, payload, self.access_key, self.secret_key, self.region
         )

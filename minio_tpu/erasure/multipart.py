@@ -94,14 +94,15 @@ class MultipartManager:
             raise UploadNotFound(upload_id) from None
 
     def put_part(
-        self, bucket: str, obj: str, upload_id: str, part_number: int, data: bytes
+        self, bucket: str, obj: str, upload_id: str, part_number: int, data: bytes,
+        extra_meta: dict[str, str] | None = None,
     ) -> str:
         if not 1 <= part_number <= 10000:
             raise InvalidPart(f"part number {part_number}")
         up = self._upload_meta(bucket, obj, upload_id)
         dist = [int(x) for x in up.user_defined["__distribution"].split(",")]
         parity = int(up.user_defined.get("__parity", self.es.default_parity))
-        part_meta: dict[str, str] | None = None
+        part_meta: dict[str, str] | None = dict(extra_meta) if extra_meta else None
         plain_after = None  # streamed transforms know the size only at EOF
         if self.part_transform is not None:
             transformed = self.part_transform(
@@ -112,7 +113,7 @@ class MultipartManager:
                 if callable(plain):
                     plain_after = plain
                 else:
-                    part_meta = {"__plain_size": str(plain)}
+                    part_meta = {**(part_meta or {}), "__plain_size": str(plain)}
         pkey = self._part_key(bucket, obj, upload_id, part_number)
         oi = self.es.put_object(
             MP_VOLUME,
@@ -130,6 +131,16 @@ class MultipartManager:
                 lambda md: md.__setitem__("__plain_size", size),
             )
         return oi.etag
+
+    def update_part_metadata(
+        self, bucket: str, obj: str, upload_id: str, part_number: int,
+        extra: dict[str, str],
+    ) -> None:
+        """Post-upload part metadata merge (streamed trailer checksums)."""
+        pkey = self._part_key(bucket, obj, upload_id, part_number)
+        self.es.update_object_metadata(
+            MP_VOLUME, pkey, "", lambda md: md.update(extra)
+        )
 
     def list_parts(
         self, bucket: str, obj: str, upload_id: str, max_parts: int = 1000,
@@ -186,8 +197,16 @@ class MultipartManager:
         upload_id: str,
         parts: list[tuple[int, str]],
         versioned: bool = False,
+        part_checksums: dict[int, dict[str, str]] | None = None,
     ) -> ObjectInfo:
-        """Stitch uploaded parts into the final object (metadata only)."""
+        """Stitch uploaded parts into the final object (metadata only).
+
+        part_checksums: client-supplied per-part x-amz-checksum values from
+        the CompleteMultipartUpload XML — verified against the stored part
+        checksums, then folded into the composite object checksum
+        (reference internal/hash/checksum.go composite semantics)."""
+        from ..utils import checksum as cks
+
         up = self._upload_meta(bucket, obj, upload_id)
         dist = [int(x) for x in up.user_defined["__distribution"].split(",")]
         parity = int(up.user_defined.get("__parity", self.es.default_parity))
@@ -212,9 +231,31 @@ class MultipartManager:
             stored_etag = pfi.metadata.get("etag", "")
             if etag.strip('"') != stored_etag:
                 raise InvalidPart(f"part {n} etag mismatch")
+            for algo, want in (part_checksums or {}).get(n, {}).items():
+                stored = pfi.metadata.get(f"{cks.META_PREFIX}{algo}")
+                # AWS rejects a checksum member the part wasn't uploaded
+                # with — silence here would defeat client-side validation
+                if stored is None or stored != want:
+                    raise InvalidPart(f"part {n} {algo} checksum mismatch")
             part_fis.append(pfi)
             md5_concat += bytes.fromhex(stored_etag)
             total += pfi.size
+
+        # composite checksums over algorithms stored on EVERY part
+        # (CRC64NVME is full-object-only per AWS — no "-N" composite form
+        # exists for it, so it stays per-part metadata only)
+        composite_meta: dict[str, str] = {}
+        part_cks_record: dict[str, dict[str, str]] = {}
+        for algo in cks.COMPOSITE_ALGOS:
+            vals = [
+                pfi.metadata.get(f"{cks.META_PREFIX}{algo}") for pfi in part_fis
+            ]
+            if all(v is not None for v in vals):
+                composite_meta[f"{cks.META_PREFIX}{algo}"] = cks.composite(
+                    algo, vals  # type: ignore[arg-type]
+                )
+                for (n, _), v in zip(parts, vals):
+                    part_cks_record.setdefault(str(n), {})[algo] = v  # type: ignore[arg-type]
 
         final_etag = hashlib.md5(md5_concat).hexdigest() + f"-{len(parts)}"
         fi = FileInfo(volume=bucket, name=obj)
@@ -226,6 +267,11 @@ class MultipartManager:
             k: v for k, v in up.user_defined.items() if not k.startswith("__")
         }
         fi.metadata["etag"] = final_etag
+        fi.metadata.update(composite_meta)
+        if part_cks_record:
+            import json as _cks_json
+
+            fi.metadata[cks.PART_CHECKSUMS_META] = _cks_json.dumps(part_cks_record)
         from ..crypto import sse as ssemod
 
         if ssemod.META_ALGO in fi.metadata:
@@ -352,9 +398,18 @@ class MultipartRouter:
         raw = self._mgr(obj, pool_idx).new_upload(bucket, obj, user_defined, parity)
         return f"{pool_idx}{POOL_SEP}{raw}"
 
-    def put_part(self, bucket, obj, upload_id, part_number, data) -> str:
+    def put_part(self, bucket, obj, upload_id, part_number, data,
+                 extra_meta=None) -> str:
         pidx, raw = self._split(upload_id)
-        return self._mgr(obj, pidx).put_part(bucket, obj, raw, part_number, data)
+        return self._mgr(obj, pidx).put_part(
+            bucket, obj, raw, part_number, data, extra_meta
+        )
+
+    def update_part_metadata(self, bucket, obj, upload_id, part_number, extra):
+        pidx, raw = self._split(upload_id)
+        return self._mgr(obj, pidx).update_part_metadata(
+            bucket, obj, raw, part_number, extra
+        )
 
     def list_parts(self, bucket, obj, upload_id, max_parts=1000, part_marker=0):
         pidx, raw = self._split(upload_id)
@@ -364,9 +419,12 @@ class MultipartRouter:
         pidx, raw = self._split(upload_id)
         self._mgr(obj, pidx).abort(bucket, obj, raw)
 
-    def complete(self, bucket, obj, upload_id, parts, versioned=False):
+    def complete(self, bucket, obj, upload_id, parts, versioned=False,
+                 part_checksums=None):
         pidx, raw = self._split(upload_id)
-        return self._mgr(obj, pidx).complete(bucket, obj, raw, parts, versioned)
+        return self._mgr(obj, pidx).complete(
+            bucket, obj, raw, parts, versioned, part_checksums
+        )
 
     def list_uploads(self, bucket, prefix="") -> list[tuple[str, str]]:
         out = []

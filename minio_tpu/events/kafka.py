@@ -23,28 +23,7 @@ import time
 
 from .notify import Target
 
-# ---- CRC32C (Castagnoli), table-driven ------------------------------------
-
-_CRC32C_TABLE = []
-
-
-def _crc32c_init() -> None:
-    if _CRC32C_TABLE:
-        return
-    poly = 0x82F63B78
-    for i in range(256):
-        c = i
-        for _ in range(8):
-            c = (c >> 1) ^ (poly if c & 1 else 0)
-        _CRC32C_TABLE.append(c)
-
-
-def crc32c(data: bytes) -> int:
-    _crc32c_init()
-    c = 0xFFFFFFFF
-    for b in data:
-        c = _CRC32C_TABLE[(c ^ b) & 0xFF] ^ (c >> 8)
-    return c ^ 0xFFFFFFFF
+from ..utils.checksum import crc32c  # CRC32C (Castagnoli), shared table
 
 
 # ---- varints (zigzag, protobuf-style) --------------------------------------

@@ -82,6 +82,10 @@ def _load():
         lib.dp_get_span.argtypes = [ccp, ctypes.c_int, u8p, ctypes.c_long,
                                     lp, lp, lp, lp, u8p]
         lib.dp_md5.argtypes = [u8p, ctypes.c_long, u8p]
+        lib.dp_crc32c.argtypes = [u8p, ctypes.c_long, ctypes.c_uint32]
+        lib.dp_crc32c.restype = ctypes.c_uint32
+        lib.dp_crc64nvme.argtypes = [u8p, ctypes.c_long, ctypes.c_uint64]
+        lib.dp_crc64nvme.restype = ctypes.c_uint64
         _lib = lib
         return _lib
 
@@ -180,6 +184,16 @@ class DataplanePut:
 
 def dataplane_available() -> bool:
     return _load() is not None
+
+
+def crc32c(data: bytes, prev: int = 0) -> int:
+    arr = np.frombuffer(data, dtype=np.uint8)
+    return int(_load().dp_crc32c(_ptr(arr), arr.size, prev))
+
+
+def crc64nvme(data: bytes, prev: int = 0) -> int:
+    arr = np.frombuffer(data, dtype=np.uint8)
+    return int(_load().dp_crc64nvme(_ptr(arr), arr.size, prev))
 
 
 DP_GET_ENOMEM = -(1 << 40)  # resource failure sentinel: blames no shard

@@ -624,3 +624,43 @@ extern "C" long dp_get_span(const char** paths, int d, const uint8_t* key32,
     for (int j = 0; j < d; j++) close(fds[j]);
     return rc ? rc : written;
 }
+
+// ------------------------------------------------------- checksums (CRC)
+// CRC32C rides the SSE4.2 hardware instruction (implied by -mavx2);
+// CRC64/NVME is table-driven. Both are exposed for the flexible-checksums
+// path (utils/checksum.py), where pure-Python table loops would dominate
+// the streaming PUT budget.
+
+#include <nmmintrin.h>
+
+extern "C" uint32_t dp_crc32c(const uint8_t* p, long n, uint32_t prev) {
+    uint64_t c = prev ^ 0xFFFFFFFFu;
+    long i = 0;
+    for (; i + 8 <= n; i += 8) {
+        uint64_t v;
+        std::memcpy(&v, p + i, 8);
+        c = _mm_crc32_u64(c, v);
+    }
+    for (; i < n; i++) c = _mm_crc32_u8((uint32_t)c, p[i]);
+    return (uint32_t)c ^ 0xFFFFFFFFu;
+}
+
+static uint64_t CRC64NVME_T[256];
+static bool crc64_ready = false;
+
+extern "C" uint64_t dp_crc64nvme(const uint8_t* p, long n, uint64_t prev) {
+    if (!crc64_ready) {
+        const uint64_t poly = 0x9A6C9329AC4BC9B5ULL;  // reflected CRC-64/NVME
+        for (int i = 0; i < 256; i++) {
+            uint64_t c = (uint64_t)i;
+            for (int k = 0; k < 8; k++)
+                c = (c >> 1) ^ ((c & 1) ? poly : 0);
+            CRC64NVME_T[i] = c;
+        }
+        crc64_ready = true;
+    }
+    uint64_t c = prev ^ 0xFFFFFFFFFFFFFFFFULL;
+    for (long i = 0; i < n; i++)
+        c = CRC64NVME_T[(c ^ p[i]) & 0xFF] ^ (c >> 8);
+    return c ^ 0xFFFFFFFFFFFFFFFFULL;
+}

@@ -87,6 +87,8 @@ def _route_action(m: str, bucket: str, key: str, q, headers) -> tuple[str, str, 
         if m in ("GET", "HEAD"):
             if "uploadId" in q:
                 return "s3:ListMultipartUploadParts", bucket, key
+            if "attributes" in q:
+                return "s3:GetObjectAttributes", bucket, key
             if "versionId" in q:
                 return "s3:GetObjectVersion", bucket, key
             return "s3:GetObject", bucket, key
@@ -175,31 +177,75 @@ def _parse_form_data(body: bytes, boundary: bytes) -> tuple[dict[str, str], byte
 
 def _verify_checksum_headers(headers, body: bytes) -> dict[str, str]:
     """AWS flexible-checksums: verify x-amz-checksum-* when present and
-    return internal metadata recording them (reference internal/hash
-    checksum readers). CRC32 via zlib, SHA1/SHA256 via hashlib; CRC32C is
-    stored unverified (no native implementation in the image)."""
-    import base64
-    import zlib as _zlib
+    return internal metadata recording them (reference internal/hash/
+    checksum.go readers). All five algorithms (CRC32, CRC32C, SHA1,
+    SHA256, CRC64NVME) are verified, none stored blind."""
+    from ..utils import checksum as cks
 
     out: dict[str, str] = {}
-    for algo in ("crc32", "crc32c", "sha1", "sha256"):
-        v = headers.get(f"x-amz-checksum-{algo}")
+    for algo in cks.ALGOS:
+        v = headers.get(f"{cks.HEADER}{algo}")
         if not v:
             continue
-        if algo == "crc32":
-            got = base64.b64encode(
-                (_zlib.crc32(body) & 0xFFFFFFFF).to_bytes(4, "big")
-            ).decode()
-        elif algo == "sha1":
-            got = base64.b64encode(hashlib.sha1(body).digest()).decode()
-        elif algo == "sha256":
-            got = base64.b64encode(hashlib.sha256(body).digest()).decode()
-        else:
-            got = v  # crc32c: stored, not verified
-        if got != v:
+        if cks.compute(algo, body) != v:
             raise s3err.InvalidDigest
-        out[f"x-minio-internal-checksum-{algo}"] = v
+        out[f"{cks.META_PREFIX}{algo}"] = v
     return out
+
+
+class _AwsChunkedDecoder:
+    """Incremental aws-chunked decoder for STREAMING-UNSIGNED-PAYLOAD-TRAILER
+    bodies (reference cmd/streaming-v4-unsigned.go): yields payload bytes,
+    captures the trailing checksum headers."""
+
+    def __init__(self):
+        self._buf = bytearray()
+        self._state = "size"  # size | data | crlf | trailer
+        self._remaining = 0
+        self.trailers: dict[str, str] = {}
+
+    def feed(self, chunk: bytes) -> bytes:
+        self._buf += chunk
+        out = bytearray()
+        while True:
+            if self._state == "size":
+                nl = self._buf.find(b"\r\n")
+                if nl < 0:
+                    break
+                line = bytes(self._buf[:nl])
+                del self._buf[: nl + 2]
+                size_hex = line.split(b";", 1)[0].strip()
+                try:
+                    self._remaining = int(size_hex, 16)
+                except ValueError:
+                    raise s3err.IncompleteBody from None
+                self._state = "data" if self._remaining else "trailer"
+            elif self._state == "data":
+                take = min(self._remaining, len(self._buf))
+                if take:
+                    out += self._buf[:take]
+                    del self._buf[:take]
+                    self._remaining -= take
+                if self._remaining:
+                    break
+                self._state = "crlf"
+            elif self._state == "crlf":
+                if len(self._buf) < 2:
+                    break
+                del self._buf[:2]
+                self._state = "size"
+            else:  # trailer: lines until blank
+                nl = self._buf.find(b"\r\n")
+                if nl < 0:
+                    break
+                line = bytes(self._buf[:nl])
+                del self._buf[: nl + 2]
+                if not line:
+                    continue  # final blank line
+                if b":" in line:
+                    k, v = line.split(b":", 1)
+                    self.trailers[k.decode().strip().lower()] = v.decode().strip()
+        return bytes(out)
 
 
 def _bucket_sse_algo(encryption_xml: str | None) -> str | None:
@@ -502,7 +548,8 @@ class S3Server:
             request.method, raw_path, query, headers, content_sha
         )
         if content_sha == signature.STREAMING_UNSIGNED_TRAILER:
-            body = streaming.decode_unsigned_chunked(body)
+            if body is not None:  # streamed bodies decode inline in the pump
+                body = self._decode_trailer_body(request, body)
         elif content_sha in (
             signature.STREAMING_PAYLOAD,
             signature.STREAMING_PAYLOAD_TRAILER,
@@ -521,6 +568,27 @@ class S3Server:
                 raise s3err.XAmzContentSHA256Mismatch
         self._check_session_token(ak, headers, {})
         return ak, body
+
+    def _decode_trailer_body(self, request, body: bytes) -> bytes:
+        """Decode a buffered aws-chunked STREAMING-UNSIGNED-PAYLOAD-TRAILER
+        body; verify every x-amz-checksum trailer against the decoded
+        payload and record it for storage (small uploads must get the
+        same integrity behavior as streamed ones)."""
+        from ..utils import checksum as cks
+
+        dec = _AwsChunkedDecoder()
+        data = dec.feed(body)
+        meta: dict[str, str] = {}
+        for k, v in dec.trailers.items():
+            if k.startswith(cks.HEADER):
+                algo = k[len(cks.HEADER):]
+                if algo in cks.ALGOS:
+                    if cks.compute(algo, data) != v:
+                        raise s3err.InvalidDigest
+                    meta[f"{cks.META_PREFIX}{algo}"] = v
+        if meta:
+            request["trailer_checksum_meta"] = meta
+        return data
 
     def _streamable_put(self, request: web.Request) -> bool:
         """True for object PUTs whose body can flow straight into the
@@ -543,21 +611,30 @@ class S3Server:
         headers = {k.lower() for k in request.headers}
         if "x-amz-copy-source" in headers or "content-md5" in headers:
             return False
+        sha = request.headers.get("x-amz-content-sha256", signature.UNSIGNED_PAYLOAD)
+        trailer_mode = sha == signature.STREAMING_UNSIGNED_TRAILER
         if any(
             h.startswith((
-                "x-amz-checksum-", "x-amz-sdk-checksum", "x-amz-trailer",
+                # full-body checksum headers need the buffered verify path;
+                # TRAILER checksums stream (decoded + verified on the fly)
+                "x-amz-checksum-",
                 # request-level SSE needs the transform pipeline (whole body)
                 "x-amz-server-side-encryption",
             ))
             for h in headers
         ):
             return False
+        if ("x-amz-trailer" in headers or "x-amz-sdk-checksum-algorithm" in headers) \
+                and not trailer_mode:
+            return False
         presigned = "X-Amz-Signature" in q
-        sha = request.headers.get("x-amz-content-sha256", signature.UNSIGNED_PAYLOAD)
-        if not presigned and sha != signature.UNSIGNED_PAYLOAD:
+        if not presigned and sha != signature.UNSIGNED_PAYLOAD and not trailer_mode:
             return False
         try:
-            cl = int(request.headers.get("Content-Length", "0"))
+            cl = int(
+                request.headers.get("x-amz-decoded-content-length")
+                or request.headers.get("Content-Length", "0")
+            )
         except ValueError:
             return False
         return cl >= int(os.environ.get("MINIO_TPU_STREAM_MIN_BYTES", str(8 << 20)))
@@ -611,19 +688,55 @@ class S3Server:
                     except _queue.Empty:
                         pass
 
-        expect = int(request.headers.get("Content-Length", "0"))
+        # aws-chunked bodies with trailing checksums decode + verify inline
+        # (reference cmd/streaming-v4-unsigned.go + internal/hash trailers)
+        decoder = None
+        hasher = None
+        trailer_algo = ""
+        if request.headers.get("x-amz-content-sha256") == \
+                signature.STREAMING_UNSIGNED_TRAILER:
+            from ..utils import checksum as cks
+
+            decoder = _AwsChunkedDecoder()
+            t = request.headers.get("x-amz-trailer", "").strip().lower()
+            if t.startswith(cks.HEADER) and t[len(cks.HEADER):] in cks.ALGOS:
+                trailer_algo = t[len(cks.HEADER):]
+                hasher = cks.Hasher(trailer_algo)
+            elif t:
+                # a declared trailer we can't verify must not be accepted
+                # silently (integrity was requested)
+                raise s3err.InvalidArgument
+
+        expect = int(
+            request.headers.get("x-amz-decoded-content-length")
+            or request.headers.get("Content-Length", "0")
+        )
         got = 0
         try:
             while True:
                 chunk = await request.content.read(chunk_sz)
                 if not chunk:
+                    err: Exception | None = None
                     if got != expect:
-                        await loop.run_in_executor(
-                            self._pump_pool, put_item, s3err.IncompleteBody,
-                        )
-                    else:
-                        await loop.run_in_executor(self._pump_pool, put_item, None)
+                        err = s3err.IncompleteBody
+                    elif decoder is not None and hasher is not None:
+                        from ..utils import checksum as cks
+
+                        want = decoder.trailers.get(f"{cks.HEADER}{trailer_algo}")
+                        if want is None or want != hasher.b64():
+                            err = s3err.InvalidDigest
+                        else:
+                            request["trailer_checksum_meta"] = {
+                                f"{cks.META_PREFIX}{trailer_algo}": want
+                            }
+                    await loop.run_in_executor(self._pump_pool, put_item, err)
                     break
+                if decoder is not None:
+                    chunk = decoder.feed(chunk)
+                    if hasher is not None and chunk:
+                        hasher.update(chunk)
+                    if not chunk:
+                        continue
                 got += len(chunk)
                 try:
                     # fast path: skip the executor hop when there's room
@@ -807,6 +920,8 @@ class S3Server:
         if m == "GET":
             if "uploadId" in q:
                 return await self.list_parts(request, bucket, key)
+            if "attributes" in q:
+                return await self.get_object_attributes(request, bucket, key)
             if "lambdaArn" in q:
                 return await self.get_object_lambda(request, bucket, key)
             return await self.get_object(request, bucket, key)
@@ -1328,8 +1443,10 @@ class S3Server:
         for k, v in oi.user_defined.items():
             if k.startswith("x-amz-meta-") or k in ("cache-control", "content-disposition", "content-encoding", "content-language", "expires"):
                 h[k] = v
-        for calgo in ("crc32", "crc32c", "sha1", "sha256"):
-            v = oi.user_defined.get(f"x-minio-internal-checksum-{calgo}")
+        from ..utils import checksum as _cks
+
+        for calgo in _cks.ALGOS:
+            v = oi.user_defined.get(f"{_cks.META_PREFIX}{calgo}")
             if v:
                 h[f"x-amz-checksum-{calgo}"] = v
         from ..ilm import tier as tiermod
@@ -1440,6 +1557,11 @@ class S3Server:
             # a transform needs the whole payload: fall back to buffering
             # (the body is still unread on the socket)
             body = await request.read() if request.body_exists else b""
+            if request.headers.get("x-amz-content-sha256") == \
+                    signature.STREAMING_UNSIGNED_TRAILER:
+                # the wire body is aws-chunked: decode + verify trailers
+                # before transforming, or the framing would be stored
+                body = self._decode_trailer_body(request, body)
         md5_hdr = request.headers.get("Content-MD5")
         if md5_hdr:
             import base64
@@ -1447,6 +1569,8 @@ class S3Server:
             if base64.b64encode(hashlib.md5(body).digest()).decode() != md5_hdr:
                 raise s3err.BadDigest
         checksum_meta = _verify_checksum_headers(request.headers, body or b"")
+        # trailers verified during buffered aws-chunked decode persist too
+        checksum_meta.update(request.get("trailer_checksum_meta") or {})
         user_defined = {}
         if ct:
             user_defined["content-type"] = ct
@@ -1469,6 +1593,16 @@ class S3Server:
                 ),
             )
             headers = {"ETag": f'"{oi.etag}"'}
+            tr = request.get("trailer_checksum_meta")
+            if tr:
+                # verified trailer checksum: persist + echo (reference
+                # internal/hash checksum trailers)
+                await self._run(
+                    self.store.update_object_metadata, bucket, key,
+                    oi.version_id, lambda md: md.update(tr),
+                )
+                for mk, mv in tr.items():
+                    headers[mk.replace("x-minio-internal-", "x-amz-")] = mv
             if oi.version_id:
                 headers["x-amz-version-id"] = oi.version_id
             from ..events import notify as ev
@@ -1758,6 +1892,104 @@ class S3Server:
         await resp.write_eof()
         return resp
 
+    async def get_object_attributes(self, request, bucket, key) -> web.Response:
+        """GetObjectAttributes (reference cmd/object-handlers.go:988):
+        ETag/Checksum/ObjectParts/StorageClass/ObjectSize, filtered by the
+        x-amz-object-attributes header."""
+        import json as _json
+
+        from ..utils import checksum as _cks
+
+        key = listing.encode_dir_object(key)
+        vid = request.rel_url.query.get("versionId", "")
+        if vid == "null":
+            vid = ""
+        want = {
+            a.strip() for a in
+            request.headers.get("x-amz-object-attributes", "").split(",") if a.strip()
+        }
+        if not want:
+            raise s3err.InvalidArgument
+        try:
+            oi = await self._run(self.store.get_object_info, bucket, key, vid)
+        except (quorum.ObjectNotFound, quorum.VersionNotFound):
+            raise s3err.NoSuchKey from None
+        if oi.delete_marker:
+            raise s3err.NoSuchKey
+        self._check_preconditions(request, oi)
+        from . import transforms
+        from ..ilm import tier as tiermod
+
+        parts_xml = ""
+        if "ObjectParts" in want:
+            stored = oi.user_defined.get(_cks.PART_CHECKSUMS_META)
+            per_part = _json.loads(stored) if stored else {}
+            if "-" in oi.etag:  # multipart object
+                try:
+                    max_parts = int(
+                        request.rel_url.query.get("max-parts", "1000") or 1000
+                    )
+                    marker = int(
+                        request.rel_url.query.get("part-number-marker", "0") or 0
+                    )
+                except ValueError:
+                    raise s3err.InvalidArgument from None
+                nparts = int(oi.etag.rsplit("-", 1)[-1])
+                body_parts = []
+                emitted = 0
+                for pn in range(1, nparts + 1):
+                    if pn <= marker:
+                        continue
+                    if emitted >= max_parts:
+                        break
+                    cx = "".join(
+                        f"<Checksum{a.upper()}>{escape(v)}</Checksum{a.upper()}>"
+                        for a, v in per_part.get(str(pn), {}).items()
+                    )
+                    body_parts.append(f"<Part><PartNumber>{pn}</PartNumber>{cx}</Part>")
+                    emitted += 1
+                parts_xml = (
+                    f"<ObjectParts><TotalPartsCount>{nparts}</TotalPartsCount>"
+                    f"<PartNumberMarker>{marker}</PartNumberMarker>"
+                    f"<MaxParts>{max_parts}</MaxParts>"
+                    f"<IsTruncated>{'true' if marker + emitted < nparts else 'false'}"
+                    f"</IsTruncated>" + "".join(body_parts) + "</ObjectParts>"
+                )
+        cks_xml = ""
+        if "Checksum" in want:
+            fields = []
+            for algo in _cks.ALGOS:
+                v = oi.user_defined.get(f"{_cks.META_PREFIX}{algo}")
+                if v:
+                    tag = "Checksum" + algo.upper()
+                    fields.append(f"<{tag}>{escape(v)}</{tag}>")
+            if fields:
+                cks_xml = "<Checksum>" + "".join(fields) + "</Checksum>"
+        etag_xml = f"<ETag>{escape(oi.etag)}</ETag>" if "ETag" in want else ""
+        size_xml = (
+            f"<ObjectSize>{transforms.logical_size(oi.user_defined, oi.size)}"
+            "</ObjectSize>" if "ObjectSize" in want else ""
+        )
+        sc = oi.user_defined.get(tiermod.TRANSITION_TIER_META) or \
+            oi.user_defined.get("x-amz-storage-class", "STANDARD")
+        sc_xml = (
+            f"<StorageClass>{escape(sc)}</StorageClass>"
+            if "StorageClass" in want else ""
+        )
+        xml = (
+            '<?xml version="1.0" encoding="UTF-8"?>'
+            '<GetObjectAttributesResponse xmlns='
+            '"http://s3.amazonaws.com/doc/2006-03-01/">'
+            + etag_xml + cks_xml + parts_xml + sc_xml + size_xml
+            + "</GetObjectAttributesResponse>"
+        )
+        headers = {"Last-Modified": _http_date(oi.mod_time)}
+        if oi.version_id:
+            headers["x-amz-version-id"] = oi.version_id
+        return web.Response(
+            body=xml.encode(), content_type="application/xml", headers=headers
+        )
+
     async def _get_transformed(self, request, bucket, key, oi, handle) -> web.Response:
         """GET for compressed/encrypted objects: decode through the
         transform pipeline (ranges map to packets for SSE-only)."""
@@ -2002,15 +2234,28 @@ class S3Server:
                         bucket, key, upload_id, part_number, rd
                     ),
                 )
+                tr = request.get("trailer_checksum_meta")
+                if tr:
+                    await self._run(
+                        self.mp.update_part_metadata, bucket, key,
+                        upload_id, part_number, tr,
+                    )
             else:
+                checksum_meta = _verify_checksum_headers(request.headers, body)
+                checksum_meta.update(request.get("trailer_checksum_meta") or {})
                 etag = await self._run(
-                    self.mp.put_part, bucket, key, upload_id, part_number, body
+                    self.mp.put_part, bucket, key, upload_id, part_number, body,
+                    checksum_meta or None,
                 )
         except mp_mod.UploadNotFound:
             raise s3err.NoSuchUpload from None
         except mp_mod.InvalidPart:
             raise s3err.InvalidPart from None
-        return web.Response(status=200, headers={"ETag": f'"{etag}"'})
+        headers = {"ETag": f'"{etag}"'}
+        for hk in request.headers:
+            if hk.lower().startswith("x-amz-checksum-"):
+                headers[hk] = request.headers[hk]
+        return web.Response(status=200, headers=headers)
 
     async def upload_part_copy(self, request, bucket, key) -> web.Response:
         from ..erasure import multipart as mp_mod
@@ -2090,20 +2335,27 @@ class S3Server:
         except ET.ParseError:
             raise s3err.MalformedXML from None
         parts = []
+        part_checksums: dict[int, dict[str, str]] = {}
         for el in root:
             if el.tag.split("}")[-1] == "Part":
                 n, etag = 0, ""
+                cks_vals: dict[str, str] = {}
                 for sub in el:
                     t = sub.tag.split("}")[-1]
                     if t == "PartNumber":
                         n = int(sub.text or "0")
                     elif t == "ETag":
                         etag = (sub.text or "").strip()
+                    elif t.startswith("Checksum"):
+                        cks_vals[t[len("Checksum"):].lower()] = (sub.text or "").strip()
                 parts.append((n, etag))
+                if cks_vals:
+                    part_checksums[n] = cks_vals
         bm = self.buckets.get(bucket)
         try:
             oi = await self._run(
-                self.mp.complete, bucket, key, upload_id, parts, bm.versioning
+                self.mp.complete, bucket, key, upload_id, parts, bm.versioning,
+                part_checksums or None,
             )
         except mp_mod.UploadNotFound:
             raise s3err.NoSuchUpload from None

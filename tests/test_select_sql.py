@@ -1,0 +1,278 @@
+"""S3 Select SQL dialect grammar/evaluation tests.
+
+Mirrors the reference's SQL package tests (internal/s3select/sql:
+parser_test.go grammar forms, funceval.go function semantics,
+evaluate.go NULL/MISSING three-valued logic)."""
+
+import datetime as dt
+import os
+
+os.environ.setdefault("MINIO_TPU_BACKEND", "numpy")
+
+import pytest
+
+from minio_tpu.s3select import sql
+
+ROWS = [
+    {"name": "alice", "age": "31", "city": "oslo", "score": "9.5"},
+    {"name": "bob", "age": "25", "city": "rome", "score": "7.0"},
+    {"name": "carol", "age": "42", "city": "oslo", "score": "8.25"},
+    {"name": "dave", "age": "19", "city": "", "score": "6"},
+]
+
+JROWS = [
+    {"user": {"name": "ann", "tags": ["a", "b", "c"]}, "n": 1, "extra": None},
+    {"user": {"name": "ben", "tags": []}, "n": 2},
+]
+
+
+def run(expr, rows=None):
+    q = sql.parse(expr)
+    return sql.execute(q, ROWS if rows is None else rows)
+
+
+def names(expr, rows=None):
+    out, _ = run(expr, rows)
+    return [r.get("name") for r in out]
+
+
+# ---------------------------------------------------------------- operators
+
+
+def test_comparisons_coerce_csv_numbers():
+    assert names("SELECT name FROM S3Object WHERE age > 30") == ["alice", "carol"]
+    assert names("SELECT name FROM S3Object WHERE age <= 25") == ["bob", "dave"]
+    assert names("SELECT name FROM S3Object WHERE score = 7.0") == ["bob"]
+    assert names("SELECT name FROM S3Object WHERE age <> 31") == ["bob", "carol", "dave"]
+
+
+def test_and_or_not_precedence():
+    got = names("SELECT name FROM S3Object WHERE city = 'oslo' AND age > 35 OR name = 'bob'")
+    assert got == ["bob", "carol"]
+    got = names("SELECT name FROM S3Object WHERE NOT city = 'oslo' AND age < 26")
+    assert got == ["bob", "dave"]
+
+
+def test_arithmetic_and_precedence():
+    out, _ = run("SELECT age + 2 * 10 AS x FROM S3Object WHERE name = 'bob'")
+    assert out == [{"x": 45}]
+    out, _ = run("SELECT (age + 2) * 10 AS x FROM S3Object WHERE name = 'bob'")
+    assert out == [{"x": 270}]
+    out, _ = run("SELECT age % 7 AS m, age / 5 AS d FROM S3Object WHERE name = 'bob'")
+    assert out == [{"m": 4, "d": 5}]
+    with pytest.raises(sql.SQLError):
+        run("SELECT age / 0 FROM S3Object")
+
+
+def test_string_concat():
+    out, _ = run("SELECT name || '@' || city AS addr FROM S3Object WHERE name = 'alice'")
+    assert out == [{"addr": "alice@oslo"}]
+
+
+def test_like_patterns_and_escape():
+    assert names("SELECT name FROM S3Object WHERE name LIKE 'a%'") == ["alice"]
+    assert names("SELECT name FROM S3Object WHERE name LIKE '_ob'") == ["bob"]
+    assert names("SELECT name FROM S3Object WHERE name NOT LIKE '%o%'") == ["alice", "dave"]
+    rows = [{"v": "50% off"}, {"v": "half off"}]
+    q = sql.parse("SELECT v FROM S3Object WHERE v LIKE '%!%%' ESCAPE '!'")
+    out, _ = sql.execute(q, rows)
+    assert out == [{"v": "50% off"}]
+
+
+def test_in_and_between():
+    assert names("SELECT name FROM S3Object WHERE city IN ('rome', 'paris')") == ["bob"]
+    assert names("SELECT name FROM S3Object WHERE age BETWEEN 25 AND 31") == ["alice", "bob"]
+    assert names("SELECT name FROM S3Object WHERE age NOT BETWEEN 20 AND 41") == ["carol", "dave"]
+    assert names("SELECT name FROM S3Object WHERE name NOT IN ('alice', 'bob', 'carol')") == ["dave"]
+
+
+def test_is_null_missing_semantics():
+    rows = [{"a": 1, "b": None}, {"a": 2}]
+    q = sql.parse("SELECT a FROM S3Object WHERE b IS NULL")
+    out, _ = sql.execute(q, rows)
+    assert [r["a"] for r in out] == [1, 2]  # MISSING IS NULL is true too
+    q = sql.parse("SELECT a FROM S3Object WHERE b IS MISSING")
+    out, _ = sql.execute(q, rows)
+    assert [r["a"] for r in out] == [2]
+    q = sql.parse("SELECT a FROM S3Object WHERE b IS NOT MISSING")
+    out, _ = sql.execute(q, rows)
+    assert [r["a"] for r in out] == [1]
+    # comparisons with NULL are UNKNOWN -> row filtered, including NOT
+    q = sql.parse("SELECT a FROM S3Object WHERE b = 1")
+    assert sql.execute(q, rows)[0] == []
+    q = sql.parse("SELECT a FROM S3Object WHERE NOT b = 1")
+    assert sql.execute(q, rows)[0] == []
+
+
+def test_json_paths_and_index():
+    q = sql.parse("SELECT s.user.name FROM S3Object s WHERE s.user.tags[1] = 'b'")
+    out, _ = sql.execute(q, JROWS)
+    assert out == [{"name": "ann"}]
+    q = sql.parse("SELECT s.user.tags[0] AS t FROM S3Object s WHERE s.n = 1")
+    out, _ = sql.execute(q, JROWS)
+    assert out == [{"t": "a"}]
+    # out-of-range index is MISSING: projection omits the key
+    q = sql.parse("SELECT s.user.tags[5] AS t, s.n FROM S3Object s WHERE s.n = 2")
+    out, _ = sql.execute(q, JROWS)
+    assert out == [{"n": 2}]
+
+
+def test_case_expressions():
+    out, _ = run(
+        "SELECT name, CASE WHEN age >= 40 THEN 'old' WHEN age >= 26 THEN 'mid' "
+        "ELSE 'young' END AS bracket FROM S3Object"
+    )
+    assert [(r["name"], r["bracket"]) for r in out] == [
+        ("alice", "mid"), ("bob", "young"), ("carol", "old"), ("dave", "young")]
+    out, _ = run(
+        "SELECT CASE city WHEN 'oslo' THEN 'no' WHEN 'rome' THEN 'it' END AS cc "
+        "FROM S3Object WHERE name = 'dave'"
+    )
+    assert out == [{"cc": None}]
+
+
+# ---------------------------------------------------------------- functions
+
+
+def test_cast():
+    out, _ = run("SELECT CAST(age AS INT) AS a, CAST(score AS FLOAT) AS s "
+                 "FROM S3Object WHERE name = 'alice'")
+    assert out == [{"a": 31, "s": 9.5}]
+    out, _ = run("SELECT CAST(age AS STRING) AS a FROM S3Object WHERE name = 'bob'")
+    assert out == [{"a": "25"}]
+    q = sql.parse("SELECT CAST(v AS BOOL) AS b FROM S3Object")
+    out, _ = sql.execute(q, [{"v": "true"}, {"v": "0"}])
+    assert [r["b"] for r in out] == [True, False]
+    with pytest.raises(sql.SQLError):
+        run("SELECT CAST(name AS INT) FROM S3Object")
+    with pytest.raises(sql.SQLError):
+        sql.parse("SELECT CAST(age AS BLOB) FROM S3Object")
+
+
+def test_substring_forms_and_edges():
+    out, _ = run("SELECT SUBSTRING(name FROM 2 FOR 3) AS x FROM S3Object WHERE name = 'alice'")
+    assert out == [{"x": "lic"}]
+    out, _ = run("SELECT SUBSTRING(name, 2) AS x FROM S3Object WHERE name = 'carol'")
+    assert out == [{"x": "arol"}]
+    # SQL semantics: start < 1 consumes length toward position 1
+    out, _ = run("SELECT SUBSTRING(name FROM -1 FOR 4) AS x FROM S3Object WHERE name = 'bob'")
+    assert out == [{"x": "bo"}]
+    with pytest.raises(sql.SQLError):
+        run("SELECT SUBSTRING(name FROM 1 FOR -2) FROM S3Object")
+
+
+def test_trim_variants():
+    rows = [{"v": "  pad  ", "w": "xxhixx"}]
+    q = sql.parse("SELECT TRIM(v) AS a, TRIM(LEADING FROM v) AS b, "
+                  "TRIM(TRAILING FROM v) AS c, TRIM(BOTH 'x' FROM w) AS d "
+                  "FROM S3Object")
+    out, _ = sql.execute(q, rows)
+    assert out == [{"a": "pad", "b": "pad  ", "c": "  pad", "d": "hi"}]
+
+
+def test_string_functions():
+    out, _ = run("SELECT UPPER(name) AS u, LOWER(city) AS l, "
+                 "CHAR_LENGTH(name) AS n FROM S3Object WHERE name = 'alice'")
+    assert out == [{"u": "ALICE", "l": "oslo", "n": 5}]
+
+
+def test_coalesce_nullif():
+    rows = [{"a": None, "b": 7}, {"a": 3, "b": 9}]
+    q = sql.parse("SELECT COALESCE(a, b) AS x, NULLIF(b, 9) AS y FROM S3Object")
+    out, _ = sql.execute(q, rows)
+    assert out == [{"x": 7, "y": 7}, {"x": 3, "y": None}]
+
+
+def test_date_functions():
+    rows = [{"ts": "2024-02-29T10:30:00Z", "ts2": "2024-03-31T00:00:00Z"}]
+    q = sql.parse("SELECT EXTRACT(YEAR FROM ts) AS y, EXTRACT(MONTH FROM ts) AS mo, "
+                  "EXTRACT(DAY FROM ts) AS d, EXTRACT(HOUR FROM ts) AS h FROM S3Object")
+    out, _ = sql.execute(q, rows)
+    assert out == [{"y": 2024, "mo": 2, "d": 29, "h": 10}]
+    # month-end clamping on DATE_ADD
+    q = sql.parse("SELECT TO_STRING(DATE_ADD(MONTH, 1, ts2), 'yyyy-MM-dd') AS t FROM S3Object")
+    out, _ = sql.execute(q, rows)
+    assert out == [{"t": "2024-04-30"}]
+    q = sql.parse("SELECT DATE_DIFF(DAY, ts, ts2) AS days FROM S3Object")
+    out, _ = sql.execute(q, rows)
+    assert out == [{"days": 30}]
+    q = sql.parse("SELECT DATE_DIFF(YEAR, TO_TIMESTAMP('2020-01-01'), ts) AS y FROM S3Object")
+    out, _ = sql.execute(q, rows)
+    assert out == [{"y": 4}]
+
+
+def test_utcnow_returns_timestamp():
+    out, _ = run("SELECT UTCNOW() AS now FROM S3Object LIMIT 1")
+    got = dt.datetime.fromisoformat(out[0]["now"])
+    assert abs((dt.datetime.now(dt.timezone.utc) - got).total_seconds()) < 60
+
+
+# --------------------------------------------------------------- aggregates
+
+
+def test_aggregates_with_aliases():
+    _, agg = run("SELECT COUNT(*) AS n, SUM(age) AS total, MIN(age) AS lo, "
+                 "MAX(age) AS hi, AVG(score) AS mean FROM S3Object")
+    assert agg["n"] == 4 and agg["total"] == 117
+    assert agg["lo"] == 19 and agg["hi"] == 42
+    assert agg["mean"] == pytest.approx((9.5 + 7.0 + 8.25 + 6) / 4)
+
+
+def test_aggregate_count_expr_skips_null():
+    rows = [{"v": 1}, {"v": None}, {}]
+    _, agg = sql.execute(sql.parse("SELECT COUNT(v) FROM S3Object"), rows)
+    assert agg == {"_1": 1}
+    _, agg = sql.execute(sql.parse("SELECT COUNT(*) FROM S3Object"), rows)
+    assert agg == {"_1": 3}
+
+
+def test_aggregate_rejections():
+    with pytest.raises(sql.SQLError):
+        sql.parse("SELECT name, COUNT(*) FROM S3Object")
+    with pytest.raises(sql.SQLError):
+        sql.parse("SELECT name FROM S3Object WHERE COUNT(*) > 1")
+
+
+# ----------------------------------------------------------------- general
+
+
+def test_projection_naming():
+    out, _ = run("SELECT name, age + 1, UPPER(city) AS cc FROM S3Object LIMIT 1")
+    assert out == [{"name": "alice", "_2": 32, "cc": "OSLO"}]
+
+
+def test_alias_and_quoted_identifiers():
+    q = sql.parse('SELECT s."name" FROM S3Object s WHERE s.city = \'rome\'')
+    out, _ = sql.execute(q, ROWS)
+    assert out == [{"name": "bob"}]
+
+
+def test_limit_and_limit_zero():
+    assert len(run("SELECT * FROM S3Object LIMIT 2")[0]) == 2
+    assert run("SELECT * FROM S3Object LIMIT 0")[0] == []
+
+
+def test_parse_errors():
+    for bad in (
+        "DROP TABLE x",
+        "SELECT FROM S3Object",
+        "SELECT * FROM users",
+        "SELECT * FROM S3Object WHERE",
+        "SELECT * FROM S3Object LIMIT",
+        "SELECT * FROM S3Object WHERE a >",
+        "SELECT SUBSTRING(name FROM) FROM S3Object",
+        "SELECT * FROM S3Object trailing garbage here",
+        "SELECT CASE WHEN a THEN 1 FROM S3Object",
+    ):
+        with pytest.raises(sql.SQLError):
+            sql.parse(bad)
+
+
+def test_boolean_literals_and_is_true():
+    rows = [{"ok": True, "v": 1}, {"ok": False, "v": 2}]
+    q = sql.parse("SELECT v FROM S3Object WHERE ok = TRUE")
+    out, _ = sql.execute(q, rows)
+    assert out == [{"v": 1}]
+    q = sql.parse("SELECT v FROM S3Object WHERE ok IS FALSE")
+    out, _ = sql.execute(q, rows)
+    assert out == [{"v": 2}]
