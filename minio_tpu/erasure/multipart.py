@@ -145,22 +145,35 @@ class MultipartManager:
     def list_parts(
         self, bucket: str, obj: str, upload_id: str, max_parts: int = 1000,
         part_marker: int = 0,
-    ) -> list[PartRecord]:
+    ) -> tuple[list[PartRecord], bool]:
+        """Parts after part_marker, plus whether more remain (the S3
+        IsTruncated contract, reference cmd/erasure-multipart.go
+        ListObjectParts)."""
         self._upload_meta(bucket, obj, upload_id)
+        if max_parts <= 0:
+            # mirror the reference: maxParts==0 is an empty, NON-truncated
+            # page (a truncated page with no next marker cannot progress)
+            return [], False
         from . import listing
 
+        # marker walk: part names are zero-padded so the lexicographic
+        # listing order IS part-number order; fetch one extra to learn
+        # whether the page is truncated
+        base = f"{self._upload_key(bucket, obj, upload_id)}/part-meta/"
         res = listing.list_objects(
             self.es,
             MP_VOLUME,
-            prefix=f"{self._upload_key(bucket, obj, upload_id)}/part-meta/",
-            max_keys=max_parts + part_marker,
+            prefix=base,
+            marker=f"{base}{part_marker:05d}" if part_marker else "",
+            max_keys=max_parts + 1,
         )
-        out = []
-        for o in res.objects:
-            n = int(o.name.rsplit("/", 1)[-1])
-            if n > part_marker:
-                out.append(PartRecord(n, o.etag, o.size, o.mod_time))
-        return out[:max_parts]
+        out = [
+            PartRecord(
+                int(o.name.rsplit("/", 1)[-1]), o.etag, o.size, o.mod_time
+            )
+            for o in res.objects
+        ]
+        return out[:max_parts], len(out) > max_parts
 
     def list_uploads(self, bucket: str, prefix: str = "") -> list[tuple[str, str]]:
         """[(object_key, upload_id)] of in-progress uploads."""

@@ -1293,7 +1293,15 @@ class ObjectHandle:
         if mtx is not None:
             mtx.runlock()
 
-    def read(self, offset: int = 0, length: int = -1) -> Iterator[bytes]:
+    def read(
+        self, offset: int = 0, length: int = -1, close_when_done: bool = True
+    ) -> Iterator[bytes]:
+        """Iterator over one byte range. By default the handle (and its
+        namespace read lock) closes when this iterator finishes — right
+        for the single-read GET path. Callers issuing MULTIPLE reads over
+        one handle (e.g. per-part SSE range decode) pass
+        close_when_done=False and close() in their own finally, so parts
+        2..N still read under the lock."""
         import time as _time
 
         if length < 0:
@@ -1314,6 +1322,7 @@ class ObjectHandle:
                         last_refresh = now
                     yield chunk
             finally:
-                self.close()
+                if close_when_done:
+                    self.close()
 
         return gen()

@@ -646,19 +646,24 @@ extern "C" uint32_t dp_crc32c(const uint8_t* p, long n, uint32_t prev) {
 }
 
 static uint64_t CRC64NVME_T[256];
-static bool crc64_ready = false;
+
+// ctypes calls drop the GIL, so table init must be race-free: build it
+// once at load time under a static initializer (C++11 guarantees
+// thread-safe static-local initialization).
+static bool crc64_init() {
+    const uint64_t poly = 0x9A6C9329AC4BC9B5ULL;  // reflected CRC-64/NVME
+    for (int i = 0; i < 256; i++) {
+        uint64_t c = (uint64_t)i;
+        for (int k = 0; k < 8; k++)
+            c = (c >> 1) ^ ((c & 1) ? poly : 0);
+        CRC64NVME_T[i] = c;
+    }
+    return true;
+}
+static const bool crc64_ready = crc64_init();
 
 extern "C" uint64_t dp_crc64nvme(const uint8_t* p, long n, uint64_t prev) {
-    if (!crc64_ready) {
-        const uint64_t poly = 0x9A6C9329AC4BC9B5ULL;  // reflected CRC-64/NVME
-        for (int i = 0; i < 256; i++) {
-            uint64_t c = (uint64_t)i;
-            for (int k = 0; k < 8; k++)
-                c = (c >> 1) ^ ((c & 1) ? poly : 0);
-            CRC64NVME_T[i] = c;
-        }
-        crc64_ready = true;
-    }
+    (void)crc64_ready;
     uint64_t c = prev ^ 0xFFFFFFFFFFFFFFFFULL;
     for (long i = 0; i < n; i++)
         c = CRC64NVME_T[(c ^ p[i]) & 0xFF] ^ (c >> 8);

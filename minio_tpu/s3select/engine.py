@@ -71,11 +71,13 @@ def read_json(data: bytes, opts: dict):
 # -- output writers ----------------------------------------------------------
 
 def write_csv(rows: list[dict], opts: dict) -> bytes:
+    from .sql import MISSING
+
     delim = opts.get("FieldDelimiter", ",") or ","
     buf = io.StringIO()
     w = csv.writer(buf, delimiter=delim, lineterminator="\n")
     for r in rows:
-        w.writerow(["" if v is None else v for v in r.values()])
+        w.writerow(["" if v is None or v is MISSING else v for v in r.values()])
     return buf.getvalue().encode()
 
 
@@ -96,8 +98,17 @@ def _json_default(v):
 
 
 def write_json(rows: list[dict], opts: dict) -> bytes:
+    from .sql import MISSING
+
     rd = opts.get("RecordDelimiter", "\n") or "\n"
-    return "".join(json.dumps(r, default=_json_default) + rd for r in rows).encode()
+    return "".join(
+        json.dumps(
+            {k: v for k, v in r.items() if v is not MISSING},
+            default=_json_default,
+        )
+        + rd
+        for r in rows
+    ).encode()
 
 
 # -- event-stream framing ----------------------------------------------------

@@ -409,9 +409,11 @@ class _Parser:
             raise SQLError("unexpected end of expression")
         if t.kind == "number":
             self.next()
-            f = float(t.text)
-            return Lit(int(f) if f.is_integer() and "." not in t.text
-                       and "e" not in t.text.lower() else f)
+            # ints parse exactly (no float round-trip: 2^53+ IDs must not
+            # be silently corrupted); anything with . or e is a float
+            if "." not in t.text and "e" not in t.text.lower():
+                return Lit(int(t.text))
+            return Lit(float(t.text))
         if t.kind == "string":
             self.next()
             return Lit(t.text[1:-1].replace("''", "'"))
@@ -1152,9 +1154,12 @@ def execute(q: Query, records) -> tuple[list[dict], dict | None]:
                     row.update(rec)
                     continue
                 v = _eval(e, env)
-                if v is MISSING:
-                    continue  # MISSING projections are omitted (AWS)
-                row[_item_name(e, name, pos)] = _json_safe(v)
+                # MISSING stays in the row as the sentinel: the JSON
+                # writer omits the key (AWS), the CSV writer emits an
+                # empty field so columns stay aligned
+                row[_item_name(e, name, pos)] = (
+                    MISSING if v is MISSING else _json_safe(v)
+                )
             out.append(row)
         if 0 <= q.limit <= len(out):
             break

@@ -1475,30 +1475,46 @@ class S3Server:
             )
         return h
 
-    def _check_preconditions(self, request, oi: ObjectInfo) -> None:
-        inm = request.headers.get("If-None-Match")
-        im = request.headers.get("If-Match")
-        ims = request.headers.get("If-Modified-Since")
-        ius = request.headers.get("If-Unmodified-Since")
+    @staticmethod
+    def _eval_preconditions(headers, oi: ObjectInfo, prefix: str, none_match_err) -> None:
+        """Shared If-Match/If-None-Match/If-(Un)Modified-Since evaluation.
+        Header precedence follows RFC 7232 (and AWS's documented copy
+        combinations): an If-Match that evaluates TRUE suppresses
+        If-Unmodified-Since, and a present If-None-Match suppresses
+        If-Modified-Since. GET/HEAD use the bare names with 304 on the
+        None-Match side; CopyObject/UploadPartCopy use the
+        x-amz-copy-source-if-* set where every failure is 412
+        (cmd/object-handlers.go checkCopyObjectPreconditions)."""
         etag = f'"{oi.etag}"'
-        if im and im.strip() not in (etag, "*", oi.etag):
-            raise s3err.PreconditionFailed
-        if ius:
-            try:
-                t = parsedate_to_datetime(ius)
-                if oi.mod_time / 1e9 > t.timestamp():
-                    raise s3err.PreconditionFailed
-            except (ValueError, TypeError):
-                pass
-        if inm and inm.strip() in (etag, "*", oi.etag):
-            raise s3err.NotModified
-        if ims:
-            try:
-                t = parsedate_to_datetime(ims)
-                if oi.mod_time / 1e9 <= t.timestamp():
-                    raise s3err.NotModified
-            except (ValueError, TypeError):
-                pass
+        im = headers.get(f"{prefix}If-Match")
+        if im:
+            if im.strip() not in (etag, "*", oi.etag):
+                raise s3err.PreconditionFailed
+        else:
+            ius = headers.get(f"{prefix}If-Unmodified-Since")
+            if ius:
+                try:
+                    t = parsedate_to_datetime(ius)
+                    if oi.mod_time / 1e9 > t.timestamp():
+                        raise s3err.PreconditionFailed
+                except (ValueError, TypeError):
+                    pass
+        inm = headers.get(f"{prefix}If-None-Match")
+        if inm:
+            if inm.strip() in (etag, "*", oi.etag):
+                raise none_match_err
+        else:
+            ims = headers.get(f"{prefix}If-Modified-Since")
+            if ims:
+                try:
+                    t = parsedate_to_datetime(ims)
+                    if oi.mod_time / 1e9 <= t.timestamp():
+                        raise none_match_err
+                except (ValueError, TypeError):
+                    pass
+
+    def _check_preconditions(self, request, oi: ObjectInfo) -> None:
+        self._eval_preconditions(request.headers, oi, "", s3err.NotModified)
 
     @staticmethod
     def _incoming_size(request, body: bytes | None) -> int:
@@ -1722,6 +1738,11 @@ class S3Server:
         self._authorize(access_key, action, src_bucket, src_key)
         return src_bucket, src_key, src_vid
 
+    def _check_copy_preconditions(self, request, oi: ObjectInfo) -> None:
+        self._eval_preconditions(
+            request.headers, oi, "x-amz-copy-source-", s3err.PreconditionFailed
+        )
+
     async def copy_object(self, request, bucket: str, key: str) -> web.Response:
         from ..crypto.sse import CryptoError
         from . import transforms
@@ -1729,13 +1750,19 @@ class S3Server:
         src_bucket, src_key, src_vid = self._parse_copy_source(
             request, request.get("access_key", "")
         )
-        oi, it = await self._run(
-            self.store.get_object, src_bucket, src_key, src_vid
+        oi, handle = await self._run(
+            self.store.open_object, src_bucket, src_key, src_vid
         )
         from .transforms import logical_size as _logical
 
-        self._enforce_quota(bucket, _logical(oi.user_defined, oi.size))
-        data = b"".join(it)
+        try:
+            # pre-read failures (412, quota) must release the source
+            # namespace read lock immediately, not wait out the lock TTL
+            self._check_copy_preconditions(request, oi)
+            self._enforce_quota(bucket, _logical(oi.user_defined, oi.size))
+            data = await self._run(lambda: b"".join(handle.read(0, -1)))
+        finally:
+            handle.close()
         req_headers = {k.lower(): v for k, v in request.headers.items()}
         # decode the SOURCE pipeline: sealed keys are bound to the source
         # bucket/key context and must never be copied verbatim
@@ -2003,7 +2030,9 @@ class S3Server:
             req_headers = {k.lower(): v for k, v in request.headers.items()}
 
             def read_fn(off, ln):
-                return b"".join(handle.read(off, ln))
+                # multiple per-part range reads over ONE handle: the outer
+                # finally owns the close, each read must keep the lock
+                return b"".join(handle.read(off, ln, close_when_done=False))
 
             def decode():
                 if rng:
@@ -2255,6 +2284,13 @@ class S3Server:
         for hk in request.headers:
             if hk.lower().startswith("x-amz-checksum-"):
                 headers[hk] = request.headers[hk]
+        # trailer-mode uploads carry the checksum in the trailer, not a
+        # header: echo the VERIFIED value so SDK response validation sees it
+        from ..utils import checksum as _cks
+
+        for mk, mv in (request.get("trailer_checksum_meta") or {}).items():
+            algo = mk[len(_cks.META_PREFIX):]
+            headers.setdefault(f"x-amz-checksum-{algo}", mv)
         return web.Response(status=200, headers=headers)
 
     async def upload_part_copy(self, request, bucket, key) -> web.Response:
@@ -2275,11 +2311,13 @@ class S3Server:
         )
         from . import transforms
 
-        self._enforce_quota(
-            bucket, transforms.logical_size(oi.user_defined, oi.size)
-        )
-
         try:
+            # any pre-read failure (412, quota) must release the source
+            # namespace read lock, not wait out the 120s TTL
+            self._check_copy_preconditions(request, oi)
+            self._enforce_quota(
+                bucket, transforms.logical_size(oi.user_defined, oi.size)
+            )
             # transformed (SSE/compressed) sources must decode to logical
             # bytes: ranges apply to plaintext, and the destination part
             # re-transforms for its own upload
@@ -2299,7 +2337,7 @@ class S3Server:
                 req_headers = {k.lower(): v for k, v in request.headers.items()}
 
                 def read_fn(off, ln):
-                    return b"".join(handle.read(off, ln))
+                    return b"".join(handle.read(off, ln, close_when_done=False))
 
                 data = await self._run(
                     transforms.decode_range, read_fn, oi.size,
@@ -2399,10 +2437,16 @@ class S3Server:
         key = listing.encode_dir_object(key)
         q = request.rel_url.query
         upload_id = q.get("uploadId", "")
-        max_parts = int(q.get("max-parts", "1000"))
-        marker = int(q.get("part-number-marker", "0"))
         try:
-            parts = await self._run(
+            max_parts = int(q.get("max-parts", "1000"))
+            marker = int(q.get("part-number-marker", "0"))
+        except ValueError:
+            raise s3err.InvalidArgument from None
+        if max_parts < 0 or marker < 0:
+            raise s3err.InvalidArgument
+        max_parts = min(max_parts, 1000)
+        try:
+            parts, truncated = await self._run(
                 self.mp.list_parts, bucket, key, upload_id, max_parts, marker
             )
         except mp_mod.UploadNotFound:
@@ -2413,12 +2457,19 @@ class S3Server:
             f"<LastModified>{_iso8601(p.mod_time)}</LastModified></Part>"
             for p in parts
         )
+        next_marker = (
+            f"<NextPartNumberMarker>{parts[-1].number}</NextPartNumberMarker>"
+            if truncated and parts
+            else ""
+        )
         xml = (
             '<?xml version="1.0" encoding="UTF-8"?>'
             '<ListPartsResult xmlns="http://s3.amazonaws.com/doc/2006-03-01/">'
             f"<Bucket>{escape(bucket)}</Bucket><Key>{escape(key)}</Key>"
             f"<UploadId>{upload_id}</UploadId><MaxParts>{max_parts}</MaxParts>"
-            f"<IsTruncated>false</IsTruncated>{items}</ListPartsResult>"
+            f"<PartNumberMarker>{marker}</PartNumberMarker>{next_marker}"
+            f"<IsTruncated>{'true' if truncated else 'false'}</IsTruncated>"
+            f"{items}</ListPartsResult>"
         )
         return web.Response(body=xml.encode(), content_type="application/xml")
 

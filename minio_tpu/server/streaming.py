@@ -18,30 +18,6 @@ from .signature import SIGN_V4_ALGORITHM, signing_key
 EMPTY_SHA = hashlib.sha256(b"").hexdigest()
 
 
-def decode_unsigned_chunked(body: bytes) -> bytes:
-    """Decode STREAMING-UNSIGNED-PAYLOAD-TRAILER bodies (trailers ignored)."""
-    out = bytearray()
-    pos = 0
-    while True:
-        nl = body.find(b"\r\n", pos)
-        if nl < 0:
-            raise s3err.IncompleteBody
-        header = body[pos:nl].decode("latin1")
-        size_hex = header.split(";", 1)[0].strip()
-        try:
-            size = int(size_hex, 16)
-        except ValueError:
-            raise s3err.IncompleteBody from None
-        pos = nl + 2
-        if size == 0:
-            return bytes(out)
-        chunk = body[pos : pos + size]
-        if len(chunk) != size:
-            raise s3err.IncompleteBody
-        out += chunk
-        pos += size + 2  # skip trailing CRLF
-
-
 def decode_signed_chunked(
     body: bytes,
     seed_signature: str,

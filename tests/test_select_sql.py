@@ -111,10 +111,14 @@ def test_json_paths_and_index():
     q = sql.parse("SELECT s.user.tags[0] AS t FROM S3Object s WHERE s.n = 1")
     out, _ = sql.execute(q, JROWS)
     assert out == [{"t": "a"}]
-    # out-of-range index is MISSING: projection omits the key
+    # out-of-range index is MISSING: kept as the sentinel in the row
+    # (for CSV column alignment) and omitted by the JSON writer
+    from minio_tpu.s3select.engine import write_json
+
     q = sql.parse("SELECT s.user.tags[5] AS t, s.n FROM S3Object s WHERE s.n = 2")
     out, _ = sql.execute(q, JROWS)
-    assert out == [{"n": 2}]
+    assert out == [{"t": sql.MISSING, "n": 2}]
+    assert write_json(out, {}) == b'{"n": 2}\n'
 
 
 def test_case_expressions():
@@ -276,3 +280,21 @@ def test_boolean_literals_and_is_true():
     q = sql.parse("SELECT v FROM S3Object WHERE ok IS FALSE")
     out, _ = sql.execute(q, rows)
     assert out == [{"v": 2}]
+
+
+def test_big_int_literals_exact():
+    # 2^53+1 must not be rounded through float (review r3 finding)
+    rows = [{"id": 9007199254740993}, {"id": 9007199254740992}]
+    out, _ = sql.execute(sql.parse("SELECT id FROM S3Object WHERE id = 9007199254740993"), rows)
+    assert out == [{"id": 9007199254740993}]
+
+
+def test_missing_projection_csv_alignment():
+    # MISSING fields keep CSV columns aligned (empty field), and are
+    # omitted from JSON output
+    from minio_tpu.s3select.engine import write_csv, write_json
+
+    rows = [{"a": 1, "b": 2}, {"b": 3}]
+    out, _ = sql.execute(sql.parse("SELECT s.a, s.b FROM S3Object s"), rows)
+    assert write_csv(out, {}) == b"1,2\n,3\n"
+    assert write_json(out, {}) == b'{"a": 1, "b": 2}\n{"b": 3}\n'
