@@ -89,6 +89,38 @@ class S3Client:
             expires,
         )
 
+    # -- admin plane (madmin wire) -------------------------------------------
+
+    def admin(
+        self,
+        method: str,
+        op: str,
+        query: dict | None = None,
+        body: bytes | dict | None = None,
+        encrypt_body: bool = False,
+    ) -> S3Response:
+        """Admin call speaking the madmin wire: optionally encrypt the
+        request body and transparently decrypt encrypted responses (both
+        keyed by this client's secret, as `mc admin` does)."""
+        import json as _json
+
+        from .server import madmin
+
+        if isinstance(body, dict):
+            body = _json.dumps(body).encode()
+        body = body or b""
+        if body and encrypt_body:
+            body = madmin.encrypt(self.secret_key, body)
+        r = self.request(method, f"/minio/admin/v3/{op}", query=query, body=body)
+        if r.body and madmin.looks_encrypted(r.body):
+            try:
+                return S3Response(
+                    r.status, r.headers, madmin.decrypt(self.secret_key, r.body)
+                )
+            except madmin.MadminCryptError:
+                pass
+        return r
+
     # -- convenience wrappers ------------------------------------------------
 
     def make_bucket(self, bucket: str) -> S3Response:

@@ -2,9 +2,12 @@
 
 Mirrors the reference's admin router (/root/reference/cmd/admin-router.go,
 admin-handlers*.go) under /minio/admin/v3/: user/group/policy management,
-service accounts, server info, storage info, heal triggering. Bodies are
-plain JSON (the reference's madmin client encrypts bodies with the admin
-credential; our wire format is documented JSON with the same semantics).
+service accounts, server info, storage info, heal triggering. Sensitive
+bodies speak the madmin wire (server/madmin.py): requests from `mc
+admin`-style clients arrive encrypted with the caller's secret key and
+are accepted alongside plain JSON; the responses the reference encrypts
+(user listings, minted credentials, config dumps) always go out
+encrypted, as madmin.DecryptData expects.
 """
 
 from __future__ import annotations
@@ -40,10 +43,27 @@ def _int_q(q, name: str, default: int, lo: int | None = None, hi: int | None = N
 
 async def handle_admin(server, request: web.Request, access_key: str, subpath: str, body: bytes):
     """Dispatch /minio/admin/v3/<op> requests."""
+    from . import madmin
+
     op = subpath.split("?")[0]
     q = request.rel_url.query
     m = request.method
     iam = server.iam
+    secret = iam.lookup_secret(access_key) or ""
+    # madmin clients (`mc admin`) encrypt sensitive bodies with the
+    # requester's secret key; our own SDK sends plain JSON — accept both.
+    # The Argon2id KDF costs ~100 ms + 64 MiB, so it runs off-loop.
+    if body and madmin.looks_encrypted(body):
+        body = await server._run(madmin.maybe_decrypt, secret, body)
+
+    async def _json_madmin(data, status=200) -> web.Response:
+        """Responses the reference wraps with madmin.EncryptData (user
+        listings, minted credentials, config dumps) go out encrypted to
+        the requester's key, exactly as `mc admin` expects."""
+        blob = await server._run(madmin.encrypt, secret, json.dumps(data).encode())
+        return web.Response(
+            status=status, body=blob, content_type="application/octet-stream"
+        )
 
     def authz(action: str):
         if not iam.is_allowed(access_key, action, ""):
@@ -164,7 +184,7 @@ async def handle_admin(server, request: web.Request, access_key: str, subpath: s
     if op == "list-users" and m == "GET":
         authz("admin:ListUsers")
         users = await server._run(iam.list_users)
-        return _json(
+        return await _json_madmin(
             {
                 k: {"status": u.status, "policyName": ",".join(u.policies), "memberOf": u.groups}
                 for k, u in users.items()
@@ -259,7 +279,7 @@ async def handle_admin(server, request: web.Request, access_key: str, subpath: s
             d.get("accessKey", ""),
             d.get("secretKey", ""),
         )
-        return _json(
+        return await _json_madmin(
             {"credentials": {"accessKey": u.access_key, "secretKey": u.secret_key}}
         )
 
@@ -403,7 +423,7 @@ async def handle_admin(server, request: web.Request, access_key: str, subpath: s
     # -- config KV ---------------------------------------------------------
     if op == "get-config" and m == "GET":
         authz("admin:ConfigUpdate")
-        return _json(server.config.dump())
+        return await _json_madmin(server.config.dump())
     if op == "set-config-kv" and m == "PUT":
         authz("admin:ConfigUpdate")
         try:

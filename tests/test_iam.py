@@ -88,7 +88,7 @@ def test_admin_user_lifecycle_and_enforcement(admin, server):
     assert alice.put_object("pub", "nope", b"x").status == 403
     assert alice.delete_object("pub", "doc.txt").status == 403
     # list users
-    r = admin.request("GET", "/minio/admin/v3/list-users")
+    r = admin.admin("GET", "list-users")
     assert r.status == 200 and b"alice" in r.body
     # disable
     assert admin.request(
@@ -131,7 +131,7 @@ def test_custom_policy_and_groups(admin, server):
 
 
 def test_service_account(admin, server):
-    r = admin.request("PUT", "/minio/admin/v3/add-service-account", body=b"{}")
+    r = admin.admin("PUT", "add-service-account", body=b"{}", encrypt_body=True)
     assert r.status == 200
     creds = json.loads(r.body)["credentials"]
     sa = S3Client(f"127.0.0.1:{server.port}", creds["accessKey"], creds["secretKey"])
@@ -276,7 +276,7 @@ def test_service_account_escalation_blocked(admin, server):
     admin.request("PUT", "/minio/admin/v3/set-user-or-group-policy",
                   query={"policyName": "sa-only", "userOrGroup": "mallory"})
     mal = S3Client(f"127.0.0.1:{server.port}", "mallory", "mallorysecret")
-    r = mal.request("PUT", "/minio/admin/v3/add-service-account",
+    r = mal.admin("PUT", "add-service-account",
                     body=json.dumps({"targetUser": "minioadmin"}).encode())
     assert r.status == 403, r.body
 
@@ -288,7 +288,7 @@ def test_disabled_parent_cuts_off_derived_credentials(admin, server):
                   body=json.dumps({"secretKey": "carolsecret"}).encode())
     admin.request("PUT", "/minio/admin/v3/set-user-or-group-policy",
                   query={"policyName": "readwrite", "userOrGroup": "carol"})
-    r = admin.request("PUT", "/minio/admin/v3/add-service-account",
+    r = admin.admin("PUT", "add-service-account",
                       body=json.dumps({"targetUser": "carol"}).encode())
     assert r.status == 200, r.body
     creds = json.loads(r.body)["credentials"]

@@ -136,14 +136,14 @@ def test_batch_expire_job(cli_a):
 
 
 def test_config_kv(cli_a):
-    r = cli_a.request("GET", "/minio/admin/v3/get-config")
+    r = cli_a.admin("GET", "get-config")
     cfg = json.loads(r.body)
     assert "scanner" in cfg and "compression" in cfg
     r = cli_a.request("PUT", "/minio/admin/v3/set-config-kv",
                       body=json.dumps({"subsys": "scanner", "key": "interval",
                                        "value": "120"}).encode())
     assert r.status == 200
-    cfg = json.loads(cli_a.request("GET", "/minio/admin/v3/get-config").body)
+    cfg = json.loads(cli_a.admin("GET", "get-config").body)
     assert cfg["scanner"]["interval"] == "120"
     r = cli_a.request("PUT", "/minio/admin/v3/set-config-kv",
                       body=json.dumps({"subsys": "nope", "key": "x", "value": "1"}).encode())
