@@ -74,6 +74,10 @@ class IAMSys:
         self.users: dict[str, UserIdentity] = {}
         self.groups: dict[str, dict] = {}  # name -> {"members": [...], "policies": [...], "status": ...}
         self.policies: dict[str, Policy] = dict(CANNED_POLICIES)
+        # LDAP DN / group-DN -> [policy names]: mappings for identities
+        # that exist only in the external directory (the reference keeps
+        # these in a dedicated policy DB, cmd/iam.go PolicyDBSet)
+        self.ldap_policy_map: dict[str, list[str]] = {}
         self._loaded = False
         # post-persist hook (site replication); applying_remote suppresses
         # it while importing a peer's snapshot
@@ -110,6 +114,7 @@ class IAMSys:
             self.policies = dict(CANNED_POLICIES)
             for k, v in pol.items():
                 self.policies[k] = Policy.from_dict(v)
+            self.ldap_policy_map = self._load_doc("ldap_policy_map")
             self._loaded = True
 
     def _persist_users(self) -> None:
@@ -206,15 +211,49 @@ class IAMSys:
             if user:
                 u = self.users.get(user)
                 if u is None:
+                    if "=" in user:
+                        # an LDAP DN: the identity lives only in the
+                        # external directory, so the mapping is stored in
+                        # the LDAP policy DB (reference PolicyDBSet for
+                        # LDAP users, cmd/admin-handlers-users.go)
+                        self.ldap_policy_map[user.lower()] = names
+                        self._save("ldap_policy_map", self.ldap_policy_map)
+                        return
                     raise NoSuchUser(user)
                 u.policies = names
                 self._persist_users()
             elif group:
+                if "=" in group:
+                    self.ldap_policy_map[group.lower()] = names
+                    self._save("ldap_policy_map", self.ldap_policy_map)
+                    return
                 g = self.groups.setdefault(
                     group, {"members": [], "policies": [], "status": "enabled"}
                 )
                 g["policies"] = names
                 self._persist_groups()
+
+    def ldap_policies_for(self, user_dn: str, groups: list[str]) -> list[str]:
+        """Policy names mapped to an LDAP user DN or any of its group DNs
+        (the reference's PolicyDBGet(userDN, groups...))."""
+        with self._lock:
+            out: list[str] = []
+            for dn in [user_dn, *groups]:
+                out.extend(self.ldap_policy_map.get(dn.lower(), []))
+            return sorted(set(out))
+
+    def assume_role_ldap(
+        self, user_dn: str, groups: list[str], duration_secs: int,
+        policies: list[str],
+    ) -> tuple[UserIdentity, str]:
+        """STS AssumeRoleWithLDAPIdentity: directory-verified identity,
+        policies resolved from the LDAP policy map at mint time
+        (/root/reference/cmd/sts-handlers.go:649)."""
+        return self._mint_temp(
+            duration_secs,
+            {"ldapUser": user_dn, "ldapGroups": groups},
+            policies=policies,
+        )
 
     # -- service accounts / temp creds --------------------------------------
 

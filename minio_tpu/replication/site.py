@@ -286,6 +286,7 @@ class SiteReplicationSys:
                 "users": users,
                 "groups": json.loads(json.dumps(iam.groups)),
                 "policies": policies,
+                "ldap_policy_map": dict(iam.ldap_policy_map),
             }
 
     def _ensure_worker(self) -> None:
@@ -392,9 +393,11 @@ class SiteReplicationSys:
                 iam.policies = dict(CANNED_POLICIES)
                 for k, v in snap.get("policies", {}).items():
                     iam.policies[k] = Policy.from_dict(v)
+                iam.ldap_policy_map = dict(snap.get("ldap_policy_map", {}))
                 iam._persist_users()
                 iam._persist_groups()
                 iam._persist_policies()
+                iam._save("ldap_policy_map", iam.ldap_policy_map)
             finally:
                 iam.applying_remote = False
 

@@ -29,6 +29,14 @@ DEFAULTS: dict[str, dict[str, str]] = {
     "identity_openid": {
         "config_url": "", "client_id": "", "claim_name": "policy",
     },
+    "identity_ldap": {
+        "server_addr": "", "lookup_bind_dn": "", "lookup_bind_password": "",
+        "user_dn_search_base_dn": "", "user_dn_search_filter": "",
+        "group_search_base_dn": "", "group_search_filter": "",
+        # TLS by default (as the reference); plaintext needs an explicit
+        # server_insecure=on
+        "server_insecure": "off", "tls_skip_verify": "off",
+    },
     "notify_webhook": {"enable": "off", "endpoint": "", "auth_token": ""},
     "notify_nats": {"enable": "off", "address": "", "subject": "minio-events"},
     "notify_redis": {"enable": "off", "address": "", "key": "minio-events"},

@@ -33,19 +33,27 @@ def _credentials_xml(action: str, user, token: str) -> bytes:
     ).encode()
 
 
+def _duration(form: dict) -> int:
+    """DurationSeconds form param -> int, 400 on garbage (shared by all
+    AssumeRole* variants)."""
+    try:
+        return int(form.get("DurationSeconds", "3600") or "3600")
+    except ValueError:
+        raise s3err.InvalidArgument from None
+
+
 async def handle_sts(server, request: web.Request, access_key: str, body: bytes):
     form = dict(urllib.parse.parse_qsl(body.decode("utf-8", "replace")))
     action = form.get("Action", "")
     if action == "AssumeRoleWithWebIdentity":
         return await _web_identity(server, form)
+    if action == "AssumeRoleWithLDAPIdentity":
+        return await _ldap_identity(server, form)
     if action != "AssumeRole":
         raise s3err.NotImplemented_
     if not access_key:
         raise s3err.AccessDenied
-    try:
-        duration = int(form.get("DurationSeconds", "3600") or "3600")
-    except ValueError:
-        raise s3err.InvalidArgument from None
+    duration = _duration(form)
     policy = None
     if form.get("Policy"):
         try:
@@ -57,6 +65,47 @@ async def handle_sts(server, request: web.Request, access_key: str, body: bytes)
     )
     return web.Response(
         body=_credentials_xml("AssumeRole", user, token),
+        content_type="application/xml",
+    )
+
+
+async def _ldap_identity(server, form: dict) -> web.Response:
+    """Directory-backed STS: the LDAP username/password pair IS the
+    credential — no SigV4 auth required
+    (/root/reference/cmd/sts-handlers.go:649 AssumeRoleWithLDAPIdentity:
+    lookup-bind search -> user bind -> policy map -> temp credentials)."""
+    from ..iam import ldap as ldapmod
+
+    cfg = ldapmod.from_config(server.config)
+    if not cfg.enabled:
+        raise s3err.NotImplemented_
+    username = form.get("LDAPUsername", "")
+    password = form.get("LDAPPassword", "")
+    if not username or not password:
+        raise s3err.InvalidArgument
+    duration = _duration(form)
+    try:
+        user_dn, groups = await server._run(cfg.bind_user, username, password)
+    except ldapmod.LDAPError:
+        raise s3err.AccessDenied from None
+    except (OSError, ValueError):
+        # directory unreachable, or a malformed configured filter
+        # template: a server-side failure, not bad credentials
+        raise s3err.InternalError from None
+    # stale names (policy deleted after mapping) drop out; reject only
+    # when NOTHING valid remains (the reference's PolicyDBGet behavior)
+    policies = [
+        p
+        for p in server.iam.ldap_policies_for(user_dn, groups)
+        if p in server.iam.policies
+    ]
+    if not policies:
+        raise s3err.AccessDenied
+    user, session = await server._run(
+        server.iam.assume_role_ldap, user_dn, groups, duration, policies
+    )
+    return web.Response(
+        body=_credentials_xml("AssumeRoleWithLDAPIdentity", user, session),
         content_type="application/xml",
     )
 
@@ -75,10 +124,7 @@ async def _web_identity(server, form: dict) -> web.Response:
     token = form.get("WebIdentityToken", "")
     if not token:
         raise s3err.InvalidArgument
-    try:
-        duration = int(form.get("DurationSeconds", "3600") or "3600")
-    except ValueError:
-        raise s3err.InvalidArgument from None
+    duration = _duration(form)
     try:
         claims = await server._run(provider.validate, token)
     except OIDCError:
