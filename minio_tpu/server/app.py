@@ -3033,6 +3033,7 @@ def main(argv: list[str] | None = None) -> None:
     ap.add_argument("--address", default="0.0.0.0:9000")
     ap.add_argument("--set-size", type=int, default=0, help="drives per erasure set")
     ap.add_argument("--ftp", type=int, default=0, help="FTP gateway port (0=off)")
+    ap.add_argument("--sftp", type=int, default=0, help="SFTP gateway port (0=off)")
     args = ap.parse_args(argv)
     host, _, port = args.address.rpartition(":")
     my_port = int(port)
@@ -3100,6 +3101,16 @@ def main(argv: list[str] | None = None) -> None:
 
                 await FTPGateway(srv).serve(host or "0.0.0.0", args.ftp)
                 print(f"FTP gateway on port {args.ftp}", flush=True)
+            if args.sftp:
+                from .sftp import SFTPGateway, load_authorized_keys
+
+                SFTPGateway(
+                    srv,
+                    authorized_keys=load_authorized_keys(
+                        os.environ.get("MINIO_SFTP_AUTHORIZED_KEYS")
+                    ),
+                ).listen(host or "0.0.0.0", args.sftp)
+                print(f"SFTP gateway on port {args.sftp}", flush=True)
 
         app["bootstrap"] = asyncio.create_task(boot_then_gateways())
 

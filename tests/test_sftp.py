@@ -1,0 +1,488 @@
+"""SFTP frontend: full-stack tests driving the from-scratch SSH transport
+with a client built on the same wire primitives (no SSH client ships in
+the image). Reference surface: /root/reference/cmd/sftp-server.go."""
+
+import os
+import socket
+import struct
+import threading
+import time
+
+os.environ.setdefault("MINIO_TPU_BACKEND", "numpy")
+
+import pytest
+
+from minio_tpu.client import S3Client
+from minio_tpu.server import sftp as sftpmod
+from minio_tpu.server import ssh as sshmod
+from minio_tpu.server.sftp import (
+    FX_EOF,
+    FX_NO_SUCH_FILE,
+    FX_OK,
+    FX_PERMISSION_DENIED,
+    FXP_ATTRS,
+    FXP_CLOSE,
+    FXP_DATA,
+    FXP_HANDLE,
+    FXP_INIT,
+    FXP_MKDIR,
+    FXP_NAME,
+    FXP_OPEN,
+    FXP_OPENDIR,
+    FXP_READ,
+    FXP_READDIR,
+    FXP_REALPATH,
+    FXP_REMOVE,
+    FXP_RENAME,
+    FXP_RMDIR,
+    FXP_STAT,
+    FXP_STATUS,
+    FXP_VERSION,
+    FXP_WRITE,
+    PF_CREAT,
+    PF_READ,
+    PF_TRUNC,
+    PF_WRITE,
+)
+from minio_tpu.server.ssh import (
+    MSG_CHANNEL_DATA,
+    MSG_CHANNEL_OPEN,
+    MSG_CHANNEL_OPEN_CONFIRMATION,
+    MSG_CHANNEL_REQUEST,
+    MSG_CHANNEL_SUCCESS,
+    MSG_CHANNEL_WINDOW_ADJUST,
+    MSG_SERVICE_ACCEPT,
+    MSG_SERVICE_REQUEST,
+    MSG_USERAUTH_FAILURE,
+    MSG_USERAUTH_REQUEST,
+    MSG_USERAUTH_SUCCESS,
+    SSHError,
+    SSHTransport,
+    wstr,
+    wu32,
+)
+
+from test_s3_api import ServerThread
+
+
+class SFTPClient:
+    """Minimal SFTP v3 client over the client role of SSHTransport."""
+
+    def __init__(self, port: int, user: str, password: str = "", key=None):
+        sock = socket.create_connection(("127.0.0.1", port), timeout=30)
+        self.tr = SSHTransport(sock, "client")
+        self.tr.handshake()
+        if key is not None:
+            self._auth_pubkey(user, key)
+        else:
+            self._auth(user, password)
+        self._open_channel()
+        self.rid = 0
+        self.buf = b""
+        self._req(bytes([FXP_INIT]) + wu32(3), raw=True)
+        t, _, payload = self._read_sftp()
+        assert t == FXP_VERSION
+
+    def _auth(self, user, password):
+        self.tr.send_packet(
+            bytes([MSG_SERVICE_REQUEST]) + wstr("ssh-userauth")
+        )
+        t, r = self.tr.read_msg()
+        assert t == MSG_SERVICE_ACCEPT
+        self.tr.send_packet(
+            bytes([MSG_USERAUTH_REQUEST])
+            + wstr(user) + wstr("ssh-connection") + wstr("password")
+            + b"\x00" + wstr(password)
+        )
+        t, r = self.tr.read_msg()
+        if t == MSG_USERAUTH_FAILURE:
+            raise PermissionError("auth failed")
+        assert t == MSG_USERAUTH_SUCCESS
+
+    def _auth_pubkey(self, user, key):
+        from cryptography.hazmat.primitives.serialization import Encoding, PublicFormat
+
+        from minio_tpu.server.ssh import MSG_USERAUTH_PK_OK, publickey_auth_blob
+
+        self.tr.send_packet(
+            bytes([MSG_SERVICE_REQUEST]) + wstr("ssh-userauth")
+        )
+        t, r = self.tr.read_msg()
+        assert t == MSG_SERVICE_ACCEPT
+        blob = wstr(b"ssh-ed25519") + wstr(
+            key.public_key().public_bytes(Encoding.Raw, PublicFormat.Raw)
+        )
+        # probe first (RFC 4252 section 7), then sign
+        self.tr.send_packet(
+            bytes([MSG_USERAUTH_REQUEST])
+            + wstr(user) + wstr("ssh-connection") + wstr("publickey")
+            + b"\x00" + wstr(b"ssh-ed25519") + wstr(blob)
+        )
+        t, r = self.tr.read_msg()
+        if t == MSG_USERAUTH_FAILURE:
+            raise PermissionError("key not trusted")
+        assert t == MSG_USERAUTH_PK_OK
+        sig = key.sign(
+            publickey_auth_blob(self.tr.session_id, user, b"ssh-ed25519", blob)
+        )
+        self.tr.send_packet(
+            bytes([MSG_USERAUTH_REQUEST])
+            + wstr(user) + wstr("ssh-connection") + wstr("publickey")
+            + b"\x01" + wstr(b"ssh-ed25519") + wstr(blob)
+            + wstr(wstr(b"ssh-ed25519") + wstr(sig))
+        )
+        t, r = self.tr.read_msg()
+        if t != MSG_USERAUTH_SUCCESS:
+            raise PermissionError("signature rejected")
+
+    def _open_channel(self):
+        self.chan = 0
+        self.tr.send_packet(
+            bytes([MSG_CHANNEL_OPEN]) + wstr("session")
+            + wu32(self.chan) + wu32(1 << 31 - 1) + wu32(32768)
+        )
+        t, r = self.tr.read_msg()
+        assert t == MSG_CHANNEL_OPEN_CONFIRMATION
+        r.u32()
+        self.server_chan = r.u32()
+        self.tr.send_packet(
+            bytes([MSG_CHANNEL_REQUEST]) + wu32(self.server_chan)
+            + wstr("subsystem") + b"\x01" + wstr("sftp")
+        )
+        t, _ = self.tr.read_msg()
+        assert t == MSG_CHANNEL_SUCCESS
+
+    def _send_sftp(self, payload: bytes):
+        framed = struct.pack(">I", len(payload)) + payload
+        self.tr.send_packet(
+            bytes([MSG_CHANNEL_DATA]) + wu32(self.server_chan) + wstr(framed)
+        )
+
+    def _req(self, body_after_type: bytes, raw=False) -> int:
+        if raw:
+            self._send_sftp(body_after_type)
+            return 0
+        self.rid += 1
+        t = body_after_type[0]
+        self._send_sftp(bytes([t]) + wu32(self.rid) + body_after_type[1:])
+        return self.rid
+
+    def _read_sftp(self):
+        while True:
+            if len(self.buf) >= 4:
+                n = struct.unpack(">I", self.buf[:4])[0]
+                if len(self.buf) >= 4 + n:
+                    pkt = self.buf[4 : 4 + n]
+                    self.buf = self.buf[4 + n :]
+                    t = pkt[0]
+                    if t == FXP_VERSION:
+                        return t, None, pkt[1:]
+                    rid = struct.unpack(">I", pkt[1:5])[0]
+                    return t, rid, pkt[5:]
+            t, r = self.tr.read_msg()
+            if t == MSG_CHANNEL_DATA:
+                r.u32()
+                self.buf += r.str_()
+            elif t == MSG_CHANNEL_WINDOW_ADJUST:
+                continue
+            else:
+                raise SSHError(f"unexpected msg {t}")
+
+    def _expect_status(self, rid: int) -> tuple[int, str]:
+        t, got, payload = self._read_sftp()
+        assert t == FXP_STATUS and got == rid, (t, got, rid)
+        code = struct.unpack(">I", payload[:4])[0]
+        mlen = struct.unpack(">I", payload[4:8])[0]
+        return code, payload[8 : 8 + mlen].decode()
+
+    # -- operations --------------------------------------------------------
+
+    def realpath(self, path: str) -> str:
+        rid = self._req(bytes([FXP_REALPATH]) + wstr(path))
+        t, _, payload = self._read_sftp()
+        assert t == FXP_NAME
+        n = struct.unpack(">I", payload[4 - 4 : 4])[0]
+        assert n == 1
+        ln = struct.unpack(">I", payload[4:8])[0]
+        return payload[8 : 8 + ln].decode()
+
+    def stat(self, path: str):
+        rid = self._req(bytes([FXP_STAT]) + wstr(path))
+        t, _, payload = self._read_sftp()
+        if t == FXP_STATUS:
+            code = struct.unpack(">I", payload[:4])[0]
+            raise FileNotFoundError(code)
+        assert t == FXP_ATTRS
+        flags = struct.unpack(">I", payload[:4])[0]
+        size = struct.unpack(">Q", payload[4:12])[0] if flags & 0x1 else 0
+        perms = 0
+        off = 4 + (8 if flags & 0x1 else 0)
+        if flags & 0x4:
+            perms = struct.unpack(">I", payload[off : off + 4])[0]
+        return size, perms
+
+    def listdir(self, path: str) -> list[str]:
+        rid = self._req(bytes([FXP_OPENDIR]) + wstr(path))
+        t, _, payload = self._read_sftp()
+        if t == FXP_STATUS:
+            raise PermissionError(struct.unpack(">I", payload[:4])[0])
+        assert t == FXP_HANDLE
+        hlen = struct.unpack(">I", payload[:4])[0]
+        handle = payload[4 : 4 + hlen]
+        names = []
+        while True:
+            rid = self._req(bytes([FXP_READDIR]) + wstr(handle))
+            t, _, payload = self._read_sftp()
+            if t == FXP_STATUS:
+                code = struct.unpack(">I", payload[:4])[0]
+                assert code == FX_EOF
+                break
+            assert t == FXP_NAME
+            count = struct.unpack(">I", payload[:4])[0]
+            p = 4
+            for _ in range(count):
+                ln = struct.unpack(">I", payload[p : p + 4])[0]
+                names.append(payload[p + 4 : p + 4 + ln].decode())
+                p += 4 + ln
+                ln2 = struct.unpack(">I", payload[p : p + 4])[0]
+                p += 4 + ln2
+                # skip attrs
+                flags = struct.unpack(">I", payload[p : p + 4])[0]
+                p += 4
+                if flags & 0x1:
+                    p += 8
+                if flags & 0x2:
+                    p += 8
+                if flags & 0x4:
+                    p += 4
+                if flags & 0x8:
+                    p += 8
+        rid = self._req(bytes([FXP_CLOSE]) + wstr(handle))
+        self._expect_status(rid)
+        return names
+
+    def _open(self, path: str, flags: int) -> bytes:
+        rid = self._req(bytes([FXP_OPEN]) + wstr(path) + wu32(flags) + wu32(0))
+        t, _, payload = self._read_sftp()
+        if t == FXP_STATUS:
+            code = struct.unpack(">I", payload[:4])[0]
+            if code == FX_PERMISSION_DENIED:
+                raise PermissionError(path)
+            raise FileNotFoundError(code)
+        assert t == FXP_HANDLE
+        hlen = struct.unpack(">I", payload[:4])[0]
+        return payload[4 : 4 + hlen]
+
+    def put(self, path: str, data: bytes, chunk: int = 32000):
+        h = self._open(path, PF_WRITE | PF_CREAT | PF_TRUNC)
+        off = 0
+        while off < len(data):
+            part = data[off : off + chunk]
+            rid = self._req(
+                bytes([FXP_WRITE]) + wstr(h) + struct.pack(">Q", off) + wstr(part)
+            )
+            code, _ = self._expect_status(rid)
+            assert code == FX_OK
+            off += len(part)
+        rid = self._req(bytes([FXP_CLOSE]) + wstr(h))
+        code, msg = self._expect_status(rid)
+        assert code == FX_OK, msg
+
+    def get(self, path: str, chunk: int = 32000) -> bytes:
+        h = self._open(path, PF_READ)
+        out = b""
+        while True:
+            rid = self._req(
+                bytes([FXP_READ]) + wstr(h) + struct.pack(">Q", len(out)) + wu32(chunk)
+            )
+            t, _, payload = self._read_sftp()
+            if t == FXP_STATUS:
+                code = struct.unpack(">I", payload[:4])[0]
+                assert code == FX_EOF
+                break
+            assert t == FXP_DATA
+            n = struct.unpack(">I", payload[:4])[0]
+            out += payload[4 : 4 + n]
+        rid = self._req(bytes([FXP_CLOSE]) + wstr(h))
+        self._expect_status(rid)
+        return out
+
+    def remove(self, path: str) -> int:
+        rid = self._req(bytes([FXP_REMOVE]) + wstr(path))
+        return self._expect_status(rid)[0]
+
+    def mkdir(self, path: str) -> int:
+        rid = self._req(bytes([FXP_MKDIR]) + wstr(path) + wu32(0))
+        return self._expect_status(rid)[0]
+
+    def rmdir(self, path: str) -> int:
+        rid = self._req(bytes([FXP_RMDIR]) + wstr(path))
+        return self._expect_status(rid)[0]
+
+    def rename(self, src: str, dst: str) -> int:
+        rid = self._req(bytes([FXP_RENAME]) + wstr(src) + wstr(dst))
+        return self._expect_status(rid)[0]
+
+    def close(self):
+        self.tr.disconnect()
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    base = tmp_path_factory.mktemp("sftpdrives")
+    st = ServerThread([str(base / f"d{i}") for i in range(4)])
+    yield st
+    st.stop()
+
+
+@pytest.fixture(scope="module")
+def gateway(server):
+    # attach the SFTP gateway to the live S3 server instance
+    gw = sftpmod.SFTPGateway(server.srv)
+    port = gw.listen("127.0.0.1", 0)
+    yield gw, port
+    gw.close()
+
+
+@pytest.fixture(scope="module")
+def s3(server):
+    return S3Client(f"127.0.0.1:{server.port}")
+
+
+@pytest.fixture()
+def cli(gateway):
+    _, port = gateway
+    c = SFTPClient(port, "minioadmin", "minioadmin")
+    yield c
+    c.close()
+
+
+def test_handshake_and_auth(gateway):
+    _, port = gateway
+    c = SFTPClient(port, "minioadmin", "minioadmin")
+    assert c.realpath(".") == "/"
+    c.close()
+
+
+def test_bad_password_rejected(gateway):
+    _, port = gateway
+    with pytest.raises(PermissionError):
+        SFTPClient(port, "minioadmin", "wrongpass")
+
+
+def test_mkdir_put_get_roundtrip(cli, s3):
+    assert cli.mkdir("/sftpbkt") == FX_OK
+    assert s3.bucket_exists("sftpbkt")
+    data = os.urandom(300_000)  # spans several WRITE/READ packets
+    cli.put("/sftpbkt/dir/file.bin", data)
+    assert cli.get("/sftpbkt/dir/file.bin") == data
+    # visible over S3 too — same object layer
+    assert s3.get_object("sftpbkt", "dir/file.bin").body == data
+
+
+def test_stat_and_listing(cli, s3):
+    s3.put_object("sftpbkt", "a.txt", b"hello")
+    size, perms = cli.stat("/sftpbkt/a.txt")
+    assert size == 5
+    import stat as stat_mod
+
+    assert stat_mod.S_ISREG(perms)
+    _, perms = cli.stat("/sftpbkt")
+    assert stat_mod.S_ISDIR(perms)
+    names = cli.listdir("/")
+    assert "sftpbkt" in names
+    names = cli.listdir("/sftpbkt")
+    assert "a.txt" in names and "dir" in names
+    assert "dir/file.bin" not in names  # delimiter listing
+    assert cli.listdir("/sftpbkt/dir") == ["file.bin"]
+
+
+def test_stat_missing(cli):
+    with pytest.raises(FileNotFoundError):
+        cli.stat("/sftpbkt/nope.bin")
+    with pytest.raises(FileNotFoundError):
+        cli.stat("/nobucket")
+
+
+def test_remove_and_rename(cli, s3):
+    s3.put_object("sftpbkt", "old.txt", b"payload")
+    assert cli.rename("/sftpbkt/old.txt", "/sftpbkt/new.txt") == FX_OK
+    assert s3.head_object("sftpbkt", "old.txt").status == 404
+    assert s3.get_object("sftpbkt", "new.txt").body == b"payload"
+    assert cli.remove("/sftpbkt/new.txt") == FX_OK
+    assert cli.remove("/sftpbkt/new.txt") == FX_NO_SUCH_FILE
+
+
+def test_iam_enforcement(gateway, s3):
+    import json
+
+    _, port = gateway
+    # a user whose policy only allows reading sftpbkt
+    pol = {
+        "Version": "2012-10-17",
+        "Statement": [
+            {
+                "Effect": "Allow",
+                "Action": ["s3:GetObject", "s3:ListBucket", "s3:ListAllMyBuckets"],
+                "Resource": ["arn:aws:s3:::sftpbkt", "arn:aws:s3:::sftpbkt/*", "arn:aws:s3:::*"],
+            }
+        ],
+    }
+    s3.request(
+        "PUT", "/minio/admin/v3/add-canned-policy", query={"name": "sftp-ro"},
+        body=json.dumps(pol).encode(),
+    )
+    s3.request(
+        "PUT", "/minio/admin/v3/add-user", query={"accessKey": "sftpro"},
+        body=json.dumps({"secretKey": "sftprosecret"}).encode(),
+    )
+    s3.request(
+        "PUT", "/minio/admin/v3/set-user-or-group-policy",
+        query={"policyName": "sftp-ro", "userOrGroup": "sftpro"},
+    )
+    s3.put_object("sftpbkt", "ro.txt", b"read-me")
+    c = SFTPClient(port, "sftpro", "sftprosecret")
+    try:
+        assert c.get("/sftpbkt/ro.txt") == b"read-me"
+        with pytest.raises(PermissionError):
+            c.put("/sftpbkt/won't.txt", b"nope")
+    finally:
+        c.close()
+
+
+def test_large_transfer(cli, s3):
+    data = os.urandom(3 * 1024 * 1024)
+    cli.put("/sftpbkt/big.bin", data)
+    assert cli.get("/sftpbkt/big.bin") == data
+
+
+def test_rmdir_bucket(cli, s3):
+    assert cli.mkdir("/scratchbkt") == FX_OK
+    assert cli.rmdir("/scratchbkt") == FX_OK
+    assert not s3.bucket_exists("scratchbkt")
+
+
+def test_publickey_auth(server, s3):
+    from cryptography.hazmat.primitives.asymmetric import ed25519
+    from cryptography.hazmat.primitives.serialization import Encoding, PublicFormat
+
+    from minio_tpu.server.ssh import wstr as _wstr
+
+    key = ed25519.Ed25519PrivateKey.generate()
+    blob = _wstr(b"ssh-ed25519") + _wstr(
+        key.public_key().public_bytes(Encoding.Raw, PublicFormat.Raw)
+    )
+    gw = sftpmod.SFTPGateway(
+        server.srv, authorized_keys={"minioadmin": {blob}}
+    )
+    port = gw.listen("127.0.0.1", 0)
+    try:
+        c = SFTPClient(port, "minioadmin", key=key)
+        assert c.realpath(".") == "/"
+        c.close()
+        # an untrusted key is refused at the probe
+        other = ed25519.Ed25519PrivateKey.generate()
+        with pytest.raises(PermissionError):
+            SFTPClient(port, "minioadmin", key=other)
+    finally:
+        gw.close()
