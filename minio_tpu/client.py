@@ -52,6 +52,7 @@ class S3Client:
         body: bytes = b"",
         headers: dict[str, str] | None = None,
         unsigned_payload: bool = False,
+        timeout: float = 60.0,
     ) -> S3Response:
         qs = urllib.parse.urlencode(query or {})
         enc_path = urllib.parse.quote(path, safe="/~-._")
@@ -65,7 +66,7 @@ class S3Client:
         signed = sign_request(
             method, url, headers or {}, payload, self.access_key, self.secret_key, self.region
         )
-        conn = http.client.HTTPConnection(self.host, self.port, timeout=60)
+        conn = http.client.HTTPConnection(self.host, self.port, timeout=timeout)
         try:
             conn.request(method, enc_path + (f"?{qs}" if qs else ""), body=body, headers=signed)
             resp = conn.getresponse()

@@ -175,6 +175,8 @@ class ReplicationPool:
         ]
         self._rules_cache: dict[str, tuple[str, list[ReplicationRule]]] = {}
         self.stats = {"replicated": 0, "deletes": 0, "failed": 0, "queued": 0}
+        # per-bucket counters for the v3 /bucket/replication metrics group
+        self.bucket_stats: dict[str, dict[str, int]] = {}
         self._threads = [
             threading.Thread(target=self._loop, args=(q_,), daemon=True,
                              name=f"repl-{i}")
@@ -212,8 +214,10 @@ class ReplicationPool:
                         _Task(bucket, key, version_id, op, rule.destination_arn)
                     )
                     self.stats["queued"] += 1
+                    self._bstat(bucket, "queued")
                 except queue.Full:
                     self.stats["failed"] += 1
+                    self._bstat(bucket, "failed")
 
     def resync(self, bucket: str) -> int:
         """Replay the whole bucket to its targets (reference resync)."""
@@ -229,6 +233,13 @@ class ReplicationPool:
         deadline = time.monotonic() + timeout
         while any(not q_.empty() for q_ in self._qs) and time.monotonic() < deadline:
             time.sleep(0.05)
+
+
+    def _bstat(self, bucket: str, key: str) -> None:
+        rec = self.bucket_stats.setdefault(
+            bucket, {"replicated": 0, "deletes": 0, "failed": 0, "queued": 0}
+        )
+        rec[key] += 1
 
     # -- worker ------------------------------------------------------------
 
@@ -246,6 +257,7 @@ class ReplicationPool:
                     ).start()
                 else:
                     self.stats["failed"] += 1
+                    self._bstat(task.bucket, "failed")
 
     def _replicate(self, task: _Task) -> None:
         arn = task.arn
@@ -270,6 +282,7 @@ class ReplicationPool:
             if r.status not in (200, 204, 404):
                 raise RuntimeError(f"remote delete failed: HTTP {r.status}")
             self.stats["deletes"] += 1
+            self._bstat(task.bucket, "deletes")
             return
         oi, it = self.store.get_object(task.bucket, task.key, task.version_id)
         data = b"".join(it)
@@ -284,3 +297,4 @@ class ReplicationPool:
         if r.status != 200:
             raise RuntimeError(f"remote put failed: HTTP {r.status}")
         self.stats["replicated"] += 1
+        self._bstat(task.bucket, "replicated")

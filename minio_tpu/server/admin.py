@@ -420,6 +420,26 @@ async def handle_admin(server, request: web.Request, access_key: str, subpath: s
             authz("admin:RebalancePool")
             return _json(pm.stop_rebalance())
 
+    # -- profiling (reference cmd/admin-handlers.go:1024 ProfileHandler) ---
+    if op == "profile" and m == "POST":
+        authz("admin:Profiling")
+        from . import profiling
+
+        ptype = q.get("profilerType", "cpu")
+        try:
+            duration = min(float(q.get("duration", "5") or 5), 120.0)
+        except ValueError:
+            raise s3err.InvalidArgument from None
+        if ptype not in profiling.PROFILERS:
+            raise s3err.InvalidArgument
+        if q.get("local") == "true":
+            # fan-out leaf: profile this node only
+            text = await server._run(profiling.run_local, ptype, duration)
+            return _json({"nodes": {"local": {ptype: text}}})
+        return _json(
+            await server._run(profiling.run_cluster, server, ptype, duration)
+        )
+
     # -- config KV ---------------------------------------------------------
     if op == "get-config" and m == "GET":
         authz("admin:ConfigUpdate")

@@ -447,10 +447,12 @@ class S3Server:
 
         t0 = _time.perf_counter()
         resp: web.StreamResponse | None = None
+        self.metrics.inflight += 1  # single-threaded event loop: no race
         try:
             resp = await self._entry_inner(request)
             return resp
         finally:
+            self.metrics.inflight -= 1
             dur = _time.perf_counter() - t0
             status = resp.status if resp is not None else 500
             api = classify_api(
@@ -461,7 +463,10 @@ class S3Server:
             )
             rx = int(request.headers.get("Content-Length") or 0)
             tx = getattr(resp, "content_length", None) or 0 if resp else 0
-            self.metrics.observe(api, status, dur, rx, tx)
+            self.metrics.observe(
+                api, status, dur, rx, tx,
+                bucket=request.match_info.get("bucket", ""),
+            )
             if self.trace.active:
                 self.trace.publish(trace_record(request, status, dur, rx, tx))
             audit = getattr(self, "audit", None)
@@ -480,14 +485,24 @@ class S3Server:
             if key.startswith("health/"):
                 # disk probes may hit remote drives: stay off the event loop
                 return await self._run(self._health, request, key)
-            if key in ("v2/metrics/cluster", "v2/metrics/node", "metrics/v3"):
+            if key in ("v2/metrics/cluster", "v2/metrics/node") or key.startswith(
+                "metrics/v3"
+            ):
                 if self.store is None:
                     return web.Response(status=503)
                 if os.environ.get("MINIO_PROMETHEUS_AUTH_TYPE", "jwt") != "public":
                     ak, _ = await self._authenticate(request)
                     if not ak or not self.iam.is_allowed(ak, "admin:Prometheus", ""):
                         raise s3err.AccessDenied
-                text = await self._run(self.metrics.render, self)
+                if key.startswith("metrics/v3"):
+                    from .metrics import render_v3
+
+                    sub = key[len("metrics/v3"):]
+                    text = await self._run(render_v3, self, sub)
+                    if text is None:
+                        return web.Response(status=404, body=b"unknown metrics path")
+                else:
+                    text = await self._run(self.metrics.render, self)
                 return web.Response(body=text.encode(), content_type="text/plain")
         try:
             if self.store is None:
@@ -3056,6 +3071,7 @@ def main(argv: list[str] | None = None) -> None:
     ns_lock = NamespaceLock(lockers)
 
     srv = S3Server(None)
+    srv.peers = peers  # cluster peers, for admin profile/pprof fan-out
     StorageRESTServer(registry, token).register(srv.app)
     LockRESTServer(local_locker, token).register(srv.app)
 

@@ -10,9 +10,13 @@ zero-cost-when-idle trace pubsub behind `mc admin trace`
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 from collections import defaultdict
+
+
+MAX_BUCKET_SERIES = 1000  # bound per-bucket label cardinality
 
 
 class Metrics:
@@ -26,19 +30,47 @@ class Metrics:
         self.tx_bytes = 0
         self.request_seconds: dict[str, float] = defaultdict(float)
         self.inflight = 0
+        # per-bucket: bucket -> api -> [requests, errors, rx, tx]
+        self.bucket_api: dict[str, dict[str, list]] = {}
 
-    def observe(self, api: str, status: int, dur: float, rx: int, tx: int) -> None:
+    def observe(
+        self, api: str, status: int, dur: float, rx: int, tx: int,
+        bucket: str = "",
+    ) -> None:
         with self._mu:
             self.requests_total[api] += 1
             self.request_seconds[api] += dur
             self.rx_bytes += rx
             self.tx_bytes += tx
+            err = status >= 400
             if status >= 500:
                 self.errors_5xx += 1
                 self.errors_total[api] += 1
-            elif status >= 400:
+            elif err:
                 self.errors_4xx += 1
                 self.errors_total[api] += 1
+            # series creation rules: never for the /minio/* pseudo-bucket
+            # or system paths, and never for a FAILED request on an
+            # untracked name — otherwise an unauthenticated scanner
+            # walking random paths would mint junk series up to the cap
+            # and real buckets could never register
+            if (
+                bucket
+                and bucket != "minio"
+                and not bucket.startswith(".minio.sys")
+                and (bucket in self.bucket_api or not err)
+                and (
+                    bucket in self.bucket_api
+                    or len(self.bucket_api) < MAX_BUCKET_SERIES
+                )
+            ):
+                rec = self.bucket_api.setdefault(bucket, {}).setdefault(
+                    api, [0, 0, 0, 0]
+                )
+                rec[0] += 1
+                rec[1] += 1 if err else 0
+                rec[2] += rx
+                rec[3] += tx
 
     def render(self, server) -> str:
         """Prometheus text exposition for the cluster endpoint."""
@@ -188,3 +220,397 @@ def classify_api(method: str, bucket: str, key: str, query) -> str:
 
 def dump_json(obj) -> bytes:
     return json.dumps(obj).encode()
+
+
+# -- metrics v3: grouped registry with path filtering ------------------------
+#
+# Mirrors /root/reference/cmd/metrics-v3.go: each collector path under
+# /minio/metrics/v3 returns one group; /bucket/* paths take a bucket name
+# suffix. GET /minio/metrics/v3 (no path) concatenates every non-bucket
+# group, /minio/metrics/v3/cluster/... serves one subtree, etc.
+
+
+def _fmt(lines: list[str], name: str, mtype: str, values, help_: str = "") -> None:
+    if help_:
+        lines.append(f"# HELP {name} {help_}")
+    lines.append(f"# TYPE {name} {mtype}")
+    for labels, v in values:
+        if labels:
+            lab = ",".join(f'{k}="{v2}"' for k, v2 in labels.items())
+            lines.append(f"{name}{{{lab}}} {v}")
+        else:
+            lines.append(f"{name} {v}")
+
+
+def _g_api_requests(server) -> list[str]:
+    m = server.metrics
+    out: list[str] = []
+    with m._mu:
+        _fmt(out, "minio_api_requests_total", "counter",
+             [({"name": a}, n) for a, n in sorted(m.requests_total.items())],
+             "Total requests by API")
+        _fmt(out, "minio_api_requests_errors_total", "counter",
+             [({"name": a}, n) for a, n in sorted(m.errors_total.items())])
+        _fmt(out, "minio_api_requests_4xx_errors_total", "counter", [({}, m.errors_4xx)])
+        _fmt(out, "minio_api_requests_5xx_errors_total", "counter", [({}, m.errors_5xx)])
+        _fmt(out, "minio_api_requests_incoming_bytes_total", "counter", [({}, m.rx_bytes)])
+        _fmt(out, "minio_api_requests_outgoing_bytes_total", "counter", [({}, m.tx_bytes)])
+        _fmt(out, "minio_api_requests_ttfb_seconds_total", "counter",
+             [({"name": a}, f"{s:.6f}") for a, s in sorted(m.request_seconds.items())])
+        _fmt(out, "minio_api_requests_inflight_total", "gauge", [({}, m.inflight)])
+    return out
+
+
+def _g_bucket_api(server, bucket: str) -> list[str]:
+    m = server.metrics
+    out: list[str] = []
+    with m._mu:
+        apis = m.bucket_api.get(bucket, {})
+        _fmt(out, "minio_bucket_api_traffic_received_bytes", "counter",
+             [({"bucket": bucket, "name": a}, r[2]) for a, r in sorted(apis.items())])
+        _fmt(out, "minio_bucket_api_traffic_sent_bytes", "counter",
+             [({"bucket": bucket, "name": a}, r[3]) for a, r in sorted(apis.items())])
+        _fmt(out, "minio_bucket_api_requests_total", "counter",
+             [({"bucket": bucket, "name": a}, r[0]) for a, r in sorted(apis.items())])
+        _fmt(out, "minio_bucket_api_requests_errors_total", "counter",
+             [({"bucket": bucket, "name": a}, r[1]) for a, r in sorted(apis.items())])
+    return out
+
+
+def _g_bucket_replication(server, bucket: str) -> list[str]:
+    out: list[str] = []
+    repl = getattr(server, "replication", None)
+    st = (
+        dict(repl.bucket_stats.get(bucket, {})) if repl is not None else {}
+    )
+    _fmt(out, "minio_bucket_replication_total", "counter",
+         [({"bucket": bucket}, st.get("replicated", 0))])
+    _fmt(out, "minio_bucket_replication_failed_total", "counter",
+         [({"bucket": bucket}, st.get("failed", 0))])
+    _fmt(out, "minio_bucket_replication_deletes_total", "counter",
+         [({"bucket": bucket}, st.get("deletes", 0))])
+    return out
+
+
+_DRIVE_PROBE_TTL = 5.0
+
+
+def _probe_drives(server) -> dict:
+    """One disk_info() sweep shared by every group in a render window —
+    in distributed mode each probe of a remote drive is a storage-REST
+    RPC, so per-group probing would triple the scrape cost."""
+    m = server.metrics
+    now = time.monotonic()
+    cached = getattr(m, "_drive_probe", None)
+    if cached is not None and now - cached[0] < _DRIVE_PROBE_TTL:
+        return cached[1]
+    per_drive = []
+    by_id: dict[int, bool] = {}
+    for d in server.store.disks:
+        path = getattr(d, "path", getattr(d, "endpoint", "?"))
+        try:
+            di = d.disk_info()
+            per_drive.append((str(path), di.total, di.free, 1))
+            by_id[id(d)] = True
+        except Exception:  # noqa: BLE001
+            per_drive.append((str(path), 0, 0, 0))
+            by_id[id(d)] = False
+    res = {
+        "per_drive": per_drive,
+        "online": sum(1 for r in per_drive if r[3]),
+        "offline": sum(1 for r in per_drive if not r[3]),
+        "total_bytes": sum(r[1] for r in per_drive),
+        "free_bytes": sum(r[2] for r in per_drive),
+        "by_id": by_id,
+    }
+    m._drive_probe = (now, res)
+    return res
+
+
+def _g_system_drive(server) -> list[str]:
+    out: list[str] = []
+    pr = _probe_drives(server)
+    per_drive = pr["per_drive"]
+    _fmt(out, "minio_system_drive_total_bytes", "gauge",
+         [({"drive": p}, t) for p, t, _, _ in per_drive])
+    _fmt(out, "minio_system_drive_free_bytes", "gauge",
+         [({"drive": p}, f) for p, _, f, _ in per_drive])
+    _fmt(out, "minio_system_drive_online", "gauge",
+         [({"drive": p}, o) for p, _, _, o in per_drive])
+    _fmt(out, "minio_system_drive_count", "gauge",
+         [({"state": "online"}, pr["online"]), ({"state": "offline"}, pr["offline"])])
+    _fmt(out, "minio_system_drive_raw_total_bytes", "gauge", [({}, pr["total_bytes"])])
+    _fmt(out, "minio_system_drive_raw_free_bytes", "gauge", [({}, pr["free_bytes"])])
+    return out
+
+
+def _proc_stat() -> dict:
+    out = {}
+    try:
+        with open("/proc/self/stat") as f:
+            raw = f.read()
+        # comm may contain spaces: fields restart after the last ')'
+        parts = raw[raw.rindex(")") + 2 :].split()
+        tck = float(os.sysconf("SC_CLK_TCK") or 100)
+        page = os.sysconf("SC_PAGE_SIZE") or 4096
+        # parts[0] is field 3 (state); utime is field 14 -> index 11
+        out["utime_s"] = int(parts[11]) / tck
+        out["stime_s"] = int(parts[12]) / tck
+        out["threads"] = int(parts[17])
+        out["vsize"] = int(parts[20])
+        out["rss_bytes"] = int(parts[21]) * page
+    except (OSError, IndexError, ValueError):
+        pass
+    try:
+        out["fds"] = len(os.listdir("/proc/self/fd"))
+    except OSError:
+        pass
+    return out
+
+
+def _g_system_process(server) -> list[str]:
+    st = _proc_stat()
+    out: list[str] = []
+    _fmt(out, "minio_system_process_uptime_seconds", "gauge",
+         [({}, f"{time.time() - server.started_at:.0f}")])
+    _fmt(out, "minio_system_process_cpu_total_seconds", "counter",
+         [({}, f"{st.get('utime_s', 0) + st.get('stime_s', 0):.2f}")])
+    _fmt(out, "minio_system_process_resident_memory_bytes", "gauge",
+         [({}, st.get("rss_bytes", 0))])
+    _fmt(out, "minio_system_process_virtual_memory_bytes", "gauge",
+         [({}, st.get("vsize", 0))])
+    _fmt(out, "minio_system_process_file_descriptor_open_total", "gauge",
+         [({}, st.get("fds", 0))])
+    _fmt(out, "minio_system_process_threads_total", "gauge",
+         [({}, st.get("threads", 0))])
+    return out
+
+
+def _g_system_memory(server) -> list[str]:
+    out: list[str] = []
+    info = {}
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                k, _, rest = line.partition(":")
+                info[k] = int(rest.split()[0]) * 1024
+    except (OSError, ValueError, IndexError):
+        pass
+    _fmt(out, "minio_system_memory_total_bytes", "gauge", [({}, info.get("MemTotal", 0))])
+    _fmt(out, "minio_system_memory_available_bytes", "gauge",
+         [({}, info.get("MemAvailable", 0))])
+    _fmt(out, "minio_system_memory_free_bytes", "gauge", [({}, info.get("MemFree", 0))])
+    _fmt(out, "minio_system_memory_buffers_bytes", "gauge", [({}, info.get("Buffers", 0))])
+    _fmt(out, "minio_system_memory_cache_bytes", "gauge", [({}, info.get("Cached", 0))])
+    return out
+
+
+def _g_system_cpu(server) -> list[str]:
+    out: list[str] = []
+    try:
+        load1, load5, load15 = os.getloadavg()
+    except OSError:
+        load1 = load5 = load15 = 0.0
+    _fmt(out, "minio_system_cpu_load_perc_avg", "gauge", [
+        ({"interval": "1m"}, f"{load1:.2f}"),
+        ({"interval": "5m"}, f"{load5:.2f}"),
+        ({"interval": "15m"}, f"{load15:.2f}"),
+    ])
+    _fmt(out, "minio_system_cpu_count", "gauge", [({}, os.cpu_count() or 1)])
+    return out
+
+
+def _g_debug_python(server) -> list[str]:
+    import gc
+
+    out: list[str] = []
+    counts = gc.get_count()
+    _fmt(out, "minio_debug_python_gc_objects", "gauge",
+         [({"generation": str(i)}, c) for i, c in enumerate(counts)])
+    _fmt(out, "minio_debug_python_threads", "gauge",
+         [({}, threading.active_count())])
+    return out
+
+
+def _g_cluster_health(server) -> list[str]:
+    out: list[str] = []
+    pr = _probe_drives(server)
+    _fmt(out, "minio_cluster_health_drives_online_count", "gauge", [({}, pr["online"])])
+    _fmt(out, "minio_cluster_health_drives_offline_count", "gauge", [({}, pr["offline"])])
+    _fmt(out, "minio_cluster_health_status", "gauge",
+         [({}, 1 if pr["offline"] == 0 else 0)], "1 when every drive is online")
+    return out
+
+
+def _g_cluster_usage(server) -> list[str]:
+    out: list[str] = []
+    bg = getattr(server, "background", None)
+    buckets = bg.usage.buckets if bg is not None else {}
+    total_b = sum(u.get("size", 0) for u in buckets.values())
+    total_o = sum(u.get("objects", 0) for u in buckets.values())
+    _fmt(out, "minio_cluster_usage_total_bytes", "gauge", [({}, total_b)])
+    _fmt(out, "minio_cluster_usage_object_total", "gauge", [({}, total_o)])
+    _fmt(out, "minio_cluster_usage_buckets_total", "gauge", [({}, len(buckets))])
+    return out
+
+
+def _g_cluster_usage_buckets(server) -> list[str]:
+    out: list[str] = []
+    bg = getattr(server, "background", None)
+    buckets = bg.usage.buckets if bg is not None else {}
+    _fmt(out, "minio_cluster_bucket_total_bytes", "gauge",
+         [({"bucket": b}, u.get("size", 0)) for b, u in sorted(buckets.items())])
+    _fmt(out, "minio_cluster_bucket_object_total", "gauge",
+         [({"bucket": b}, u.get("objects", 0)) for b, u in sorted(buckets.items())])
+    return out
+
+
+def _g_cluster_erasure_set(server) -> list[str]:
+    out: list[str] = []
+    rows = []
+    by_id = _probe_drives(server)["by_id"]
+    for pi, pool in enumerate(server.store.pools):
+        for si, es in enumerate(pool.sets):
+            ok = sum(1 for d in es.disks if by_id.get(id(d), False))
+            rows.append((pi, si, es.n, ok, es.n - es.default_parity))
+    _fmt(out, "minio_cluster_erasure_set_online_drives_count", "gauge",
+         [({"pool": str(p), "set": str(s)}, ok) for p, s, _, ok, _ in rows])
+    # writeQuorum = data, +1 when data == parity (cmd/erasure-object.go)
+    _fmt(out, "minio_cluster_erasure_set_overall_write_quorum", "gauge",
+         [({"pool": str(p), "set": str(s)}, d + 1 if n == 2 * d else d)
+          for p, s, n, _, d in rows])
+    _fmt(out, "minio_cluster_erasure_set_healing_drives_count", "gauge",
+         [({"pool": str(p), "set": str(s)}, 0) for p, s, _, _, _ in rows])
+    return out
+
+
+def _g_cluster_iam(server) -> list[str]:
+    out: list[str] = []
+    iam = server.iam
+    temp = sum(1 for u in iam.users.values() if u.is_temp)
+    svc = sum(1 for u in iam.users.values() if u.parent and not u.is_temp)
+    _fmt(out, "minio_cluster_iam_users_total", "gauge",
+         [({}, len(iam.users) - temp - svc)])
+    _fmt(out, "minio_cluster_iam_groups_total", "gauge", [({}, len(iam.groups))])
+    _fmt(out, "minio_cluster_iam_policies_total", "gauge", [({}, len(iam.policies))])
+    _fmt(out, "minio_cluster_iam_sts_accounts_total", "gauge", [({}, temp)])
+    _fmt(out, "minio_cluster_iam_svc_accounts_total", "gauge", [({}, svc)])
+    return out
+
+
+def _g_cluster_config(server) -> list[str]:
+    out: list[str] = []
+    cfg = getattr(server, "config", None)
+    n = 0
+    if cfg is not None:
+        from .config_kv import DEFAULTS
+
+        n = len(DEFAULTS)
+    _fmt(out, "minio_cluster_config_subsystems_total", "gauge", [({}, n)])
+    return out
+
+
+def _bg_stat(server, key: str) -> int:
+    bg = getattr(server, "background", None)
+    return bg.stats.get(key, 0) if bg is not None else 0
+
+
+def _g_ilm(server) -> list[str]:
+    out: list[str] = []
+    _fmt(out, "minio_ilm_expired_objects_total", "counter",
+         [({}, _bg_stat(server, "ilm_expired"))])
+    _fmt(out, "minio_ilm_transitioned_objects_total", "counter",
+         [({}, _bg_stat(server, "ilm_transitioned"))])
+    return out
+
+
+def _g_scanner(server) -> list[str]:
+    out: list[str] = []
+    _fmt(out, "minio_scanner_objects_scanned_total", "counter",
+         [({}, _bg_stat(server, "objects_scanned"))])
+    _fmt(out, "minio_scanner_cycles_total", "counter", [({}, _bg_stat(server, "scans"))])
+    _fmt(out, "minio_scanner_heals_queued_total", "counter",
+         [({}, _bg_stat(server, "heals_queued"))])
+    _fmt(out, "minio_scanner_heals_done_total", "counter",
+         [({}, _bg_stat(server, "heals_done"))])
+    _fmt(out, "minio_scanner_heals_failed_total", "counter",
+         [({}, _bg_stat(server, "heals_failed"))])
+    return out
+
+
+def _g_replication(server) -> list[str]:
+    out: list[str] = []
+    repl = getattr(server, "replication", None)
+    st = dict(repl.stats) if repl is not None else {}
+    _fmt(out, "minio_replication_total", "counter", [({}, st.get("replicated", 0))])
+    _fmt(out, "minio_replication_deletes_total", "counter", [({}, st.get("deletes", 0))])
+    _fmt(out, "minio_replication_failed_total", "counter", [({}, st.get("failed", 0))])
+    _fmt(out, "minio_replication_queued_total", "counter", [({}, st.get("queued", 0))])
+    return out
+
+
+def _g_notification(server) -> list[str]:
+    out: list[str] = []
+    noti = getattr(server, "notifier", None)
+    st = dict(noti.stats) if noti is not None else {}
+    _fmt(out, "minio_notify_events_sent_total", "counter", [({}, st.get("sent", 0))])
+    _fmt(out, "minio_notify_events_failed_total", "counter", [({}, st.get("failed", 0))])
+    _fmt(out, "minio_notify_events_skipped_total", "counter", [({}, st.get("dropped", 0))])
+    return out
+
+
+def _g_audit(server) -> list[str]:
+    out: list[str] = []
+    audit = getattr(server, "audit", None)
+    st = dict(audit.stats) if audit is not None else {}
+    _fmt(out, "minio_audit_total_messages", "counter", [({}, st.get("sent", 0))])
+    _fmt(out, "minio_audit_failed_messages", "counter", [({}, st.get("failed", 0))])
+    return out
+
+
+# collector path -> renderer; bucket paths live in V3_BUCKET_GROUPS
+V3_GROUPS = {
+    "/api/requests": _g_api_requests,
+    "/system/drive": _g_system_drive,
+    "/system/memory": _g_system_memory,
+    "/system/cpu": _g_system_cpu,
+    "/system/process": _g_system_process,
+    "/debug/python": _g_debug_python,
+    "/cluster/health": _g_cluster_health,
+    "/cluster/usage/objects": _g_cluster_usage,
+    "/cluster/usage/buckets": _g_cluster_usage_buckets,
+    "/cluster/erasure-set": _g_cluster_erasure_set,
+    "/cluster/iam": _g_cluster_iam,
+    "/cluster/config": _g_cluster_config,
+    "/ilm": _g_ilm,
+    "/scanner": _g_scanner,
+    "/replication": _g_replication,
+    "/notification": _g_notification,
+    "/audit": _g_audit,
+}
+V3_BUCKET_GROUPS = {
+    "/bucket/api": _g_bucket_api,
+    "/bucket/replication": _g_bucket_replication,
+}
+
+
+def render_v3(server, path: str) -> str | None:
+    """Render the v3 group(s) under `path` ('' = all non-bucket groups).
+    Returns None for an unknown path (-> 404)."""
+    path = "/" + path.strip("/") if path.strip("/") else ""
+    for bpath, fn in V3_BUCKET_GROUPS.items():
+        if path.startswith(bpath + "/"):
+            bucket = path[len(bpath) + 1 :]
+            return "\n".join(fn(server, bucket)) + "\n"
+    out: list[str] = []
+    matched = False
+    for gpath, fn in V3_GROUPS.items():
+        if not path or gpath == path or gpath.startswith(path + "/"):
+            matched = True
+            try:
+                out.extend(fn(server))
+            except Exception:  # noqa: BLE001 — one broken group must not
+                pass  # take down the whole exposition
+    if not matched:
+        return None
+    return "\n".join(out) + "\n"

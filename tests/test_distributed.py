@@ -93,6 +93,25 @@ def test_shards_actually_distributed(cluster):
     assert n1 > 0 and n2 > 0, "both nodes must hold shards"
 
 
+def test_profile_fans_out_to_peers(cluster):
+    """admin profile collects from every node (reference ProfileHandler
+    fan-out, cmd/admin-handlers.go:1024). Runs before the node-kill test."""
+    import json
+
+    cli1 = cluster["cli1"]
+    p2 = cluster["ports"][1]
+    r = cli1.request(
+        "POST", "/minio/admin/v3/profile",
+        query={"profilerType": "cpu", "duration": "0.3"},
+    )
+    assert r.status == 200, r.body
+    nodes = json.loads(r.body)["nodes"]
+    assert "local" in nodes
+    peer = f"127.0.0.1:{p2}"
+    assert peer in nodes, nodes.keys()
+    assert "cpu" in nodes[peer] and "error" not in nodes[peer]
+
+
 def test_node_failure_tolerance(cluster):
     cli1 = cluster["cli1"]
     body = os.urandom(300 * 1024)

@@ -1,0 +1,144 @@
+"""Metrics v3 grouped registry + cluster profiling (reference:
+cmd/metrics-v3.go collector paths, cmd/admin-handlers.go ProfileHandler)."""
+
+import json
+import os
+
+os.environ.setdefault("MINIO_TPU_BACKEND", "numpy")
+os.environ.setdefault("MINIO_PROMETHEUS_AUTH_TYPE", "public")
+
+import pytest
+
+from minio_tpu.client import S3Client
+
+from test_s3_api import ServerThread
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    base = tmp_path_factory.mktemp("metricsdrives")
+    st = ServerThread([str(base / f"d{i}") for i in range(4)])
+    yield st
+    st.stop()
+
+
+@pytest.fixture(scope="module")
+def cli(server):
+    c = S3Client(f"127.0.0.1:{server.port}")
+    c.make_bucket("metbkt")
+    c.put_object("metbkt", "obj", b"x" * 1000)
+    c.get_object("metbkt", "obj")
+    c.get_object("metbkt", "missing")  # a 404 for the error counters
+    return c
+
+
+def _get(cli, path):
+    return cli.request("GET", f"/minio/metrics/v3{path}")
+
+
+def test_v3_all_groups(cli):
+    r = _get(cli, "")
+    assert r.status == 200
+    text = r.body.decode()
+    for series in (
+        "minio_api_requests_total",
+        "minio_system_drive_count",
+        "minio_system_process_resident_memory_bytes",
+        "minio_system_memory_total_bytes",
+        "minio_system_cpu_count",
+        "minio_cluster_health_status",
+        "minio_cluster_erasure_set_online_drives_count",
+        "minio_cluster_iam_policies_total",
+        "minio_scanner_objects_scanned_total",
+        "minio_replication_total",
+        "minio_notify_events_sent_total",
+        "minio_audit_total_messages",
+        "minio_ilm_expired_objects_total",
+        "minio_debug_python_threads",
+    ):
+        assert series in text, series
+
+
+def test_v3_path_filtering(cli):
+    r = _get(cli, "/api/requests")
+    text = r.body.decode()
+    assert "minio_api_requests_total" in text
+    assert "minio_system_drive" not in text
+    # subtree selection: /system matches every system group
+    r = _get(cli, "/system")
+    text = r.body.decode()
+    assert "minio_system_drive_count" in text
+    assert "minio_system_cpu_count" in text
+    assert "minio_api_requests_total" not in text
+    # unknown path -> 404
+    assert _get(cli, "/nonexistent/group").status == 404
+
+
+def test_v3_requests_counted(cli):
+    text = _get(cli, "/api/requests").body.decode()
+    assert 'minio_api_requests_total{name="PutObject"}' in text
+    assert 'minio_api_requests_total{name="GetObject"}' in text
+
+
+def test_v3_bucket_api(cli):
+    r = _get(cli, "/bucket/api/metbkt")
+    assert r.status == 200
+    text = r.body.decode()
+    assert 'minio_bucket_api_requests_total{bucket="metbkt",name="GetObject"}' in text
+    assert 'minio_bucket_api_requests_errors_total{bucket="metbkt",name="GetObject"}' in text
+    # an untouched bucket renders empty-but-valid
+    r = _get(cli, "/bucket/api/ghostbkt")
+    assert r.status == 200
+
+
+def test_v3_erasure_set_quorum(cli):
+    text = _get(cli, "/cluster/erasure-set").body.decode()
+    # 4 drives EC 2+2: data == parity, so write quorum is d+1 = 3
+    assert 'minio_cluster_erasure_set_overall_write_quorum{pool="0",set="0"} 3' in text
+
+
+def test_profile_cpu_local(cli):
+    r = cli.request(
+        "POST", "/minio/admin/v3/profile",
+        query={"profilerType": "cpu", "duration": "0.3"},
+    )
+    assert r.status == 200, r.body
+    nodes = json.loads(r.body)["nodes"]
+    assert "local" in nodes and "cpu" in nodes["local"]
+    # collapsed-stack lines: "frame;frame;... count"
+    body = nodes["local"]["cpu"]
+    assert any(";" in line for line in body.splitlines())
+
+
+def test_profile_threads(cli):
+    r = cli.request(
+        "POST", "/minio/admin/v3/profile", query={"profilerType": "threads"},
+    )
+    assert r.status == 200
+    nodes = json.loads(r.body)["nodes"]
+    assert "--- thread" in nodes["local"]["threads"]
+
+
+def test_profile_bad_type(cli):
+    r = cli.request(
+        "POST", "/minio/admin/v3/profile", query={"profilerType": "heapx"},
+    )
+    assert r.status == 400
+
+
+def test_phantom_buckets_not_tracked(cli):
+    # failed requests to unknown bucket names must not mint series
+    cli.request("GET", "/phantom-bkt-xyz/some-key")
+    cli.request("GET", "/phantom-bkt-xyz")
+    r = _get(cli, "/bucket/api/phantom-bkt-xyz")
+    assert r.status == 200
+    assert "phantom-bkt-xyz" not in r.body.decode()
+    # but errors on a TRACKED bucket do count
+    cli.get_object("metbkt", "missing2")
+    text = _get(cli, "/bucket/api/metbkt").body.decode()
+    assert 'minio_bucket_api_requests_errors_total{bucket="metbkt",name="GetObject"}' in text
+
+
+def test_inflight_gauge_exposed(cli):
+    text = _get(cli, "/api/requests").body.decode()
+    assert "minio_api_requests_inflight_total" in text
