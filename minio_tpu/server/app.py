@@ -339,9 +339,12 @@ class S3Server:
         from .config_kv import ConfigKV
 
         self.notifier = EventNotifier(self.buckets)
-        self.kms = KMS(store=store)  # persisted auto-key unless env-provided
         self.audit = AuditLog()
         self.config = ConfigKV(store)
+        from ..crypto.kes import from_env_or_config
+
+        # KES external KMS when configured; builtin persisted key otherwise
+        self.kms = from_env_or_config(cfg=self.config, store=store)
         self.repl_targets = TargetRegistry(store)
         from ..ilm.tier import TierRegistry
 
@@ -550,13 +553,37 @@ class S3Server:
         else:
             body = await request.read() if request.body_exists else b""
 
-        if "X-Amz-Signature" in dict(query):
+        qdict = dict(query)
+        if "X-Amz-Signature" in qdict:
             ak = self.verifier.verify_presigned(request.method, raw_path, query, headers)
-            self._check_session_token(ak, headers, dict(query))
+            self._check_session_token(ak, headers, qdict)
+            return ak, body
+        if (
+            "Signature" in qdict
+            and "AWSAccessKeyId" in qdict
+            and "Expires" in qdict
+        ):
+            # legacy presigned V2 (reference cmd/signature-v2.go)
+            from .signature import SigV2Verifier
+
+            ak = SigV2Verifier(self.iam.lookup_secret).verify_presigned(
+                request.method, raw_path, request.rel_url.raw_query_string,
+                headers,
+            )
+            self._check_session_token(ak, headers, qdict)
             return ak, body
         if "authorization" not in headers:
             # anonymous: only bucket policies can authorize it downstream
             return "", body
+        if headers["authorization"].startswith("AWS "):
+            # legacy header V2: HMAC-SHA1 over the V2 string-to-sign
+            from .signature import SigV2Verifier
+
+            ak = SigV2Verifier(self.iam.lookup_secret).verify_header(
+                request.method, raw_path, request.rel_url.raw_query_string, headers
+            )
+            self._check_session_token(ak, headers, {})
+            return ak, body
 
         content_sha = headers.get("x-amz-content-sha256", signature.UNSIGNED_PAYLOAD)
         ak = self.verifier.verify_header_auth(

@@ -41,16 +41,41 @@ DEFAULTS: dict[str, dict[str, str]] = {
     "notify_nats": {"enable": "off", "address": "", "subject": "minio-events"},
     "notify_redis": {"enable": "off", "address": "", "key": "minio-events"},
     "notify_mqtt": {"enable": "off", "broker": "", "topic": "minio-events"},
+    "notify_kafka": {"enable": "off", "brokers": "", "topic": "minio-events"},
+    "notify_amqp": {"enable": "off", "url": "", "exchange": "", "routing_key": ""},
+    "notify_nsq": {"enable": "off", "nsqd_address": "", "topic": "minio-events"},
+    "notify_mysql": {
+        "enable": "off", "dsn_string": "", "table": "minio_events",
+        "format": "namespace",
+    },
+    "notify_postgres": {
+        "enable": "off", "connection_string": "", "table": "minio_events",
+        "format": "namespace",
+    },
+    "notify_elasticsearch": {
+        "enable": "off", "url": "", "index": "minio-events", "format": "namespace",
+    },
     "logger_webhook": {"enable": "off", "endpoint": ""},
     "audit_webhook": {"enable": "off", "endpoint": ""},
+    "audit_kafka": {"enable": "off", "brokers": "", "topic": ""},
     "lambda_webhook": {"enable": "off", "endpoint": ""},
     "site": {"name": "", "region": "us-east-1"},
+    "region": {"name": "us-east-1"},  # legacy alias of site.region
     "etcd": {"endpoints": ""},  # accepted, unused (no etcd federation)
     "cache": {"enable": "off", "ttl": "300"},
     "browser": {"enable": "off"},
     "ilm": {"transition_workers": "1", "expiry_workers": "1"},
     "drive": {"max_timeout": "30s"},
     "subnet": {"license": ""},  # accepted for config compat
+    "callhome": {"enable": "off", "frequency": "24h"},
+    "kms_kes": {
+        "endpoint": "", "key_name": "", "api_key": "",
+        "cert_file": "", "key_file": "", "capath": "",
+    },
+    "identity_tls": {"enable": "off", "skip_verify": "off"},
+    "identity_plugin": {"url": "", "auth_token": "", "role_policy": ""},
+    "policy_opa": {"url": "", "auth_token": ""},  # deprecated in reference
+    "policy_plugin": {"url": "", "auth_token": ""},
 }
 
 

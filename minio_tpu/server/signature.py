@@ -295,3 +295,197 @@ def sign_request(
         f"SignedHeaders={';'.join(signed_headers)}, Signature={sig}"
     )
     return out
+
+
+# -- Signature V2 (deprecated AWS auth, reference cmd/signature-v2.go) -------
+
+SIGN_V2_ALGORITHM = "AWS"
+
+# sub-resources included in the V2 canonicalized resource, pre-sorted
+V2_RESOURCE_LIST = [
+    "acl", "cors", "delete", "encryption", "legal-hold", "lifecycle",
+    "location", "logging", "notification", "partNumber", "policy",
+    "requestPayment", "response-cache-control", "response-content-disposition",
+    "response-content-encoding", "response-content-language",
+    "response-content-type", "response-expires", "retention", "select",
+    "select-type", "tagging", "torrent", "uploadId", "uploads", "versionId",
+    "versioning", "versions", "website",
+]
+
+
+def _canonicalized_amz_headers_v2(headers: dict[str, str]) -> str:
+    amz: dict[str, list[str]] = {}
+    for k, v in headers.items():
+        lk = k.lower()
+        if lk.startswith("x-amz-"):
+            amz.setdefault(lk, []).append(v.strip())
+    return "\n".join(f"{k}:{','.join(vs)}" for k, vs in sorted(amz.items()))
+
+
+def _canonicalized_resource_v2(encoded_resource: str, encoded_query: str) -> str:
+    keyval: dict[str, str] = {}
+    for q in encoded_query.split("&"):
+        if not q:
+            continue
+        k, _, v = q.partition("=")
+        keyval[k] = v
+    parts = []
+    for key in V2_RESOURCE_LIST:
+        if key in keyval:
+            parts.append(f"{key}={keyval[key]}" if keyval[key] else key)
+    return encoded_resource + (f"?{'&'.join(parts)}" if parts else "")
+
+
+def string_to_sign_v2(
+    method: str,
+    encoded_resource: str,
+    encoded_query: str,
+    headers: dict[str, str],
+    expires: str = "",
+) -> str:
+    """V2 StringToSign (expires set -> presigned form, Date replaced)."""
+    canonical_headers = _canonicalized_amz_headers_v2(headers)
+    if canonical_headers:
+        canonical_headers += "\n"
+    date = expires or headers.get("date", "")
+    return (
+        "\n".join(
+            [
+                method,
+                headers.get("content-md5", ""),
+                headers.get("content-type", ""),
+                date,
+                canonical_headers,
+            ]
+        )
+        + _canonicalized_resource_v2(encoded_resource, encoded_query)
+    )
+
+
+def _v2_signature(secret: str, sts: str) -> str:
+    import base64
+
+    return base64.b64encode(
+        hmac.new(secret.encode(), sts.encode("utf-8"), hashlib.sha1).digest()
+    ).decode()
+
+
+def _unescape_query_v2(raw_query: str) -> str:
+    """Decode each &-separated element (reference unescapeQueries: split
+    FIRST, then QueryUnescape each element) — V2 canonicalization works
+    on decoded values."""
+    return "&".join(
+        urllib.parse.unquote_plus(q) for q in raw_query.split("&") if q
+    )
+
+
+def sign_request_v2(
+    method: str,
+    url: str,
+    headers: dict[str, str],
+    access_key: str,
+    secret_key: str,
+) -> dict[str, str]:
+    """Client-side V2 signer (tests / legacy SDK compatibility)."""
+    from email.utils import formatdate
+
+    parsed = urllib.parse.urlsplit(url)
+    out = {k.lower(): v for k, v in headers.items()}
+    out.setdefault("date", formatdate(usegmt=True))
+    out["host"] = parsed.netloc
+    sts = string_to_sign_v2(
+        method, parsed.path, _unescape_query_v2(parsed.query), out
+    )
+    out["authorization"] = (
+        f"{SIGN_V2_ALGORITHM} {access_key}:{_v2_signature(secret_key, sts)}"
+    )
+    return out
+
+
+def presign_url_v2(
+    method: str, url: str, access_key: str, secret_key: str, expires_in: int
+) -> str:
+    import time as _time
+
+    parsed = urllib.parse.urlsplit(url)
+    expires = str(int(_time.time()) + expires_in)
+    sts = string_to_sign_v2(
+        method, parsed.path, _unescape_query_v2(parsed.query), {}, expires
+    )
+    q = {
+        "AWSAccessKeyId": access_key,
+        "Expires": expires,
+        "Signature": _v2_signature(secret_key, sts),
+    }
+    sep = "&" if parsed.query else "?"
+    return f"{url}{sep}{urllib.parse.urlencode(q)}"
+
+
+class SigV2Verifier:
+    """Server-side V2 verification (header + presigned query forms)."""
+
+    def __init__(self, lookup_secret):
+        self.lookup_secret = lookup_secret
+
+    def verify_header(
+        self, method: str, raw_path: str, raw_query: str, headers: dict[str, str]
+    ) -> str:
+        auth = headers.get("authorization", "")
+        if not auth.startswith(f"{SIGN_V2_ALGORITHM} "):
+            raise s3err.AccessDenied
+        try:
+            access_key, got = auth[len(SIGN_V2_ALGORITHM) + 1 :].split(":", 1)
+        except ValueError:
+            raise s3err.InvalidArgument from None
+        secret = self.lookup_secret(access_key)
+        if secret is None:
+            raise s3err.InvalidAccessKeyId
+        if not headers.get("date") and not headers.get("x-amz-date"):
+            raise s3err.MissingFields
+        sts = string_to_sign_v2(
+            method, raw_path, _unescape_query_v2(raw_query), headers
+        )
+        if not hmac.compare_digest(_v2_signature(secret, sts), got):
+            raise s3err.SignatureDoesNotMatch
+        return access_key
+
+    def verify_presigned(
+        self, method: str, raw_path: str, raw_query: str,
+        headers: dict[str, str] | None = None,
+    ) -> str:
+        """Presigned V2: the string-to-sign includes the request headers
+        (the reference's preSignatureV2 passes r.Header) with Expires in
+        the Date slot; auth params are filtered out of the query."""
+        import time as _time
+
+        access_key = signature = expires = ""
+        filtered = []
+        for q in raw_query.split("&"):
+            if not q:
+                continue
+            uq = urllib.parse.unquote_plus(q)
+            k, has_eq, v = uq.partition("=")
+            if k == "AWSAccessKeyId":
+                access_key = v
+            elif k == "Signature":
+                signature = v
+            elif k == "Expires":
+                expires = v
+            else:
+                filtered.append(uq if has_eq or not k else k)
+        if not access_key or not signature or not expires:
+            raise s3err.MissingFields
+        secret = self.lookup_secret(access_key)
+        if secret is None:
+            raise s3err.InvalidAccessKeyId
+        try:
+            if int(expires) < _time.time():
+                raise s3err.ExpiredPresignRequest
+        except ValueError:
+            raise s3err.InvalidArgument from None
+        sts = string_to_sign_v2(
+            method, raw_path, "&".join(filtered), headers or {}, expires
+        )
+        if not hmac.compare_digest(_v2_signature(secret, sts), signature):
+            raise s3err.SignatureDoesNotMatch
+        return access_key
