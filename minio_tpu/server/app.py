@@ -485,6 +485,15 @@ class S3Server:
         bucket = request.match_info.get("bucket", "")
         key = request.match_info.get("key", "")
         if bucket == "minio":
+            if request.method in ("GET", "HEAD") and (
+                key == "console" or key.startswith("console/")
+            ):
+                # embedded browser console (reference embeds minio/console,
+                # cmd/common-main.go:46); static page, data calls signed
+                # in-browser
+                from .console import handle_console
+
+                return handle_console(request)
             if key.startswith("health/"):
                 # disk probes may hit remote drives: stay off the event loop
                 return await self._run(self._health, request, key)

@@ -1,0 +1,121 @@
+"""Embedded web console (reference embeds minio/console,
+cmd/common-main.go:46-48)."""
+
+import os
+
+os.environ.setdefault("MINIO_TPU_BACKEND", "numpy")
+
+import http.client
+
+import pytest
+
+from test_s3_api import ServerThread
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    base = tmp_path_factory.mktemp("consoledrives")
+    st = ServerThread([str(base / f"d{i}") for i in range(4)])
+    yield st
+    st.stop()
+
+
+def _get(server, path):
+    conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=30)
+    conn.request("GET", path)
+    r = conn.getresponse()
+    body = r.read()
+    hdrs = {k.lower(): v for k, v in r.getheaders()}
+    conn.close()
+    return r.status, hdrs, body
+
+
+def test_console_served_unauthenticated(server):
+    st, hdrs, body = _get(server, "/minio/console")
+    assert st == 200
+    assert hdrs["content-type"].startswith("text/html")
+    assert hdrs["cache-control"] == "no-store"
+    assert b"minio_tpu console" in body
+    # SPA signs its own requests: the SigV4 machinery must be embedded
+    assert b"AWS4-HMAC-SHA256" in body
+    # trailing-path variant also serves the page
+    st, _, _ = _get(server, "/minio/console/")
+    assert st == 200
+
+
+def test_console_not_a_bucket_route(server):
+    # /minio/consolex must NOT serve the page (it's a key under the
+    # reserved pseudo-bucket, which has no real handler -> error)
+    st, _, body = _get(server, "/minio/consolex")
+    assert st != 200 or b"minio_tpu console" not in body
+
+
+def test_js_signing_procedure_accepted(server):
+    """Replicates the console JS's signedFetch byte-for-byte (UNSIGNED-
+    PAYLOAD, host;x-amz-content-sha256;x-amz-date signed headers,
+    encodeURIComponent-style path encoding) and asserts the server
+    accepts it — the protocol path the browser uses, minus the browser."""
+    import hashlib
+    import hmac as hmac_mod
+    import time
+    import urllib.parse
+
+    def js_uri_enc(s, slash=False):
+        # encodeURIComponent leaves A-Za-z0-9 -_.!~*'() ; the JS then
+        # re-encodes !'()* — net effect: quote with safe "-_.~" (+ "/")
+        out = urllib.parse.quote(s, safe="-_.~" + ("/" if slash else ""))
+        return out
+
+    from minio_tpu.client import S3Client
+
+    S3Client(f"127.0.0.1:{server.port}").make_bucket("uibkt")
+    ak = sk = "minioadmin"
+    region = "us-east-1"
+    for path, query, method, body in [
+        ("/uibkt", {"list-type": "2", "prefix": "", "delimiter": "/"}, "GET", b""),
+        ("/uibkt/dir with space/obj+plus.txt", {}, "PUT", b"js-signed"),
+        ("/uibkt/dir with space/obj+plus.txt", {}, "GET", b""),
+    ]:
+        amzdate = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+        scope_date = amzdate[:8]
+        host = f"127.0.0.1:{server.port}"
+        payload_hash = "UNSIGNED-PAYLOAD"
+        qp = sorted((js_uri_enc(k), js_uri_enc(str(v))) for k, v in query.items())
+        canon_q = "&".join(f"{k}={v}" for k, v in qp)
+        canon_path = js_uri_enc(path, slash=True)
+        headers = {
+            "host": host, "x-amz-content-sha256": payload_hash,
+            "x-amz-date": amzdate,
+        }
+        signed_headers = ";".join(sorted(headers))
+        canon_headers = "".join(f"{h}:{headers[h]}\n" for h in sorted(headers))
+        canon = "\n".join(
+            [method, canon_path, canon_q, canon_headers, signed_headers, payload_hash]
+        )
+        scope = f"{scope_date}/{region}/s3/aws4_request"
+        sts = "\n".join([
+            "AWS4-HMAC-SHA256", amzdate, scope,
+            hashlib.sha256(canon.encode()).hexdigest(),
+        ])
+        key = f"AWS4{sk}".encode()
+        for part in (scope_date, region, "s3", "aws4_request"):
+            key = hmac_mod.new(key, part.encode(), hashlib.sha256).digest()
+        sig = hmac_mod.new(key, sts.encode(), hashlib.sha256).hexdigest()
+        auth = (
+            f"AWS4-HMAC-SHA256 Credential={ak}/{scope}, "
+            f"SignedHeaders={signed_headers}, Signature={sig}"
+        )
+        conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=30)
+        conn.request(
+            method, canon_path + (f"?{canon_q}" if canon_q else ""), body=body,
+            headers={
+                "Authorization": auth, "x-amz-content-sha256": payload_hash,
+                "x-amz-date": amzdate,
+            },
+        )
+        r = conn.getresponse()
+        data = r.read()
+        conn.close()
+        assert r.status == 200, (method, path, r.status, data[:300])
+        if method == "GET" and path.endswith(".txt"):
+            assert data == b"js-signed"
