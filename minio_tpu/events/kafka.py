@@ -142,6 +142,11 @@ class KafkaTarget(Target):
              "Key": f"{record['s3']['bucket']['name']}/{record['s3']['object']['key']}",
              "Records": [record]}
         ).encode()
+        self.send_raw(payload)
+
+    def send_raw(self, payload: bytes) -> None:
+        """Produce an arbitrary payload (audit log records ride the same
+        client as event notifications)."""
         with self._mu:
             try:
                 if self._sock is None:

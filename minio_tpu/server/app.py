@@ -3110,6 +3110,10 @@ def main(argv: list[str] | None = None) -> None:
     srv.peers = peers  # cluster peers, for admin profile/pprof fan-out
     StorageRESTServer(registry, token).register(srv.app)
     LockRESTServer(local_locker, token).register(srv.app)
+    from ..cluster import bootstrap as bootmod
+
+    my_syscfg = bootmod.system_config(sorted(str(e) for e in all_eps), salt=token)
+    bootmod.BootstrapRESTServer(my_syscfg, token).register(srv.app)
 
     async def bootstrap():
         import asyncio
@@ -3120,6 +3124,16 @@ def main(argv: list[str] | None = None) -> None:
             return make_object_layer(
                 args.drives, args.set_size, my_port, token, registry, ns_lock
             )
+
+        if peers:
+            # cross-node config consistency check (reference
+            # cmd/bootstrap-peer-server.go verifyServerSystemConfig):
+            # catches divergent drive lists / MINIO_* env before serving
+            problems = await loop.run_in_executor(
+                None, bootmod.verify_peers, my_syscfg, peers, token
+            )
+            for p in problems:
+                print(f"bootstrap config check: {p}", flush=True)
 
         last = None
         for _ in range(180):

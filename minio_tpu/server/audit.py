@@ -25,17 +25,27 @@ class AuditLog:
                 tok = os.environ.get(f"MINIO_AUDIT_WEBHOOK_AUTH_TOKEN_{ident}", "")
                 if ep:
                     self.endpoints.append((ep, tok))
+        # audit-to-Kafka (reference internal/logger/target/kafka): same
+        # raw Produce client the event sinks use
+        self.kafka = None
+        if os.environ.get("MINIO_AUDIT_KAFKA_ENABLE", "") in ("on", "true", "1"):
+            brokers = os.environ.get("MINIO_AUDIT_KAFKA_BROKERS", "")
+            topic = os.environ.get("MINIO_AUDIT_KAFKA_TOPIC", "minio-audit")
+            if brokers:
+                from ..events.kafka import KafkaTarget
+
+                self.kafka = KafkaTarget("audit", brokers.split(",")[0].strip(), topic)
         self._q: queue.Queue = queue.Queue(maxsize=5000)
         self.stats = {"sent": 0, "failed": 0, "dropped": 0}
-        if self.endpoints:
+        if self.enabled:
             threading.Thread(target=self._loop, daemon=True, name="audit").start()
 
     @property
     def enabled(self) -> bool:
-        return bool(self.endpoints)
+        return bool(self.endpoints) or self.kafka is not None
 
     def emit(self, record: dict) -> None:
-        if not self.endpoints:
+        if not self.enabled:
             return
         try:
             self._q.put_nowait(record)
@@ -54,6 +64,12 @@ class AuditLog:
                                  **({"Authorization": f"Bearer {tok}"} if tok else {})},
                     )
                     urllib.request.urlopen(req, timeout=5).read()
+                    self.stats["sent"] += 1
+                except Exception:  # noqa: BLE001
+                    self.stats["failed"] += 1
+            if self.kafka is not None:
+                try:
+                    self.kafka.send_raw(body)
                     self.stats["sent"] += 1
                 except Exception:  # noqa: BLE001
                     self.stats["failed"] += 1

@@ -93,6 +93,40 @@ def test_shards_actually_distributed(cluster):
     assert n1 > 0 and n2 > 0, "both nodes must hold shards"
 
 
+def test_bootstrap_env_mismatch_reported(cluster, tmp_path):
+    """A node launched with a divergent MINIO_* env logs the exact
+    difference during bootstrap (reference verifyServerSystemConfig)."""
+    p3 = _free_port()
+    env = dict(os.environ)
+    env["MINIO_TPU_BACKEND"] = "numpy"
+    env["PYTHONPATH"] = REPO
+    env.pop("JAX_PLATFORMS", None)
+    env["MINIO_DIVERGENT_SETTING"] = "only-on-this-node"
+    log = tmp_path / "rogue.log"
+    with open(log, "wb") as lf:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "minio_tpu.server", "--address",
+             f"127.0.0.1:{p3}", *cluster["specs"]],
+            env=env, stdout=lf, stderr=subprocess.STDOUT,
+        )
+    try:
+        # this node's endpoint list doesn't include itself -> it's a
+        # rogue joiner; we only care that the env check runs and reports.
+        # Peers are already up, so the report lands shortly after the
+        # listener comes up — poll the log instead of a fixed sleep.
+        deadline = time.time() + 45
+        out = b""
+        while time.time() < deadline:
+            out = log.read_bytes()
+            if b"MINIO_DIVERGENT_SETTING" in out:
+                break
+            time.sleep(0.5)
+        assert b"bootstrap config check" in out, out[-2000:]
+        assert b"MINIO_DIVERGENT_SETTING" in out, out[-2000:]
+    finally:
+        proc.kill()
+
+
 def test_profile_fans_out_to_peers(cluster):
     """admin profile collects from every node (reference ProfileHandler
     fan-out, cmd/admin-handlers.go:1024). Runs before the node-kill test."""

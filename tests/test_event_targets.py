@@ -371,3 +371,46 @@ def test_elasticsearch_target():
     path, body = got[0]
     assert path == "/minio-idx/_doc"
     assert b"s3:ObjectCreated:Put" in body
+
+
+def test_audit_to_kafka(monkeypatch):
+    """Audit records ride the raw Kafka produce client when
+    MINIO_AUDIT_KAFKA_* is configured (reference audit_kafka target)."""
+    import json as _json
+    import struct
+    import time
+
+    def handler(conn, got):
+        size = struct.unpack(">i", conn.recv(4))[0]
+        req = b""
+        while len(req) < size:
+            req += conn.recv(size - len(req))
+        corr = struct.unpack(">i", req[4:8])[0]
+        got.append(req)
+        topic = b"minio-audit"
+        resp = (
+            struct.pack(">i", corr) + struct.pack(">i", 1)
+            + struct.pack(">h", len(topic)) + topic
+            + struct.pack(">i", 1) + struct.pack(">i", 0)
+            + struct.pack(">h", 0) + struct.pack(">q", 0)
+            + struct.pack(">q", -1) + struct.pack(">i", 0)
+        )
+        conn.sendall(struct.pack(">i", len(resp)) + resp)
+
+    srv, got, done = _serve(handler)
+    monkeypatch.setenv("MINIO_AUDIT_KAFKA_ENABLE", "on")
+    monkeypatch.setenv(
+        "MINIO_AUDIT_KAFKA_BROKERS", f"127.0.0.1:{srv.getsockname()[1]}"
+    )
+    from minio_tpu.server.audit import AuditLog
+
+    log = AuditLog()
+    assert log.enabled and log.kafka is not None
+    log.emit({"version": "1", "api": {"name": "PutObject"}})
+    assert done.wait(5)
+    assert b"PutObject" in got[0]
+    for _ in range(50):
+        if log.stats["sent"]:
+            break
+        time.sleep(0.1)
+    assert log.stats["sent"] == 1

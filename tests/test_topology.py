@@ -93,3 +93,30 @@ def test_listing_across_sets(tmp_path):
 
     res = listing.list_objects(store, "lst")
     assert [o.name for o in res.objects] == names
+
+
+def test_bootstrap_config_diff():
+    """Cross-node config verification (reference
+    cmd/bootstrap-peer-server.go ServerSystemConfig.Diff)."""
+    from minio_tpu.cluster.bootstrap import diff_configs, system_config
+
+    a = {"n_endpoints": 4, "endpoints": ["e1", "e2"], "env": {"MINIO_X": "h1"}}
+    assert diff_configs(a, dict(a)) is None
+    b = dict(a, n_endpoints=8)
+    assert "endpoints" in diff_configs(a, b)
+    c = dict(a, env={"MINIO_X": "h2"})
+    assert "differing values" in diff_configs(a, c)
+    d = dict(a, env={})
+    assert "missing on peer" in diff_configs(a, d)
+    # credentials and per-node vars never enter the comparison
+    import os
+    os.environ["MINIO_ROOT_PASSWORD"] = "secret"
+    os.environ["MINIO_TEST_CONSISTENT"] = "same"
+    try:
+        cfg = system_config(["a", "b"])
+        assert "MINIO_ROOT_PASSWORD" not in cfg["env"]
+        assert "MINIO_TEST_CONSISTENT" in cfg["env"]
+        # values are hashed, not exposed
+        assert cfg["env"]["MINIO_TEST_CONSISTENT"] != "same"
+    finally:
+        del os.environ["MINIO_ROOT_PASSWORD"], os.environ["MINIO_TEST_CONSISTENT"]
