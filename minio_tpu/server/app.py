@@ -309,6 +309,10 @@ class S3Server:
         self.root_user = os.environ.get("MINIO_ROOT_USER", "minioadmin")
         self.root_pass = os.environ.get("MINIO_ROOT_PASSWORD", "minioadmin")
         self.app = web.Application(client_max_size=1 << 30)
+        # CORS decoration rides the prepare signal: it must run before
+        # headers hit the wire, which for streamed GETs happens INSIDE the
+        # handler — a post-dispatch wrapper would be too late
+        self.app.on_response_prepare.append(self._cors_on_prepare)
         self.app.router.add_route("*", "/", self._entry)
         self.app.router.add_route("*", "/{bucket}", self._entry)
         self.app.router.add_route("*", "/{bucket}/{key:.*}", self._entry)
@@ -452,6 +456,12 @@ class S3Server:
         resp: web.StreamResponse | None = None
         self.metrics.inflight += 1  # single-threaded event loop: no race
         try:
+            origin = request.headers.get("Origin", "")
+            if origin and request.method == "OPTIONS" and request.headers.get(
+                "Access-Control-Request-Method"
+            ):
+                resp = await self._cors_preflight(request, origin)
+                return resp
             resp = await self._entry_inner(request)
             return resp
         finally:
@@ -479,6 +489,94 @@ class S3Server:
                 audit.emit(
                     audit_record(request, status, dur, request.get("access_key", ""))
                 )
+
+    @staticmethod
+    def _is_user_bucket(bucket: str) -> bool:
+        return bool(bucket) and bucket != "minio" and not bucket.startswith(".minio.sys")
+
+    def _cors_rules_for(self, raw: str):
+        """Parsed bucket CORS rules, memoized by the raw document — the
+        response path must not pay an XML parse per request."""
+        from . import cors as corsmod
+
+        cache = getattr(self, "_cors_rule_cache", None)
+        if cache is None:
+            cache = self._cors_rule_cache = {}
+        rules = cache.get(raw)
+        if rules is None:
+            if len(cache) > 256:
+                cache.clear()
+            try:
+                rules = cache[raw] = corsmod.parse_bucket_cors(raw)
+            except ValueError:
+                rules = cache[raw] = []
+        return rules or None
+
+    def _cors_headers(
+        self, bucket: str, origin: str, method: str, req_headers: list[str],
+        allow_load: bool = False,
+    ) -> dict[str, str] | None:
+        """Evaluate bucket CORS rules (when configured) or the global
+        api.cors_allow_origin config (reference cmd/api-router.go:651).
+        allow_load=False restricts to the metadata CACHE (event-loop
+        callers); allow_load=True (executor callers) falls through to a
+        bucket_exists-gated metadata load, so attacker-chosen names never
+        reach get() (which would cache a default entry per name)."""
+        rules = None
+        if self._is_user_bucket(bucket):
+            bm = self.buckets.peek(bucket)
+            if bm is None and allow_load and self.store is not None:
+                try:
+                    if self.store.bucket_exists(bucket):
+                        bm = self.buckets.get(bucket)
+                except Exception:  # noqa: BLE001 — degraded metadata reads
+                    bm = None     # fall back to global rules
+            raw = bm.cors if bm is not None else None
+            if raw:
+                rules = self._cors_rules_for(raw)
+        from . import cors as corsmod
+
+        global_origins = [
+            o.strip()
+            for o in (self.config.get("api", "cors_allow_origin") or "*").split(",")
+            if o.strip()
+        ] if self.config is not None else ["*"]
+        return corsmod.evaluate(origin, method, req_headers, rules, global_origins)
+
+    async def _cors_on_prepare(self, request: web.Request, response) -> None:
+        origin = request.headers.get("Origin", "")
+        if not origin or request.method == "OPTIONS":
+            return
+        bucket = request.match_info.get("bucket", "") if request.match_info else ""
+        if self._is_user_bucket(bucket) and self.buckets.peek(bucket) is None:
+            # uncached bucket (e.g. first GET after restart): its CORS
+            # rules are authoritative, so load them off-loop rather than
+            # silently falling back to the permissive global default
+            hdrs = await self._run(
+                self._cors_headers, bucket, origin, request.method, [], True
+            )
+        else:
+            hdrs = self._cors_headers(bucket, origin, request.method, [])
+        if hdrs:
+            for k, v in hdrs.items():
+                response.headers.setdefault(k, v)
+
+    async def _cors_preflight(self, request: web.Request, origin: str) -> web.Response:
+        """OPTIONS preflight: unauthenticated by design (browsers send no
+        credentials); only reveals whether an origin/method is allowed."""
+        method = request.headers.get("Access-Control-Request-Method", "")
+        req_headers = [
+            h.strip()
+            for h in request.headers.get("Access-Control-Request-Headers", "").split(",")
+            if h.strip()
+        ]
+        hdrs = await self._run(
+            self._cors_headers, request.match_info.get("bucket", ""), origin,
+            method, req_headers, True,
+        )
+        if hdrs is None:
+            return web.Response(status=403, body=b"CORSResponse: origin not allowed")
+        return web.Response(status=200, headers=hdrs)
 
     async def _entry_inner(self, request: web.Request) -> web.StreamResponse:
         # unauthenticated planes: health + metrics
@@ -1156,6 +1254,13 @@ class S3Server:
 
             try:
                 validate_lifecycle(body.decode())
+            except (ValueError, ET.ParseError):
+                raise s3err.MalformedXML from None
+        if attr == "cors":
+            from . import cors as corsmod
+
+            try:
+                corsmod.parse_bucket_cors(body.decode())
             except (ValueError, ET.ParseError):
                 raise s3err.MalformedXML from None
         if attr == "policy":

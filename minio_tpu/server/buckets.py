@@ -56,6 +56,12 @@ class BucketMetadataSys:
         # a remote change to avoid echo loops
         self.on_change = None
 
+    def peek(self, bucket: str):
+        """Cache-only lookup (no storage IO): for callers on the event
+        loop that must never block, e.g. CORS response decoration."""
+        with self._lock:
+            return self._cache.get(bucket)
+
     def _key(self, bucket: str) -> str:
         return f"{CONFIG_PREFIX}/{bucket}/.metadata.json"
 

@@ -1,0 +1,199 @@
+"""CORS enforcement (reference cmd/api-router.go:651 corsHandler +
+per-bucket CORS configuration documents)."""
+
+import http.client
+import os
+
+os.environ.setdefault("MINIO_TPU_BACKEND", "numpy")
+
+import json
+
+import pytest
+
+from minio_tpu.client import S3Client
+
+from test_s3_api import ServerThread
+
+BUCKET_CORS = b"""<CORSConfiguration>
+  <CORSRule>
+    <AllowedOrigin>https://app.example.com</AllowedOrigin>
+    <AllowedMethod>GET</AllowedMethod>
+    <AllowedMethod>PUT</AllowedMethod>
+    <AllowedHeader>x-amz-*</AllowedHeader>
+    <ExposeHeader>ETag</ExposeHeader>
+    <MaxAgeSeconds>600</MaxAgeSeconds>
+  </CORSRule>
+  <CORSRule>
+    <AllowedOrigin>https://*.trusted.org</AllowedOrigin>
+    <AllowedMethod>GET</AllowedMethod>
+  </CORSRule>
+</CORSConfiguration>"""
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    base = tmp_path_factory.mktemp("corsdrives")
+    st = ServerThread([str(base / f"d{i}") for i in range(4)])
+    yield st
+    st.stop()
+
+
+@pytest.fixture(scope="module")
+def cli(server):
+    c = S3Client(f"127.0.0.1:{server.port}")
+    c.make_bucket("corsbkt")
+    c.put_object("corsbkt", "obj", b"cors-data")
+    assert c.request(
+        "PUT", "/corsbkt", query={"cors": ""}, body=BUCKET_CORS
+    ).ok
+    c.make_bucket("nocors")
+    c.put_object("nocors", "obj", b"global-cors")
+    return c
+
+
+def _preflight(server, path, origin, method, req_headers=""):
+    conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=30)
+    headers = {"Origin": origin, "Access-Control-Request-Method": method}
+    if req_headers:
+        headers["Access-Control-Request-Headers"] = req_headers
+    conn.request("OPTIONS", path, headers=headers)
+    r = conn.getresponse()
+    r.read()
+    hdrs = {k.lower(): v for k, v in r.getheaders()}
+    conn.close()
+    return r.status, hdrs
+
+
+def test_preflight_bucket_rules(cli, server):
+    st, h = _preflight(server, "/corsbkt/obj", "https://app.example.com", "PUT")
+    assert st == 200
+    assert h["access-control-allow-origin"] == "https://app.example.com"
+    assert "PUT" in h["access-control-allow-methods"]
+    assert h["access-control-max-age"] == "600"
+    # wildcard origin rule, GET only
+    st, h = _preflight(server, "/corsbkt/obj", "https://x.trusted.org", "GET")
+    assert st == 200
+    st, _ = _preflight(server, "/corsbkt/obj", "https://x.trusted.org", "PUT")
+    assert st == 403
+    # unknown origin rejected by bucket rules
+    st, _ = _preflight(server, "/corsbkt/obj", "https://evil.example", "GET")
+    assert st == 403
+
+
+def test_preflight_requested_headers(cli, server):
+    st, h = _preflight(
+        server, "/corsbkt/obj", "https://app.example.com", "PUT",
+        req_headers="x-amz-meta-tag, x-amz-date",
+    )
+    assert st == 200
+    # a header outside the allowed pattern fails the rule
+    st, _ = _preflight(
+        server, "/corsbkt/obj", "https://app.example.com", "PUT",
+        req_headers="x-custom-header",
+    )
+    assert st == 403
+
+
+def test_response_headers_attached(cli, server):
+    r = cli.get_object(
+        "corsbkt", "obj", headers={"Origin": "https://app.example.com"}
+    )
+    assert r.status == 200
+    assert r.headers["access-control-allow-origin"] == "https://app.example.com"
+    assert "etag" in r.headers["access-control-expose-headers"].lower()
+    # disallowed origin gets data (CORS is a browser control) but NO
+    # allow-origin header, so the browser blocks the read
+    r = cli.get_object("corsbkt", "obj", headers={"Origin": "https://evil.example"})
+    assert r.status == 200
+    assert "access-control-allow-origin" not in r.headers
+
+
+def test_global_fallback(cli, server):
+    # bucket without CORS config: the api.cors_allow_origin default (*)
+    st, h = _preflight(server, "/nocors/obj", "https://anything.example", "GET")
+    assert st == 200
+    assert h["access-control-allow-origin"] == "https://anything.example"
+    r = cli.get_object("nocors", "obj", headers={"Origin": "https://any.example"})
+    assert r.headers.get("access-control-allow-origin") == "https://any.example"
+
+
+def test_global_origin_restriction(cli, server):
+    assert cli.request(
+        "PUT", "/minio/admin/v3/set-config-kv",
+        body=json.dumps({
+            "subsys": "api", "key": "cors_allow_origin",
+            "value": "https://only.example.com",
+        }).encode(),
+    ).status == 200
+    try:
+        st, _ = _preflight(server, "/nocors/obj", "https://other.example", "GET")
+        assert st == 403
+        st, _ = _preflight(server, "/nocors/obj", "https://only.example.com", "GET")
+        assert st == 200
+        # bucket-level rules still govern their bucket
+        st, _ = _preflight(server, "/corsbkt/obj", "https://app.example.com", "PUT")
+        assert st == 200
+    finally:
+        cli.request(
+            "PUT", "/minio/admin/v3/set-config-kv",
+            body=json.dumps({
+                "subsys": "api", "key": "cors_allow_origin", "value": "*",
+            }).encode(),
+        )
+
+
+def test_malformed_cors_rejected(cli):
+    r = cli.request(
+        "PUT", "/corsbkt", query={"cors": ""},
+        body=b"<CORSConfiguration><CORSRule><AllowedOrigin>x</AllowedOrigin></CORSRule></CORSConfiguration>",
+    )
+    assert r.status == 400
+    r = cli.request(
+        "PUT", "/corsbkt", query={"cors": ""}, body=b"<not-xml",
+    )
+    assert r.status == 400
+
+
+def test_bucket_rules_survive_cache_flush(cli, server):
+    """First request after a restart (empty metadata cache) must still
+    enforce bucket CORS — not fall back to the permissive global default
+    (review r3 security finding)."""
+    server.srv.buckets._cache.clear()
+    r = cli.get_object("corsbkt", "obj", headers={"Origin": "https://evil.example"})
+    assert r.status == 200
+    assert "access-control-allow-origin" not in r.headers
+    server.srv.buckets._cache.clear()
+    st, _ = _preflight(server, "/corsbkt/obj", "https://evil.example", "GET")
+    assert st == 403
+
+
+def test_bucket_named_minio_prefix_enforced(cli, server):
+    """A user bucket whose name merely STARTS with 'minio' still gets its
+    own CORS rules (only the exact /minio pseudo-bucket is excluded)."""
+    cli.make_bucket("minio-backups")
+    cli.put_object("minio-backups", "o", b"x")
+    assert cli.request(
+        "PUT", "/minio-backups", query={"cors": ""}, body=BUCKET_CORS
+    ).ok
+    st, _ = _preflight(server, "/minio-backups/o", "https://evil.example", "GET")
+    assert st == 403
+    st, _ = _preflight(server, "/minio-backups/o", "https://app.example.com", "GET")
+    assert st == 200
+
+
+def test_preflight_unknown_bucket_no_metadata_pollution(cli, server):
+    """Unauthenticated preflights on made-up names must not grow the
+    metadata cache (review r3 memory-exhaustion finding)."""
+    before = len(server.srv.buckets._cache)
+    for i in range(20):
+        _preflight(server, f"/no-such-bkt-{i}/k", "https://a.example", "GET")
+    assert len(server.srv.buckets._cache) == before
+
+
+def test_cors_rule_rejects_stray_elements(cli):
+    r = cli.request(
+        "PUT", "/corsbkt", query={"cors": ""},
+        body=b"<CORSConfiguration><MyCORSRule><AllowedOrigin>*</AllowedOrigin>"
+             b"<AllowedMethod>GET</AllowedMethod></MyCORSRule></CORSConfiguration>",
+    )
+    assert r.status == 400
