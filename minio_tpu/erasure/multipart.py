@@ -211,6 +211,7 @@ class MultipartManager:
         parts: list[tuple[int, str]],
         versioned: bool = False,
         part_checksums: dict[int, dict[str, str]] | None = None,
+        check_precond=None,
     ) -> ObjectInfo:
         """Stitch uploaded parts into the final object (metadata only).
 
@@ -313,6 +314,24 @@ class MultipartManager:
         if not mtx.lock(30.0):
             # server-side contention is retryable, not a client error
             raise QuorumError(f"namespace lock timeout completing {bucket}/{obj}")
+        if check_precond is not None:
+            # conditional completes (If-None-Match/If-Match on
+            # CompleteMultipartUpload) evaluate under the same lock as the
+            # commit — identical discipline to put_object's hook
+            try:
+                try:
+                    cfi, _, _, _ = self.es._quorum_fileinfo(
+                        bucket, obj, "", read_data=False
+                    )
+                    cur = None if cfi.deleted else self.es._to_object_info(
+                        bucket, obj, cfi
+                    )
+                except Exception:  # noqa: BLE001 — absent object
+                    cur = None
+                check_precond(cur)
+            except BaseException:
+                mtx.unlock()
+                raise
 
         def commit(i: int, disk) -> None:
             shard_idx = dist[i] - 1
@@ -433,10 +452,10 @@ class MultipartRouter:
         self._mgr(obj, pidx).abort(bucket, obj, raw)
 
     def complete(self, bucket, obj, upload_id, parts, versioned=False,
-                 part_checksums=None):
+                 part_checksums=None, check_precond=None):
         pidx, raw = self._split(upload_id)
         return self._mgr(obj, pidx).complete(
-            bucket, obj, raw, parts, versioned, part_checksums
+            bucket, obj, raw, parts, versioned, part_checksums, check_precond
         )
 
     def list_uploads(self, bucket, prefix="") -> list[tuple[str, str]]:

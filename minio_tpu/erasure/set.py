@@ -38,6 +38,7 @@ from .quorum import (
     BucketNotFound,
     ObjectNotFound,
     QuorumError,
+    VersionNotFound,
     count_none,
     find_file_info_in_quorum,
     object_quorum_from_meta,
@@ -232,16 +233,31 @@ class ErasureSet:
         parity: int | None = None,
         distribution: list[int] | None = None,
         allow_inline: bool = True,
+        check_precond=None,
     ) -> ObjectInfo:
         """distribution/allow_inline overrides serve the multipart plane:
         all parts of an upload must share the final object's shard layout
-        and be rename-able files (never inline)."""
+        and be rename-able files (never inline). check_precond(current
+        ObjectInfo | None) runs UNDER the namespace write lock — the
+        conditional-write hook (PUT If-Match / If-None-Match, reference
+        checkPreconditionsPUT) with no TOCTOU window."""
         if not self.bucket_exists(bucket) and not bucket.startswith(".minio.sys"):
             raise BucketNotFound(bucket)
         mtx = self.ns.new(bucket, obj)
         if not mtx.lock(30.0):
             raise QuorumError(f"namespace write lock timeout on {bucket}/{obj}")
         try:
+            if check_precond is not None:
+                try:
+                    fi, _, _, _ = self._quorum_fileinfo(
+                        bucket, obj, "", read_data=False
+                    )
+                    cur = None if fi.deleted else self._to_object_info(
+                        bucket, obj, fi
+                    )
+                except (ObjectNotFound, VersionNotFound):
+                    cur = None
+                check_precond(cur)  # raises to abort before any write
             # active refresh with loss abort: a partitioned holder must stop
             # writing once the cluster no longer holds its lock (reference
             # internal/dsync/drwmutex.go:340 refreshLock). Only long-running

@@ -261,12 +261,16 @@ async def handle_admin(server, request: web.Request, access_key: str, subpath: s
 
     # -- service accounts -------------------------------------------------
     if op == "add-service-account" and m == "PUT":
-        authz("admin:CreateServiceAccount")
         try:
             d = json.loads(body) if body else {}
         except ValueError:
             raise s3err.InvalidArgument from None
         parent = d.get("targetUser") or access_key
+        if parent != access_key:
+            # minting for ANOTHER identity needs the admin grant; minting
+            # for oneself does not (reference AddServiceAccount: self-ops
+            # bypass the policy check, cmd/admin-handlers-users.go)
+            authz("admin:CreateServiceAccount")
         # creating credentials for ANOTHER identity inherits that identity's
         # privileges — only the cluster owner may do it (else any holder of
         # admin:CreateServiceAccount could mint root-equivalent keys)
@@ -282,6 +286,50 @@ async def handle_admin(server, request: web.Request, access_key: str, subpath: s
         return await _json_madmin(
             {"credentials": {"accessKey": u.access_key, "secretKey": u.secret_key}}
         )
+
+    if op == "list-service-accounts" and m == "GET":
+        # reference cmd/admin-handlers-users.go ListServiceAccounts: any
+        # authenticated user may manage their OWN service accounts (no
+        # admin policy needed); other users' SAs need owner/admin rights
+        target = q.get("user", "") or access_key
+        if target != access_key:
+            authz("admin:ListServiceAccounts")
+            if not iam.is_owner(access_key):
+                raise s3err.AccessDenied
+        accounts = [
+            {"accessKey": u.access_key, "parentUser": u.parent,
+             "accountStatus": u.status,
+             "expiration": u.expiration or None}
+            for u in iam.users.values()
+            if u.parent == target and not u.is_temp
+        ]
+        return await _json_madmin({"accounts": accounts})
+    if op == "info-service-account" and m == "GET":
+        sa = iam.users.get(q.get("accessKey", ""))
+        if sa is None or not sa.parent or sa.is_temp:
+            return _json({"error": "service account not found"}, 404)
+        if sa.parent != access_key:
+            authz("admin:ListServiceAccounts")
+            if not iam.is_owner(access_key):
+                raise s3err.AccessDenied
+        return await _json_madmin({
+            "parentUser": sa.parent,
+            "accountStatus": sa.status,
+            "impliedPolicy": not sa.session_policy,
+            "policy": json.dumps(sa.session_policy) if sa.session_policy else "",
+        })
+    if op == "delete-service-account" and m == "DELETE":
+        sa = iam.users.get(q.get("accessKey", ""))
+        if sa is None or not sa.parent or sa.is_temp:
+            return _json({"error": "service account not found"}, 404)
+        # the parent may always revoke their own SA; anyone else needs
+        # owner/admin rights (reference DeleteServiceAccount)
+        if sa.parent != access_key:
+            authz("admin:RemoveServiceAccount")
+            if not iam.is_owner(access_key):
+                raise s3err.AccessDenied
+        await server._run(iam.remove_user, sa.access_key)
+        return web.Response(status=204)
 
     # -- observability ----------------------------------------------------
     if op == "trace" and m == "GET":

@@ -1709,11 +1709,34 @@ class S3Server:
         if usage and usage.get("size", 0) > 0 and usage["size"] + size >= q:
             raise s3err.AdminBucketQuotaExceeded
 
+    @staticmethod
+    def _put_precond(request):
+        """Conditional writes (reference checkPreconditionsPUT,
+        cmd/object-handlers.go:2017): If-None-Match: * fails when the key
+        exists; If-Match: <etag> fails unless the CURRENT etag matches.
+        Runs under the namespace write lock inside the erasure layer."""
+        inm = request.headers.get("If-None-Match", "").strip()
+        im = request.headers.get("If-Match", "").strip()
+        if not inm and not im:
+            return None
+
+        def check(cur) -> None:
+            if inm and cur is not None and (
+                inm == "*" or inm in (f'"{cur.etag}"', cur.etag)
+            ):
+                raise s3err.PreconditionFailed
+            if im:
+                if cur is None or im not in ("*", f'"{cur.etag}"', cur.etag):
+                    raise s3err.PreconditionFailed
+
+        return check
+
     async def put_object(
         self, request, bucket: str, key: str, body: bytes | None
     ) -> web.Response:
         key = listing.encode_dir_object(key)
         bm = self.buckets.get(bucket)
+        precond = self._put_precond(request)
         self._enforce_quota(bucket, self._incoming_size(request, body))
         # overwriting an unversioned transitioned object orphans its warm-
         # tier data unless swept (reference enforces this via objSweeper)
@@ -1761,7 +1784,7 @@ class S3Server:
                 request,
                 lambda rd: self.store.put_object(
                     bucket, key, rd, user_defined, None, bm.versioning,
-                    parity=sc_parity,
+                    parity=sc_parity, check_precond=precond,
                 ),
             )
             headers = {"ETag": f'"{oi.etag}"'}
@@ -1804,14 +1827,11 @@ class S3Server:
             body = tr.data
         user_defined.update(checksum_meta)
         oi = await self._run(
-            self.store.put_object,
-            bucket,
-            key,
-            body,
-            user_defined,
-            None,
-            bm.versioning,
-            parity=self._parity_for_storage_class(request),
+            lambda: self.store.put_object(
+                bucket, key, body, user_defined, None, bm.versioning,
+                parity=self._parity_for_storage_class(request),
+                check_precond=precond,
+            )
         )
         headers = {"ETag": f'"{oi.etag}"'}
         headers.update(tr.response_headers)
@@ -2549,7 +2569,7 @@ class S3Server:
         try:
             oi = await self._run(
                 self.mp.complete, bucket, key, upload_id, parts, bm.versioning,
-                part_checksums or None,
+                part_checksums or None, self._put_precond(request),
             )
         except mp_mod.UploadNotFound:
             raise s3err.NoSuchUpload from None
@@ -3071,17 +3091,55 @@ class S3Server:
         return {"scanned": scanned, "healed": healed, "failed": failed}
 
     async def list_multipart_uploads(self, request, bucket) -> web.Response:
-        prefix = request.rel_url.query.get("prefix", "")
-        uploads = await self._run(self.mp.list_uploads, bucket, prefix)
+        q = request.rel_url.query
+        prefix = q.get("prefix", "")
+        key_marker = q.get("key-marker", "")
+        uid_marker = q.get("upload-id-marker", "")
+        try:
+            max_uploads = min(max(int(q.get("max-uploads", "1000")), 0), 1000)
+        except ValueError:
+            raise s3err.InvalidArgument from None
+        if max_uploads == 0:
+            # an empty page with no next marker cannot progress: report it
+            # as NON-truncated (same discipline as ListParts max-parts=0)
+            return web.Response(
+                body=(
+                    '<?xml version="1.0" encoding="UTF-8"?>'
+                    '<ListMultipartUploadsResult xmlns="http://s3.amazonaws.com/doc/2006-03-01/">'
+                    f"<Bucket>{escape(bucket)}</Bucket><Prefix>{escape(prefix)}</Prefix>"
+                    "<MaxUploads>0</MaxUploads>"
+                    "<IsTruncated>false</IsTruncated></ListMultipartUploadsResult>"
+                ).encode(),
+                content_type="application/xml",
+            )
+        uploads = sorted(await self._run(self.mp.list_uploads, bucket, prefix))
+        if key_marker:
+            # marker semantics (cmd/erasure-multipart.go ListMultipartUploads):
+            # strictly after (key_marker, uid_marker)
+            uploads = [
+                (k, u) for k, u in uploads
+                if k > key_marker or (k == key_marker and uid_marker and u > uid_marker)
+            ]
+        page = uploads[:max_uploads]
+        truncated = len(uploads) > len(page)
         items = "".join(
             f"<Upload><Key>{escape(k)}</Key><UploadId>{uid}</UploadId></Upload>"
-            for k, uid in uploads
+            for k, uid in page
+        )
+        next_markers = (
+            f"<NextKeyMarker>{escape(page[-1][0])}</NextKeyMarker>"
+            f"<NextUploadIdMarker>{page[-1][1]}</NextUploadIdMarker>"
+            if truncated and page
+            else ""
         )
         xml = (
             '<?xml version="1.0" encoding="UTF-8"?>'
             '<ListMultipartUploadsResult xmlns="http://s3.amazonaws.com/doc/2006-03-01/">'
             f"<Bucket>{escape(bucket)}</Bucket><Prefix>{escape(prefix)}</Prefix>"
-            f"<IsTruncated>false</IsTruncated>{items}</ListMultipartUploadsResult>"
+            f"<KeyMarker>{escape(key_marker)}</KeyMarker>"
+            f"<MaxUploads>{max_uploads}</MaxUploads>{next_markers}"
+            f"<IsTruncated>{'true' if truncated else 'false'}</IsTruncated>"
+            f"{items}</ListMultipartUploadsResult>"
         )
         return web.Response(body=xml.encode(), content_type="application/xml")
 

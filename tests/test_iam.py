@@ -343,3 +343,59 @@ def test_bucket_policy_not_policy_shaped_is_400(admin, server):
     for bad in (b"[]", b'"str"', b'{"Statement": "foo"}', b'{"Statement": [1]}'):
         r = admin.request("PUT", "/pub", query={"policy": ""}, body=bad)
         assert r.status == 400, (bad, r.status, r.body)
+
+
+def test_service_account_list_info_delete(admin, server):
+    """SA lifecycle admin ops (reference cmd/admin-handlers-users.go
+    ListServiceAccounts/InfoServiceAccount/DeleteServiceAccount)."""
+    r = admin.admin("PUT", "add-service-account",
+                    body={"targetUser": "minioadmin"}, encrypt_body=True)
+    assert r.status == 200, r.body
+    creds = json.loads(r.body)["credentials"]
+    ak = creds["accessKey"]
+    # list for self includes it (madmin-encrypted response)
+    r = admin.admin("GET", "list-service-accounts")
+    assert r.status == 200
+    accounts = json.loads(r.body)["accounts"]
+    assert any(a["accessKey"] == ak for a in accounts)
+    # info
+    r = admin.admin("GET", "info-service-account", query={"accessKey": ak})
+    assert r.status == 200
+    assert json.loads(r.body)["parentUser"] == "minioadmin"
+    # a non-owner cannot inspect someone else's SA
+    admin.request("PUT", "/minio/admin/v3/add-user", query={"accessKey": "dave"},
+                  body=json.dumps({"secretKey": "davesecret1"}).encode())
+    admin.request("PUT", "/minio/admin/v3/set-user-or-group-policy",
+                  query={"policyName": "readwrite", "userOrGroup": "dave"})
+    dave = S3Client(f"127.0.0.1:{server.port}", "dave", "davesecret1")
+    r = dave.admin("GET", "info-service-account", query={"accessKey": ak})
+    assert r.status == 403
+    # delete: the SA stops authenticating immediately
+    sa = S3Client(f"127.0.0.1:{server.port}", ak, creds["secretKey"])
+    assert sa.request("GET", "/").status == 200
+    r = admin.admin("DELETE", "delete-service-account", query={"accessKey": ak})
+    assert r.status == 204, r.body
+    assert sa.request("GET", "/").status == 403
+    r = admin.admin("GET", "list-service-accounts")
+    assert not any(a["accessKey"] == ak for a in json.loads(r.body)["accounts"])
+
+
+def test_service_account_self_service(admin, server):
+    """A plain user (no admin policies) manages their OWN service
+    accounts — reference semantics (self-ops need no admin grant)."""
+    admin.request("PUT", "/minio/admin/v3/add-user", query={"accessKey": "selfsa"},
+                  body=json.dumps({"secretKey": "selfsasecret"}).encode())
+    admin.request("PUT", "/minio/admin/v3/set-user-or-group-policy",
+                  query={"policyName": "readwrite", "userOrGroup": "selfsa"})
+    u = S3Client(f"127.0.0.1:{server.port}", "selfsa", "selfsasecret")
+    r = u.admin("PUT", "add-service-account", body=b"{}", encrypt_body=True)
+    assert r.status == 200, r.body
+    ak = json.loads(r.body)["credentials"]["accessKey"]
+    r = u.admin("GET", "list-service-accounts")
+    assert r.status == 200
+    assert any(a["accessKey"] == ak for a in json.loads(r.body)["accounts"])
+    assert u.admin("GET", "info-service-account", query={"accessKey": ak}).status == 200
+    assert u.admin("DELETE", "delete-service-account", query={"accessKey": ak}).status == 204
+    # but another user's SAs remain off-limits
+    r = u.admin("GET", "list-service-accounts", query={"user": "minioadmin"})
+    assert r.status == 403

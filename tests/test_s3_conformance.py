@@ -740,3 +740,116 @@ def test_suspended_versioning_null_version(cli):
     vids = [el.find(f"{ns}VersionId").text for el in x.iter(f"{ns}Version")]
     # exactly one null version (overwritten in place), plus v1
     assert sorted(vids) == sorted([v1, "null"])
+
+
+# -- conditional writes (PUT If-Match / If-None-Match) -----------------------
+
+
+def test_conditional_put_if_none_match(cli):
+    cli.make_bucket("condput")
+    # create-only semantics: first write wins
+    r = cli.put_object("condput", "once", b"first", headers={"If-None-Match": "*"})
+    assert r.status == 200
+    r = cli.put_object("condput", "once", b"second", headers={"If-None-Match": "*"})
+    assert r.status == 412
+    assert cli.get_object("condput", "once").body == b"first"
+    # unconditional overwrite still allowed
+    assert cli.put_object("condput", "once", b"third").status == 200
+
+
+def test_conditional_put_if_match(cli):
+    r = cli.put_object("condput", "cas", b"v1")
+    etag = r.headers["etag"]
+    # compare-and-swap: stale etag loses
+    r = cli.put_object("condput", "cas", b"v2", headers={"If-Match": etag})
+    assert r.status == 200
+    r = cli.put_object("condput", "cas", b"v3", headers={"If-Match": etag})
+    assert r.status == 412
+    assert cli.get_object("condput", "cas").body == b"v2"
+    # If-Match on a nonexistent key fails
+    r = cli.put_object("condput", "ghost", b"x", headers={"If-Match": '"abc"'})
+    assert r.status == 412
+
+
+def test_conditional_put_streaming(cli):
+    """The precondition binds the streaming (unsigned-payload) path too."""
+    import hashlib
+
+    big = os.urandom(9 * 1024 * 1024)  # above the 8 MiB streaming floor
+    sha = "STREAMING-UNSIGNED-PAYLOAD-TRAILER"
+    chunk = f"{len(big):x}\r\n".encode() + big + b"\r\n0\r\n\r\n"
+    hdrs = {
+        "x-amz-content-sha256": sha,
+        "x-amz-decoded-content-length": str(len(big)),
+        "content-encoding": "aws-chunked",
+        "If-None-Match": "*",
+    }
+    r = cli.request("PUT", "/condput/stream", body=chunk, headers=hdrs)
+    assert r.status == 200, r.body
+    r = cli.request("PUT", "/condput/stream", body=chunk, headers=hdrs)
+    assert r.status == 412
+
+
+# -- ListMultipartUploads pagination -----------------------------------------
+
+
+def test_list_multipart_uploads_pagination(cli, mpu_bucket):
+    uids = {}
+    for i in range(5):
+        uids[f"page/u{i}"] = _initiate(cli, "mpu", f"page/u{i}")
+    try:
+        r = cli.request(
+            "GET", "/mpu", query={"uploads": "", "prefix": "page/", "max-uploads": "2"}
+        )
+        x = r.xml()
+        ns = x.tag.split("}")[0] + "}"
+        keys = [el.text for el in x.iter(f"{ns}Key")]
+        assert len(keys) == 2 and keys == sorted(keys)
+        assert x.find(f"{ns}IsTruncated").text == "true"
+        km = x.find(f"{ns}NextKeyMarker").text
+        um = x.find(f"{ns}NextUploadIdMarker").text
+        seen = list(keys)
+        while True:
+            r = cli.request(
+                "GET", "/mpu",
+                query={"uploads": "", "prefix": "page/", "max-uploads": "2",
+                       "key-marker": km, "upload-id-marker": um},
+            )
+            x = r.xml()
+            seen += [el.text for el in x.iter(f"{ns}Key")]
+            if x.find(f"{ns}IsTruncated").text != "true":
+                break
+            km = x.find(f"{ns}NextKeyMarker").text
+            um = x.find(f"{ns}NextUploadIdMarker").text
+        assert seen == sorted(uids.keys())
+    finally:
+        for k, uid in uids.items():
+            cli.request("DELETE", f"/mpu/{k}", query={"uploadId": uid})
+
+
+def test_conditional_complete_multipart(cli, mpu_bucket):
+    """If-None-Match: * on CompleteMultipartUpload enforces create-only
+    through the multipart path too (review r3 finding)."""
+    cli.put_object("mpu", "condmp", b"already-here")
+    uid = _initiate(cli, "mpu", "condmp")
+    et = _upload_part(cli, "mpu", "condmp", uid, 1, os.urandom(1024))
+    inner = f"<Part><PartNumber>1</PartNumber><ETag>{et}</ETag></Part>"
+    r = cli.request(
+        "POST", "/mpu/condmp", query={"uploadId": uid},
+        headers={"If-None-Match": "*"},
+        body=f"<CompleteMultipartUpload>{inner}</CompleteMultipartUpload>".encode(),
+    )
+    assert r.status == 412, r.body
+    assert cli.get_object("mpu", "condmp").body == b"already-here"
+
+
+def test_list_multipart_uploads_max_zero(cli, mpu_bucket):
+    uid = _initiate(cli, "mpu", "zeropage")
+    try:
+        r = cli.request("GET", "/mpu", query={"uploads": "", "max-uploads": "0"})
+        x = r.xml()
+        ns = x.tag.split("}")[0] + "}"
+        assert x.find(f"{ns}IsTruncated").text == "false"
+        assert not list(x.iter(f"{ns}Key"))
+    finally:
+        cli.request("DELETE", "/mpu/zeropage", query={"uploadId": uid})
