@@ -165,13 +165,57 @@ class LockRESTServer:
             return web.Response(status=404)
         return web.Response(body=msgpack.packb(ok))
 
+    def register_grid(self, grid) -> None:
+        """Lock RPCs over the muxed grid. Clients connect on a dedicated
+        "lock" plane websocket, reproducing the reference's separate lock
+        grid (cmd/grid.go:76): lock traffic never queues behind a burst of
+        storage metadata RPCs sharing a connection."""
+
+        def call(payload: bytes) -> bytes:
+            op, resource, uid = msgpack.unpackb(payload, raw=False)
+            if op == "stats":
+                return msgpack.packb(self.locker.stats())
+            if op == "force_unlock":
+                return msgpack.packb(self.locker.force_unlock(resource))
+            if op in ("lock", "unlock", "rlock", "runlock", "refresh"):
+                return msgpack.packb(getattr(self.locker, op)(resource, uid))
+            raise ValueError(f"unknown lock op {op}")
+
+        # inline: pure in-memory table ops must not queue behind the
+        # executor's disk-bound storage work — that would re-couple the
+        # planes server-side
+        grid.register_single("lock.call", call, inline=True)
+
 
 class _RemoteLocker:
     def __init__(self, host: str, port: int, token: str):
         self.host, self.port, self.token = host, port, token
         self._local = threading.local()
+        from .grid import GridGate
+
+        self._gate = GridGate(host, port, token, "lock")
 
     def _call(self, op: str, resource: str, uid: str) -> bool:
+        # a lock RPC that dies mid-flight may still have been granted; the
+        # TTL expiry (LOCK_TTL) reclaims such orphans on both transports
+        g = self._gate.client()
+        if g is not None:
+            try:
+                return bool(
+                    msgpack.unpackb(
+                        g.call(
+                            "lock.call",
+                            msgpack.packb([op, resource, uid]),
+                            timeout=5.0,
+                        ),
+                        raw=False,
+                    )
+                )
+            except Exception:  # noqa: BLE001 — not granted; try HTTP once
+                self._gate.failed()
+        return self._call_http(op, resource, uid)
+
+    def _call_http(self, op: str, resource: str, uid: str) -> bool:
         conn = getattr(self._local, "conn", None)
         try:
             if conn is None:

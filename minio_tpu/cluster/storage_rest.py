@@ -74,6 +74,48 @@ class StorageRESTServer:
             "POST", STORAGE_PREFIX + "/{drive:\\d+}/{op}", self.handle
         )
 
+    def register_grid(self, grid) -> None:
+        """Expose the same ops over the muxed grid: small RPCs as
+        `storage.call` single requests, walkdir as a credit-controlled
+        stream (the reference moved exactly this class of traffic onto
+        internal/grid; bulk shard bodies stay on HTTP)."""
+
+        def call(payload: bytes) -> bytes:
+            drive_idx, op, body = msgpack.unpackb(payload, raw=False)
+            drive = self.drives.get(drive_idx)
+            if drive is None:
+                raise errors.DiskNotFound("bad drive index")
+            return self._call(drive, op, body)
+
+        async def walkdir(payload: bytes, stream) -> None:
+            import asyncio
+
+            drive_idx, volume, base, after = msgpack.unpackb(payload, raw=False)
+            drive = self.drives.get(drive_idx)
+            if drive is None:
+                raise errors.DiskNotFound("bad drive index")
+            it = drive.walk_dir(volume, base)
+            loop = asyncio.get_running_loop()
+
+            def next_batch() -> list[str]:
+                out: list[str] = []
+                for key in it:
+                    if after and key <= after:
+                        continue
+                    out.append(key)
+                    if len(out) >= 512:
+                        break
+                return out
+
+            while True:
+                batch = await loop.run_in_executor(None, next_batch)
+                if not batch:
+                    return
+                await stream.send(msgpack.packb(batch))
+
+        grid.register_single("storage.call", call)
+        grid.register_stream("storage.walkdir", walkdir)
+
     async def handle(self, request: web.Request) -> web.Response:
         if request.headers.get("x-minio-token") != self.token:
             return web.Response(status=403)
@@ -208,6 +250,11 @@ class StorageRESTClient(StorageAPI):
         self.endpoint = endpoint or f"http://{host}:{port}/#{drive_index}"
         self.disk_id = ""
         self._local = threading.local()
+        # small metadata RPCs ride the muxed grid connection shared by all
+        # drives pointing at this peer; bulk shard bodies stay on HTTP
+        from .grid import GridGate
+
+        self._gate = GridGate(host, port, token, "storage")
 
     def _conn(self) -> http.client.HTTPConnection:
         c = getattr(self._local, "conn", None)
@@ -226,8 +273,38 @@ class StorageRESTClient(StorageAPI):
          "statinfofile", "verifyfile"}
     )
 
+    # bulk shard payloads: per the grid design (reference grid README) these
+    # stay on their own HTTP bodies so one large transfer can't stall every
+    # muxed RPC behind it
+    _BULK_OPS = frozenset({"createfile", "appendfile", "readfile"})
+
     def _rpc(self, op: str, args: dict | None = None) -> bytes:
         body = msgpack.packb(args or {})
+        if op not in self._BULK_OPS:
+            g = self._gate.client()
+            if g is not None:
+                from .grid import GridConnectError, GridError, RemoteError
+
+                try:
+                    return g.call(
+                        "storage.call",
+                        msgpack.packb([self.drive_index, op, body]),
+                        retry=op in self._RETRYABLE,
+                    )
+                except RemoteError as e:
+                    err_type = _ERR_TYPES.get(e.err_type, errors.StorageError)
+                    raise err_type(str(e)) from None
+                except GridConnectError:
+                    # never sent: safe to fall back to HTTP for any op
+                    self._gate.failed()
+                except GridError:
+                    self._gate.failed()
+                    if op not in self._RETRYABLE:
+                        # may have been applied remotely; resending over
+                        # HTTP would violate the no-replay discipline
+                        raise errors.DiskNotFound(
+                            f"{self.endpoint} grid rpc {op} failed mid-flight"
+                        ) from None
         path = f"{STORAGE_PREFIX}/{self.drive_index}/{op}"
         attempts = (0, 1) if op in self._RETRYABLE else (1,)
         for attempt in attempts:
@@ -367,6 +444,36 @@ class StorageRESTClient(StorageAPI):
 
     def walk_dir(self, volume: str, base: str = "") -> Iterator[str]:
         after = ""
+        g = self._gate.client()
+        if g is not None:
+            from .grid import GridError, RemoteError
+
+            st = None
+            try:
+                st = g.stream(
+                    "storage.walkdir",
+                    msgpack.packb([self.drive_index, volume, base, after]),
+                )
+                while True:
+                    item = st.recv()
+                    if item is None:
+                        return
+                    for key in msgpack.unpackb(item, raw=False):
+                        yield key
+                        after = key
+            except RemoteError as e:
+                err_type = _ERR_TYPES.get(e.err_type, errors.StorageError)
+                raise err_type(str(e)) from None
+            except GridError:
+                # keys stream in sorted walk order, so the HTTP pager below
+                # resumes exactly after the last delivered key
+                self._gate.failed()
+            finally:
+                # listings abandon per-drive walks early (k-way merge stops
+                # at the prefix end); cancel tells the server to release
+                # the handler parked on credits instead of leaking it
+                if st is not None:
+                    st.cancel()
         limit = 10000
         while True:
             page = msgpack.unpackb(

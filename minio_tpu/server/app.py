@@ -3271,8 +3271,18 @@ def main(argv: list[str] | None = None) -> None:
 
     srv = S3Server(None)
     srv.peers = peers  # cluster peers, for admin profile/pprof fan-out
-    StorageRESTServer(registry, token).register(srv.app)
-    LockRESTServer(local_locker, token).register(srv.app)
+    from ..cluster.grid import GridServer
+
+    storage_srv = StorageRESTServer(registry, token)
+    lock_srv = LockRESTServer(local_locker, token)
+    storage_srv.register(srv.app)
+    lock_srv.register(srv.app)
+    # muxed internode RPC: small storage ops + lock ops share one
+    # websocket per (peer, plane); HTTP routes above stay as fallback
+    grid = GridServer(token)
+    storage_srv.register_grid(grid)
+    lock_srv.register_grid(grid)
+    grid.register(srv.app)
     from ..cluster import bootstrap as bootmod
 
     my_syscfg = bootmod.system_config(sorted(str(e) for e in all_eps), salt=token)
