@@ -484,7 +484,8 @@ class BackgroundOps:
         data = b"".join(it)
         remote_key = t.remote_key(bucket, obj)
         r = t.client().put_object(t.bucket, remote_key, data)
-        if r.status != 200:
+        # any 2xx: S3 answers 200, Azure Blob answers 201 Created
+        if not 200 <= r.status < 300:
             raise RuntimeError(f"tier upload failed: HTTP {r.status}")
         self.store.transition_object(bucket, obj, tier_name, remote_key)
         self.stats["ilm_transitioned"] = self.stats.get("ilm_transitioned", 0) + 1

@@ -34,10 +34,30 @@ class Tier:
     secret_key: str
     bucket: str
     prefix: str = ""
-    tier_type: str = "minio"  # "minio" | "s3" — same wire protocol
+    # "minio"/"s3" share the S3 wire protocol; "azure" = Blob REST with
+    # SharedKey (access_key=account, secret_key=account key); "gcs" = JSON
+    # API with a service-account JWT (secret_key=the SA JSON) — the same
+    # four families as the reference's warm backends (cmd/warm-backend-*.go)
+    tier_type: str = "minio"
 
-    def client(self) -> S3Client:
-        return S3Client(self.endpoint, self.access_key, self.secret_key)
+    def client(self):
+        # cached per Tier: the GCS backend holds an OAuth token that must
+        # survive across operations (one JWT exchange per hour, not per op)
+        c = getattr(self, "_client", None)
+        if c is not None:
+            return c
+        if self.tier_type == "azure":
+            from .warm_backends import AzureWarmClient
+
+            c = AzureWarmClient(self.endpoint, self.access_key, self.secret_key)
+        elif self.tier_type == "gcs":
+            from .warm_backends import GCSWarmClient
+
+            c = GCSWarmClient(self.endpoint, self.secret_key)
+        else:
+            c = S3Client(self.endpoint, self.access_key, self.secret_key)
+        self._client = c
+        return c
 
     def remote_key(self, bucket: str, obj: str) -> str:
         """Unique per transition epoch: a later re-transition of a changed
@@ -45,7 +65,8 @@ class Tier:
         return f"{self.prefix}{bucket}/{obj}/{uuid.uuid4()}"
 
     def to_dict(self) -> dict:
-        return dict(self.__dict__)
+        # private state (the cached client) must not persist to tiers.json
+        return {k: v for k, v in self.__dict__.items() if not k.startswith("_")}
 
 
 def is_transitioned(user_defined: dict) -> bool:

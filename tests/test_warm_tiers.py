@@ -1,0 +1,359 @@
+"""Azure Blob + GCS warm-tier backends (ilm/warm_backends.py) against
+loopback fake services that verify the auth material — the analogue of
+the reference's warm-backend tests (cmd/warm-backend-azure.go,
+warm-backend-gcs.go), which this image cannot run for lack of the SDKs."""
+
+import base64
+import hashlib
+import hmac
+import json
+import os
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+os.environ.setdefault("MINIO_TPU_BACKEND", "numpy")
+os.environ.setdefault("MINIO_TPU_SCAN_INTERVAL", "0")
+
+import numpy as np
+import pytest
+
+from minio_tpu.ilm.warm_backends import AzureWarmClient, GCSWarmClient
+
+RNG = np.random.default_rng(77)
+
+AZ_ACCOUNT = "tpuacct"
+AZ_KEY = base64.b64encode(b"azure-secret-key-material-32byte").decode()
+
+
+class _FakeAzure(BaseHTTPRequestHandler):
+    """Block Blob surface with real SharedKey verification: every request's
+    Authorization header is recomputed from the canonical string-to-sign
+    (per the published SharedKey rules, independently of the client)."""
+
+    blobs: dict[str, bytes] = {}
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *a):
+        pass
+
+    def _verify(self, verb: str, length: int) -> bool:
+        u = urllib.parse.urlparse(self.path)
+        query = dict(urllib.parse.parse_qsl(u.query))
+        hdrs = {k.lower(): v for k, v in self.headers.items()}
+        canon_headers = "".join(
+            f"{k}:{hdrs[k]}\n" for k in sorted(hdrs) if k.startswith("x-ms-")
+        )
+        canon_resource = f"/{AZ_ACCOUNT}{u.path}"
+        for qk in sorted(query):
+            canon_resource += f"\n{qk.lower()}:{query[qk]}"
+        sts = "\n".join([
+            verb,
+            hdrs.get("content-encoding", ""),
+            hdrs.get("content-language", ""),
+            str(length) if length else "",
+            hdrs.get("content-md5", ""),
+            hdrs.get("content-type", ""),
+            "",
+            hdrs.get("if-modified-since", ""),
+            hdrs.get("if-match", ""),
+            hdrs.get("if-none-match", ""),
+            hdrs.get("if-unmodified-since", ""),
+            hdrs.get("range", ""),
+        ]) + "\n" + canon_headers + canon_resource
+        want = base64.b64encode(
+            hmac.new(base64.b64decode(AZ_KEY), sts.encode(), hashlib.sha256).digest()
+        ).decode()
+        got = self.headers.get("Authorization", "")
+        return got == f"SharedKey {AZ_ACCOUNT}:{want}"
+
+    def _reply(self, status: int, body: bytes = b"", extra: dict | None = None):
+        self.send_response(status)
+        for k, v in (extra or {}).items():
+            self.send_header(k, v)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_PUT(self):
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length)
+        if not self._verify("PUT", length):
+            return self._reply(403, b"bad signature")
+        if self.headers.get("x-ms-blob-type") != "BlockBlob":
+            return self._reply(400, b"missing x-ms-blob-type")
+        if not self.headers.get("x-ms-version"):
+            return self._reply(400, b"missing x-ms-version")
+        self.blobs[urllib.parse.unquote(self.path)] = body
+        self._reply(201)
+
+    def do_GET(self):
+        if not self._verify("GET", 0):
+            return self._reply(403, b"bad signature")
+        u = urllib.parse.urlparse(self.path)
+        blob = self.blobs.get(urllib.parse.unquote(u.path))
+        if blob is None:
+            return self._reply(404, b"BlobNotFound")
+        rng = self.headers.get("Range", "")
+        if rng.startswith("bytes="):
+            start, _, end = rng[6:].partition("-")
+            start = int(start)
+            end = int(end) if end else len(blob) - 1
+            part = blob[start:end + 1]
+            return self._reply(
+                206, part,
+                {"Content-Range": f"bytes {start}-{end}/{len(blob)}"})
+        self._reply(200, blob)
+
+    def do_DELETE(self):
+        if not self._verify("DELETE", 0):
+            return self._reply(403, b"bad signature")
+        u = urllib.parse.urlparse(self.path)
+        if self.blobs.pop(urllib.parse.unquote(u.path), None) is None:
+            return self._reply(404, b"BlobNotFound")
+        self._reply(202)
+
+
+@pytest.fixture(scope="module")
+def azure_srv():
+    _FakeAzure.blobs = {}
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _FakeAzure)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}", _FakeAzure.blobs
+    srv.shutdown()
+
+
+def test_azure_roundtrip(azure_srv):
+    ep, blobs = azure_srv
+    c = AzureWarmClient(ep, AZ_ACCOUNT, AZ_KEY)
+    data = RNG.integers(0, 256, size=200_000, dtype=np.uint8).tobytes()
+    assert c.put_object("tierc", "deep/key name.bin", data).status == 201
+    assert blobs["/tierc/deep/key name.bin"] == data
+    g = c.get_object("tierc", "deep/key name.bin")
+    assert g.status == 200 and g.body == data
+    r = c.get_object("tierc", "deep/key name.bin",
+                     headers={"Range": "bytes=500-999"})
+    assert r.status == 206 and r.body == data[500:1000]
+    d = c.delete_object("tierc", "deep/key name.bin")
+    assert d.status == 204  # Azure's 202 mapped to the S3 code callers expect
+    assert c.get_object("tierc", "deep/key name.bin").status == 404
+
+
+def test_azure_bad_key_rejected(azure_srv):
+    ep, _ = azure_srv
+    bad = AzureWarmClient(ep, AZ_ACCOUNT,
+                          base64.b64encode(b"wrong-key-material-wrong-key-mat").decode())
+    assert bad.put_object("tierc", "nope", b"x").status == 403
+
+
+# ---------------------------------------------------------------------------
+# GCS: JSON API + OAuth2 service-account JWT grant
+# ---------------------------------------------------------------------------
+
+
+def _make_sa():
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    pem = key.private_bytes(
+        serialization.Encoding.PEM, serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption())
+    return key.public_key(), pem.decode()
+
+
+class _FakeGCS(BaseHTTPRequestHandler):
+    """Token endpoint (verifies the RS256 JWT with the SA public key) +
+    the JSON-API object surface (verifies the bearer token)."""
+
+    objects: dict[str, bytes] = {}
+    public_key = None
+    token = "tok-fake-gcs-1"
+    token_grants = 0
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *a):
+        pass
+
+    def _reply(self, status: int, body: bytes = b"", extra: dict | None = None):
+        self.send_response(status)
+        for k, v in (extra or {}).items():
+            self.send_header(k, v)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _authed(self) -> bool:
+        return self.headers.get("Authorization") == f"Bearer {self.token}"
+
+    def do_POST(self):
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length)
+        u = urllib.parse.urlparse(self.path)
+        if u.path == "/token":
+            form = dict(urllib.parse.parse_qsl(body.decode()))
+            if form.get("grant_type") != "urn:ietf:params:oauth:grant-type:jwt-bearer":
+                return self._reply(400, b'{"error":"bad grant"}')
+            try:
+                h, c, s = form["assertion"].split(".")
+                from cryptography.hazmat.primitives import hashes
+                from cryptography.hazmat.primitives.asymmetric import padding
+
+                pad = "=" * (-len(s) % 4)
+                self.public_key.verify(
+                    base64.urlsafe_b64decode(s + pad), f"{h}.{c}".encode(),
+                    padding.PKCS1v15(), hashes.SHA256())
+                claims = json.loads(
+                    base64.urlsafe_b64decode(c + "=" * (-len(c) % 4)))
+                assert claims["scope"].endswith("devstorage.read_write")
+            except Exception:  # noqa: BLE001
+                return self._reply(401, b'{"error":"bad assertion"}')
+            type(self).token_grants += 1
+            return self._reply(200, json.dumps(
+                {"access_token": self.token, "expires_in": 3600,
+                 "token_type": "Bearer"}).encode(),
+                {"Content-Type": "application/json"})
+        # media upload
+        if u.path.startswith("/upload/storage/v1/b/"):
+            if not self._authed():
+                return self._reply(401)
+            q = dict(urllib.parse.parse_qsl(u.query))
+            bucket = u.path.split("/")[5]
+            self.objects[f"{bucket}/{q['name']}"] = body
+            return self._reply(200, json.dumps({"name": q["name"]}).encode())
+        self._reply(404)
+
+    def do_GET(self):
+        if not self._authed():
+            return self._reply(401)
+        u = urllib.parse.urlparse(self.path)
+        parts = u.path.split("/")  # /storage/v1/b/{bucket}/o/{object}
+        if len(parts) < 7:
+            return self._reply(404)
+        key = f"{parts[4]}/{urllib.parse.unquote(parts[6])}"
+        obj = self.objects.get(key)
+        if obj is None:
+            return self._reply(404)
+        rng = self.headers.get("Range", "")
+        if rng.startswith("bytes="):
+            start, _, end = rng[6:].partition("-")
+            start = int(start)
+            end = int(end) if end else len(obj) - 1
+            return self._reply(206, obj[start:end + 1])
+        self._reply(200, obj)
+
+    def do_DELETE(self):
+        if not self._authed():
+            return self._reply(401)
+        u = urllib.parse.urlparse(self.path)
+        parts = u.path.split("/")
+        key = f"{parts[4]}/{urllib.parse.unquote(parts[6])}"
+        if self.objects.pop(key, None) is None:
+            return self._reply(404)
+        self._reply(204)
+
+
+@pytest.fixture(scope="module")
+def gcs_srv():
+    pub, pem = _make_sa()
+    _FakeGCS.objects = {}
+    _FakeGCS.public_key = pub
+    _FakeGCS.token_grants = 0
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _FakeGCS)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    ep = f"http://127.0.0.1:{srv.server_address[1]}"
+    creds = {"client_email": "tier@tpu.iam.gserviceaccount.com",
+             "private_key": pem, "token_uri": f"{ep}/token"}
+    yield ep, creds
+    srv.shutdown()
+
+
+def test_gcs_roundtrip(gcs_srv):
+    ep, creds = gcs_srv
+    c = GCSWarmClient(ep, creds)
+    data = RNG.integers(0, 256, size=150_000, dtype=np.uint8).tobytes()
+    assert c.put_object("gbkt", "a/b/obj.bin", data).status == 200
+    g = c.get_object("gbkt", "a/b/obj.bin")
+    assert g.status == 200 and g.body == data
+    r = c.get_object("gbkt", "a/b/obj.bin", headers={"Range": "bytes=0-99"})
+    assert r.status == 206 and r.body == data[:100]
+    assert c.delete_object("gbkt", "a/b/obj.bin").status == 204
+    assert c.get_object("gbkt", "a/b/obj.bin").status == 404
+
+
+def test_gcs_token_cached_across_requests(gcs_srv):
+    ep, creds = gcs_srv
+    before = _FakeGCS.token_grants
+    c = GCSWarmClient(ep, creds)
+    for i in range(5):
+        c.put_object("gbkt", f"k{i}", b"v")
+    assert _FakeGCS.token_grants == before + 1  # one JWT exchange, then cached
+
+
+def test_gcs_credentials_as_json_string(gcs_srv):
+    ep, creds = gcs_srv
+    c = GCSWarmClient(ep, json.dumps(creds))
+    assert c.put_object("gbkt", "strcreds", b"v").status == 200
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: ILM transition to an azure-typed tier through the real server
+# ---------------------------------------------------------------------------
+
+
+def test_ilm_transition_to_azure_tier(azure_srv, tmp_path):
+    from minio_tpu.client import S3Client
+    from tests.test_s3_api import ServerThread
+
+    ep, blobs = azure_srv
+    prev = os.environ.get("MINIO_COMPRESSION_ENABLE")
+    os.environ["MINIO_COMPRESSION_ENABLE"] = "off"
+    hot = ServerThread([str(tmp_path / f"h{i}") for i in range(4)])
+    try:
+        ch = S3Client(f"127.0.0.1:{hot.port}")
+        r = ch.request("PUT", "/minio/admin/v3/tier", body=json.dumps({
+            "name": "AZWARM", "endpoint": ep, "accessKey": AZ_ACCOUNT,
+            "secretKey": AZ_KEY, "bucket": "tierc", "prefix": "az/",
+            "type": "azure",
+        }).encode())
+        assert r.status == 200, r.body
+        assert ch.make_bucket("azilm").status == 200
+        body = RNG.integers(0, 256, size=120_000, dtype=np.uint8).tobytes()
+        assert ch.put_object("azilm", "cold.bin", body).status == 200
+        lc = ("<LifecycleConfiguration><Rule><ID>t0</ID><Status>Enabled"
+              "</Status><Filter><Prefix></Prefix></Filter><Transition>"
+              "<Days>0</Days><StorageClass>AZWARM</StorageClass>"
+              "</Transition></Rule></LifecycleConfiguration>").encode()
+        assert ch.request("PUT", "/azilm", query={"lifecycle": ""},
+                          body=lc).status == 200
+        hot.srv.background.scan_once()
+        # the bytes now live in the fake Azure container
+        az_keys = [k for k in blobs if k.startswith("/tierc/az/azilm/")]
+        assert az_keys, list(blobs)
+        # and the object really became a stub (otherwise the read-through
+        # assertions below would pass vacuously against local shards)
+        h = ch.head_object("azilm", "cold.bin")
+        assert h.headers.get("x-amz-storage-class") == "AZWARM", h.headers
+        # read-through GET pulls them back via the Blob REST protocol
+        g = ch.get_object("azilm", "cold.bin")
+        assert g.status == 200 and g.body == body
+        rr = ch.get_object("azilm", "cold.bin",
+                           headers={"Range": "bytes=1000-1999"})
+        assert rr.status == 206 and rr.body == body[1000:2000]
+        # delete sweeps the remote tier (tier GC through the Azure client);
+        # the sweep is fire-and-forget off the response path, so poll
+        import time
+
+        assert ch.delete_object("azilm", "cold.bin").status == 204
+        deadline = time.time() + 10
+        while ([k for k in blobs if k.startswith("/tierc/az/azilm/")]
+               and time.time() < deadline):
+            time.sleep(0.1)
+        assert not [k for k in blobs if k.startswith("/tierc/az/azilm/")]
+    finally:
+        hot.stop()
+        if prev is None:
+            os.environ.pop("MINIO_COMPRESSION_ENABLE", None)
+        else:
+            os.environ["MINIO_COMPRESSION_ENABLE"] = prev
