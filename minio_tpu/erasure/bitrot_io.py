@@ -6,6 +6,13 @@ blocks of `shard_size` bytes (last may be short) is stored as
     hash(block_0) || block_0 || hash(block_1) || block_1 || ...
 with HighwayHash-256 (32-byte digests, MinIO magic key). Verification reads
 recompute each block's digest (/root/reference/cmd/bitrot.go:164-216).
+
+The legacy WHOLE-FILE format (/root/reference/cmd/bitrot-whole.go) is also
+supported for reading: the shard file holds raw shard bytes and ONE digest
+over the whole file lives in the version metadata
+(ErasureInfo.checksums[part].hash). New writes always produce the
+streaming format, like the reference; whole-file is a read/verify/heal
+compatibility surface for imported legacy data.
 """
 
 from __future__ import annotations
@@ -45,6 +52,28 @@ def verify_block(
     if got != digest:
         raise errors.FileCorrupt("bitrot detected")
     return block
+
+
+def whole_file_digest(data: bytes, algo: BitrotAlgorithm = DEFAULT_BITROT_ALGO) -> bytes:
+    """Digest of a whole raw shard file (legacy whole-file bitrot mode)."""
+    if algo in (BitrotAlgorithm.HIGHWAYHASH256, BitrotAlgorithm.HIGHWAYHASH256S):
+        from ..ops.bitrot import fast_hash256
+
+        return fast_hash256(data)
+    h = algo.new()
+    h.update(data)
+    return h.digest()
+
+
+def verify_whole_file(
+    data: bytes, expect_digest: bytes,
+    algo: BitrotAlgorithm = DEFAULT_BITROT_ALGO,
+) -> bytes:
+    """Verify a whole raw shard against its stored metadata digest
+    (reference cmd/bitrot-whole.go wholeBitrotVerifier)."""
+    if whole_file_digest(data, algo) != expect_digest:
+        raise errors.FileCorrupt("bitrot detected (whole-file)")
+    return data
 
 
 def bitrot_verify_file(

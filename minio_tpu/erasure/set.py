@@ -50,6 +50,19 @@ TMP_VOLUME = ".minio.sys/tmp"
 DIGEST = bitrot_io.DIGEST_SIZE
 
 
+def _whole_file_hash(m: "FileInfo", part_number: int):
+    """This drive's stored (digest, algorithm) for a part, or None when the
+    shard uses the streaming format (reference cmd/bitrot-whole.go: legacy
+    shards carry one metadata digest instead of interleaved frames). The
+    stored algorithm matters: legacy data may be sha256/blake2b hashed."""
+    from ..ops.bitrot import algorithm_from_string
+
+    for c in m.erasure.checksums:
+        if c.part_number == part_number and c.hash:
+            return c.hash, algorithm_from_string(c.algorithm)
+    return None
+
+
 def _native_plane_enabled(device_active: bool = False) -> bool:
     """Native C++ streaming data plane (native/dataplane.cpp): used for the
     PUT/GET hot path whenever every target drive is local. One GIL-releasing
@@ -662,8 +675,45 @@ class ErasureSet:
         if len(sources) < self.n:
             report_degraded()  # some drive lacks this version entirely
 
+        # legacy whole-file shards: raw bytes on disk, one digest in the
+        # drive's metadata; read+verify the whole shard once per part.
+        # Futures memoize the load so the read pool's concurrent blocks
+        # share ONE read+hash instead of racing past a bare dict check.
+        from concurrent.futures import Future
+
+        whole_cache: dict[tuple[int, int], Future] = {}
+        whole_lock = threading.Lock()
+
+        def read_whole_shard(idx: int, part_num: int, wh, algo) -> bytes:
+            k = (idx, part_num)
+            with whole_lock:
+                fut = whole_cache.get(k)
+                owner = fut is None
+                if owner:
+                    fut = whole_cache[k] = Future()
+            if owner:
+                try:
+                    disk, m = sources[idx]
+                    raw = m.inline_data if m.inline_data else disk.read_file(
+                        bucket, f"{obj}/{fi.data_dir}/part.{part_num}", 0, -1
+                    )
+                    fut.set_result(
+                        bitrot_io.verify_whole_file(bytes(raw), wh, algo)
+                    )
+                except Exception as e:  # noqa: BLE001 — typed via the future
+                    fut.set_exception(e)
+            return fut.result()
+
         def read_shard_block(part_num: int, idx: int, per: int, f_off: int) -> bytes:
             disk, m = sources[idx]
+            wf = _whole_file_hash(m, part_num)
+            if wf is not None:
+                block_i = f_off // (DIGEST + coder.shard_size)
+                data = read_whole_shard(idx, part_num, *wf)
+                blk = data[block_i * coder.shard_size:][:per]
+                if len(blk) != per:
+                    raise errors.FileCorrupt("short whole-file shard")
+                return blk
             if m.inline_data:
                 buf = m.inline_data[f_off : f_off + DIGEST + per]
             else:
@@ -703,7 +753,9 @@ class ErasureSet:
         # (native/dataplane.cpp dp_get_span); any failure falls back to the
         # reconstructing windowed path below for the remaining plan.
         if plan and _native_plane_enabled() and all(
-            i in sources and not sources[i][1].inline_data for i in range(d)
+            i in sources and not sources[i][1].inline_data
+            and not any(c.hash for c in sources[i][1].erasure.checksums)
+            for i in range(d)
         ):
             from .. import native
             from ..ops.highwayhash import MINIO_KEY
@@ -1157,8 +1209,25 @@ class ErasureSet:
         survivors_idx = sorted(good.keys())[:d]
         missing_idx = tuple(sorted(idx for idx, _ in stale))
 
+        heal_whole_cache: dict[tuple[int, int], bytes] = {}
+
         def read_block(part, idx, f_off, per):
             disk, m = good[idx]
+            wf = _whole_file_hash(m, part.number)
+            if wf is not None:  # legacy whole-file survivor
+                k = (idx, part.number)
+                if k not in heal_whole_cache:  # heal reads single-threaded
+                    raw = m.inline_data if m.inline_data else disk.read_file(
+                        bucket, f"{obj}/{fi.data_dir}/part.{part.number}", 0, -1
+                    )
+                    heal_whole_cache[k] = bitrot_io.verify_whole_file(
+                        bytes(raw), *wf
+                    )
+                block_i = f_off // (DIGEST + coder.shard_size)
+                blk = heal_whole_cache[k][block_i * coder.shard_size:][:per]
+                if len(blk) != per:
+                    raise errors.FileCorrupt("short whole-file shard")
+                return blk
             if m.inline_data:
                 buf = m.inline_data[f_off : f_off + DIGEST + per]
             else:
@@ -1167,6 +1236,11 @@ class ErasureSet:
                     f_off, DIGEST + per,
                 )
             return bitrot_io.verify_block(buf, per)
+
+        # healed shards keep the OBJECT's format: streaming objects get
+        # digest||block frames, legacy whole-file objects raw bytes plus a
+        # fresh metadata digest (the reference heals legacy in kind too)
+        whole = any(c.hash for c in fi.erasure.checksums)
 
         for part in fi.parts:
             geometry = coder.shard_sizes_for(part.size)
@@ -1181,6 +1255,7 @@ class ErasureSet:
                 coder._jax is not None
                 and full_n >= 4
                 and not fi.inline_data
+                and not whole  # device path emits streaming frames only
                 and _os.environ.get("MINIO_TPU_DEVICE_HEAL", "0") == "1"
             )
             batched_done = 0
@@ -1226,7 +1301,8 @@ class ErasureSet:
                 rec = coder.reconstruct_block(got, per)
                 for idx, _ in stale:
                     blk = rec[idx].tobytes()
-                    rebuilt[idx] += fast_hash256(blk)
+                    if not whole:
+                        rebuilt[idx] += fast_hash256(blk)
                     rebuilt[idx] += blk
             per_part_rebuilt[part.number] = rebuilt
         if lock is not None and lock.lost:
@@ -1237,6 +1313,23 @@ class ErasureSet:
             dfi = FileInfo.from_dict(fi.to_dict())
             dfi.volume, dfi.name = bucket, obj
             dfi.erasure.index = shard_idx + 1
+            if whole:
+                # this drive's metadata must carry ITS shard's digests, not
+                # the survivor's (checksums are per-drive in this format);
+                # keep the object's stored algorithm (legacy may be sha256)
+                from ..ops.bitrot import algorithm_from_string
+
+                algo_str = next(
+                    (c.algorithm for c in fi.erasure.checksums if c.hash),
+                    DEFAULT_BITROT_ALGO.string,
+                )
+                dfi.erasure.checksums = [
+                    ChecksumInfo(p.number, algo_str,
+                                 bitrot_io.whole_file_digest(
+                                     bytes(per_part_rebuilt[p.number][shard_idx]),
+                                     algorithm_from_string(algo_str)))
+                    for p in fi.parts
+                ]
             try:
                 if fi.inline_data is not None or not fi.data_dir:
                     dfi.inline_data = bytes(per_part_rebuilt[fi.parts[0].number][shard_idx])

@@ -483,6 +483,29 @@ class XLStorage(StorageAPI):
             part_path = os.path.join(
                 self._file_path(volume, path), fi.data_dir, f"part.{part.number}"
             )
+            wh = next(
+                (c for c in fi.erasure.checksums
+                 if c.part_number == part.number and c.hash), None,
+            )
+            if wh is not None:
+                # legacy whole-file bitrot: raw shard on disk, digest in
+                # the metadata (/root/reference/cmd/bitrot-whole.go), hashed
+                # with the STORED algorithm (legacy may be sha256/blake2b)
+                from ..erasure.bitrot_io import verify_whole_file
+                from ..ops.bitrot import algorithm_from_string
+
+                expect = fi.erasure.shard_file_size(part.size)
+                try:
+                    with open(part_path, "rb") as f:
+                        data = f.read()
+                except FileNotFoundError:
+                    raise errors.FileNotFound(part_path) from None
+                if len(data) != expect:
+                    raise errors.FileCorrupt(
+                        f"whole-file shard size {len(data)} != {expect}"
+                    )
+                verify_whole_file(data, wh.hash, algorithm_from_string(wh.algorithm))
+                continue
             bitrot_verify_file(
                 part_path,
                 fi.erasure.shard_file_size(part.size),

@@ -1,0 +1,154 @@
+"""Legacy whole-file bitrot format: raw shard files + one metadata digest
+per part (reference cmd/bitrot-whole.go). We never WRITE this format for
+new objects (neither does the reference); imported legacy data must be
+readable, verifiable, and healable in kind."""
+
+import os
+
+os.environ.setdefault("MINIO_TPU_BACKEND", "numpy")
+
+import numpy as np
+import pytest
+
+from minio_tpu.erasure import bitrot_io
+from minio_tpu.erasure.set import ErasureSet
+from minio_tpu.ops.bitrot import DEFAULT_BITROT_ALGO
+from minio_tpu.storage import errors
+from minio_tpu.storage.datatypes import ChecksumInfo
+from minio_tpu.storage.xlstorage import XLStorage
+
+RNG = np.random.default_rng(23)
+
+
+@pytest.fixture
+def es(tmp_path):
+    disks = [XLStorage(str(tmp_path / f"d{i}")) for i in range(4)]
+    s = ErasureSet(disks)  # EC 2+2
+    s.make_bucket("bkt")
+    return s
+
+
+def _to_whole_file(es: ErasureSet, bucket: str, obj: str,
+                   algo=DEFAULT_BITROT_ALGO) -> None:
+    """Convert a streaming-format object on all drives to the legacy
+    whole-file layout: strip the interleaved digests from each shard file
+    and stamp the whole-shard digest into that drive's metadata — exactly
+    what imported legacy data looks like on disk."""
+    for disk in es.disks:
+        try:
+            fi = disk.read_version(bucket, obj)
+        except Exception:  # noqa: BLE001 — drive without this version
+            continue
+        assert fi.inline_data is None, "fabricator expects on-disk shards"
+        shard_size = fi.erasure.shard_size()
+        checksums = []
+        for part in fi.parts:
+            rel = f"{obj}/{fi.data_dir}/part.{part.number}"
+            framed = disk.read_file(bucket, rel, 0, -1)
+            raw = bytearray()
+            off = 0
+            left = fi.erasure.shard_file_size(part.size)
+            while left > 0:
+                n = min(shard_size, left)
+                raw += framed[off + bitrot_io.DIGEST_SIZE: off + bitrot_io.DIGEST_SIZE + n]
+                off += bitrot_io.DIGEST_SIZE + n
+                left -= n
+            disk.delete(bucket, rel)
+            disk.create_file(bucket, rel, bytes(raw))
+            checksums.append(
+                ChecksumInfo(part.number, algo.string,
+                             bitrot_io.whole_file_digest(bytes(raw), algo))
+            )
+        fi.erasure.checksums = checksums
+        disk.write_metadata(bucket, obj, fi)
+
+
+def _mk_whole(es, name, size):
+    data = RNG.integers(0, 256, size=size, dtype=np.uint8).tobytes()
+    es.put_object("bkt", name, data)
+    _to_whole_file(es, "bkt", name)
+    return data
+
+
+def test_whole_file_get_roundtrip(es):
+    # multi-block so the per-block projection out of the raw shard matters
+    data = _mk_whole(es, "legacy", 3 * 1024 * 1024 + 917)
+    oi, it = es.get_object("bkt", "legacy")
+    assert b"".join(it) == data
+    assert oi.size == len(data)
+
+
+def test_whole_file_sha256_algorithm_honored(es):
+    """Legacy shards hashed with sha256 (the stored algorithm string) must
+    verify with sha256, not the default highwayhash."""
+    from minio_tpu.ops.bitrot import BitrotAlgorithm
+
+    data = RNG.integers(0, 256, size=900_000, dtype=np.uint8).tobytes()
+    es.put_object("bkt", "legacy-sha", data)
+    _to_whole_file(es, "bkt", "legacy-sha", algo=BitrotAlgorithm.SHA256)
+    _, it = es.get_object("bkt", "legacy-sha")
+    assert b"".join(it) == data
+    fi = es.disks[0].read_version("bkt", "legacy-sha")
+    es.disks[0].verify_file("bkt", "legacy-sha", fi)  # no raise
+
+
+def test_whole_file_ranged_reads(es):
+    data = _mk_whole(es, "legacy-r", 2 * 1024 * 1024 + 41)
+    for off, ln in [(0, 10), (1024 * 1024 - 3, 7), (len(data) - 5, 5),
+                    (512 * 1024, 1024 * 1024)]:
+        _, it = es.get_object("bkt", "legacy-r", offset=off, length=ln)
+        assert b"".join(it) == data[off:off + ln], (off, ln)
+
+
+def test_whole_file_bitrot_detected_and_tolerated(es, tmp_path):
+    """A flipped byte in a raw legacy shard fails that shard's whole-file
+    digest; the read succeeds via reconstruction from the others."""
+    data = _mk_whole(es, "legacy-c", 1024 * 1024 + 5)
+    # corrupt one data shard file in place
+    vdir = tmp_path / "d0" / "bkt" / "legacy-c"
+    part = next(vdir.glob("*/part.1"))
+    blob = bytearray(part.read_bytes())
+    blob[100] ^= 0xFF
+    part.write_bytes(bytes(blob))
+    _, it = es.get_object("bkt", "legacy-c")
+    assert b"".join(it) == data  # reconstructed around the bad shard
+
+
+def test_whole_file_verify_file(es, tmp_path):
+    _mk_whole(es, "legacy-v", 700_000)
+    fi = es.disks[1].read_version("bkt", "legacy-v")
+    es.disks[1].verify_file("bkt", "legacy-v", fi)  # clean: no raise
+    vdir = tmp_path / "d1" / "bkt" / "legacy-v"
+    part = next(vdir.glob("*/part.1"))
+    blob = bytearray(part.read_bytes())
+    blob[-1] ^= 0x01
+    part.write_bytes(bytes(blob))
+    with pytest.raises(errors.FileCorrupt):
+        es.disks[1].verify_file("bkt", "legacy-v", fi)
+
+
+def test_whole_file_heal_preserves_format(es, tmp_path):
+    """Healing a lost drive of a legacy object writes the healed shard in
+    the SAME whole-file layout with a fresh per-drive metadata digest."""
+    import shutil
+
+    data = _mk_whole(es, "legacy-h", 2 * 1024 * 1024 + 99)
+    shutil.rmtree(tmp_path / "d2" / "bkt" / "legacy-h")
+    res = es.heal_object("bkt", "legacy-h")
+    assert res["healed"], res
+    # the healed drive holds a RAW shard (no interleaved digests): its
+    # file size equals the data-only shard size
+    fi = es.disks[2].read_version("bkt", "legacy-h")
+    expect = fi.erasure.shard_file_size(fi.parts[0].size)
+    part = next((tmp_path / "d2" / "bkt" / "legacy-h").glob("*/part.1"))
+    assert part.stat().st_size == expect
+    # and its metadata digest verifies
+    es.disks[2].verify_file("bkt", "legacy-h", fi)
+    # full read still exact with the healed shard in rotation
+    _, it = es.get_object("bkt", "legacy-h")
+    assert b"".join(it) == data
+    # streaming objects are untouched by the whole-file branches
+    sdata = RNG.integers(0, 256, size=600_000, dtype=np.uint8).tobytes()
+    es.put_object("bkt", "modern", sdata)
+    _, it = es.get_object("bkt", "modern")
+    assert b"".join(it) == sdata
