@@ -66,6 +66,15 @@ DEFAULT_WINDOW = 32  # stream messages in flight before the sender blocks
 SEND_TIMEOUT = 30.0  # socket write timeout: a wedged peer errors, not hangs
 _HDR = struct.Struct("<BI")
 
+# process-wide internode transport counters (metrics v3
+# /system/network/internode — reference minio_system_network_internode_*);
+# plain int += under the GIL: approximate-but-cheap, like the reference's
+# atomic adds
+STATS = {
+    "dials": 0, "dial_errors": 0, "disconnects": 0,
+    "tx_bytes": 0, "rx_bytes": 0, "calls": 0, "streams": 0,
+}
+
 
 class GridError(Exception):
     """Transport-level failure (disconnected, timeout, handshake)."""
@@ -516,12 +525,14 @@ class GridClient:
                         f"grid {self.host}:{self.port}: recent connect failure"
                     )
             try:
+                STATS["dials"] += 1
                 ws = _WSock(
                     self.host, self.port, GRID_ROUTE,
                     {"x-minio-token": self.token,
                      "x-minio-grid-plane": self.plane},
                 )
             except (OSError, GridError) as e:
+                STATS["dial_errors"] += 1
                 with self._lock:
                     self._connect_fail_until = time.monotonic() + 1.0
                 raise GridConnectError(str(e)) from None
@@ -550,10 +561,14 @@ class GridClient:
             self._ws = None
             calls, self._calls = self._calls, {}
             streams, self._streams = self._streams, {}
+        STATS["disconnects"] += 1
         err = GridError(f"grid {self.host}:{self.port} disconnected")
         for q in calls.values():
             q.put(err)
         for st in streams.values():
+            # _err makes the NEXT send() fail fast too: the server lost the
+            # mux, so further sends would vanish silently after reconnect
+            st._err = err
             st._inbox.put(err)
         ws.close()
 
@@ -571,6 +586,7 @@ class GridClient:
             # the (possibly slow) socket write, so a stalled send to a
             # wedged peer cannot block unrelated state transitions
             ws.send_binary(data)
+            STATS["tx_bytes"] += len(data)
         except OSError as e:
             self._drop(ws)
             raise GridError(f"grid send failed: {e}") from None
@@ -581,6 +597,7 @@ class GridClient:
                 msg = ws.recv_message()
                 if msg is None:
                     break
+                STATS["rx_bytes"] += len(msg)
                 ftype, mux = _HDR.unpack_from(msg)
                 payload = msg[_HDR.size:]
                 if ftype == T_RESP:
@@ -643,6 +660,7 @@ class GridClient:
         """Single-payload request/response. Raises RemoteError (typed) or
         GridError (transport). retry=True re-sends once after reconnect —
         callers must only set it for idempotent ops."""
+        STATS["calls"] += 1
         attempts = 2 if retry else 1
         last: Exception = GridError("unreachable")
         for _ in range(attempts):
@@ -675,6 +693,7 @@ class GridClient:
 
     def stream(self, handler: str, payload: bytes,
                window: int = DEFAULT_WINDOW) -> ClientStream:
+        STATS["streams"] += 1
         mux = self._next_mux()
         st = ClientStream(self, mux, window)
         with self._lock:

@@ -132,6 +132,9 @@ class TierRegistry:
 
 JOURNAL_KEY = "config/tier-journal.json"
 _journal_mu = threading.Lock()
+# cached entry count so metrics scrapes don't pay a store read; updated by
+# every journal mutation, primed lazily on first read
+_journal_count: int | None = None
 
 
 def _journal_load(store) -> list[dict]:
@@ -150,10 +153,22 @@ def _journal_save(store, entries: list[dict]) -> None:
 
 def journal_add(store, tier_name: str, remote_key: str) -> None:
     """Persist a failed sweep for retry (the reference's tierJournal)."""
+    global _journal_count
     with _journal_mu:
         entries = _journal_load(store)
         entries.append({"tier": tier_name, "key": remote_key})
         _journal_save(store, entries)
+        _journal_count = len(entries)
+
+
+def journal_size(store) -> int:
+    """Entry count for metrics: cached (mutations refresh it), with one
+    store read to prime a fresh process."""
+    global _journal_count
+    with _journal_mu:
+        if _journal_count is None:
+            _journal_count = len(_journal_load(store))
+        return _journal_count
 
 
 def retry_journal(tiers: "TierRegistry") -> int:
@@ -179,11 +194,13 @@ def retry_journal(tiers: "TierRegistry") -> int:
             resolved.append(e)
         except Exception:  # noqa: BLE001 — keep for the next cycle
             pass
+    global _journal_count
     with _journal_mu:
         # re-read: new failures may have been journaled while we swept
         current = _journal_load(tiers.store)
         left = [e for e in current if e not in resolved]
         _journal_save(tiers.store, left)
+        _journal_count = len(left)
         return len(left)
 
 

@@ -453,6 +453,7 @@ class S3Server:
         from .metrics import classify_api, trace_record
 
         t0 = _time.perf_counter()
+        request["_t0"] = t0  # TTFB measured at response prepare time
         resp: web.StreamResponse | None = None
         self.metrics.inflight += 1  # single-threaded event loop: no race
         try:
@@ -479,6 +480,7 @@ class S3Server:
             self.metrics.observe(
                 api, status, dur, rx, tx,
                 bucket=request.match_info.get("bucket", ""),
+                ttfb=request.get("_ttfb"),
             )
             if self.trace.active:
                 self.trace.publish(trace_record(request, status, dur, rx, tx))
@@ -544,6 +546,12 @@ class S3Server:
         return corsmod.evaluate(origin, method, req_headers, rules, global_origins)
 
     async def _cors_on_prepare(self, request: web.Request, response) -> None:
+        import time as _time
+
+        t0 = request.get("_t0")
+        if t0 is not None and "_ttfb" not in request:
+            # first byte leaves here for both buffered and streamed bodies
+            request["_ttfb"] = _time.perf_counter() - t0
         origin = request.headers.get("Origin", "")
         if not origin or request.method == "OPTIONS":
             return

@@ -19,6 +19,22 @@ from collections import defaultdict
 MAX_BUCKET_SERIES = 1000  # bound per-bucket label cardinality
 
 
+# TTFB distribution buckets, matching the reference's
+# minio_api_requests_ttfb_seconds_distribution edges (cmd/metrics-v3-api.go)
+TTFB_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+def ttfb_distribution_rows(hist: dict[str, list[int]]):
+    """Cumulative (api, le, count) rows — single source for the v2 and v3
+    expositions so the bucket edges and le formatting cannot diverge."""
+    for api, h in sorted(hist.items()):
+        cum = 0
+        for i, edge in enumerate(TTFB_BUCKETS):
+            cum += h[i]
+            yield api, str(edge), cum
+        yield api, "+Inf", cum + h[-1]
+
+
 class Metrics:
     def __init__(self):
         self._mu = threading.Lock()
@@ -26,29 +42,50 @@ class Metrics:
         self.errors_total: dict[str, int] = defaultdict(int)  # by api
         self.errors_4xx: int = 0
         self.errors_5xx: int = 0
+        self.rejected_auth: int = 0  # 401/403: failed authentication/authz
+        self.rejected_invalid: int = 0  # 400: malformed requests
         self.rx_bytes = 0
         self.tx_bytes = 0
         self.request_seconds: dict[str, float] = defaultdict(float)
+        # TTFB kept separate from full-request duration: a streamed 10s
+        # GET with 20ms TTFB must not skew the TTFB sum
+        self.ttfb_seconds: dict[str, float] = defaultdict(float)
+        self.ttfb_hist: dict[str, list[int]] = {}  # api -> bucket counts+[+Inf]
         self.inflight = 0
         # per-bucket: bucket -> api -> [requests, errors, rx, tx]
         self.bucket_api: dict[str, dict[str, list]] = {}
 
     def observe(
         self, api: str, status: int, dur: float, rx: int, tx: int,
-        bucket: str = "",
+        bucket: str = "", ttfb: float | None = None,
     ) -> None:
         with self._mu:
             self.requests_total[api] += 1
             self.request_seconds[api] += dur
             self.rx_bytes += rx
             self.tx_bytes += tx
+            h = self.ttfb_hist.get(api)
+            if h is None:
+                h = self.ttfb_hist[api] = [0] * (len(TTFB_BUCKETS) + 1)
+            t = dur if ttfb is None else ttfb
+            self.ttfb_seconds[api] += t
+            for i, edge in enumerate(TTFB_BUCKETS):
+                if t <= edge:
+                    h[i] += 1
+                    break
+            else:
+                h[-1] += 1
             err = status >= 400
+            if status in (401, 403):
+                self.rejected_auth += 1
+            elif status == 400:
+                self.rejected_invalid += 1
             if status >= 500:
                 self.errors_5xx += 1
                 self.errors_total[api] += 1
             elif err:
-                self.errors_4xx += 1
                 self.errors_total[api] += 1
+                self.errors_4xx += 1
             # series creation rules: never for the /minio/* pseudo-bucket
             # or system paths, and never for a FAILED request on an
             # untracked name — otherwise an unauthenticated scanner
@@ -95,10 +132,21 @@ class Metrics:
                 f"minio_s3_traffic_received_bytes {self.rx_bytes}",
                 "# TYPE minio_s3_traffic_sent_bytes counter",
                 f"minio_s3_traffic_sent_bytes {self.tx_bytes}",
+                "# TYPE minio_s3_requests_rejected_auth_total counter",
+                f"minio_s3_requests_rejected_auth_total {self.rejected_auth}",
+                "# TYPE minio_s3_requests_rejected_invalid_total counter",
+                f"minio_s3_requests_rejected_invalid_total {self.rejected_invalid}",
+                "# TYPE minio_s3_requests_inflight_total gauge",
+                f"minio_s3_requests_inflight_total {self.inflight}",
                 "# TYPE minio_s3_request_seconds_total counter",
             ]
             for api, s in sorted(self.request_seconds.items()):
                 lines.append(f'minio_s3_request_seconds_total{{api="{api}"}} {s:.6f}')
+            lines.append("# TYPE minio_s3_ttfb_seconds_distribution counter")
+            for api, le, cum in ttfb_distribution_rows(self.ttfb_hist):
+                lines.append(
+                    f'minio_s3_ttfb_seconds_distribution{{api="{api}",le="{le}"}} {cum}'
+                )
         # storage series
         store = server.store
         if store is not None:
@@ -256,8 +304,17 @@ def _g_api_requests(server) -> list[str]:
         _fmt(out, "minio_api_requests_incoming_bytes_total", "counter", [({}, m.rx_bytes)])
         _fmt(out, "minio_api_requests_outgoing_bytes_total", "counter", [({}, m.tx_bytes)])
         _fmt(out, "minio_api_requests_ttfb_seconds_total", "counter",
+             [({"name": a}, f"{s:.6f}") for a, s in sorted(m.ttfb_seconds.items())])
+        _fmt(out, "minio_api_requests_duration_seconds_total", "counter",
              [({"name": a}, f"{s:.6f}") for a, s in sorted(m.request_seconds.items())])
         _fmt(out, "minio_api_requests_inflight_total", "gauge", [({}, m.inflight)])
+        _fmt(out, "minio_api_requests_rejected_auth_total", "counter",
+             [({}, m.rejected_auth)])
+        _fmt(out, "minio_api_requests_rejected_invalid_total", "counter",
+             [({}, m.rejected_invalid)])
+        _fmt(out, "minio_api_requests_ttfb_seconds_distribution", "counter",
+             [({"name": a, "le": le}, cum)
+              for a, le, cum in ttfb_distribution_rows(m.ttfb_hist)])
     return out
 
 
@@ -515,12 +572,47 @@ def _bg_stat(server, key: str) -> int:
     return bg.stats.get(key, 0) if bg is not None else 0
 
 
+def _g_system_network(server) -> list[str]:
+    """Internode (grid + storage REST) transport counters — the analogue
+    of the reference's minio_system_network_internode_* group."""
+    from ..cluster import grid as gridmod
+
+    out: list[str] = []
+    st = dict(gridmod.STATS)
+    _fmt(out, "minio_system_network_internode_dials_total", "counter",
+         [({}, st["dials"])], "Grid connections dialed")
+    _fmt(out, "minio_system_network_internode_dial_errors_total", "counter",
+         [({}, st["dial_errors"])])
+    _fmt(out, "minio_system_network_internode_disconnects_total", "counter",
+         [({}, st["disconnects"])])
+    _fmt(out, "minio_system_network_internode_sent_bytes_total", "counter",
+         [({}, st["tx_bytes"])])
+    _fmt(out, "minio_system_network_internode_recv_bytes_total", "counter",
+         [({}, st["rx_bytes"])])
+    _fmt(out, "minio_system_network_internode_calls_total", "counter",
+         [({}, st["calls"])])
+    _fmt(out, "minio_system_network_internode_streams_total", "counter",
+         [({}, st["streams"])])
+    return out
+
+
 def _g_ilm(server) -> list[str]:
     out: list[str] = []
     _fmt(out, "minio_ilm_expired_objects_total", "counter",
          [({}, _bg_stat(server, "ilm_expired"))])
     _fmt(out, "minio_ilm_transitioned_objects_total", "counter",
          [({}, _bg_stat(server, "ilm_transitioned"))])
+    _fmt(out, "minio_ilm_restores_expired_total", "counter",
+         [({}, _bg_stat(server, "ilm_restore_expired"))])
+    # orphaned warm-tier sweeps awaiting retry (reference tier journal);
+    # cached count — scrapes must not pay a store read each
+    try:
+        from ..ilm import tier as tiermod
+
+        entries = tiermod.journal_size(server.store)
+    except Exception:  # noqa: BLE001 — scrape must not fail on store errors
+        entries = 0
+    _fmt(out, "minio_ilm_tier_journal_entries", "gauge", [({}, entries)])
     return out
 
 
@@ -571,6 +663,7 @@ def _g_audit(server) -> list[str]:
 # collector path -> renderer; bucket paths live in V3_BUCKET_GROUPS
 V3_GROUPS = {
     "/api/requests": _g_api_requests,
+    "/system/network/internode": _g_system_network,
     "/system/drive": _g_system_drive,
     "/system/memory": _g_system_memory,
     "/system/cpu": _g_system_cpu,

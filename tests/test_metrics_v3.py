@@ -54,9 +54,47 @@ def test_v3_all_groups(cli):
         "minio_notify_events_sent_total",
         "minio_audit_total_messages",
         "minio_ilm_expired_objects_total",
+        "minio_ilm_tier_journal_entries",
         "minio_debug_python_threads",
+        "minio_system_network_internode_dials_total",
+        "minio_api_requests_rejected_auth_total",
     ):
         assert series in text, series
+
+
+def test_v3_ttfb_distribution(cli):
+    text = _get(cli, "/api/requests").body.decode()
+    # cumulative histogram with the reference's bucket edges, per API
+    assert 'minio_api_requests_ttfb_seconds_distribution{name="GetObject",le="0.05"}' in text
+    assert 'minio_api_requests_ttfb_seconds_distribution{name="GetObject",le="+Inf"}' in text
+    # cumulative: +Inf count >= first bucket count
+    import re
+
+    first = int(re.search(
+        r'ttfb_seconds_distribution\{name="GetObject",le="0.05"\} (\d+)', text).group(1))
+    inf = int(re.search(
+        r'ttfb_seconds_distribution\{name="GetObject",le="\+Inf"\} (\d+)', text).group(1))
+    assert inf >= first >= 0 and inf >= 1
+
+
+def test_v3_rejected_auth_counted(cli):
+    import urllib.request
+
+    base = f"http://{cli.host}:{cli.port}"
+    before = _get(cli, "/api/requests").body.decode()
+    # unsigned request to a real API -> 403 -> rejected_auth
+    try:
+        urllib.request.urlopen(f"{base}/metbkt/obj")
+    except Exception:  # noqa: BLE001 — 403 expected
+        pass
+    after = _get(cli, "/api/requests").body.decode()
+    import re
+
+    def val(t):
+        m = re.search(r"minio_api_requests_rejected_auth_total (\d+)", t)
+        return int(m.group(1))
+
+    assert val(after) >= val(before) + 1
 
 
 def test_v3_path_filtering(cli):
