@@ -189,6 +189,7 @@ class GridServer:
         async def send_frame(data: bytes) -> None:
             async with send_lock:
                 await ws.send_bytes(data)
+            STATS["tx_bytes"] += len(data)
 
         streams: dict[int, ServerStream] = {}
         stream_tasks: dict[int, asyncio.Task] = {}
@@ -198,15 +199,18 @@ class GridServer:
                 if msg.type != web.WSMsgType.BINARY:
                     continue
                 data = msg.data
+                STATS["rx_bytes"] += len(data)
                 ftype, mux = _HDR.unpack_from(data)
                 payload = data[_HDR.size:]
                 if ftype == T_PING:
                     await send_frame(_frame(T_PONG, mux))
                 elif ftype == T_REQ:
+                    STATS["calls"] += 1
                     t = asyncio.create_task(self._run_single(send_frame, mux, payload))
                     tasks.add(t)
                     t.add_done_callback(tasks.discard)
                 elif ftype == T_STR_OPEN:
+                    STATS["streams"] += 1
                     handler, req, window = msgpack.unpackb(payload, raw=False)
                     fn = self._stream.get(handler)
                     if fn is None:

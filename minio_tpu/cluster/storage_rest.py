@@ -317,6 +317,13 @@ class StorageRESTClient(StorageAPI):
                 )
                 resp = conn.getresponse()
                 data = resp.read()
+                # internode accounting covers the HTTP plane too (bulk
+                # shard bodies + grid fallback), not just the mux
+                from .grid import STATS
+
+                STATS["calls"] += 1
+                STATS["tx_bytes"] += len(body)
+                STATS["rx_bytes"] += len(data)
                 break
             except (http.client.HTTPException, OSError):
                 self._local.conn = None

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 import uuid
 from dataclasses import dataclass
 
@@ -132,9 +133,13 @@ class TierRegistry:
 
 JOURNAL_KEY = "config/tier-journal.json"
 _journal_mu = threading.Lock()
-# cached entry count so metrics scrapes don't pay a store read; updated by
-# every journal mutation, primed lazily on first read
+# cached entry count so metrics scrapes don't pay a store read per scrape;
+# local mutations refresh it immediately, and a TTL re-reads the shared
+# journal so OTHER nodes' additions surface too (the journal object is
+# cluster-shared, the cache is per-process)
 _journal_count: int | None = None
+_journal_count_ts = 0.0
+JOURNAL_CACHE_TTL = 60.0
 
 
 def _journal_load(store) -> list[dict]:
@@ -153,21 +158,25 @@ def _journal_save(store, entries: list[dict]) -> None:
 
 def journal_add(store, tier_name: str, remote_key: str) -> None:
     """Persist a failed sweep for retry (the reference's tierJournal)."""
-    global _journal_count
+    global _journal_count, _journal_count_ts
     with _journal_mu:
         entries = _journal_load(store)
         entries.append({"tier": tier_name, "key": remote_key})
         _journal_save(store, entries)
         _journal_count = len(entries)
+        _journal_count_ts = time.monotonic()
 
 
 def journal_size(store) -> int:
-    """Entry count for metrics: cached (mutations refresh it), with one
-    store read to prime a fresh process."""
-    global _journal_count
+    """Entry count for metrics: cached with a TTL — local mutations
+    refresh it instantly, and the periodic re-read picks up entries other
+    nodes journaled into the shared object."""
+    global _journal_count, _journal_count_ts
     with _journal_mu:
-        if _journal_count is None:
+        now = time.monotonic()
+        if _journal_count is None or now - _journal_count_ts > JOURNAL_CACHE_TTL:
             _journal_count = len(_journal_load(store))
+            _journal_count_ts = now
         return _journal_count
 
 
@@ -194,13 +203,14 @@ def retry_journal(tiers: "TierRegistry") -> int:
             resolved.append(e)
         except Exception:  # noqa: BLE001 — keep for the next cycle
             pass
-    global _journal_count
+    global _journal_count, _journal_count_ts
     with _journal_mu:
         # re-read: new failures may have been journaled while we swept
         current = _journal_load(tiers.store)
         left = [e for e in current if e not in resolved]
         _journal_save(tiers.store, left)
         _journal_count = len(left)
+        _journal_count_ts = time.monotonic()
         return len(left)
 
 

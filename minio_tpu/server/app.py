@@ -312,6 +312,7 @@ class S3Server:
         # CORS decoration rides the prepare signal: it must run before
         # headers hit the wire, which for streamed GETs happens INSIDE the
         # handler — a post-dispatch wrapper would be too late
+        self.app.on_response_prepare.append(self._ttfb_on_prepare)
         self.app.on_response_prepare.append(self._cors_on_prepare)
         self.app.router.add_route("*", "/", self._entry)
         self.app.router.add_route("*", "/{bucket}", self._entry)
@@ -545,13 +546,16 @@ class S3Server:
         ] if self.config is not None else ["*"]
         return corsmod.evaluate(origin, method, req_headers, rules, global_origins)
 
-    async def _cors_on_prepare(self, request: web.Request, response) -> None:
+    async def _ttfb_on_prepare(self, request: web.Request, response) -> None:
+        """Metrics TTFB capture: first byte leaves at response-prepare time
+        for both buffered and streamed bodies."""
         import time as _time
 
         t0 = request.get("_t0")
         if t0 is not None and "_ttfb" not in request:
-            # first byte leaves here for both buffered and streamed bodies
             request["_ttfb"] = _time.perf_counter() - t0
+
+    async def _cors_on_prepare(self, request: web.Request, response) -> None:
         origin = request.headers.get("Origin", "")
         if not origin or request.method == "OPTIONS":
             return
@@ -591,6 +595,23 @@ class S3Server:
         bucket = request.match_info.get("bucket", "")
         key = request.match_info.get("key", "")
         if bucket == "minio":
+            if request.method == "GET" and key == "console/api/users":
+                # console backend API (the reference console ships its own
+                # REST layer too): same authz as madmin ListUsers, but plain
+                # JSON — the browser cannot speak the argon2id-encrypted
+                # madmin framing. No secrets travel: status/policies/groups.
+                try:
+                    ak, _ = await self._authenticate(request)
+                except s3err.APIError as e:
+                    return self._err_response(request, e)
+                if not ak or not self.iam.is_allowed(ak, "admin:ListUsers", ""):
+                    return self._err_response(request, s3err.AccessDenied)
+                users = await self._run(self.iam.list_users)
+                return web.json_response({
+                    k: {"status": u.status, "policyName": ",".join(u.policies),
+                        "memberOf": u.groups}
+                    for k, u in users.items()
+                })
             if request.method in ("GET", "HEAD") and (
                 key == "console" or key.startswith("console/")
             ):

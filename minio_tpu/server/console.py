@@ -70,7 +70,7 @@ function uriEnc(s, slash){
   return encodeURIComponent(s).replace(/[!'()*]/g, c=>"%"+c.charCodeAt(0).toString(16).toUpperCase())
     .replace(slash?/%2F/g:/$^/g, "/");
 }
-async function signedFetch(method, path, query, body){
+async function signedFetch(method, path, query, body, signal){
   const amzdate = new Date().toISOString().replace(/[-:]/g,"").replace(/\..*/,"")+"Z";
   const scopeDate = amzdate.slice(0,8);
   const host = location.host;
@@ -93,7 +93,7 @@ async function signedFetch(method, path, query, body){
   const sendHeaders = {"Authorization": auth, "x-amz-content-sha256": payloadHash, "x-amz-date": amzdate};
   if (S.token) sendHeaders["x-amz-security-token"] = S.token;
   return fetch(canonPath + (canonQ?`?${canonQ}`:""), {
-    method, body: body===undefined?null:body, headers: sendHeaders,
+    method, body: body===undefined?null:body, headers: sendHeaders, signal,
   });
 }
 function xml(t){ return new DOMParser().parseFromString(t, "text/xml"); }
@@ -136,7 +136,7 @@ function shell(tab, content){
   document.getElementById("who").textContent = S.ak;
   document.getElementById("logout").style.display = "";
   app.innerHTML = `<div class="tabs">
-    ${["buckets","info","metrics"].map(t=>
+    ${["buckets","iam","watch","diagnostics","info","metrics"].map(t=>
       `<button class="${t===tab?"active":""}" data-tab="${t}">${t}</button>`).join("")}
     </div><div id="content">${content}</div>`;
   app.querySelectorAll(".tabs button").forEach(b=>
@@ -144,7 +144,11 @@ function shell(tab, content){
 }
 
 async function mainView(tab){
+  if (watchAbort){ watchAbort.abort(); watchAbort = null; }
   if (tab==="buckets") return bucketsView();
+  if (tab==="iam") return iamView();
+  if (tab==="watch") return watchView();
+  if (tab==="diagnostics") return diagView();
   if (tab==="info") return infoView();
   if (tab==="metrics") return metricsView();
 }
@@ -265,6 +269,163 @@ async function metricsView(){
   const text = r.status===200 ? await r.text()
                               : `HTTP ${r.status} (admin:Prometheus needed)`;
   shell("metrics", `<div class="panel"><h3>metrics snapshot (v3)</h3><pre>${esc(text)}</pre></div>`);
+}
+
+// ---- IAM management (users + policies) ----
+async function iamView(){
+  const [ur, pr] = await Promise.all([
+    signedFetch("GET", "/minio/console/api/users", {}),
+    signedFetch("GET", "/minio/admin/v3/list-canned-policies", {})]);
+  if (authFailed(ur)) return;
+  const users = ur.status===200 ? await ur.json() : null;
+  const pols  = pr.status===200 ? await pr.json() : {};
+  const polNames = Object.keys(pols).sort();
+  const userRows = users===null
+    ? `<tr><td colspan="5" class="err">listing users needs admin:ListUsers (HTTP ${ur.status})</td></tr>`
+    : Object.entries(users).sort().map(([ak,u])=>`<tr>
+        <td>${esc(ak)}</td><td class="${u.status==="enabled"?"ok":"err"}">${esc(u.status)}</td>
+        <td>${esc(u.policyName||"")}</td><td>${esc((u.memberOf||[]).join(", "))}</td>
+        <td style="text-align:right">
+          <select data-attachsel="${esc(ak)}">${polNames.map(p=>`<option>${esc(p)}</option>`).join("")}</select>
+          <button class="alt" data-attach="${esc(ak)}">attach</button>
+          <button class="ghost" data-toggle="${esc(ak)}" data-st="${esc(u.status)}">${u.status==="enabled"?"disable":"enable"}</button>
+          <button class="ghost" data-deluser="${esc(ak)}">delete</button></td></tr>`).join("");
+  shell("iam", `<div class="panel"><h3>users</h3>
+      <div class="row"><input id="nak" placeholder="access key">
+        <input id="nsk" placeholder="secret key" type="password">
+        <button id="adduser">add user</button></div>
+      <table><tr><th>access key</th><th>status</th><th>policies</th><th>groups</th><th></th></tr>
+      ${userRows}</table></div>
+    <div class="panel"><h3>policies</h3>
+      <div class="row"><input id="pname" placeholder="policy name">
+        <button id="addpol">create from JSON below</button></div>
+      <p><textarea id="pjson" rows="6" style="width:100%;font-family:monospace"
+        placeholder='{"Version":"2012-10-17","Statement":[{"Effect":"Allow","Action":["s3:*"],"Resource":["arn:aws:s3:::*"]}]}'></textarea></p>
+      <table><tr><th>name</th><th></th></tr>
+      ${polNames.map(p=>`<tr><td><a data-viewpol="${esc(p)}">${esc(p)}</a></td>
+        <td style="text-align:right"><button class="ghost" data-delpol="${esc(p)}">delete</button></td></tr>`).join("")}
+      </table><pre id="polview" style="display:none"></pre></div>`);
+  document.getElementById("adduser").onclick = async ()=>{
+    const ak = document.getElementById("nak").value.trim();
+    const sk = document.getElementById("nsk").value;
+    if (!ak || !sk) return;
+    const r = await signedFetch("PUT", "/minio/admin/v3/add-user", {accessKey:ak},
+      JSON.stringify({secretKey:sk, status:"enabled"}));
+    if (r.status!==200) alert("add user failed: "+await r.text()); else iamView();
+  };
+  document.getElementById("addpol").onclick = async ()=>{
+    const n = document.getElementById("pname").value.trim();
+    const j = document.getElementById("pjson").value;
+    if (!n || !j) return;
+    const r = await signedFetch("PUT", "/minio/admin/v3/add-canned-policy", {name:n}, j);
+    if (r.status!==200) alert("create policy failed: "+await r.text()); else iamView();
+  };
+  app.querySelectorAll("button[data-deluser]").forEach(b=> b.onclick = async ()=>{
+    if (!confirm(`delete user ${b.dataset.deluser}?`)) return;
+    await signedFetch("DELETE", "/minio/admin/v3/remove-user", {accessKey:b.dataset.deluser});
+    iamView();
+  });
+  app.querySelectorAll("button[data-toggle]").forEach(b=> b.onclick = async ()=>{
+    const to = b.dataset.st==="enabled" ? "disabled" : "enabled";
+    await signedFetch("PUT", "/minio/admin/v3/set-user-status",
+      {accessKey:b.dataset.toggle, status:to});
+    iamView();
+  });
+  app.querySelectorAll("button[data-attach]").forEach(b=> b.onclick = async ()=>{
+    const sel = app.querySelector(`select[data-attachsel="${CSS.escape(b.dataset.attach)}"]`);
+    const r = await signedFetch("PUT", "/minio/admin/v3/set-user-or-group-policy",
+      {policyName:sel.value, userOrGroup:b.dataset.attach, isGroup:"false"});
+    if (r.status!==200) alert("attach failed: "+await r.text()); else iamView();
+  });
+  app.querySelectorAll("a[data-viewpol]").forEach(a=> a.onclick = ()=>{
+    const pv = document.getElementById("polview");
+    pv.style.display = "";
+    pv.textContent = JSON.stringify(pols[a.dataset.viewpol], null, 2);
+  });
+  app.querySelectorAll("button[data-delpol]").forEach(b=> b.onclick = async ()=>{
+    if (!confirm(`delete policy ${b.dataset.delpol}?`)) return;
+    await signedFetch("DELETE", "/minio/admin/v3/remove-canned-policy", {name:b.dataset.delpol});
+    iamView();
+  });
+}
+
+// ---- live watch (bucket event firehose) ----
+let watchAbort = null;
+async function watchView(){
+  shell("watch", `<div class="panel"><div class="row">
+      <input id="wb" placeholder="bucket">
+      <input id="wp" placeholder="prefix (optional)">
+      <input id="ws" placeholder="suffix (optional)">
+      <select id="we"><option>s3:ObjectCreated:*,s3:ObjectRemoved:*</option>
+        <option>s3:ObjectCreated:*</option><option>s3:ObjectRemoved:*</option>
+        <option>s3:ObjectAccessed:*</option></select>
+      <button id="wstart">watch</button>
+      <button id="wstop" class="ghost" disabled>stop</button></div></div>
+    <div class="panel"><pre id="wlog" style="max-height:500px">waiting…</pre></div>`);
+  const log = document.getElementById("wlog");
+  const startB = document.getElementById("wstart"), stopB = document.getElementById("wstop");
+  stopB.onclick = ()=>{ if (watchAbort){ watchAbort.abort(); watchAbort=null; }
+    startB.disabled=false; stopB.disabled=true; };
+  startB.onclick = async ()=>{
+    const b = document.getElementById("wb").value.trim();
+    if (!b) return;
+    startB.disabled = true; stopB.disabled = false;
+    log.textContent = "";
+    watchAbort = new AbortController();
+    // sign the request, then re-issue it with the stream abortable
+    const q = {events: document.getElementById("we").value,
+               prefix: document.getElementById("wp").value,
+               suffix: document.getElementById("ws").value};
+    try {
+      const r = await signedFetch("GET", "/"+b, q, undefined, watchAbort.signal);
+      if (r.status!==200){ log.textContent = `listen failed: HTTP ${r.status}`; return; }
+      const reader = r.body.getReader();
+      const dec = new TextDecoder();
+      let buf = "";
+      for (;;){
+        const {done, value} = await reader.read();
+        if (done) break;
+        buf += dec.decode(value, {stream:true});
+        let i;
+        while ((i = buf.indexOf("\n")) >= 0){
+          const line = buf.slice(0, i).trim(); buf = buf.slice(i+1);
+          if (!line) continue;  // keep-alive
+          try {
+            const rec = JSON.parse(line).Records[0];
+            log.textContent += `${rec.eventTime}  ${rec.eventName}  ` +
+              `${rec.s3.bucket.name}/${rec.s3.object.key}  ${rec.s3.object.size??""}\n`;
+          } catch(e){ log.textContent += line + "\n"; }
+          log.scrollTop = log.scrollHeight;
+        }
+      }
+    } catch(e){ if (e.name!=="AbortError") log.textContent += "\nstream error: "+e; }
+    finally { startB.disabled=false; stopB.disabled=true; }
+  };
+}
+// ---- diagnostics (health, usage, heal, locks, scanner) ----
+async function diagView(){
+  shell("diagnostics", `<div class="panel">loading…</div>`);
+  const get = async (p, q)=>{
+    const r = await signedFetch("GET", p, q||{});
+    if (r.status!==200) return `HTTP ${r.status}`;
+    const t = await r.text();
+    try { return JSON.stringify(JSON.parse(t), null, 2); } catch(e){ return t; }
+  };
+  const [live, cluster, usage, heal, scanner, locks] = await Promise.all([
+    fetch("/minio/health/live").then(r=>r.status),
+    fetch("/minio/health/cluster").then(r=>r.status),
+    get("/minio/admin/v3/datausageinfo"),
+    get("/minio/admin/v3/background-heal/status"),
+    get("/minio/admin/v3/scanner/status"),
+    get("/minio/admin/v3/top/locks")]);
+  document.getElementById("content").innerHTML = `
+    <div class="panel"><h3>health</h3>
+      <p>liveness: <span class="${live===200?"ok":"err"}">${live===200?"OK":"HTTP "+live}</span>
+      &nbsp; cluster (write quorum): <span class="${cluster===200?"ok":"err"}">${cluster===200?"OK":"HTTP "+cluster}</span></p></div>
+    <div class="panel"><h3>data usage</h3><pre>${esc(usage)}</pre></div>
+    <div class="panel"><h3>heal status</h3><pre>${esc(heal)}</pre></div>
+    <div class="panel"><h3>scanner</h3><pre>${esc(scanner)}</pre></div>
+    <div class="panel"><h3>top locks</h3><pre>${esc(locks)}</pre></div>`;
 }
 
 document.getElementById("logout").onclick = ()=>{

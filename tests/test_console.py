@@ -119,3 +119,32 @@ def test_js_signing_procedure_accepted(server):
         assert r.status == 200, (method, path, r.status, data[:300])
         if method == "GET" and path.endswith(".txt"):
             assert data == b"js-signed"
+
+
+def test_console_new_tabs_embedded(server):
+    _, _, body = _get(server, "/minio/console")
+    # round-3 console surface: IAM management, live watch, diagnostics
+    for marker in (b'"iam"', b'"watch"', b'"diagnostics"', b"iamView",
+                   b"watchView", b"diagView", b"add-canned-policy",
+                   b"set-user-or-group-policy", b"console/api/users"):
+        assert marker in body, marker
+
+
+def test_console_api_users(server):
+    from minio_tpu.client import S3Client
+
+    # unauthenticated -> denied
+    st, _, _ = _get(server, "/minio/console/api/users")
+    assert st == 403
+    cli = S3Client(f"127.0.0.1:{server.port}")
+    r = cli.request("PUT", "/minio/admin/v3/add-user",
+                    query={"accessKey": "console-user-1"},
+                    body=b'{"secretKey": "console-secret-1"}')
+    assert r.status == 200, r.body
+    r = cli.request("GET", "/minio/console/api/users")
+    assert r.status == 200, r.body
+    import json
+
+    users = json.loads(r.body)
+    assert users["console-user-1"]["status"] == "enabled"
+    assert "secret" not in r.body.decode().lower()  # no secret material leaks
