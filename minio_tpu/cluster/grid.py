@@ -67,13 +67,19 @@ SEND_TIMEOUT = 30.0  # socket write timeout: a wedged peer errors, not hangs
 _HDR = struct.Struct("<BI")
 
 # process-wide internode transport counters (metrics v3
-# /system/network/internode — reference minio_system_network_internode_*);
-# plain int += under the GIL: approximate-but-cheap, like the reference's
-# atomic adds
+# /system/network/internode — reference minio_system_network_internode_*)
 STATS = {
     "dials": 0, "dial_errors": 0, "disconnects": 0,
     "tx_bytes": 0, "rx_bytes": 0, "calls": 0, "streams": 0,
 }
+_stats_lock = threading.Lock()
+
+
+def stats_add(key: str, n: int = 1) -> None:
+    # dict += is not atomic under the GIL (load/add/store interleaves);
+    # counters feed metrics, so take the (uncontended) lock
+    with _stats_lock:
+        STATS[key] += n
 
 
 class GridError(Exception):
@@ -189,7 +195,7 @@ class GridServer:
         async def send_frame(data: bytes) -> None:
             async with send_lock:
                 await ws.send_bytes(data)
-            STATS["tx_bytes"] += len(data)
+            stats_add("tx_bytes", len(data))
 
         streams: dict[int, ServerStream] = {}
         stream_tasks: dict[int, asyncio.Task] = {}
@@ -199,18 +205,18 @@ class GridServer:
                 if msg.type != web.WSMsgType.BINARY:
                     continue
                 data = msg.data
-                STATS["rx_bytes"] += len(data)
+                stats_add("rx_bytes", len(data))
                 ftype, mux = _HDR.unpack_from(data)
                 payload = data[_HDR.size:]
                 if ftype == T_PING:
                     await send_frame(_frame(T_PONG, mux))
                 elif ftype == T_REQ:
-                    STATS["calls"] += 1
+                    stats_add("calls")
                     t = asyncio.create_task(self._run_single(send_frame, mux, payload))
                     tasks.add(t)
                     t.add_done_callback(tasks.discard)
                 elif ftype == T_STR_OPEN:
-                    STATS["streams"] += 1
+                    stats_add("streams")
                     handler, req, window = msgpack.unpackb(payload, raw=False)
                     fn = self._stream.get(handler)
                     if fn is None:
@@ -529,14 +535,14 @@ class GridClient:
                         f"grid {self.host}:{self.port}: recent connect failure"
                     )
             try:
-                STATS["dials"] += 1
+                stats_add("dials")
                 ws = _WSock(
                     self.host, self.port, GRID_ROUTE,
                     {"x-minio-token": self.token,
                      "x-minio-grid-plane": self.plane},
                 )
             except (OSError, GridError) as e:
-                STATS["dial_errors"] += 1
+                stats_add("dial_errors")
                 with self._lock:
                     self._connect_fail_until = time.monotonic() + 1.0
                 raise GridConnectError(str(e)) from None
@@ -565,7 +571,7 @@ class GridClient:
             self._ws = None
             calls, self._calls = self._calls, {}
             streams, self._streams = self._streams, {}
-        STATS["disconnects"] += 1
+        stats_add("disconnects")
         err = GridError(f"grid {self.host}:{self.port} disconnected")
         for q in calls.values():
             q.put(err)
@@ -590,7 +596,7 @@ class GridClient:
             # the (possibly slow) socket write, so a stalled send to a
             # wedged peer cannot block unrelated state transitions
             ws.send_binary(data)
-            STATS["tx_bytes"] += len(data)
+            stats_add("tx_bytes", len(data))
         except OSError as e:
             self._drop(ws)
             raise GridError(f"grid send failed: {e}") from None
@@ -601,7 +607,7 @@ class GridClient:
                 msg = ws.recv_message()
                 if msg is None:
                     break
-                STATS["rx_bytes"] += len(msg)
+                stats_add("rx_bytes", len(msg))
                 ftype, mux = _HDR.unpack_from(msg)
                 payload = msg[_HDR.size:]
                 if ftype == T_RESP:
@@ -664,7 +670,7 @@ class GridClient:
         """Single-payload request/response. Raises RemoteError (typed) or
         GridError (transport). retry=True re-sends once after reconnect —
         callers must only set it for idempotent ops."""
-        STATS["calls"] += 1
+        stats_add("calls")
         attempts = 2 if retry else 1
         last: Exception = GridError("unreachable")
         for _ in range(attempts):
@@ -697,7 +703,7 @@ class GridClient:
 
     def stream(self, handler: str, payload: bytes,
                window: int = DEFAULT_WINDOW) -> ClientStream:
-        STATS["streams"] += 1
+        stats_add("streams")
         mux = self._next_mux()
         st = ClientStream(self, mux, window)
         with self._lock:

@@ -319,11 +319,11 @@ class StorageRESTClient(StorageAPI):
                 data = resp.read()
                 # internode accounting covers the HTTP plane too (bulk
                 # shard bodies + grid fallback), not just the mux
-                from .grid import STATS
+                from .grid import stats_add
 
-                STATS["calls"] += 1
-                STATS["tx_bytes"] += len(body)
-                STATS["rx_bytes"] += len(data)
+                stats_add("calls")
+                stats_add("tx_bytes", len(body))
+                stats_add("rx_bytes", len(data))
                 break
             except (http.client.HTTPException, OSError):
                 self._local.conn = None

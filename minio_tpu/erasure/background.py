@@ -18,6 +18,7 @@ import os
 import queue
 import threading
 import time
+import urllib.parse
 from dataclasses import dataclass, field
 
 
@@ -415,6 +416,12 @@ class BackgroundOps:
                 num_versions=len(versions),
                 successor_mod_time_ns=versions[i - 1].mod_time if i else 0,
                 noncurrent_rank=noncurrent_rank,
+                # tag-filtered rules (Filter><And><Tag>) need the stored
+                # tag set; it rides the version metadata urlencoded
+                tags=dict(urllib.parse.parse_qsl(
+                    (oi.user_defined or {}).get("x-minio-internal-tags", ""),
+                    keep_blank_values=True,
+                )),
             )
             act = ilm.eval_action(rules, st)
             try:

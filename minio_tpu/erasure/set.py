@@ -1121,7 +1121,8 @@ class ErasureSet:
 
         fi, *_ = self._quorum_fileinfo(bucket, obj, version_id)
         raw = fi.metadata.get(self.TAGS_META_KEY, "")
-        return dict(_up.parse_qsl(raw))
+        # empty tag VALUES are legal ("env=") and must round-trip
+        return dict(_up.parse_qsl(raw, keep_blank_values=True))
 
     # -- versions ----------------------------------------------------------
 

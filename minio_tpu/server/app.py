@@ -442,10 +442,17 @@ class S3Server:
         )
 
     def _err_response(self, request, err: s3err.APIError) -> web.Response:
+        headers = {}
+        size = request.get("_range_object_size")
+        if err.http_status == 416 and size is not None:
+            # RFC 7233: unsatisfiable ranges advertise the actual length
+            # (the reference sets this on InvalidRange responses too)
+            headers["Content-Range"] = f"bytes */{size}"
         return web.Response(
             status=err.http_status,
             body=err.to_xml(resource=request.path),
             content_type="application/xml",
+            headers=headers,
         )
 
     async def _entry(self, request: web.Request) -> web.StreamResponse:
@@ -1634,6 +1641,11 @@ class S3Server:
             v = oi.user_defined.get(f"{_cks.META_PREFIX}{calgo}")
             if v:
                 h[f"x-amz-checksum-{calgo}"] = v
+        raw_tags = oi.user_defined.get(self.TAGS_META)
+        if raw_tags:
+            h["x-amz-tagging-count"] = str(
+                len(urllib.parse.parse_qsl(raw_tags, keep_blank_values=True))
+            )
         from ..ilm import tier as tiermod
 
         tname = oi.user_defined.get(tiermod.TRANSITION_TIER_META)
@@ -1805,6 +1817,12 @@ class S3Server:
                 "content-language", "expires", "x-amz-storage-class",
             ):
                 user_defined[lk] = v
+        if request.headers.get("x-amz-tagging"):
+            # tag set supplied at PUT time (reference PutObjectHandler
+            # parses x-amz-tagging into the version's tag metadata)
+            user_defined[self.TAGS_META] = self._tagging_header_meta(
+                request.headers["x-amz-tagging"]
+            )
         if body is None:
             # streaming path: body flows HTTP -> erasure encode -> drives
             user_defined.update(checksum_meta)
@@ -1992,6 +2010,23 @@ class S3Server:
             except CryptoError:
                 raise s3err.AccessDenied from None
         directive = request.headers.get("x-amz-metadata-directive", "COPY")
+        # copying an object onto itself without changing anything is an
+        # error (reference cmd/object-handlers.go isTargetSameAsSource):
+        # REPLACE directives, new SSE attributes, or a storage-class change
+        # make it a legal metadata update
+        if (
+            src_bucket == bucket
+            and src_key == listing.encode_dir_object(key)
+            and not src_vid
+            and directive != "REPLACE"
+            and request.headers.get("x-amz-tagging-directive", "COPY") != "REPLACE"
+            and not request.headers.get("x-amz-server-side-encryption")
+            and not request.headers.get(
+                "x-amz-server-side-encryption-customer-algorithm"
+            )
+            and not request.headers.get("x-amz-storage-class")
+        ):
+            raise s3err.InvalidCopyDest
         user_defined = {
             k: v for k, v in oi.user_defined.items()
             if not k.startswith("x-minio-internal-")
@@ -2005,6 +2040,16 @@ class S3Server:
             }
             if request.headers.get("Content-Type"):
                 user_defined["content-type"] = request.headers["Content-Type"]
+        # tag set travels by its OWN directive, independent of metadata
+        # (reference: x-amz-tagging-directive on CopyObject)
+        if request.headers.get("x-amz-tagging-directive", "COPY") == "REPLACE":
+            user_defined.pop(self.TAGS_META, None)
+            if request.headers.get("x-amz-tagging"):
+                user_defined[self.TAGS_META] = self._tagging_header_meta(
+                    request.headers["x-amz-tagging"]
+                )
+        elif oi.user_defined.get(self.TAGS_META):
+            user_defined[self.TAGS_META] = oi.user_defined[self.TAGS_META]
         bm = self.buckets.get(bucket)
         # re-encode for the destination (its SSE headers / bucket default)
         try:
@@ -2049,6 +2094,7 @@ class S3Server:
         rng = request.headers.get("Range")
         if not rng or not rng.startswith("bytes="):
             return None
+        request["_range_object_size"] = size  # for the 416 Content-Range
         spec = rng[len("bytes=") :]
         if "," in spec:
             raise s3err.NotImplemented_
@@ -2286,7 +2332,14 @@ class S3Server:
             vid = ""
         bm = self.buckets.get(bucket)
         headers = {}
-        await self._run(self._check_object_lock, bucket, key, vid)
+        await self._run(
+            self._check_object_lock, bucket, key, vid,
+            # the IAM resource must use the CLIENT's key form, matching the
+            # raw key the multi-delete path passes
+            self._bypass_governance(
+                request, bucket, listing.decode_dir_object(key)
+            ),
+        )
         # deleting a version (or the sole unversioned copy) of a
         # transitioned object must sweep its warm-tier data (tier GC)
         sweep_ud = None
@@ -2356,9 +2409,11 @@ class S3Server:
             try:
                 # retention/legal hold protects versions through
                 # multi-delete exactly as through single DELETE
+                # (including the governance-bypass header)
                 await self._run(
                     self._check_object_lock, bucket,
                     listing.encode_dir_object(k), "" if v == "null" else v,
+                    self._bypass_governance(request, bucket, k),
                 )
                 vv = "" if v == "null" else v
                 sweep_ud = None
@@ -2419,6 +2474,10 @@ class S3Server:
         for k, v in request.headers.items():
             if k.lower().startswith("x-amz-meta-"):
                 user_defined[k.lower()] = v
+        if request.headers.get("x-amz-tagging"):
+            user_defined[self.TAGS_META] = self._tagging_header_meta(
+                request.headers["x-amz-tagging"]
+            )
         sse_resp: dict[str, str] = {}
         try:
             req_headers = {k.lower(): v for k, v in request.headers.items()}
@@ -2990,9 +3049,13 @@ class S3Server:
         )
         return web.Response(body=xml.encode(), content_type="application/xml")
 
-    def _check_object_lock(self, bucket: str, key: str, vid: str) -> None:
+    def _check_object_lock(self, bucket: str, key: str, vid: str,
+                           bypass_governance: bool = False) -> None:
         """Block data-destroying deletes while retention/legal hold is
-        active (reference: enforceRetentionForDeletion)."""
+        active (reference: enforceRetentionForDeletion). GOVERNANCE
+        retention may be bypassed by a caller holding
+        s3:BypassGovernanceRetention who sent the bypass header;
+        COMPLIANCE and legal hold can never be bypassed."""
         if not vid:
             # on a VERSIONED bucket this only adds a marker; on an
             # unversioned one it destroys the latest version — guard it
@@ -3008,7 +3071,9 @@ class S3Server:
         if raw:
             import datetime as _dt
 
-            _, until = raw.split("|", 1)
+            mode, until = raw.split("|", 1)
+            if mode == "GOVERNANCE" and bypass_governance:
+                return
             try:
                 t = _dt.datetime.fromisoformat(until.replace("Z", "+00:00"))
             except ValueError:
@@ -3016,7 +3081,44 @@ class S3Server:
             if t.tzinfo is None or _dt.datetime.now(_dt.timezone.utc) < t:
                 raise s3err.AccessDenied
 
+    def _bypass_governance(self, request, bucket: str, key: str) -> bool:
+        """True iff the caller asked to bypass GOVERNANCE retention and
+        holds s3:BypassGovernanceRetention (reference
+        cmd/object-handlers.go x-amz-bypass-governance-retention)."""
+        if request.headers.get(
+            "x-amz-bypass-governance-retention", ""
+        ).lower() != "true":
+            return False
+        ak = request.get("access_key", "")
+        if not ak:
+            return False
+        return self.iam.is_allowed(
+            ak, "s3:BypassGovernanceRetention", f"{bucket}/{key}"
+        )
+
     # -- object tagging --------------------------------------------------------
+
+    TAGS_META = "x-minio-internal-tags"
+
+    @staticmethod
+    def _validate_tags(pairs) -> dict[str, str]:
+        """Enforce the S3 tag-set rules on (key, value) pairs (reference
+        pkg tags.ParseObjectTags): <=10 tags, unique keys, key 1-128
+        chars, value <=256 chars."""
+        if len(pairs) > 10:
+            raise s3err.InvalidTag
+        tags: dict[str, str] = {}
+        for k, v in pairs:
+            if not k or len(k) > 128 or len(v) > 256 or k in tags:
+                raise s3err.InvalidTag
+            tags[k] = v
+        return tags
+
+    @classmethod
+    def _tagging_header_meta(cls, header_value: str) -> str:
+        """x-amz-tagging header (urlencoded) -> validated stored form."""
+        pairs = urllib.parse.parse_qsl(header_value, keep_blank_values=True)
+        return urllib.parse.urlencode(cls._validate_tags(pairs))
 
     async def put_object_tagging(self, request, bucket, key, body) -> web.Response:
         key = listing.encode_dir_object(key)
@@ -3025,7 +3127,7 @@ class S3Server:
             root = ET.fromstring(body)
         except ET.ParseError:
             raise s3err.MalformedXML from None
-        tags = {}
+        pairs = []
         for el in root.iter():
             if el.tag.endswith("Tag"):
                 k = v = ""
@@ -3034,10 +3136,8 @@ class S3Server:
                         k = sub.text or ""
                     elif sub.tag.endswith("Value"):
                         v = sub.text or ""
-                if k:
-                    tags[k] = v
-        if len(tags) > 10:
-            raise s3err.InvalidArgument
+                pairs.append((k, v))
+        tags = self._validate_tags(pairs)
         await self._run(self.store.set_object_tags, bucket, key, tags, vid)
         return web.Response(status=200)
 

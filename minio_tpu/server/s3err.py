@@ -39,6 +39,16 @@ InvalidArgument = APIError("InvalidArgument", "Invalid Argument", 400)
 InvalidBucketName = APIError("InvalidBucketName", "The specified bucket is not valid.", 400)
 InvalidDigest = APIError("InvalidDigest", "The Content-Md5 you specified is not valid.", 400)
 InvalidRange = APIError("InvalidRange", "The requested range is not satisfiable", 416)
+InvalidTag = APIError(
+    "InvalidTag", "The TagKey or TagValue you have provided is invalid", 400
+)
+InvalidCopyDest = APIError(
+    "InvalidRequest",
+    "This copy request is illegal because it is trying to copy an object "
+    "to itself without changing the object's metadata, storage class, "
+    "website redirect location or encryption attributes.",
+    400,
+)
 MalformedXML = APIError("MalformedXML", "The XML you provided was not well-formed or did not validate against our published schema.", 400)
 MissingContentLength = APIError("MissingContentLength", "You must provide the Content-Length HTTP header.", 411)
 NoSuchBucket = APIError("NoSuchBucket", "The specified bucket does not exist", 404)

@@ -1,0 +1,346 @@
+"""S3 conformance depth, round 2: governance-bypass deletes, copy
+metadata/tagging directives, tag-set limits, checksum algorithm matrix,
+Range edge cases, POST-policy condition matrix, and lifecycle tag-filter
+expiry — the scenario classes of the reference's
+cmd/object-handlers_test.go, cmd/bucket-lifecycle_test.go, and Mint."""
+
+import base64
+import hashlib
+import hmac as hmac_mod
+import http.client
+import json
+import os
+import time
+import urllib.parse
+
+os.environ.setdefault("MINIO_TPU_BACKEND", "numpy")
+os.environ.setdefault("MINIO_TPU_SCAN_INTERVAL", "0")
+
+import pytest
+
+from minio_tpu.client import S3Client
+
+from test_s3_api import ServerThread
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    base = tmp_path_factory.mktemp("conf2drives")
+    st = ServerThread([str(base / f"d{i}") for i in range(4)])
+    yield st
+    st.stop()
+
+
+@pytest.fixture(scope="module")
+def cli(server):
+    return S3Client(f"127.0.0.1:{server.port}")
+
+
+# -- governance bypass -------------------------------------------------------
+
+
+def test_governance_bypass_delete(cli):
+    cli.request("PUT", "/govb", headers={"x-amz-bucket-object-lock-enabled": "true"})
+    v = cli.put_object("govb", "doc", b"governed").headers["x-amz-version-id"]
+    until = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(time.time() + 3600))
+    ret = (f"<Retention><Mode>GOVERNANCE</Mode>"
+           f"<RetainUntilDate>{until}</RetainUntilDate></Retention>").encode()
+    assert cli.request("PUT", "/govb/doc",
+                       query={"retention": "", "versionId": v}, body=ret).status == 200
+    # no bypass header: denied
+    assert cli.delete_object("govb", "doc", version_id=v).status == 403
+    # bypass header + root credential (holds s3:*): allowed
+    r = cli.request("DELETE", "/govb/doc", query={"versionId": v},
+                    headers={"x-amz-bypass-governance-retention": "true"})
+    assert r.status == 204
+    assert cli.get_object("govb", "doc", query={"versionId": v}).status == 404
+
+
+def test_governance_bypass_requires_permission(cli, server):
+    # a user without s3:BypassGovernanceRetention cannot bypass even with
+    # the header (reference: checkRequestAuthType on the bypass action)
+    cli.request("PUT", "/minio/admin/v3/add-user", query={"accessKey": "govuser"},
+                body=b'{"secretKey": "govsecret1"}')
+    pol = {"Version": "2012-10-17", "Statement": [
+        {"Effect": "Allow",
+         "Action": ["s3:GetObject", "s3:DeleteObject", "s3:DeleteObjectVersion",
+                    "s3:PutObject"],
+         "Resource": ["arn:aws:s3:::govb/*"]}]}
+    cli.request("PUT", "/minio/admin/v3/add-canned-policy", query={"name": "govpol"},
+                body=json.dumps(pol).encode())
+    cli.request("PUT", "/minio/admin/v3/set-user-or-group-policy",
+                query={"policyName": "govpol", "userOrGroup": "govuser",
+                       "isGroup": "false"})
+    v = cli.put_object("govb", "doc2", b"governed").headers["x-amz-version-id"]
+    until = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(time.time() + 3600))
+    ret = (f"<Retention><Mode>GOVERNANCE</Mode>"
+           f"<RetainUntilDate>{until}</RetainUntilDate></Retention>").encode()
+    assert cli.request("PUT", "/govb/doc2",
+                       query={"retention": "", "versionId": v}, body=ret).status == 200
+    user = S3Client(f"127.0.0.1:{server.port}", "govuser", "govsecret1")
+    r = user.request("DELETE", "/govb/doc2", query={"versionId": v},
+                     headers={"x-amz-bypass-governance-retention": "true"})
+    assert r.status == 403  # header without the permission is not enough
+    # COMPLIANCE ignores bypass even for root
+    v2 = cli.put_object("govb", "doc3", b"compliant").headers["x-amz-version-id"]
+    ret = (f"<Retention><Mode>COMPLIANCE</Mode>"
+           f"<RetainUntilDate>{until}</RetainUntilDate></Retention>").encode()
+    assert cli.request("PUT", "/govb/doc3",
+                       query={"retention": "", "versionId": v2}, body=ret).status == 200
+    r = cli.request("DELETE", "/govb/doc3", query={"versionId": v2},
+                    headers={"x-amz-bypass-governance-retention": "true"})
+    assert r.status == 403
+
+
+# -- copy directives ---------------------------------------------------------
+
+
+def test_copy_metadata_directives(cli):
+    cli.make_bucket("cpmeta")
+    cli.put_object("cpmeta", "src", b"copy me", headers={
+        "x-amz-meta-color": "red", "Content-Type": "text/plain"})
+    # default COPY: metadata travels
+    r = cli.request("PUT", "/cpmeta/dst1",
+                    headers={"x-amz-copy-source": "/cpmeta/src"})
+    assert r.status == 200
+    h = cli.head_object("cpmeta", "dst1")
+    assert h.headers.get("x-amz-meta-color") == "red"
+    assert h.headers.get("content-type") == "text/plain"
+    # REPLACE: source metadata dropped, new metadata applies
+    r = cli.request("PUT", "/cpmeta/dst2", headers={
+        "x-amz-copy-source": "/cpmeta/src",
+        "x-amz-metadata-directive": "REPLACE",
+        "x-amz-meta-shape": "square", "Content-Type": "application/json"})
+    assert r.status == 200
+    h = cli.head_object("cpmeta", "dst2")
+    assert "x-amz-meta-color" not in h.headers
+    assert h.headers.get("x-amz-meta-shape") == "square"
+    assert h.headers.get("content-type") == "application/json"
+    # self-copy without REPLACE is invalid (reference: InvalidRequest)
+    r = cli.request("PUT", "/cpmeta/src",
+                    headers={"x-amz-copy-source": "/cpmeta/src"})
+    assert r.status == 400
+    # self-copy WITH REPLACE updates metadata in place
+    r = cli.request("PUT", "/cpmeta/src", headers={
+        "x-amz-copy-source": "/cpmeta/src",
+        "x-amz-metadata-directive": "REPLACE",
+        "x-amz-meta-color": "blue"})
+    assert r.status == 200
+    assert cli.head_object("cpmeta", "src").headers.get("x-amz-meta-color") == "blue"
+
+
+def test_copy_tagging_directive(cli):
+    cli.make_bucket("cptag")
+    cli.put_object("cptag", "src", b"tagged", headers={"x-amz-tagging": "a=1&b=2"})
+    # default COPY carries the tag set
+    cli.request("PUT", "/cptag/dst1", headers={"x-amz-copy-source": "/cptag/src"})
+    t = cli.request("GET", "/cptag/dst1", query={"tagging": ""})
+    assert b"<Key>a</Key>" in t.body and b"<Value>2</Value>" in t.body
+    # REPLACE swaps it
+    cli.request("PUT", "/cptag/dst2", headers={
+        "x-amz-copy-source": "/cptag/src",
+        "x-amz-tagging-directive": "REPLACE", "x-amz-tagging": "c=3"})
+    t = cli.request("GET", "/cptag/dst2", query={"tagging": ""})
+    assert b"<Key>c</Key>" in t.body and b"<Key>a</Key>" not in t.body
+
+
+# -- tag-set limits ----------------------------------------------------------
+
+
+def test_tagging_limits(cli):
+    cli.make_bucket("taglim")
+    cli.put_object("taglim", "obj", b"x")
+
+    def put_tags(pairs):
+        tags = "".join(
+            f"<Tag><Key>{k}</Key><Value>{v}</Value></Tag>" for k, v in pairs
+        )
+        return cli.request(
+            "PUT", "/taglim/obj", query={"tagging": ""},
+            body=f"<Tagging><TagSet>{tags}</TagSet></Tagging>".encode(),
+        )
+
+    # 10 tags allowed
+    assert put_tags([(f"k{i}", f"v{i}") for i in range(10)]).status == 200
+    # 11 rejected (reference: BadRequest / InvalidTag)
+    assert put_tags([(f"k{i}", f"v{i}") for i in range(11)]).status == 400
+    # duplicate keys rejected
+    assert put_tags([("dup", "1"), ("dup", "2")]).status == 400
+    # key >128 chars rejected, value >256 rejected
+    assert put_tags([("K" * 129, "v")]).status == 400
+    assert put_tags([("k", "V" * 257)]).status == 400
+    # boundary sizes pass
+    assert put_tags([("K" * 128, "V" * 256)]).status == 200
+
+
+# -- checksum algorithm matrix ------------------------------------------------
+
+
+@pytest.mark.parametrize("algo", ["crc32", "crc32c", "sha1", "sha256", "crc64nvme"])
+def test_checksum_algorithms_roundtrip(cli, algo):
+    from minio_tpu.utils import checksum as cks
+
+    cli.make_bucket("ckmx")
+    body = b"checksum matrix body " * 50
+    want = cks.compute(algo, body)
+    r = cli.put_object("ckmx", f"obj-{algo}", body,
+                       headers={f"x-amz-checksum-{algo}": want})
+    assert r.status == 200, r.body
+    h = cli.head_object("ckmx", f"obj-{algo}",
+                        query={"attributes": ""}) if False else cli.head_object(
+        "ckmx", f"obj-{algo}")
+    assert h.headers.get(f"x-amz-checksum-{algo}") == want
+    # wrong digest rejected
+    bad = cks.compute(algo, b"different")
+    r = cli.put_object("ckmx", "rejected", body,
+                       headers={f"x-amz-checksum-{algo}": bad})
+    assert r.status == 400
+
+
+# -- Range edge cases ---------------------------------------------------------
+
+
+def test_range_edge_cases(cli):
+    cli.make_bucket("rng")
+    body = bytes(range(256)) * 40  # 10240 bytes
+    cli.put_object("rng", "obj", body)
+    # suffix range
+    r = cli.get_object("rng", "obj", headers={"Range": "bytes=-100"})
+    assert r.status == 206 and r.body == body[-100:]
+    assert r.headers.get("content-range") == f"bytes {len(body)-100}-{len(body)-1}/{len(body)}"
+    # over-long end clamps
+    r = cli.get_object("rng", "obj", headers={"Range": f"bytes=10000-{len(body)*2}"})
+    assert r.status == 206 and r.body == body[10000:]
+    # start beyond EOF -> 416 with the star content-range
+    r = cli.get_object("rng", "obj", headers={"Range": f"bytes={len(body)}-"})
+    assert r.status == 416
+    assert r.headers.get("content-range") == f"bytes */{len(body)}"
+    # suffix longer than the object returns the whole object
+    r = cli.get_object("rng", "obj", headers={"Range": f"bytes=-{len(body)*2}"})
+    assert r.status == 206 and r.body == body
+    # multi-range is not implemented (the reference rejects it too)
+    r = cli.get_object("rng", "obj", headers={"Range": "bytes=0-1,5-6"})
+    assert r.status in (200, 501)
+    # malformed range ignored -> full object (per RFC 7233 MUST ignore)
+    r = cli.get_object("rng", "obj", headers={"Range": "bytes=abc"})
+    assert r.status in (200, 400)
+
+
+# -- POST policy condition matrix ---------------------------------------------
+
+
+def _post_form(server, bucket, fields, file_bytes=b"FILEBYTES"):
+    boundary = "xxCONFBOUNDARYxx"
+    parts = []
+    for n, v in fields:
+        parts.append(
+            f'--{boundary}\r\nContent-Disposition: form-data; name="{n}"\r\n\r\n{v}\r\n'
+        )
+    parts.append(
+        f'--{boundary}\r\nContent-Disposition: form-data; name="file"; '
+        f'filename="f.bin"\r\nContent-Type: application/octet-stream\r\n\r\n'
+    )
+    body = "".join(parts).encode() + file_bytes + f"\r\n--{boundary}--\r\n".encode()
+    conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=30)
+    try:
+        conn.request("POST", f"/{bucket}", body=body, headers={
+            "Content-Type": f"multipart/form-data; boundary={boundary}"})
+        r = conn.getresponse()
+        return r.status, r.read()
+    finally:
+        conn.close()
+
+
+def _signed_policy_fields(key, bucket_conditions, expires_in=600):
+    from minio_tpu.server.signature import signing_key
+
+    amz_date = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+    scope_date = amz_date[:8]
+    cred = f"minioadmin/{scope_date}/us-east-1/s3/aws4_request"
+    policy = {
+        "expiration": time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime(time.time() + expires_in)),
+        "conditions": bucket_conditions + [
+            {"x-amz-credential": cred}, {"x-amz-date": amz_date}],
+    }
+    pb64 = base64.b64encode(json.dumps(policy).encode()).decode()
+    skey = signing_key("minioadmin", scope_date, "us-east-1")
+    sig = hmac_mod.new(skey, pb64.encode(), hashlib.sha256).hexdigest()
+    return [("key", key), ("policy", pb64),
+            ("x-amz-algorithm", "AWS4-HMAC-SHA256"),
+            ("x-amz-credential", cred), ("x-amz-date", amz_date),
+            ("x-amz-signature", sig)]
+
+
+def test_post_policy_conditions(cli, server):
+    cli.make_bucket("postc")
+    # content-length-range too small for the payload -> rejected
+    fields = _signed_policy_fields("small.bin", [
+        {"bucket": "postc"}, ["starts-with", "$key", ""],
+        ["content-length-range", 1, 4]])
+    st, body = _post_form(server, "postc", fields, b"MORE-THAN-FOUR-BYTES")
+    assert st == 400, body
+    # in-range accepted
+    fields = _signed_policy_fields("small.bin", [
+        {"bucket": "postc"}, ["starts-with", "$key", ""],
+        ["content-length-range", 1, 10_000]])
+    st, body = _post_form(server, "postc", fields, b"ok-bytes")
+    assert st in (200, 201, 204), body
+    assert cli.get_object("postc", "small.bin").body == b"ok-bytes"
+    # key outside the starts-with prefix -> rejected
+    fields = _signed_policy_fields("outside/key.bin", [
+        {"bucket": "postc"}, ["starts-with", "$key", "inside/"]])
+    st, body = _post_form(server, "postc", fields)
+    assert st == 403, body
+    # policy for a different bucket -> rejected
+    fields = _signed_policy_fields("k.bin", [
+        {"bucket": "some-other-bucket"}, ["starts-with", "$key", ""]])
+    st, body = _post_form(server, "postc", fields)
+    assert st == 403, body
+    # expired policy -> rejected
+    fields = _signed_policy_fields("k.bin", [
+        {"bucket": "postc"}, ["starts-with", "$key", ""]], expires_in=-5)
+    st, body = _post_form(server, "postc", fields)
+    assert st == 403, body
+
+
+# -- lifecycle: tag filters + expired delete markers --------------------------
+
+
+def test_lifecycle_tag_filter_expiry(cli, server):
+    cli.make_bucket("lctags")
+    cli.put_object("lctags", "keep/a", b"x", headers={"x-amz-tagging": "tier=hot"})
+    cli.put_object("lctags", "drop/b", b"x", headers={"x-amz-tagging": "tier=cold"})
+    past = time.strftime("%Y-%m-%dT00:00:00Z", time.gmtime(time.time() - 86400))
+    lc = (
+        "<LifecycleConfiguration><Rule><ID>cold</ID><Status>Enabled</Status>"
+        "<Filter><And><Prefix>drop/</Prefix>"
+        "<Tag><Key>tier</Key><Value>cold</Value></Tag></And></Filter>"
+        f"<Expiration><Date>{past}</Date></Expiration></Rule>"
+        "</LifecycleConfiguration>"
+    ).encode()
+    assert cli.request("PUT", "/lctags", query={"lifecycle": ""}, body=lc).status == 200
+    server.srv.background.scan_once()
+    assert cli.get_object("lctags", "keep/a").status == 200  # wrong tag: kept
+    assert cli.get_object("lctags", "drop/b").status == 404  # matched: expired
+
+
+def test_lifecycle_expired_delete_marker_cleanup(cli, server):
+    cli.make_bucket("lcmark")
+    cli.request("PUT", "/lcmark", query={"versioning": ""},
+                body=b"<VersioningConfiguration><Status>Enabled</Status>"
+                     b"</VersioningConfiguration>")
+    v = cli.put_object("lcmark", "obj", b"x").headers["x-amz-version-id"]
+    cli.delete_object("lcmark", "obj")  # adds a delete marker on top
+    cli.delete_object("lcmark", "obj", version_id=v)  # remove the only data version
+    # the marker is now the ONLY version: eligible for cleanup
+    lc = (
+        "<LifecycleConfiguration><Rule><ID>dm</ID><Status>Enabled</Status>"
+        "<Filter><Prefix></Prefix></Filter>"
+        "<Expiration><ExpiredObjectDeleteMarker>true</ExpiredObjectDeleteMarker>"
+        "</Expiration></Rule></LifecycleConfiguration>"
+    ).encode()
+    assert cli.request("PUT", "/lcmark", query={"lifecycle": ""}, body=lc).status == 200
+    server.srv.background.scan_once()
+    r = cli.request("GET", "/lcmark", query={"versions": ""})
+    assert b"<DeleteMarker>" not in r.body  # marker swept, namespace clean

@@ -17,9 +17,11 @@ from tests.test_s3_api import ServerThread
 RNG = np.random.default_rng(21)
 
 
-def _wait(cond, timeout=45.0, every=0.2):
+def _wait(cond, timeout=120.0, every=0.2):
     # generous: the 1-core CI host runs replication workers, two server
-    # processes, and the test runner on the same core
+    # processes, and the test runner on the same core; one replication
+    # attempt alone can take most of a minute when the whole suite has
+    # the core saturated (observed full-suite flakes at 45s)
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
         try:
