@@ -21,6 +21,8 @@ import time
 import urllib.parse
 from dataclasses import dataclass, field
 
+from .set import TAGS_META_KEY
+
 
 @dataclass
 class DataUsage:
@@ -419,7 +421,7 @@ class BackgroundOps:
                 # tag-filtered rules (Filter><And><Tag>) need the stored
                 # tag set; it rides the version metadata urlencoded
                 tags=dict(urllib.parse.parse_qsl(
-                    (oi.user_defined or {}).get("x-minio-internal-tags", ""),
+                    (oi.user_defined or {}).get(TAGS_META_KEY, ""),
                     keep_blank_values=True,
                 )),
             )

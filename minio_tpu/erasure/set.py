@@ -48,6 +48,9 @@ from .types import BucketInfo, ObjectInfo
 
 TMP_VOLUME = ".minio.sys/tmp"
 DIGEST = bitrot_io.DIGEST_SIZE
+# single source for the internal tag metadata key: the S3 layer stores it,
+# the ILM scanner filters on it, this layer round-trips it
+TAGS_META_KEY = "x-minio-internal-tags"
 
 
 def _whole_file_hash(m: "FileInfo", part_number: int):
@@ -957,7 +960,7 @@ class ErasureSet:
 
     # -- object tags -------------------------------------------------------
 
-    TAGS_META_KEY = "x-minio-internal-tags"
+    TAGS_META_KEY = TAGS_META_KEY  # module constant, kept as class attr for callers
 
     def update_object_metadata(
         self, bucket: str, obj: str, version_id: str, mutate

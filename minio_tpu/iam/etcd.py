@@ -1,0 +1,130 @@
+"""etcd-backed IAM store: shared identities across deployments.
+
+Mirrors the reference's etcd IAM backend (/root/reference/cmd/
+iam-etcd-store.go + internal/config/etcd): when MINIO_ETCD_ENDPOINTS is
+set, IAM documents (users, groups, policies, mappings) live in etcd
+instead of the object store, so several independent clusters can share
+one identity plane. Speaks etcd's v3 JSON gateway (`/v3/kv/range|put|
+deleterange`, base64-encoded keys/values) dependency-free — the same
+protocol surface the etcd client uses over gRPC, exposed by every etcd
+since 3.0 via grpc-gateway.
+"""
+
+from __future__ import annotations
+
+import base64
+import http.client
+import json
+import urllib.parse
+
+from ..erasure.quorum import ObjectNotFound
+
+KEY_PREFIX = "minio_tpu/iam/"
+
+
+class EtcdError(Exception):
+    pass
+
+
+class EtcdKV:
+    """Minimal etcd v3 JSON-gateway client (put/get/delete/list) with
+    endpoint failover: each call tries the configured endpoints in order
+    (last-known-good first), like the real client's balancer."""
+
+    def __init__(self, endpoints: str | list[str], timeout: float = 10.0):
+        if isinstance(endpoints, str):
+            endpoints = [e.strip() for e in endpoints.split(",") if e.strip()]
+        self.endpoints: list[tuple[str, int, bool]] = []
+        for ep in endpoints:
+            tls = ep.startswith("https://")
+            if "://" in ep:
+                ep = ep.split("://", 1)[1]
+            host, _, port = ep.partition(":")
+            self.endpoints.append((host, int(port) if port else 2379, tls))
+        if not self.endpoints:
+            raise ValueError("no etcd endpoints")
+        self.timeout = timeout
+
+    @staticmethod
+    def _b64(data: bytes) -> str:
+        return base64.b64encode(data).decode()
+
+    def _call_one(self, ep: tuple[str, int, bool], path: str, payload: dict) -> dict:
+        host, port, tls = ep
+        cls = http.client.HTTPSConnection if tls else http.client.HTTPConnection
+        conn = cls(host, port, timeout=self.timeout)
+        try:
+            conn.request("POST", path, body=json.dumps(payload).encode(),
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            data = resp.read()
+            if resp.status != 200:
+                raise EtcdError(f"etcd {path}: HTTP {resp.status} {data[:200]!r}")
+            return json.loads(data)
+        except (OSError, ValueError) as e:
+            raise EtcdError(f"etcd {host}:{port}{path}: {e}") from None
+        finally:
+            conn.close()
+
+    def _call(self, path: str, payload: dict) -> dict:
+        last: EtcdError | None = None
+        for i, ep in enumerate(self.endpoints):
+            try:
+                out = self._call_one(ep, path, payload)
+                if i:  # promote the healthy endpoint for subsequent calls
+                    self.endpoints.insert(0, self.endpoints.pop(i))
+                return out
+            except EtcdError as e:
+                last = e
+        raise last if last is not None else EtcdError("no endpoints")
+
+    def put(self, key: str, value: bytes) -> None:
+        self._call("/v3/kv/put", {
+            "key": self._b64(key.encode()), "value": self._b64(value)})
+
+    def get(self, key: str) -> bytes | None:
+        out = self._call("/v3/kv/range", {"key": self._b64(key.encode())})
+        kvs = out.get("kvs") or []
+        if not kvs:
+            return None
+        return base64.b64decode(kvs[0].get("value", ""))
+
+    def delete(self, key: str) -> None:
+        self._call("/v3/kv/deleterange", {"key": self._b64(key.encode())})
+
+    def list(self, prefix: str) -> dict[str, bytes]:
+        """All keys under prefix (range_end = prefix with last byte +1)."""
+        p = prefix.encode()
+        end = p[:-1] + bytes([p[-1] + 1])
+        out = self._call("/v3/kv/range", {
+            "key": self._b64(p), "range_end": self._b64(end)})
+        result = {}
+        for kv in out.get("kvs") or []:
+            k = base64.b64decode(kv.get("key", "")).decode()
+            result[k] = base64.b64decode(kv.get("value", ""))
+        return result
+
+
+class EtcdIAMStore:
+    """Duck-types the slice of the object-layer API IAMSys persists
+    through (put_object / get_object on the system bucket), routing the
+    documents to etcd. IAMSys stays completely unaware of the backend."""
+
+    def __init__(self, kv: EtcdKV):
+        self.kv = kv
+
+    @staticmethod
+    def _key(obj: str) -> str:
+        return KEY_PREFIX + obj
+
+    def put_object(self, bucket: str, obj: str, data: bytes, *a, **kw):
+        self.kv.put(self._key(obj), bytes(data))
+
+    def get_object(self, bucket: str, obj: str, *a, **kw):
+        val = self.kv.get(self._key(obj))
+        if val is None:
+            raise ObjectNotFound(f"{bucket}/{obj}")
+        return None, iter([val])
+
+    def delete_object(self, bucket: str, obj: str, *a, **kw):
+        self.kv.delete(self._key(obj))

@@ -162,6 +162,144 @@ async def handle_admin(server, request: web.Request, access_key: str, subpath: s
             raise s3err.InvalidArgument from None
         return _json({"success": True})
 
+    # -- IAM + bucket-metadata export/import (reference
+    # cmd/admin-handlers.go ExportIAM/ImportIAM,
+    # ExportBucketMetadata/ImportBucketMetadata: zip-of-JSON snapshots
+    # that move whole deployments between clusters) ------------------------
+    if op == "export-iam" and m == "GET":
+        authz("admin:ExportIAMAction")
+        import io
+        import zipfile
+
+        from ..iam.policy import CANNED_POLICIES
+
+        iam_ = server.iam
+        with iam_._lock:
+            users = {k: u.to_dict() for k, u in iam_.users.items() if not u.is_temp}
+            groups = json.loads(json.dumps(iam_.groups))
+            policies = {
+                k: p.to_dict() for k, p in iam_.policies.items()
+                if k not in CANNED_POLICIES
+            }
+            ldap_map = dict(iam_.ldap_policy_map)
+        buf = io.BytesIO()
+        with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+            z.writestr("iam-assets/users.json", json.dumps(users, indent=2))
+            z.writestr("iam-assets/groups.json", json.dumps(groups, indent=2))
+            z.writestr("iam-assets/policies.json", json.dumps(policies, indent=2))
+            z.writestr(
+                "iam-assets/ldap-policy-map.json", json.dumps(ldap_map, indent=2)
+            )
+        return web.Response(
+            body=buf.getvalue(), content_type="application/zip",
+            headers={"Content-Disposition": "attachment; filename=iam-assets.zip"},
+        )
+    if op == "import-iam" and m == "PUT":
+        authz("admin:ImportIAMAction")
+        import io
+        import zipfile
+
+        try:
+            z = zipfile.ZipFile(io.BytesIO(body))
+
+            def _read(name: str) -> dict:
+                try:
+                    return json.loads(z.read(f"iam-assets/{name}"))
+                except KeyError:
+                    return {}
+
+            snap = {
+                "users": _read("users.json"),
+                "groups": _read("groups.json"),
+                "policies": _read("policies.json"),
+                "ldap_policy_map": _read("ldap-policy-map.json"),
+            }
+        except (zipfile.BadZipFile, ValueError):
+            raise s3err.InvalidArgument from None
+
+        def _merge_iam() -> None:
+            # ADDITIVE: a zip carrying only policies must not wipe users
+            # (the reference's ImportIAM applies file-by-file the same way)
+            from ..iam.policy import Policy
+            from ..iam.sys import UserIdentity
+
+            iam_ = server.iam
+            with iam_._lock:
+                for k, v in snap["users"].items():
+                    iam_.users[k] = UserIdentity.from_dict(v)
+                iam_.groups.update(snap["groups"])
+                for k, v in snap["policies"].items():
+                    iam_.policies[k] = Policy.from_dict(v)
+                iam_.ldap_policy_map.update(snap["ldap_policy_map"])
+                iam_._persist_users()
+                iam_._persist_groups()
+                iam_._persist_policies()
+                iam_._save("ldap_policy_map", iam_.ldap_policy_map)
+
+        await server._run(_merge_iam)
+        if getattr(server.site, "enabled", False):
+            server.site.sync_iam()  # imported identities propagate site-wide
+        return _json({"success": True})
+    if op == "export-bucket-metadata" and m == "GET":
+        authz("admin:ExportBucketMetadataAction")
+        import io
+        import zipfile
+
+        only = q.get("bucket", "")
+        names = (
+            [only] if only
+            else [b.name for b in await server._run(server.store.list_buckets)]
+        )
+        buf = io.BytesIO()
+        with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+            for name in names:
+                if name.startswith(".minio.sys"):
+                    continue
+                bm = server.buckets.get(name)
+                z.writestr(f"buckets/{name}.json", bm.to_json())
+        return web.Response(
+            body=buf.getvalue(), content_type="application/zip",
+            headers={"Content-Disposition": "attachment; filename=bucket-metadata.zip"},
+        )
+    if op == "import-bucket-metadata" and m == "PUT":
+        authz("admin:ImportBucketMetadataAction")
+        import io
+        import zipfile
+
+        from ..replication.site import _SYNCED_META
+
+        try:
+            z = zipfile.ZipFile(io.BytesIO(body))
+            entries = [
+                n for n in z.namelist()
+                if n.startswith("buckets/") and n.endswith(".json")
+            ]
+            docs = {
+                n[len("buckets/"):-len(".json")]: json.loads(z.read(n))
+                for n in entries
+            }
+        except (zipfile.BadZipFile, ValueError):
+            raise s3err.InvalidArgument from None
+
+        def _apply_buckets() -> list[str]:
+            applied = []
+            # the synced set plus export-only fields that must survive a
+            # migration (suspended-versioning state, ownership controls)
+            fields = _SYNCED_META + ("versioning_suspended", "ownership")
+            for name, doc in docs.items():
+                if not server.store.bucket_exists(name):
+                    server.store.make_bucket(name)
+                bm = server.buckets.get(name)
+                for f in fields:
+                    if f in doc:
+                        setattr(bm, f, doc[f])
+                server.buckets.set(name, bm)
+                applied.append(name)
+            return applied
+
+        applied = await server._run(_apply_buckets)
+        return _json({"success": True, "buckets": applied})
+
     # -- users ------------------------------------------------------------
     if op == "add-user" and m == "PUT":
         authz("admin:CreateUser")

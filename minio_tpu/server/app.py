@@ -43,6 +43,13 @@ _SUBRESOURCE_ACTIONS = {
     "cors": ("s3:GetBucketCORS", "s3:PutBucketCORS"),
     "replication": ("s3:GetReplicationConfiguration", "s3:PutReplicationConfiguration"),
     "versioning": ("s3:GetBucketVersioning", "s3:PutBucketVersioning"),
+    "acl": ("s3:GetBucketAcl", "s3:PutBucketAcl"),
+    "policyStatus": ("s3:GetBucketPolicyStatus", "s3:PutBucketPolicy"),
+    "requestPayment": ("s3:GetBucketRequestPayment", "s3:PutBucketRequestPayment"),
+    "logging": ("s3:GetBucketLogging", "s3:PutBucketLogging"),
+    "ownershipControls": (
+        "s3:GetBucketOwnershipControls", "s3:PutBucketOwnershipControls",
+    ),
 }
 
 
@@ -84,6 +91,10 @@ def _route_action(m: str, bucket: str, key: str, q, headers) -> tuple[str, str, 
                 "PUT": "s3:PutObjectTagging",
                 "DELETE": "s3:DeleteObjectTagging",
             }.get(m, "s3:*"), bucket, key
+        if "acl" in q:
+            return (
+                "s3:GetObjectAcl" if m in ("GET", "HEAD") else "s3:PutObjectAcl"
+            ), bucket, key
         if m in ("GET", "HEAD"):
             if "uploadId" in q:
                 return "s3:ListMultipartUploadParts", bucket, key
@@ -329,7 +340,17 @@ class S3Server:
 
         self.buckets = BucketMetadataSys(store)
         self.mp = MultipartRouter(store, part_transform=self._mp_part_transform)
-        self.iam = IAMSys(store, self.root_user, self.root_pass)
+        # IAM documents move to etcd when configured, so independent
+        # deployments share one identity plane (reference
+        # cmd/iam-etcd-store.go; same env variable)
+        etcd_eps = os.environ.get("MINIO_ETCD_ENDPOINTS", "")
+        if etcd_eps:
+            from ..iam.etcd import EtcdIAMStore, EtcdKV
+
+            iam_store = EtcdIAMStore(EtcdKV(etcd_eps))
+        else:
+            iam_store = store
+        self.iam = IAMSys(iam_store, self.root_user, self.root_pass)
         # a real load error must abort boot: running with silently-empty IAM
         # would wipe stored identities on the next persist (first boot is
         # fine — missing documents load as empty)
@@ -1035,12 +1056,35 @@ class S3Server:
                     return await self.put_bucket_simple(request, bucket, "cors", body)
                 if "replication" in q:
                     return await self.put_bucket_simple(request, bucket, "replication", body)
+                if "acl" in q:
+                    return await self.put_acl(request, bucket, "", body)
+                if "requestPayment" in q:
+                    return await self.put_request_payment(request, bucket, body)
+                if "ownershipControls" in q:
+                    return await self.put_bucket_simple(
+                        request, bucket, "ownership", body
+                    )
+                if "logging" in q or "website" in q or "accelerate" in q:
+                    raise s3err.NotImplemented_
+                if any(s in q for s in _SUBRESOURCE_ACTIONS):
+                    # unhandled method on a known subresource must NOT fall
+                    # through to bucket creation (it was authorized for the
+                    # SUBRESOURCE action, not s3:CreateBucket)
+                    raise s3err.MethodNotAllowed
                 return await self.put_bucket(request, bucket)
             if m == "DELETE":
                 for sub in ("policy", "lifecycle", "tagging", "notification",
-                            "encryption", "cors", "replication"):
+                            "encryption", "cors", "replication",
+                            "ownershipControls"):
                     if sub in q:
                         return await self.delete_bucket_simple(request, bucket, sub)
+                if any(s in q for s in _SUBRESOURCE_ACTIONS) or any(
+                    s in q for s in ("website", "logging", "accelerate")
+                ):
+                    # e.g. DELETE ?acl or ?versioning was authorized for the
+                    # subresource action only — falling through would delete
+                    # the BUCKET without s3:DeleteBucket
+                    raise s3err.MethodNotAllowed
                 return await self.delete_bucket(request, bucket)
             if m == "HEAD":
                 return await self.head_bucket(request, bucket)
@@ -1065,6 +1109,23 @@ class S3Server:
                 ):
                     if sub in q:
                         return await self.get_bucket_simple(request, bucket, attr, missing)
+                if "acl" in q:
+                    return await self.get_acl(request, bucket, "")
+                if "policyStatus" in q:
+                    return await self.get_policy_status(request, bucket)
+                if "requestPayment" in q:
+                    return await self.get_request_payment(request, bucket)
+                if "logging" in q:
+                    return await self.get_bucket_logging(request, bucket)
+                if "ownershipControls" in q:
+                    return await self.get_bucket_simple(
+                        request, bucket, "ownership",
+                        s3err.OwnershipControlsNotFoundError,
+                    )
+                if "website" in q:
+                    if not await self._run(self.store.bucket_exists, bucket):
+                        raise s3err.NoSuchBucket
+                    raise s3err.NoSuchWebsiteConfiguration
                 if "uploads" in q:
                     return await self.list_multipart_uploads(request, bucket)
                 return await self.list_objects(request, bucket)
@@ -1076,17 +1137,22 @@ class S3Server:
                     return await self.post_policy_upload(request, bucket, body)
             raise s3err.MethodNotAllowed
 
-        # object-level
+        # object-level. Subresource blocks terminate: an unhandled method
+        # was authorized for the SUBRESOURCE action and must not fall
+        # through to object read/delete (e.g. DELETE ?retention holding
+        # only s3:PutObjectRetention must not delete the object).
         if "retention" in q:
             if m == "PUT":
                 return await self.put_object_retention(request, bucket, key, body)
             if m == "GET":
                 return await self.get_object_retention(request, bucket, key)
+            raise s3err.MethodNotAllowed
         if "legal-hold" in q:
             if m == "PUT":
                 return await self.put_legal_hold(request, bucket, key, body)
             if m == "GET":
                 return await self.get_legal_hold(request, bucket, key)
+            raise s3err.MethodNotAllowed
         if "tagging" in q:
             if m == "PUT":
                 return await self.put_object_tagging(request, bucket, key, body)
@@ -1094,6 +1160,13 @@ class S3Server:
                 return await self.get_object_tagging(request, bucket, key)
             if m == "DELETE":
                 return await self.delete_object_tagging(request, bucket, key)
+            raise s3err.MethodNotAllowed
+        if "acl" in q:
+            if m == "PUT":
+                return await self.put_acl(request, bucket, key, body)
+            if m == "GET":
+                return await self.get_acl(request, bucket, key)
+            raise s3err.MethodNotAllowed
         if m == "PUT":
             if "partNumber" in q and "uploadId" in q:
                 if "x-amz-copy-source" in request.headers:
@@ -1323,8 +1396,129 @@ class S3Server:
         await self._run(self.buckets.set, bucket, bm)
         return web.Response(status=200 if attr != "policy" else 204)
 
+    # -- ACL / misc compat surface (reference cmd/acl-handlers.go,
+    # bucket-handlers.go requestPayment/logging/policyStatus) ----------------
+
+    def _owner_xml(self) -> str:
+        # deterministic canonical owner id for this deployment (the
+        # reference serves a fixed owner id + "minio" display name)
+        oid = hashlib.sha256(self.root_user.encode()).hexdigest()
+        return (
+            f"<Owner><ID>{oid}</ID>"
+            f"<DisplayName>minio</DisplayName></Owner>"
+        )
+
+    async def get_acl(self, request, bucket: str, key: str) -> web.Response:
+        """Canned-ACL world: everything is owner FULL_CONTROL (reference
+        GetBucketACLHandler / GetObjectACLHandler)."""
+        if not await self._run(self.store.bucket_exists, bucket):
+            raise s3err.NoSuchBucket
+        if key:
+            # missing objects must 404, same as a GET
+            await self._run(
+                self.store.get_object_info, bucket,
+                listing.encode_dir_object(key),
+                request.rel_url.query.get("versionId", ""),
+            )
+        owner = self._owner_xml()
+        oid = hashlib.sha256(self.root_user.encode()).hexdigest()
+        xml = (
+            '<?xml version="1.0" encoding="UTF-8"?>'
+            '<AccessControlPolicy xmlns="http://s3.amazonaws.com/doc/2006-03-01/">'
+            f"{owner}<AccessControlList><Grant>"
+            '<Grantee xmlns:xsi="http://www.w3.org/2001/XMLSchema-instance" '
+            'xsi:type="CanonicalUser">'
+            f"<ID>{oid}</ID><DisplayName>minio</DisplayName></Grantee>"
+            "<Permission>FULL_CONTROL</Permission></Grant></AccessControlList>"
+            "</AccessControlPolicy>"
+        )
+        return web.Response(body=xml.encode(), content_type="application/xml")
+
+    async def put_acl(self, request, bucket: str, key: str, body: bytes) -> web.Response:
+        """Only the private canned ACL (or an equivalent single
+        FULL_CONTROL grant document) is accepted; anything else is
+        NotImplemented — bucket policies are the access-control system
+        (reference PutBucketACLHandler/PutObjectACLHandler)."""
+        if not await self._run(self.store.bucket_exists, bucket):
+            raise s3err.NoSuchBucket
+        if key:
+            # a missing object must 404, matching the GET side
+            await self._run(
+                self.store.get_object_info, bucket,
+                listing.encode_dir_object(key),
+                request.rel_url.query.get("versionId", ""),
+            )
+        canned = request.headers.get("x-amz-acl", "")
+        if canned:
+            if canned != "private":
+                raise s3err.NotImplemented_
+            return web.Response(status=200)
+        try:
+            root = ET.fromstring(body)
+        except ET.ParseError:
+            raise s3err.MalformedXML from None
+        grants = [el for el in root.iter() if el.tag.split("}")[-1] == "Grant"]
+        if len(grants) != 1:
+            raise s3err.NotImplemented_
+        perm = next(
+            (el.text for el in grants[0] if el.tag.split("}")[-1] == "Permission"),
+            "",
+        )
+        if perm != "FULL_CONTROL":
+            raise s3err.NotImplemented_
+        return web.Response(status=200)
+
+    async def get_policy_status(self, request, bucket: str) -> web.Response:
+        """Whether anonymous requests are allowed by the bucket policy
+        (reference GetBucketPolicyStatusHandler)."""
+        if not await self._run(self.store.bucket_exists, bucket):
+            raise s3err.NoSuchBucket
+        bm = self.buckets.get(bucket)
+        public = False
+        for st in (bm.policy or {}).get("Statement", []):
+            principal = st.get("Principal", "")
+            aws = principal.get("AWS", "") if isinstance(principal, dict) else principal
+            if isinstance(aws, list):
+                aws = "*" if "*" in aws else ""
+            if st.get("Effect") == "Allow" and aws == "*":
+                public = True
+        xml = (
+            '<?xml version="1.0" encoding="UTF-8"?>'
+            '<PolicyStatus xmlns="http://s3.amazonaws.com/doc/2006-03-01/">'
+            f"<IsPublic>{'true' if public else 'false'}</IsPublic></PolicyStatus>"
+        )
+        return web.Response(body=xml.encode(), content_type="application/xml")
+
+    async def get_request_payment(self, request, bucket: str) -> web.Response:
+        if not await self._run(self.store.bucket_exists, bucket):
+            raise s3err.NoSuchBucket
+        xml = (
+            '<?xml version="1.0" encoding="UTF-8"?>'
+            '<RequestPaymentConfiguration xmlns="http://s3.amazonaws.com/doc/2006-03-01/">'
+            "<Payer>BucketOwner</Payer></RequestPaymentConfiguration>"
+        )
+        return web.Response(body=xml.encode(), content_type="application/xml")
+
+    async def put_request_payment(self, request, bucket: str, body: bytes) -> web.Response:
+        if not await self._run(self.store.bucket_exists, bucket):
+            raise s3err.NoSuchBucket
+        if b"Requester" in body:
+            raise s3err.NotImplemented_  # only BucketOwner payment exists
+        return web.Response(status=200)
+
+    async def get_bucket_logging(self, request, bucket: str) -> web.Response:
+        if not await self._run(self.store.bucket_exists, bucket):
+            raise s3err.NoSuchBucket
+        # access logging rides the audit/notification planes; the S3 call
+        # reports it disabled, like the reference
+        xml = (
+            '<?xml version="1.0" encoding="UTF-8"?>'
+            '<BucketLoggingStatus xmlns="http://s3.amazonaws.com/doc/2006-03-01/" />'
+        )
+        return web.Response(body=xml.encode(), content_type="application/xml")
+
     async def delete_bucket_simple(self, request, bucket, sub) -> web.Response:
-        attr = {"tagging": "tags"}.get(sub, sub)
+        attr = {"tagging": "tags", "ownershipControls": "ownership"}.get(sub, sub)
         bm = self.buckets.get(bucket)
         setattr(bm, attr, None if attr != "tags" else {})
         await self._run(self.buckets.set, bucket, bm)
@@ -3098,7 +3292,7 @@ class S3Server:
 
     # -- object tagging --------------------------------------------------------
 
-    TAGS_META = "x-minio-internal-tags"
+    from ..erasure.set import TAGS_META_KEY as TAGS_META
 
     @staticmethod
     def _validate_tags(pairs) -> dict[str, str]:

@@ -32,6 +32,7 @@ class BucketMetadata:
         self.object_lock: str | None = None
         self.cors: str | None = None
         self.replication: str | None = None
+        self.ownership: str | None = None  # OwnershipControls XML
 
     def to_json(self) -> bytes:
         return json.dumps(self.__dict__).encode()

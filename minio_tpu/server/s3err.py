@@ -39,6 +39,14 @@ InvalidArgument = APIError("InvalidArgument", "Invalid Argument", 400)
 InvalidBucketName = APIError("InvalidBucketName", "The specified bucket is not valid.", 400)
 InvalidDigest = APIError("InvalidDigest", "The Content-Md5 you specified is not valid.", 400)
 InvalidRange = APIError("InvalidRange", "The requested range is not satisfiable", 416)
+NoSuchWebsiteConfiguration = APIError(
+    "NoSuchWebsiteConfiguration",
+    "The specified bucket does not have a website configuration", 404,
+)
+OwnershipControlsNotFoundError = APIError(
+    "OwnershipControlsNotFoundError",
+    "The bucket ownership controls were not found", 404,
+)
 InvalidTag = APIError(
     "InvalidTag", "The TagKey or TagValue you have provided is invalid", 400
 )

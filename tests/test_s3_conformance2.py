@@ -344,3 +344,179 @@ def test_lifecycle_expired_delete_marker_cleanup(cli, server):
     server.srv.background.scan_once()
     r = cli.request("GET", "/lcmark", query={"versions": ""})
     assert b"<DeleteMarker>" not in r.body  # marker swept, namespace clean
+
+
+# -- ACL / policyStatus / requestPayment / logging / ownership ---------------
+
+
+def test_acl_surface(cli):
+    cli.make_bucket("aclb")
+    cli.put_object("aclb", "obj", b"x")
+    # GET bucket + object ACL: canned owner FULL_CONTROL
+    for path, q in (("/aclb", {"acl": ""}), ("/aclb/obj", {"acl": ""})):
+        r = cli.request("GET", path, query=q)
+        assert r.status == 200, r.body
+        assert b"FULL_CONTROL" in r.body and b"<Owner>" in r.body
+    # PUT private canned: accepted; anything else NotImplemented
+    assert cli.request("PUT", "/aclb", query={"acl": ""},
+                       headers={"x-amz-acl": "private"}).status == 200
+    assert cli.request("PUT", "/aclb", query={"acl": ""},
+                       headers={"x-amz-acl": "public-read"}).status == 501
+    assert cli.request("PUT", "/aclb/obj", query={"acl": ""},
+                       headers={"x-amz-acl": "private"}).status == 200
+    # equivalent XML document with one FULL_CONTROL grant: accepted
+    xml = (b'<AccessControlPolicy><AccessControlList><Grant>'
+           b'<Grantee><ID>abc</ID></Grantee><Permission>FULL_CONTROL</Permission>'
+           b'</Grant></AccessControlList></AccessControlPolicy>')
+    assert cli.request("PUT", "/aclb", query={"acl": ""}, body=xml).status == 200
+    # object ACL on a missing key: 404
+    assert cli.request("GET", "/aclb/missing", query={"acl": ""}).status == 404
+
+
+def test_policy_status(cli):
+    cli.make_bucket("pstat")
+    r = cli.request("GET", "/pstat", query={"policyStatus": ""})
+    assert r.status == 200 and b"<IsPublic>false</IsPublic>" in r.body
+    pol = {"Version": "2012-10-17", "Statement": [{
+        "Effect": "Allow", "Principal": "*", "Action": ["s3:GetObject"],
+        "Resource": ["arn:aws:s3:::pstat/*"]}]}
+    assert cli.request("PUT", "/pstat", query={"policy": ""},
+                       body=json.dumps(pol).encode()).status == 204
+    r = cli.request("GET", "/pstat", query={"policyStatus": ""})
+    assert b"<IsPublic>true</IsPublic>" in r.body
+
+
+def test_request_payment_logging_website(cli):
+    cli.make_bucket("payb")
+    r = cli.request("GET", "/payb", query={"requestPayment": ""})
+    assert r.status == 200 and b"<Payer>BucketOwner</Payer>" in r.body
+    ok = b"<RequestPaymentConfiguration><Payer>BucketOwner</Payer></RequestPaymentConfiguration>"
+    assert cli.request("PUT", "/payb", query={"requestPayment": ""}, body=ok).status == 200
+    bad = ok.replace(b"BucketOwner", b"Requester")
+    assert cli.request("PUT", "/payb", query={"requestPayment": ""}, body=bad).status == 501
+    r = cli.request("GET", "/payb", query={"logging": ""})
+    assert r.status == 200 and b"BucketLoggingStatus" in r.body
+    assert cli.request("GET", "/payb", query={"website": ""}).status == 404
+    assert cli.request("PUT", "/payb", query={"website": ""}, body=b"<x/>").status == 501
+
+
+def test_ownership_controls_roundtrip(cli):
+    cli.make_bucket("ownb")
+    assert cli.request("GET", "/ownb", query={"ownershipControls": ""}).status == 404
+    doc = (b"<OwnershipControls><Rule><ObjectOwnership>BucketOwnerEnforced"
+           b"</ObjectOwnership></Rule></OwnershipControls>")
+    assert cli.request("PUT", "/ownb", query={"ownershipControls": ""},
+                       body=doc).status == 200
+    r = cli.request("GET", "/ownb", query={"ownershipControls": ""})
+    assert r.status == 200 and b"BucketOwnerEnforced" in r.body
+    assert cli.request("DELETE", "/ownb", query={"ownershipControls": ""}).status == 204
+    assert cli.request("GET", "/ownb", query={"ownershipControls": ""}).status == 404
+
+
+# -- IAM + bucket metadata export/import --------------------------------------
+
+
+def test_iam_export_import_roundtrip(cli, server, tmp_path_factory):
+    import io
+    import zipfile
+
+    from test_s3_api import ServerThread
+
+    # populate IAM state
+    cli.request("PUT", "/minio/admin/v3/add-user", query={"accessKey": "exp-user"},
+                body=b'{"secretKey": "exp-secret-1"}')
+    pol = {"Version": "2012-10-17", "Statement": [{
+        "Effect": "Allow", "Action": ["s3:GetObject"],
+        "Resource": ["arn:aws:s3:::exported/*"]}]}
+    cli.request("PUT", "/minio/admin/v3/add-canned-policy", query={"name": "exp-pol"},
+                body=json.dumps(pol).encode())
+    cli.request("PUT", "/minio/admin/v3/set-user-or-group-policy",
+                query={"policyName": "exp-pol", "userOrGroup": "exp-user",
+                       "isGroup": "false"})
+    r = cli.request("GET", "/minio/admin/v3/export-iam")
+    assert r.status == 200
+    z = zipfile.ZipFile(io.BytesIO(r.body))
+    users = json.loads(z.read("iam-assets/users.json"))
+    pols = json.loads(z.read("iam-assets/policies.json"))
+    assert "exp-user" in users and "exp-pol" in pols
+    assert "exp-pol" in users["exp-user"]["policies"]
+    # secrets export for migration (the reference exports credentials too)
+
+    # import into a FRESH cluster
+    base = tmp_path_factory.mktemp("iamimport")
+    st2 = ServerThread([str(base / f"d{i}") for i in range(4)])
+    try:
+        c2 = S3Client(f"127.0.0.1:{st2.port}")
+        r2 = c2.request("PUT", "/minio/admin/v3/import-iam", body=r.body)
+        assert r2.status == 200, r2.body
+        listing = c2.request("GET", "/minio/console/api/users")
+        assert b"exp-user" in listing.body
+        # the imported user's credentials WORK on the new cluster
+        u2 = S3Client(f"127.0.0.1:{st2.port}", "exp-user", "exp-secret-1")
+        c2.make_bucket("exported")
+        c2.put_object("exported", "o", b"x")
+        assert u2.get_object("exported", "o").status == 200
+        assert u2.put_object("exported", "nope", b"x").status == 403  # GET-only policy
+    finally:
+        st2.stop()
+
+
+def test_bucket_metadata_export_import(cli, server, tmp_path_factory):
+    import io
+    import zipfile
+
+    from test_s3_api import ServerThread
+
+    cli.make_bucket("meta-exp")
+    pol = {"Version": "2012-10-17", "Statement": [{
+        "Effect": "Allow", "Principal": "*", "Action": ["s3:GetObject"],
+        "Resource": ["arn:aws:s3:::meta-exp/*"]}]}
+    cli.request("PUT", "/meta-exp", query={"policy": ""},
+                body=json.dumps(pol).encode())
+    cli.request("PUT", "/minio/admin/v3/set-bucket-quota",
+                query={"bucket": "meta-exp"},
+                body=json.dumps({"quota": 1 << 30, "quotatype": "hard"}).encode())
+    r = cli.request("GET", "/minio/admin/v3/export-bucket-metadata",
+                    query={"bucket": "meta-exp"})
+    assert r.status == 200
+    z = zipfile.ZipFile(io.BytesIO(r.body))
+    doc = json.loads(z.read("buckets/meta-exp.json"))
+    assert doc["policy"]["Statement"][0]["Effect"] == "Allow"
+
+    base = tmp_path_factory.mktemp("bmimport")
+    st2 = ServerThread([str(base / f"d{i}") for i in range(4)])
+    try:
+        c2 = S3Client(f"127.0.0.1:{st2.port}")
+        r2 = c2.request("PUT", "/minio/admin/v3/import-bucket-metadata", body=r.body)
+        assert r2.status == 200, r2.body
+        # bucket exists on the new cluster with its policy live
+        g = c2.request("GET", "/meta-exp", query={"policy": ""})
+        assert g.status == 200 and b"GetObject" in g.body
+        # quota traveled too
+        gq = c2.request("GET", "/minio/admin/v3/get-bucket-quota",
+                        query={"bucket": "meta-exp"})
+        assert gq.status == 200 and b"1073741824" in gq.body
+    finally:
+        st2.stop()
+
+
+def test_subresource_methods_never_fall_through(cli):
+    """An unhandled method on a known subresource must be 405, never fall
+    through to bucket/object deletion (that path was authorized for the
+    SUBRESOURCE action only)."""
+    cli.make_bucket("nofall")
+    cli.put_object("nofall", "obj", b"x")
+    # bucket-level: DELETE on non-deletable subresources
+    for sub in ("acl", "versioning", "object-lock", "requestPayment"):
+        r = cli.request("DELETE", "/nofall", query={sub: ""})
+        assert r.status == 405, (sub, r.status)
+    # PUT on a read-only subresource must not create/overwrite the bucket
+    assert cli.request("PUT", "/nofall", query={"policyStatus": ""}).status == 405
+    # object-level: DELETE ?acl / ?retention must not delete the object
+    for sub in ("acl", "retention", "legal-hold"):
+        r = cli.request("DELETE", "/nofall/obj", query={sub: ""})
+        assert r.status == 405, (sub, r.status)
+    assert cli.get_object("nofall", "obj").status == 200  # object survived
+    # PUT object acl on a missing key: 404, matching GET
+    assert cli.request("PUT", "/nofall/ghost", query={"acl": ""},
+                       headers={"x-amz-acl": "private"}).status == 404
