@@ -44,6 +44,9 @@ class EtcdKV:
         if not self.endpoints:
             raise ValueError("no etcd endpoints")
         self.timeout = timeout
+        import threading
+
+        self._mu = threading.Lock()  # guards endpoint-order mutation
 
     @staticmethod
     def _b64(data: bytes) -> str:
@@ -68,11 +71,16 @@ class EtcdKV:
 
     def _call(self, path: str, payload: dict) -> dict:
         last: EtcdError | None = None
-        for i, ep in enumerate(self.endpoints):
+        with self._mu:
+            snapshot = list(self.endpoints)  # iterate a stable copy
+        for i, ep in enumerate(snapshot):
             try:
                 out = self._call_one(ep, path, payload)
                 if i:  # promote the healthy endpoint for subsequent calls
-                    self.endpoints.insert(0, self.endpoints.pop(i))
+                    with self._mu:
+                        if ep in self.endpoints:
+                            self.endpoints.remove(ep)
+                            self.endpoints.insert(0, ep)
                 return out
             except EtcdError as e:
                 last = e

@@ -173,25 +173,33 @@ async def handle_admin(server, request: web.Request, access_key: str, subpath: s
 
         from ..iam.policy import CANNED_POLICIES
 
-        iam_ = server.iam
-        with iam_._lock:
-            users = {k: u.to_dict() for k, u in iam_.users.items() if not u.is_temp}
-            groups = json.loads(json.dumps(iam_.groups))
-            policies = {
-                k: p.to_dict() for k, p in iam_.policies.items()
-                if k not in CANNED_POLICIES
-            }
-            ldap_map = dict(iam_.ldap_policy_map)
-        buf = io.BytesIO()
-        with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
-            z.writestr("iam-assets/users.json", json.dumps(users, indent=2))
-            z.writestr("iam-assets/groups.json", json.dumps(groups, indent=2))
-            z.writestr("iam-assets/policies.json", json.dumps(policies, indent=2))
-            z.writestr(
-                "iam-assets/ldap-policy-map.json", json.dumps(ldap_map, indent=2)
-            )
+        def _build_iam_zip() -> bytes:
+            # off-loop: iam._lock may be held by a pool thread mid-persist
+            iam_ = server.iam
+            with iam_._lock:
+                users = {
+                    k: u.to_dict() for k, u in iam_.users.items() if not u.is_temp
+                }
+                groups = json.loads(json.dumps(iam_.groups))
+                policies = {
+                    k: p.to_dict() for k, p in iam_.policies.items()
+                    if k not in CANNED_POLICIES
+                }
+                ldap_map = dict(iam_.ldap_policy_map)
+            buf = io.BytesIO()
+            with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+                z.writestr("iam-assets/users.json", json.dumps(users, indent=2))
+                z.writestr("iam-assets/groups.json", json.dumps(groups, indent=2))
+                z.writestr(
+                    "iam-assets/policies.json", json.dumps(policies, indent=2)
+                )
+                z.writestr(
+                    "iam-assets/ldap-policy-map.json", json.dumps(ldap_map, indent=2)
+                )
+            return buf.getvalue()
+
         return web.Response(
-            body=buf.getvalue(), content_type="application/zip",
+            body=await server._run(_build_iam_zip), content_type="application/zip",
             headers={"Content-Disposition": "attachment; filename=iam-assets.zip"},
         )
     if op == "import-iam" and m == "PUT":
@@ -246,19 +254,29 @@ async def handle_admin(server, request: web.Request, access_key: str, subpath: s
         import zipfile
 
         only = q.get("bucket", "")
-        names = (
-            [only] if only
-            else [b.name for b in await server._run(server.store.list_buckets)]
-        )
-        buf = io.BytesIO()
-        with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
-            for name in names:
-                if name.startswith(".minio.sys"):
-                    continue
-                bm = server.buckets.get(name)
-                z.writestr(f"buckets/{name}.json", bm.to_json())
+        if only and not await server._run(server.store.bucket_exists, only):
+            from ..erasure.quorum import BucketNotFound
+
+            raise BucketNotFound(only)
+
+        def _build_zip() -> bytes:
+            # off-loop: cold bucket-metadata reads hit the erasure store
+            names = (
+                [only] if only
+                else [b.name for b in server.store.list_buckets()]
+            )
+            buf = io.BytesIO()
+            with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+                for name in names:
+                    if name.startswith(".minio.sys"):
+                        continue
+                    bm = server.buckets.get(name)
+                    z.writestr(f"buckets/{name}.json", bm.to_json())
+            return buf.getvalue()
+
+        blob = await server._run(_build_zip)
         return web.Response(
-            body=buf.getvalue(), content_type="application/zip",
+            body=blob, content_type="application/zip",
             headers={"Content-Disposition": "attachment; filename=bucket-metadata.zip"},
         )
     if op == "import-bucket-metadata" and m == "PUT":
@@ -282,11 +300,23 @@ async def handle_admin(server, request: web.Request, access_key: str, subpath: s
             raise s3err.InvalidArgument from None
 
         def _apply_buckets() -> list[str]:
+            from .app import BUCKET_NAME_RE
+
             applied = []
             # the synced set plus export-only fields that must survive a
             # migration (suspended-versioning state, ownership controls)
             fields = _SYNCED_META + ("versioning_suspended", "ownership")
             for name, doc in docs.items():
+                # zip entry names are untrusted: enforce the same bucket
+                # naming rules put_bucket does, and never touch the
+                # system namespace
+                if (
+                    not BUCKET_NAME_RE.match(name)
+                    or ".." in name
+                    or "/" in name
+                    or name.startswith(".minio.sys")
+                ):
+                    continue
                 if not server.store.bucket_exists(name):
                     server.store.make_bucket(name)
                 bm = server.buckets.get(name)

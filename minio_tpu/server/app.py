@@ -462,6 +462,40 @@ class S3Server:
             self._io_pool, lambda: fn(*args, **kw)
         )
 
+    def _prometheus_bearer_ok(self, request) -> bool:
+        """Validate a madmin-style prometheus JWT: HS512 signed with the
+        subject's secret key, standard base64url framing."""
+        import base64 as _b64
+        import hmac as _hmac
+        import json as _json
+        import time as _time
+
+        auth = request.headers.get("Authorization", "")
+        if not auth.startswith("Bearer "):
+            return False
+
+        def _unb64(s: str) -> bytes:
+            return _b64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
+
+        try:
+            h, c, s = auth[7:].split(".")
+            claims = _json.loads(_unb64(c))
+            ak = claims.get("sub", "")
+            secret = self.iam.lookup_secret(ak)
+            if not secret:
+                return False
+            want = _hmac.new(
+                secret.encode(), f"{h}.{c}".encode(), hashlib.sha512
+            ).digest()
+            if not _hmac.compare_digest(_unb64(s), want):
+                return False
+            exp = claims.get("exp")
+            if exp is not None and _time.time() > float(exp):
+                return False
+        except Exception:  # noqa: BLE001 — any malformed token is a no
+            return False
+        return self.iam.is_allowed(ak, "admin:Prometheus", "")
+
     def _err_response(self, request, err: s3err.APIError) -> web.Response:
         headers = {}
         size = request.get("_range_object_size")
@@ -658,9 +692,19 @@ class S3Server:
                 if self.store is None:
                     return web.Response(status=503)
                 if os.environ.get("MINIO_PROMETHEUS_AUTH_TYPE", "jwt") != "public":
-                    ak, _ = await self._authenticate(request)
-                    if not ak or not self.iam.is_allowed(ak, "admin:Prometheus", ""):
-                        raise s3err.AccessDenied
+                    # scrapers authenticate with the bearer JWT that
+                    # `mc admin prometheus generate` mints (HS512 over the
+                    # caller's secret key); SigV4 remains accepted for
+                    # our own SDK (reference cmd/metrics-router.go)
+                    if not self._prometheus_bearer_ok(request):
+                        try:
+                            ak, _ = await self._authenticate(request)
+                        except s3err.APIError as e:
+                            return self._err_response(request, e)
+                        if not ak or not self.iam.is_allowed(
+                            ak, "admin:Prometheus", ""
+                        ):
+                            return self._err_response(request, s3err.AccessDenied)
                 if key.startswith("metrics/v3"):
                     from .metrics import render_v3
 
@@ -1399,12 +1443,14 @@ class S3Server:
     # -- ACL / misc compat surface (reference cmd/acl-handlers.go,
     # bucket-handlers.go requestPayment/logging/policyStatus) ----------------
 
-    def _owner_xml(self) -> str:
+    def _owner_id(self) -> str:
         # deterministic canonical owner id for this deployment (the
         # reference serves a fixed owner id + "minio" display name)
-        oid = hashlib.sha256(self.root_user.encode()).hexdigest()
+        return hashlib.sha256(self.root_user.encode()).hexdigest()
+
+    def _owner_xml(self) -> str:
         return (
-            f"<Owner><ID>{oid}</ID>"
+            f"<Owner><ID>{self._owner_id()}</ID>"
             f"<DisplayName>minio</DisplayName></Owner>"
         )
 
@@ -1421,7 +1467,7 @@ class S3Server:
                 request.rel_url.query.get("versionId", ""),
             )
         owner = self._owner_xml()
-        oid = hashlib.sha256(self.root_user.encode()).hexdigest()
+        oid = self._owner_id()
         xml = (
             '<?xml version="1.0" encoding="UTF-8"?>'
             '<AccessControlPolicy xmlns="http://s3.amazonaws.com/doc/2006-03-01/">'

@@ -180,3 +180,44 @@ def test_phantom_buckets_not_tracked(cli):
 def test_inflight_gauge_exposed(cli):
     text = _get(cli, "/api/requests").body.decode()
     assert "minio_api_requests_inflight_total" in text
+
+
+def test_prometheus_jwt_bearer(server, cli, monkeypatch):
+    """JWT scrape auth (mc admin prometheus generate mints this token):
+    HS512 over the subject's secret key."""
+    import base64
+    import hashlib
+    import hmac as hmac_mod
+    import time
+    import urllib.request
+
+    monkeypatch.setenv("MINIO_PROMETHEUS_AUTH_TYPE", "jwt")
+
+    def b64u(b):
+        return base64.urlsafe_b64encode(b).rstrip(b"=")
+
+    def mint(secret, sub, exp_delta=3600):
+        h = b64u(json.dumps({"alg": "HS512", "typ": "JWT"}).encode())
+        c = b64u(json.dumps({
+            "sub": sub, "iss": "prometheus",
+            "exp": int(time.time()) + exp_delta}).encode())
+        sig = b64u(hmac_mod.new(secret.encode(), h + b"." + c,
+                                hashlib.sha512).digest())
+        return (h + b"." + c + b"." + sig).decode()
+
+    url = f"http://127.0.0.1:{server.port}/minio/metrics/v3"
+
+    def scrape(token=None):
+        req = urllib.request.Request(url)
+        if token:
+            req.add_header("Authorization", f"Bearer {token}")
+        try:
+            with urllib.request.urlopen(req) as r:
+                return r.status
+        except urllib.error.HTTPError as e:
+            return e.code
+
+    assert scrape() == 403  # no credentials
+    assert scrape(mint("minioadmin", "minioadmin")) == 200  # valid JWT
+    assert scrape(mint("wrong-secret", "minioadmin")) == 403  # bad signature
+    assert scrape(mint("minioadmin", "minioadmin", exp_delta=-5)) == 403  # expired
