@@ -27,9 +27,24 @@ numpy codec + numpy HighwayHash runs before timing.
 """
 
 import json
+import statistics
 import time
 
 BASELINE_GIBPS = 10.0
+EPOCHS = 5  # median-of-5 with recorded spread (best-of overstates)
+
+
+def _epochs(run, dd, checksum, sync_cost, iters: int) -> list[float]:
+    """Per-epoch wall seconds for `iters` chained dispatches."""
+    times = []
+    for _ in range(EPOCHS):
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(iters):
+            out = run(dd)
+        _ = int(checksum(out))
+        times.append(time.perf_counter() - t0 - sync_cost)
+    return times
 D, P = 8, 8            # EC 8+8
 N = (1 << 20) // D     # 1 MiB stripe block -> 128 KiB shards
 BATCH = 192            # concurrent stripe blocks per dispatch
@@ -121,14 +136,9 @@ def _bench_decode(jax, jnp, np) -> float:
         _ = int(checksum(out))
         sync_cost = min(sync_cost, time.perf_counter() - t0)
     iters = 15
-    best = float("inf")
-    for _ in range(3):
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            out = run(dd)
-        _ = int(checksum(out))
-        best = min(best, time.perf_counter() - t0 - sync_cost)
-    return (B * d * n / 2**30) * iters / best
+    times = _epochs(run, dd, checksum, sync_cost, iters)
+    gib = B * d * n / 2**30
+    return gib * iters / statistics.median(times)
 
 
 def main() -> None:
@@ -155,8 +165,8 @@ def main() -> None:
     verify(*out)
 
     # measure sync overhead (min-of-3: a spiked sample would inflate every
-    # epoch), then amortize over chained dispatches; best-of-3 epochs
-    # excludes tunnel/host interference spikes
+    # epoch), then amortize over chained dispatches; MEDIAN of 5 epochs
+    # with the min..max spread recorded (best-of overstates — VERDICT r2)
     sync_cost = float("inf")
     for _ in range(3):
         t0 = time.perf_counter()
@@ -164,16 +174,10 @@ def main() -> None:
         sync_cost = min(sync_cost, time.perf_counter() - t0)
 
     iters = 15
-    best = float("inf")
-    for _ in range(3):
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            out = fused(dd)
-        _ = int(checksum(out))
-        best = min(best, time.perf_counter() - t0 - sync_cost)
-
+    times = _epochs(fused, dd, checksum, sync_cost, iters)
     gib = data_bytes / 2**30
-    gibps = gib * iters / best
+    gibps = gib * iters / statistics.median(times)
+    spread = [gib * iters / max(t, 1e-9) for t in times]
     try:
         decode_gibps = _bench_decode(jax, jnp, np)
     except Exception:  # noqa: BLE001 — decode metric must not sink the line
@@ -185,6 +189,9 @@ def main() -> None:
                 "value": round(gibps, 2),
                 "unit": "GiB/s",
                 "vs_baseline": round(gibps / BASELINE_GIBPS, 2),
+                "epochs": EPOCHS,
+                "spread_min": round(min(spread), 2),
+                "spread_max": round(max(spread), 2),
                 "decode_metric": "rs_decode_verify_ec8_2lost_gibps",
                 "decode_value": round(decode_gibps, 2),
             }

@@ -465,17 +465,15 @@ class S3Server:
     def _prometheus_bearer_ok(self, request) -> bool:
         """Validate a madmin-style prometheus JWT: HS512 signed with the
         subject's secret key, standard base64url framing."""
-        import base64 as _b64
         import hmac as _hmac
         import json as _json
         import time as _time
 
+        from ..iam.oidc import _b64url as _unb64  # shared padded decoder
+
         auth = request.headers.get("Authorization", "")
         if not auth.startswith("Bearer "):
             return False
-
-        def _unb64(s: str) -> bytes:
-            return _b64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
 
         try:
             h, c, s = auth[7:].split(".")
@@ -510,11 +508,42 @@ class S3Server:
             headers=headers,
         )
 
+    def _apply_vhost_style(self, request: web.Request) -> None:
+        """Virtual-host-style addressing (reference MINIO_DOMAIN,
+        cmd/generic-handlers.go setBucketForwardingMiddleware): for
+        `bucket.domain` hosts the bucket rides the Host header and the
+        whole path is the key. SigV4 verification keeps the original
+        path — that is what vhost clients sign."""
+        domains = os.environ.get("MINIO_DOMAIN", "")
+        if not domains:
+            return
+        host = request.headers.get("Host", "").rsplit(":", 1)[0].lower()
+        # longest suffix first: with domains example.test + s3.example.test
+        # configured, host b.s3.example.test must parse bucket "b", not
+        # the dotted label "b.s3"
+        ordered = sorted(
+            (d.strip().lower() for d in domains.split(",") if d.strip()),
+            key=len, reverse=True,
+        )
+        for dom in ordered:
+            if not host.endswith("." + dom):
+                continue
+            vb = host[: -len(dom) - 1]
+            if not BUCKET_NAME_RE.match(vb):
+                return  # not a bucket label (e.g. console.domain)
+            # the key is the WHOLE request path (not re-joined match_info
+            # segments: that would drop a trailing slash, losing folder
+            # markers like "photos/")
+            request.match_info["key"] = request.path.lstrip("/")
+            request.match_info["bucket"] = vb
+            return
+
     async def _entry(self, request: web.Request) -> web.StreamResponse:
         import time as _time
 
         from .metrics import classify_api, trace_record
 
+        self._apply_vhost_style(request)
         t0 = _time.perf_counter()
         request["_t0"] = t0  # TTFB measured at response prepare time
         resp: web.StreamResponse | None = None

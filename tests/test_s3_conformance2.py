@@ -520,3 +520,73 @@ def test_subresource_methods_never_fall_through(cli):
     # PUT object acl on a missing key: 404, matching GET
     assert cli.request("PUT", "/nofall/ghost", query={"acl": ""},
                        headers={"x-amz-acl": "private"}).status == 404
+
+
+# -- virtual-host-style addressing --------------------------------------------
+
+
+def test_virtual_host_style_addressing(cli, server, monkeypatch):
+    """bucket.domain Host headers route the bucket (reference
+    MINIO_DOMAIN); path-style keeps working alongside."""
+    monkeypatch.setenv("MINIO_DOMAIN", "s3.example.test")
+    cli.make_bucket("vhostbkt")
+    cli.put_object("vhostbkt", "deep/obj.txt", b"vhost body")
+    pol = {"Version": "2012-10-17", "Statement": [{
+        "Effect": "Allow", "Principal": "*",
+        "Action": ["s3:GetObject", "s3:ListBucket"],
+        "Resource": ["arn:aws:s3:::vhostbkt/*", "arn:aws:s3:::vhostbkt"]}]}
+    assert cli.request("PUT", "/vhostbkt", query={"policy": ""},
+                       body=json.dumps(pol).encode()).status == 204
+
+    def vhost(method, path, host, q=""):
+        conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=30)
+        try:
+            conn.request(method, path + q, headers={"Host": host})
+            r = conn.getresponse()
+            return r.status, r.read()
+        finally:
+            conn.close()
+
+    # object GET through the vhost: path IS the key
+    st, body = vhost("GET", "/deep/obj.txt", "vhostbkt.s3.example.test")
+    assert st == 200 and body == b"vhost body"
+    # bucket listing at the vhost root
+    st, body = vhost("GET", "/", "vhostbkt.s3.example.test", "?list-type=2")
+    assert st == 200 and b"deep/obj.txt" in body
+    # unknown bucket label routes as a bucket (anonymous + no public
+    # policy -> AccessDenied without disclosing existence), not a route 404
+    st, body = vhost("GET", "/x", "missing-bkt.s3.example.test")
+    assert st == 403 and b"AccessDenied" in body
+    # non-bucket host labels (console.domain) stay path-style
+    st, body = vhost("GET", "/vhostbkt/deep/obj.txt", "s3.example.test")
+    assert st == 200 and body == b"vhost body"
+    # path-style via the normal client still works with the domain set
+    assert cli.get_object("vhostbkt", "deep/obj.txt").body == b"vhost body"
+
+
+def test_virtual_host_longest_domain_and_trailing_slash(cli, server, monkeypatch):
+    monkeypatch.setenv("MINIO_DOMAIN", "example.test,s3.example.test")
+    cli.make_bucket("vh2bkt")
+    cli.put_object("vh2bkt", "folder/", b"")  # folder marker
+    cli.put_object("vh2bkt", "folder", b"not the marker")
+    pol = {"Version": "2012-10-17", "Statement": [{
+        "Effect": "Allow", "Principal": "*", "Action": ["s3:GetObject"],
+        "Resource": ["arn:aws:s3:::vh2bkt/*"]}]}
+    assert cli.request("PUT", "/vh2bkt", query={"policy": ""},
+                       body=json.dumps(pol).encode()).status == 204
+
+    def vhost(path, host):
+        conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=30)
+        try:
+            conn.request("GET", path, headers={"Host": host})
+            r = conn.getresponse()
+            return r.status, r.read()
+        finally:
+            conn.close()
+
+    # the MORE SPECIFIC domain must win: bucket is vh2bkt, not vh2bkt.s3
+    st, body = vhost("/folder", "vh2bkt.s3.example.test")
+    assert st == 200 and body == b"not the marker"
+    # trailing slash reaches the folder-marker object, not "folder"
+    st, body = vhost("/folder/", "vh2bkt.example.test")
+    assert st == 200 and body == b""
