@@ -36,6 +36,8 @@ class S3Client:
         access_key: str = "minioadmin",
         secret_key: str = "minioadmin",
         region: str = "us-east-1",
+        ca_file: str | None = None,
+        client_cert: tuple[str, str] | None = None,
     ):
         u = urllib.parse.urlsplit(endpoint if "//" in endpoint else f"http://{endpoint}")
         self.host = u.hostname or "127.0.0.1"
@@ -43,6 +45,27 @@ class S3Client:
         self.access_key = access_key
         self.secret_key = secret_key
         self.region = region
+        self.secure = u.scheme == "https"
+        self.scheme = "https" if self.secure else "http"
+        self._ssl_ctx = None
+        if self.secure:
+            import ssl
+
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+            if ca_file:
+                ctx.load_verify_locations(cafile=ca_file)
+            else:
+                ctx.load_default_certs()
+            if client_cert:
+                ctx.load_cert_chain(client_cert[0], client_cert[1])
+            self._ssl_ctx = ctx
+
+    def _connect(self, timeout: float) -> http.client.HTTPConnection:
+        if self.secure:
+            return http.client.HTTPSConnection(
+                self.host, self.port, timeout=timeout, context=self._ssl_ctx
+            )
+        return http.client.HTTPConnection(self.host, self.port, timeout=timeout)
 
     def request(
         self,
@@ -56,7 +79,9 @@ class S3Client:
     ) -> S3Response:
         qs = urllib.parse.urlencode(query or {})
         enc_path = urllib.parse.quote(path, safe="/~-._")
-        url = f"http://{self.host}:{self.port}{enc_path}" + (f"?{qs}" if qs else "")
+        url = f"{self.scheme}://{self.host}:{self.port}{enc_path}" + (
+            f"?{qs}" if qs else ""
+        )
         hdrs_lower = {k.lower(): v for k, v in (headers or {}).items()}
         # an explicit content-sha256 (e.g. STREAMING-UNSIGNED-PAYLOAD-TRAILER)
         # is the payload hash to sign with, not something to clobber
@@ -66,7 +91,7 @@ class S3Client:
         signed = sign_request(
             method, url, headers or {}, payload, self.access_key, self.secret_key, self.region
         )
-        conn = http.client.HTTPConnection(self.host, self.port, timeout=timeout)
+        conn = self._connect(timeout)
         try:
             conn.request(method, enc_path + (f"?{qs}" if qs else ""), body=body, headers=signed)
             resp = conn.getresponse()
@@ -83,7 +108,7 @@ class S3Client:
         path = urllib.parse.quote(f"/{bucket}/{key}", safe="/~-._")
         return presign_url(
             method,
-            f"http://{self.host}:{self.port}{path}",
+            f"{self.scheme}://{self.host}:{self.port}{path}",
             self.access_key,
             self.secret_key,
             self.region,

@@ -121,7 +121,9 @@ def verify_peers(
         last = "unreachable"
         for attempt in range(retries):
             try:
-                conn = http.client.HTTPConnection(host, int(port), timeout=5)
+                from ..crypto import tlsconf
+
+                conn = tlsconf.http_connection(host, int(port), timeout=5)
                 conn.request(
                     "GET", BOOTSTRAP_ROUTE, headers={"x-minio-token": token}
                 )

@@ -314,6 +314,10 @@ class _WSock:
                  timeout: float = 10.0):
         self.sock = socket.create_connection((host, port), timeout=timeout)
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        from ..crypto import tlsconf
+
+        # internode TLS: the grid rides wss when the cluster serves https
+        self.sock = tlsconf.wrap_client_socket(self.sock, host)
         key = base64.b64encode(os.urandom(16)).decode()
         req = (
             f"GET {path} HTTP/1.1\r\nHost: {host}:{port}\r\n"

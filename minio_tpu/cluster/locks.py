@@ -219,7 +219,9 @@ class _RemoteLocker:
         conn = getattr(self._local, "conn", None)
         try:
             if conn is None:
-                conn = http.client.HTTPConnection(self.host, self.port, timeout=5)
+                from ..crypto import tlsconf
+
+                conn = tlsconf.http_connection(self.host, self.port, timeout=5)
                 self._local.conn = conn
             conn.request(
                 "POST", f"{LOCK_PREFIX}/{op}",

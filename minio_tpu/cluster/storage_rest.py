@@ -259,7 +259,9 @@ class StorageRESTClient(StorageAPI):
     def _conn(self) -> http.client.HTTPConnection:
         c = getattr(self._local, "conn", None)
         if c is None:
-            c = http.client.HTTPConnection(self.host, self.port, timeout=30)
+            from ..crypto import tlsconf
+
+            c = tlsconf.http_connection(self.host, self.port, timeout=30)
             self._local.conn = c
         return c
 

@@ -255,6 +255,19 @@ class IAMSys:
             policies=policies,
         )
 
+    def assume_role_certificate(
+        self, common_name: str, duration_secs: int,
+        cert_expiry: float | None = None,
+    ) -> tuple[UserIdentity, str]:
+        """STS AssumeRoleWithCertificate: mTLS-verified identity; the
+        certificate CommonName is both the parent identity and the policy
+        name, and the credentials never outlive the certificate
+        (/root/reference/cmd/sts-handlers.go:180,917)."""
+        return self._mint_temp(
+            duration_secs, {"certCN": common_name}, policies=[common_name],
+            max_expiry=cert_expiry,
+        )
+
     # -- service accounts / temp creds --------------------------------------
 
     def _sign_token(self, claims: dict) -> str:

@@ -3646,9 +3646,38 @@ def main(argv: list[str] | None = None) -> None:
     ap.add_argument("--set-size", type=int, default=0, help="drives per erasure set")
     ap.add_argument("--ftp", type=int, default=0, help="FTP gateway port (0=off)")
     ap.add_argument("--sftp", type=int, default=0, help="SFTP gateway port (0=off)")
+    ap.add_argument(
+        "--certs-dir",
+        default=os.environ.get("MINIO_TPU_CERTS_DIR", ""),
+        help="directory with public.crt/private.key (+ CAs/); enables TLS "
+        "for the listener and all internode planes when the pair exists",
+    )
     args = ap.parse_args(argv)
     host, _, port = args.address.rpartition(":")
     my_port = int(port)
+
+    # TLS: certs-dir with a keypair turns on https + wss everywhere, with
+    # in-place hot reload (reference cmd/common-main.go:942 getTLSConfig)
+    from ..crypto import tlsconf
+
+    cert_mgr = None
+    if args.certs_dir:
+        have_cert = os.path.isfile(os.path.join(args.certs_dir, tlsconf.CERT_FILE))
+        have_key = os.path.isfile(os.path.join(args.certs_dir, tlsconf.KEY_FILE))
+        if have_cert and have_key:
+            cert_mgr = tlsconf.GLOBAL.enable(args.certs_dir)
+        elif have_cert or have_key:
+            # half a keypair is a misconfiguration, not a plain-HTTP
+            # deployment; refuse rather than silently serving cleartext
+            raise SystemExit(
+                f"certs-dir {args.certs_dir}: need BOTH {tlsconf.CERT_FILE} "
+                f"and {tlsconf.KEY_FILE} (found only one)"
+            )
+        else:
+            print(
+                f"certs-dir {args.certs_dir}: no {tlsconf.CERT_FILE}/"
+                f"{tlsconf.KEY_FILE}; serving plain HTTP", flush=True,
+            )
 
     root_user = os.environ.get("MINIO_ROOT_USER", "minioadmin")
     root_pass = os.environ.get("MINIO_ROOT_PASSWORD", "minioadmin")
@@ -3766,8 +3795,29 @@ def main(argv: list[str] | None = None) -> None:
             ),
         )
         await runner.setup()
-        site = web.TCPSite(runner, host or "0.0.0.0", my_port)
+        site = web.TCPSite(
+            runner, host or "0.0.0.0", my_port,
+            ssl_context=cert_mgr.ctx if cert_mgr else None,
+        )
         await site.start()
+        cert_watcher = None
+        if cert_mgr is not None:
+            print(f"serving https on {args.address}", flush=True)
+
+            async def _watch_certs():
+                while True:
+                    await _asyncio.sleep(2.0)
+                    if cert_mgr.maybe_reload(min_interval=0.0):
+                        # internode dialers must re-anchor trust too when
+                        # the deployment pins the shared public.crt
+                        tlsconf.GLOBAL.refresh_client_context()
+                        print("TLS certificate reloaded", flush=True)
+
+            # keep a strong reference: asyncio tasks are weakly held and
+            # an unreferenced watcher would be GC-collected mid-flight
+            cert_watcher = _asyncio.get_running_loop().create_task(
+                _watch_certs()
+            )
         stop = _asyncio.Event()
         loop = _asyncio.get_running_loop()
         for sig in (_signal.SIGINT, _signal.SIGTERM):
@@ -3776,6 +3826,8 @@ def main(argv: list[str] | None = None) -> None:
             except NotImplementedError:  # non-unix
                 pass
         await stop.wait()
+        if cert_watcher is not None:
+            cert_watcher.cancel()
         await runner.cleanup()  # close listeners, drain in-flight requests
 
     try:

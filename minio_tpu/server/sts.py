@@ -49,6 +49,8 @@ async def handle_sts(server, request: web.Request, access_key: str, body: bytes)
         return await _web_identity(server, form)
     if action == "AssumeRoleWithLDAPIdentity":
         return await _ldap_identity(server, form)
+    if action == "AssumeRoleWithCertificate":
+        return await _certificate(server, request, form)
     if action != "AssumeRole":
         raise s3err.NotImplemented_
     if not access_key:
@@ -65,6 +67,55 @@ async def handle_sts(server, request: web.Request, access_key: str, body: bytes)
     )
     return web.Response(
         body=_credentials_xml("AssumeRole", user, token),
+        content_type="application/xml",
+    )
+
+
+async def _certificate(server, request: web.Request, form: dict) -> web.Response:
+    """mTLS STS: the verified client certificate IS the credential
+    (/root/reference/cmd/sts-handlers.go:180 AssumeRoleWithCertificate).
+
+    Requires the TLS listener (the CA-validated peer certificate arrives
+    on the connection's ssl object); the certificate's CommonName names
+    both the minted identity and the policy it gets — the reference's
+    `parentUser = cert.Subject.CommonName` + policy-by-CN mapping.
+    Gated on MINIO_IDENTITY_TLS_ENABLE like the reference's sts_tls
+    config subsystem.
+    """
+    enabled = server.config.get("identity_tls", "enable") if hasattr(
+        server, "config"
+    ) else ""
+    import os as _os
+
+    if (_os.environ.get("MINIO_IDENTITY_TLS_ENABLE", enabled or "")
+            .lower() not in ("on", "true", "1")):
+        raise s3err.NotImplemented_
+    ssl_obj = request.transport.get_extra_info("ssl_object")
+    if ssl_obj is None:
+        # reference: sts-handlers.go rejects non-TLS certificate STS
+        raise s3err.AccessDenied
+    der = ssl_obj.getpeercert(binary_form=True)
+    if not der:
+        raise s3err.AccessDenied
+    # the handshake already chain-validated against the certs-dir CAs
+    # (CERT_OPTIONAL still verifies any presented cert); here we only
+    # check the leaf is client-auth capable and extract identity
+    from ..crypto import x509util
+
+    cn = x509util.cert_common_name(der)
+    if not cn:
+        raise s3err.AccessDenied
+    duration = _duration(form)
+    if cn not in server.iam.policies:
+        # reference: no policy matching the CN -> auth failure, so a
+        # random-but-valid client cert can't mint credentials
+        raise s3err.AccessDenied
+    user, session = await server._run(
+        server.iam.assume_role_certificate, cn, duration,
+        x509util.cert_not_after(der),
+    )
+    return web.Response(
+        body=_credentials_xml("AssumeRoleWithCertificate", user, session),
         content_type="application/xml",
     )
 
