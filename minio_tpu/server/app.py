@@ -11,281 +11,34 @@ from __future__ import annotations
 import asyncio
 import hashlib
 import os
-import re
-import urllib.parse
-import xml.etree.ElementTree as ET
-from datetime import datetime, timezone
-from email.utils import format_datetime, parsedate_to_datetime
-from xml.sax.saxutils import escape
 
 from aiohttp import web
 
-from ..erasure import listing, quorum
-from ..erasure.set import ErasureSet
-from ..erasure.types import ObjectInfo
+from ..erasure import quorum
 from ..storage.xlstorage import XLStorage
-from . import s3err, signature, streaming
+from . import s3err, signature
 from .buckets import BucketMetadataSys
 
-BUCKET_NAME_RE = re.compile(r"^[a-z0-9][a-z0-9.\-]{1,61}[a-z0-9]$")
-
-# bucket subresource -> (GET action, PUT action)
-_SUBRESOURCE_ACTIONS = {
-    "policy": ("s3:GetBucketPolicy", "s3:PutBucketPolicy"),
-    "lifecycle": ("s3:GetLifecycleConfiguration", "s3:PutLifecycleConfiguration"),
-    "tagging": ("s3:GetBucketTagging", "s3:PutBucketTagging"),
-    "notification": ("s3:GetBucketNotification", "s3:PutBucketNotification"),
-    "encryption": ("s3:GetEncryptionConfiguration", "s3:PutEncryptionConfiguration"),
-    "object-lock": (
-        "s3:GetBucketObjectLockConfiguration",
-        "s3:PutBucketObjectLockConfiguration",
-    ),
-    "cors": ("s3:GetBucketCORS", "s3:PutBucketCORS"),
-    "replication": ("s3:GetReplicationConfiguration", "s3:PutReplicationConfiguration"),
-    "versioning": ("s3:GetBucketVersioning", "s3:PutBucketVersioning"),
-    "acl": ("s3:GetBucketAcl", "s3:PutBucketAcl"),
-    "policyStatus": ("s3:GetBucketPolicyStatus", "s3:PutBucketPolicy"),
-    "requestPayment": ("s3:GetBucketRequestPayment", "s3:PutBucketRequestPayment"),
-    "logging": ("s3:GetBucketLogging", "s3:PutBucketLogging"),
-    "ownershipControls": (
-        "s3:GetBucketOwnershipControls", "s3:PutBucketOwnershipControls",
-    ),
-}
+from .auth import RequestAuthMixin
+from .bucket_handlers import BucketHandlersMixin
+from .handler_utils import (
+    BUCKET_NAME_RE,
+    _SUBRESOURCE_ACTIONS,
+    _route_action,
+    _route_conditions,
+)
+from .multipart_handlers import MultipartHandlersMixin
+from .object_handlers import ObjectHandlersMixin
+from .postpolicy import PostPolicyMixin
 
 
-class _ConsumerDone(Exception):
-    """Streaming-put pump: the erasure consumer finished before EOF."""
-
-
-def _restored_locally(oi) -> bool:
-    """A transitioned object whose restore window is still open has its
-    data back on local drives and serves the normal path."""
-    import time as _time
-
-    from ..ilm import tier as tiermod
-
-    exp = oi.user_defined.get(tiermod.RESTORE_EXPIRY_META)
-    try:
-        return bool(exp) and float(exp) > _time.time()
-    except (TypeError, ValueError):
-        return False
-
-
-def _route_action(m: str, bucket: str, key: str, q, headers) -> tuple[str, str, str]:
-    """(action, bucket, key) for authorization — the request->policy-action
-    mapping the reference does per-handler via checkRequestAuthType."""
-    if key:
-        if "retention" in q:
-            return (
-                "s3:GetObjectRetention" if m in ("GET", "HEAD")
-                else "s3:PutObjectRetention"
-            ), bucket, key
-        if "legal-hold" in q:
-            return (
-                "s3:GetObjectLegalHold" if m in ("GET", "HEAD")
-                else "s3:PutObjectLegalHold"
-            ), bucket, key
-        if "tagging" in q:
-            return {
-                "GET": "s3:GetObjectTagging",
-                "PUT": "s3:PutObjectTagging",
-                "DELETE": "s3:DeleteObjectTagging",
-            }.get(m, "s3:*"), bucket, key
-        if "acl" in q:
-            return (
-                "s3:GetObjectAcl" if m in ("GET", "HEAD") else "s3:PutObjectAcl"
-            ), bucket, key
-        if m in ("GET", "HEAD"):
-            if "uploadId" in q:
-                return "s3:ListMultipartUploadParts", bucket, key
-            if "attributes" in q:
-                return "s3:GetObjectAttributes", bucket, key
-            if "versionId" in q:
-                return "s3:GetObjectVersion", bucket, key
-            return "s3:GetObject", bucket, key
-        if m == "PUT":
-            return "s3:PutObject", bucket, key
-        if m == "DELETE":
-            if "uploadId" in q:
-                return "s3:AbortMultipartUpload", bucket, key
-            if "versionId" in q:
-                return "s3:DeleteObjectVersion", bucket, key
-            return "s3:DeleteObject", bucket, key
-        if m == "POST":
-            if "select" in q:
-                return "s3:GetObject", bucket, key  # Select is a READ
-            if "restore" in q:
-                return "s3:RestoreObject", bucket, key
-            return "s3:PutObject", bucket, key
-        return "s3:*", bucket, key
-    # bucket level
-    for sub, (get_a, put_a) in _SUBRESOURCE_ACTIONS.items():
-        if sub in q:
-            if m in ("GET", "HEAD"):
-                return get_a, bucket, ""
-            return put_a, bucket, ""
-    if m == "PUT":
-        return "s3:CreateBucket", bucket, ""
-    if m == "DELETE":
-        return "s3:DeleteBucket", bucket, ""
-    if m == "POST":
-        return "", bucket, ""  # multi-delete authorizes PER KEY in its handler
-    if "versions" in q:
-        return "s3:ListBucketVersions", bucket, ""
-    if "location" in q:
-        return "s3:GetBucketLocation", bucket, ""
-    if "uploads" in q:
-        return "s3:ListBucketMultipartUploads", bucket, ""
-    return "s3:ListBucket", bucket, ""
-
-
-def _route_conditions(q) -> dict[str, str]:
-    return {"s3:prefix": q.get("prefix", ""), "s3:delimiter": q.get("delimiter", "")}
-
-
-def _parse_form_data(body: bytes, boundary: bytes) -> tuple[dict[str, str], bytes]:
-    """Minimal multipart/form-data parser for POST-policy uploads.
-
-    Returns (fields, file_bytes); the file part's filename lands in
-    fields['__filename'].
-    """
-    fields: dict[str, str] = {}
-    file_data = b""
-    delim = b"--" + boundary
-    chunks = body.split(delim)
-    for part in chunks[1:]:  # [0] is the preamble
-        if part.startswith(b"--"):
-            break  # closing boundary
-        # strip EXACTLY the framing CRLFs — file payloads may legitimately
-        # begin/end with newline bytes that must survive
-        if part.startswith(b"\r\n"):
-            part = part[2:]
-        if part.endswith(b"\r\n"):
-            part = part[:-2]
-        head, _, content = part.partition(b"\r\n\r\n")
-        disp = ""
-        for line in head.split(b"\r\n"):
-            if line.lower().startswith(b"content-disposition"):
-                disp = line.decode("utf-8", "replace")
-        name = ""
-        filename = None
-        for tok in disp.split(";"):
-            tok = tok.strip()
-            if tok.startswith("name="):
-                name = tok[5:].strip('"')
-            elif tok.startswith("filename="):
-                filename = tok[9:].strip('"')
-        if not name:
-            continue
-        if name == "file":
-            file_data = content
-            if filename:
-                fields["__filename"] = filename.rsplit("/", 1)[-1]
-        else:
-            fields[name] = content.decode("utf-8", "replace")
-    return fields, file_data
-
-
-def _verify_checksum_headers(headers, body: bytes) -> dict[str, str]:
-    """AWS flexible-checksums: verify x-amz-checksum-* when present and
-    return internal metadata recording them (reference internal/hash/
-    checksum.go readers). All five algorithms (CRC32, CRC32C, SHA1,
-    SHA256, CRC64NVME) are verified, none stored blind."""
-    from ..utils import checksum as cks
-
-    out: dict[str, str] = {}
-    for algo in cks.ALGOS:
-        v = headers.get(f"{cks.HEADER}{algo}")
-        if not v:
-            continue
-        if cks.compute(algo, body) != v:
-            raise s3err.InvalidDigest
-        out[f"{cks.META_PREFIX}{algo}"] = v
-    return out
-
-
-class _AwsChunkedDecoder:
-    """Incremental aws-chunked decoder for STREAMING-UNSIGNED-PAYLOAD-TRAILER
-    bodies (reference cmd/streaming-v4-unsigned.go): yields payload bytes,
-    captures the trailing checksum headers."""
-
-    def __init__(self):
-        self._buf = bytearray()
-        self._state = "size"  # size | data | crlf | trailer
-        self._remaining = 0
-        self.trailers: dict[str, str] = {}
-
-    def feed(self, chunk: bytes) -> bytes:
-        self._buf += chunk
-        out = bytearray()
-        while True:
-            if self._state == "size":
-                nl = self._buf.find(b"\r\n")
-                if nl < 0:
-                    break
-                line = bytes(self._buf[:nl])
-                del self._buf[: nl + 2]
-                size_hex = line.split(b";", 1)[0].strip()
-                try:
-                    self._remaining = int(size_hex, 16)
-                except ValueError:
-                    raise s3err.IncompleteBody from None
-                self._state = "data" if self._remaining else "trailer"
-            elif self._state == "data":
-                take = min(self._remaining, len(self._buf))
-                if take:
-                    out += self._buf[:take]
-                    del self._buf[:take]
-                    self._remaining -= take
-                if self._remaining:
-                    break
-                self._state = "crlf"
-            elif self._state == "crlf":
-                if len(self._buf) < 2:
-                    break
-                del self._buf[:2]
-                self._state = "size"
-            else:  # trailer: lines until blank
-                nl = self._buf.find(b"\r\n")
-                if nl < 0:
-                    break
-                line = bytes(self._buf[:nl])
-                del self._buf[: nl + 2]
-                if not line:
-                    continue  # final blank line
-                if b":" in line:
-                    k, v = line.split(b":", 1)
-                    self.trailers[k.decode().strip().lower()] = v.decode().strip()
-        return bytes(out)
-
-
-def _bucket_sse_algo(encryption_xml: str | None) -> str | None:
-    """SSEAlgorithm from a bucket's default-encryption config XML."""
-    if not encryption_xml:
-        return None
-    try:
-        root = ET.fromstring(encryption_xml)
-        for el in root.iter():
-            if el.tag.endswith("SSEAlgorithm"):
-                return el.text or None
-    except ET.ParseError:
-        return None
-    return None
-
-
-def _iso8601(ns: int) -> str:
-    return datetime.fromtimestamp(ns / 1e9, tz=timezone.utc).strftime(
-        "%Y-%m-%dT%H:%M:%S.%f"
-    )[:-3] + "Z"
-
-
-def _http_date(ns: int) -> str:
-    return format_datetime(
-        datetime.fromtimestamp(ns / 1e9, tz=timezone.utc), usegmt=True
-    )
-
-
-class S3Server:
+class S3Server(
+    RequestAuthMixin,
+    BucketHandlersMixin,
+    ObjectHandlersMixin,
+    MultipartHandlersMixin,
+    PostPolicyMixin,
+):
     def __init__(self, store=None, region: str = "us-east-1"):
         import time as _time
 
@@ -770,299 +523,6 @@ class S3Server:
 
             traceback.print_exc()
             return self._err_response(request, s3err.InternalError)
-
-    async def _authenticate(
-        self, request: web.Request, stream_body: bool = False
-    ) -> tuple[str, bytes | None]:
-        """Verify request auth; returns (access_key, payload bytes).
-
-        stream_body=True leaves the body unread (returned as None) for the
-        streaming PUT path — only valid for auth modes that don't hash the
-        payload (presigned / UNSIGNED-PAYLOAD), which _streamable_put
-        guarantees."""
-        headers = {k.lower(): v for k, v in request.headers.items()}
-        raw_path = request.rel_url.raw_path
-        query = urllib.parse.parse_qsl(
-            request.rel_url.raw_query_string, keep_blank_values=True
-        )
-        if stream_body:
-            body = None
-        else:
-            body = await request.read() if request.body_exists else b""
-
-        qdict = dict(query)
-        if "X-Amz-Signature" in qdict:
-            ak = self.verifier.verify_presigned(request.method, raw_path, query, headers)
-            self._check_session_token(ak, headers, qdict)
-            return ak, body
-        if (
-            "Signature" in qdict
-            and "AWSAccessKeyId" in qdict
-            and "Expires" in qdict
-        ):
-            # legacy presigned V2 (reference cmd/signature-v2.go)
-            from .signature import SigV2Verifier
-
-            ak = SigV2Verifier(self.iam.lookup_secret).verify_presigned(
-                request.method, raw_path, request.rel_url.raw_query_string,
-                headers,
-            )
-            self._check_session_token(ak, headers, qdict)
-            return ak, body
-        if "authorization" not in headers:
-            # anonymous: only bucket policies can authorize it downstream
-            return "", body
-        if headers["authorization"].startswith("AWS "):
-            # legacy header V2: HMAC-SHA1 over the V2 string-to-sign
-            from .signature import SigV2Verifier
-
-            ak = SigV2Verifier(self.iam.lookup_secret).verify_header(
-                request.method, raw_path, request.rel_url.raw_query_string, headers
-            )
-            self._check_session_token(ak, headers, {})
-            return ak, body
-
-        content_sha = headers.get("x-amz-content-sha256", signature.UNSIGNED_PAYLOAD)
-        ak = self.verifier.verify_header_auth(
-            request.method, raw_path, query, headers, content_sha
-        )
-        if content_sha == signature.STREAMING_UNSIGNED_TRAILER:
-            if body is not None:  # streamed bodies decode inline in the pump
-                body = self._decode_trailer_body(request, body)
-        elif content_sha in (
-            signature.STREAMING_PAYLOAD,
-            signature.STREAMING_PAYLOAD_TRAILER,
-        ):
-            auth = signature.parse_auth_header(headers["authorization"])
-            body = streaming.decode_signed_chunked(
-                body,
-                auth.signature,
-                headers.get("x-amz-date", ""),
-                auth.scope,
-                self.iam.lookup_secret(ak) or "",
-                trailer_mode=content_sha == signature.STREAMING_PAYLOAD_TRAILER,
-            )
-        elif content_sha not in (signature.UNSIGNED_PAYLOAD,):
-            if hashlib.sha256(body).hexdigest() != content_sha:
-                raise s3err.XAmzContentSHA256Mismatch
-        self._check_session_token(ak, headers, {})
-        return ak, body
-
-    def _decode_trailer_body(self, request, body: bytes) -> bytes:
-        """Decode a buffered aws-chunked STREAMING-UNSIGNED-PAYLOAD-TRAILER
-        body; verify every x-amz-checksum trailer against the decoded
-        payload and record it for storage (small uploads must get the
-        same integrity behavior as streamed ones)."""
-        from ..utils import checksum as cks
-
-        dec = _AwsChunkedDecoder()
-        data = dec.feed(body)
-        meta: dict[str, str] = {}
-        for k, v in dec.trailers.items():
-            if k.startswith(cks.HEADER):
-                algo = k[len(cks.HEADER):]
-                if algo in cks.ALGOS:
-                    if cks.compute(algo, data) != v:
-                        raise s3err.InvalidDigest
-                    meta[f"{cks.META_PREFIX}{algo}"] = v
-        if meta:
-            request["trailer_checksum_meta"] = meta
-        return data
-
-    def _streamable_put(self, request: web.Request) -> bool:
-        """True for object PUTs whose body can flow straight into the
-        erasure plane without buffering: auth never hashes the payload
-        (presigned or UNSIGNED-PAYLOAD), no Content-MD5/checksum headers
-        to verify over the whole body, no copy source, and the body is big
-        enough for streaming to matter. Transform applicability (SSE,
-        compression) is re-checked in the handler, which falls back to the
-        buffered path since the body is still unread."""
-        if request.method != "PUT":
-            return False
-        bucket = request.match_info.get("bucket", "")
-        key = request.match_info.get("key", "")
-        if not bucket or not key or bucket == "minio" or bucket.startswith(".minio.sys"):
-            return False
-        q = request.rel_url.query
-        for sub in ("retention", "legal-hold", "tagging", "acl"):
-            if sub in q:
-                return False
-        headers = {k.lower() for k in request.headers}
-        if "x-amz-copy-source" in headers or "content-md5" in headers:
-            return False
-        sha = request.headers.get("x-amz-content-sha256", signature.UNSIGNED_PAYLOAD)
-        trailer_mode = sha == signature.STREAMING_UNSIGNED_TRAILER
-        if any(
-            h.startswith((
-                # full-body checksum headers need the buffered verify path;
-                # TRAILER checksums stream (decoded + verified on the fly)
-                "x-amz-checksum-",
-                # request-level SSE needs the transform pipeline (whole body)
-                "x-amz-server-side-encryption",
-            ))
-            for h in headers
-        ):
-            return False
-        if ("x-amz-trailer" in headers or "x-amz-sdk-checksum-algorithm" in headers) \
-                and not trailer_mode:
-            return False
-        presigned = "X-Amz-Signature" in q
-        if not presigned and sha != signature.UNSIGNED_PAYLOAD and not trailer_mode:
-            return False
-        try:
-            cl = int(
-                request.headers.get("x-amz-decoded-content-length")
-                or request.headers.get("Content-Length", "0")
-            )
-        except ValueError:
-            return False
-        return cl >= int(os.environ.get("MINIO_TPU_STREAM_MIN_BYTES", str(8 << 20)))
-
-    async def _run_streaming_put(self, request: web.Request, consume):
-        """Run consume(chunk_iterator) in the io pool while pumping the
-        request body into it through a bounded queue (~8 MiB of chunks):
-        the async HTTP read and the sync erasure encode/write overlap, and
-        a part is never fully resident. A short body (client hung up) or
-        pump failure raises into the consumer so the put aborts cleanly.
-        """
-        import queue as _queue
-
-        chunk_sz = int(os.environ.get("MINIO_TPU_PUT_CHUNK_MB", "4")) << 20
-        q: _queue.Queue = _queue.Queue(maxsize=max(2, (8 << 20) // chunk_sz))
-
-        def gen():
-            while True:
-                item = q.get()
-                if item is None:
-                    return
-                if isinstance(item, Exception):
-                    raise item
-                yield item
-
-        self.streaming_puts += 1
-        task = asyncio.ensure_future(self._run(consume, gen()))
-        loop = asyncio.get_running_loop()
-
-        def put_item(item):
-            while True:
-                if task.done():
-                    raise _ConsumerDone
-                try:
-                    q.put(item, timeout=0.25)
-                    return
-                except _queue.Full:
-                    continue
-
-        def inject_error(e: Exception):
-            """Guaranteed delivery: drain the queue until the sentinel fits
-            so the consumer can never block forever on q.get() (which would
-            wedge the namespace write lock and leak the io-pool thread)."""
-            while True:
-                try:
-                    q.put_nowait(e)
-                    return
-                except _queue.Full:
-                    try:
-                        q.get_nowait()
-                    except _queue.Empty:
-                        pass
-
-        # aws-chunked bodies with trailing checksums decode + verify inline
-        # (reference cmd/streaming-v4-unsigned.go + internal/hash trailers)
-        decoder = None
-        hasher = None
-        trailer_algo = ""
-        if request.headers.get("x-amz-content-sha256") == \
-                signature.STREAMING_UNSIGNED_TRAILER:
-            from ..utils import checksum as cks
-
-            decoder = _AwsChunkedDecoder()
-            t = request.headers.get("x-amz-trailer", "").strip().lower()
-            if t.startswith(cks.HEADER) and t[len(cks.HEADER):] in cks.ALGOS:
-                trailer_algo = t[len(cks.HEADER):]
-                hasher = cks.Hasher(trailer_algo)
-            elif t:
-                # a declared trailer we can't verify must not be accepted
-                # silently (integrity was requested)
-                raise s3err.InvalidArgument
-
-        expect = int(
-            request.headers.get("x-amz-decoded-content-length")
-            or request.headers.get("Content-Length", "0")
-        )
-        got = 0
-        try:
-            while True:
-                chunk = await request.content.read(chunk_sz)
-                if not chunk:
-                    err: Exception | None = None
-                    if got != expect:
-                        err = s3err.IncompleteBody
-                    elif decoder is not None and hasher is not None:
-                        from ..utils import checksum as cks
-
-                        want = decoder.trailers.get(f"{cks.HEADER}{trailer_algo}")
-                        if want is None or want != hasher.b64():
-                            err = s3err.InvalidDigest
-                        else:
-                            request["trailer_checksum_meta"] = {
-                                f"{cks.META_PREFIX}{trailer_algo}": want
-                            }
-                    await loop.run_in_executor(self._pump_pool, put_item, err)
-                    break
-                if decoder is not None:
-                    chunk = decoder.feed(chunk)
-                    if hasher is not None and chunk:
-                        hasher.update(chunk)
-                    if not chunk:
-                        continue
-                got += len(chunk)
-                try:
-                    # fast path: skip the executor hop when there's room
-                    q.put_nowait(chunk)
-                except _queue.Full:
-                    await loop.run_in_executor(self._pump_pool, put_item, chunk)
-        except _ConsumerDone:
-            pass  # consumer already finished/failed; its result surfaces below
-        except BaseException as e:
-            inject_error(e if isinstance(e, Exception) else RuntimeError(str(e)))
-            raise
-        return await task
-
-    def _check_session_token(self, access_key: str, headers, query) -> None:
-        """Temp (STS) credentials must present a valid session token whose
-        claims match the signing key (reference: checkClaimsFromToken)."""
-        u = self.iam.users.get(access_key)
-        if u is None or not u.is_temp:
-            return
-        token = headers.get("x-amz-security-token", "") or query.get(
-            "X-Amz-Security-Token", ""
-        )
-        claims = self.iam.verify_token(token) if token else None
-        if not claims or claims.get("accessKey") != access_key:
-            raise s3err.AccessDenied
-
-    # -- dispatch ------------------------------------------------------------
-
-    def _authorize(
-        self, access_key: str, action: str, bucket: str, key: str = "",
-        conditions: dict[str, str] | None = None,
-    ) -> None:
-        if not action:
-            return  # handler performs its own per-key authorization
-        resource = f"{bucket}/{key}" if key else bucket
-        bucket_policy = None
-        if bucket:
-            raw = self.buckets.get(bucket).policy
-            if raw:
-                from ..iam.policy import Policy
-
-                bucket_policy = Policy.from_dict(raw)
-        if not self.iam.is_allowed(
-            access_key, action, resource, conditions, bucket_policy
-        ):
-            raise s3err.AccessDenied
-
     async def _dispatch(self, request: web.Request) -> web.StreamResponse:
         ak, body = await self._authenticate(
             request, stream_body=self._streamable_put(request)
@@ -1274,1738 +734,6 @@ class S3Server:
         raise s3err.MethodNotAllowed
 
     # -- service -------------------------------------------------------------
-
-    async def list_buckets(self, request) -> web.Response:
-        buckets = await self._run(self.store.list_buckets)
-        items = "".join(
-            f"<Bucket><Name>{escape(b.name)}</Name>"
-            f"<CreationDate>{_iso8601(b.created)}</CreationDate></Bucket>"
-            for b in buckets
-        )
-        xml = (
-            '<?xml version="1.0" encoding="UTF-8"?>'
-            '<ListAllMyBucketsResult xmlns="http://s3.amazonaws.com/doc/2006-03-01/">'
-            "<Owner><ID>minio-tpu</ID><DisplayName>minio-tpu</DisplayName></Owner>"
-            f"<Buckets>{items}</Buckets></ListAllMyBucketsResult>"
-        )
-        return web.Response(body=xml.encode(), content_type="application/xml")
-
-    # -- bucket --------------------------------------------------------------
-
-    async def put_bucket(self, request, bucket: str) -> web.Response:
-        if not BUCKET_NAME_RE.match(bucket) or ".." in bucket:
-            raise s3err.InvalidBucketName
-        await self._run(self.store.make_bucket, bucket)
-        lock_enabled = request.headers.get("x-amz-bucket-object-lock-enabled", "") == "true"
-        if lock_enabled:
-            bm = self.buckets.get(bucket)
-            bm.versioning = True
-            bm.object_lock = "<ObjectLockConfiguration><ObjectLockEnabled>Enabled</ObjectLockEnabled></ObjectLockConfiguration>"
-            await self._run(self.buckets.set, bucket, bm)
-        if self.site.enabled:
-            await self._run(self.site.sync_bucket_create, bucket)
-        return web.Response(status=200, headers={"Location": f"/{bucket}"})
-
-    async def head_bucket(self, request, bucket: str) -> web.Response:
-        if not await self._run(self.store.bucket_exists, bucket):
-            return web.Response(status=404)
-        return web.Response(status=200)
-
-    async def delete_bucket(self, request, bucket: str) -> web.Response:
-        force = request.headers.get("x-minio-force-delete", "") == "true"
-        # refuse non-empty buckets (cheap check: any object at all)
-        res = await self._run(
-            listing.list_objects, self.store, bucket, "", "", "", 1, True
-        )
-        if (res.objects or res.prefixes) and not force:
-            raise s3err.BucketNotEmpty
-        await self._run(self.store.delete_bucket, bucket, force or bool(res.objects))
-        self.buckets.drop(bucket)
-        if self.site.enabled:
-            await self._run(self.site.sync_bucket_delete, bucket)
-        return web.Response(status=204)
-
-    async def get_bucket_location(self, request, bucket: str) -> web.Response:
-        if not await self._run(self.store.bucket_exists, bucket):
-            raise s3err.NoSuchBucket
-        xml = (
-            '<?xml version="1.0" encoding="UTF-8"?>'
-            f'<LocationConstraint xmlns="http://s3.amazonaws.com/doc/2006-03-01/">{self.region}</LocationConstraint>'
-        )
-        return web.Response(body=xml.encode(), content_type="application/xml")
-
-    async def get_bucket_versioning(self, request, bucket: str) -> web.Response:
-        if not await self._run(self.store.bucket_exists, bucket):
-            raise s3err.NoSuchBucket
-        bm = self.buckets.get(bucket)
-        inner = ""
-        if bm.versioning:
-            inner = "<Status>Enabled</Status>"
-        elif bm.versioning_suspended:
-            inner = "<Status>Suspended</Status>"
-        xml = (
-            '<?xml version="1.0" encoding="UTF-8"?>'
-            f'<VersioningConfiguration xmlns="http://s3.amazonaws.com/doc/2006-03-01/">{inner}</VersioningConfiguration>'
-        )
-        return web.Response(body=xml.encode(), content_type="application/xml")
-
-    async def put_bucket_versioning(self, request, bucket: str, body: bytes) -> web.Response:
-        if not await self._run(self.store.bucket_exists, bucket):
-            raise s3err.NoSuchBucket
-        try:
-            root = ET.fromstring(body)
-            status = ""
-            for el in root.iter():
-                if el.tag.endswith("Status"):
-                    status = el.text or ""
-        except ET.ParseError:
-            raise s3err.MalformedXML from None
-        bm = self.buckets.get(bucket)
-        if bm.object_lock and status != "Enabled":
-            # AWS: versioning cannot be suspended on object-lock buckets
-            # (retention would otherwise guard nothing)
-            raise s3err.InvalidBucketState
-        bm.versioning = status == "Enabled"
-        bm.versioning_suspended = status == "Suspended"
-        await self._run(self.buckets.set, bucket, bm)
-        return web.Response(status=200)
-
-    async def get_bucket_simple(self, request, bucket, attr, missing_err) -> web.Response:
-        if not await self._run(self.store.bucket_exists, bucket):
-            raise s3err.NoSuchBucket
-        bm = self.buckets.get(bucket)
-        val = getattr(bm, attr)
-        if not val:
-            if missing_err is None:
-                val = '<?xml version="1.0" encoding="UTF-8"?><NotificationConfiguration/>'
-            else:
-                raise missing_err
-        if isinstance(val, dict):
-            import json
-
-            return web.Response(body=json.dumps(val).encode(), content_type="application/json")
-        return web.Response(body=val.encode() if isinstance(val, str) else val,
-                            content_type="application/xml")
-
-    async def listen_events(self, request, bucket: str) -> web.StreamResponse:
-        """Real-time event firehose (reference
-        cmd/listen-notification-handlers.go)."""
-        import asyncio as _asyncio
-        import json as _json
-        import queue as _queue
-
-        q = request.rel_url.query
-        events = [e for e in q.get("events", "").split(",") if e]
-        ent = self.notifier.subscribe(
-            bucket, q.get("prefix", ""), q.get("suffix", ""), events
-        )
-        resp = web.StreamResponse(headers={"Content-Type": "application/json"})
-        await resp.prepare(request)
-        loop = _asyncio.get_running_loop()
-        try:
-            while True:
-                try:
-                    rec = await loop.run_in_executor(
-                        self._longpoll_pool, ent[0].get, True, 1.0
-                    )
-                except _queue.Empty:
-                    await resp.write(b" \n")  # keep-alive, like the reference
-                    continue
-                await resp.write(
-                    _json.dumps({"Records": [rec]}).encode() + b"\n"
-                )
-        except (ConnectionResetError, _asyncio.CancelledError):
-            pass
-        finally:
-            self.notifier.unsubscribe(ent)
-        return resp
-
-    async def put_bucket_simple(self, request, bucket, attr, body: bytes) -> web.Response:
-        if not await self._run(self.store.bucket_exists, bucket):
-            raise s3err.NoSuchBucket
-        bm = self.buckets.get(bucket)
-        if attr == "notification":
-            try:
-                self.notifier.validate_config(body.decode())
-            except ValueError:
-                raise s3err.InvalidArgument from None
-            except ET.ParseError:
-                raise s3err.MalformedXML from None
-        if attr == "lifecycle":
-            from ..ilm.lifecycle import validate_lifecycle
-
-            try:
-                validate_lifecycle(body.decode())
-            except (ValueError, ET.ParseError):
-                raise s3err.MalformedXML from None
-        if attr == "cors":
-            from . import cors as corsmod
-
-            try:
-                corsmod.parse_bucket_cors(body.decode())
-            except (ValueError, ET.ParseError):
-                raise s3err.MalformedXML from None
-        if attr == "policy":
-            import json
-
-            from ..iam.policy import Policy
-
-            try:
-                doc = json.loads(body)
-                pol = Policy.from_dict(doc)
-            except ValueError:
-                raise s3err.MalformedXML from None
-            except (AttributeError, TypeError):
-                # valid JSON but not policy-shaped (e.g. a list or scalar)
-                raise s3err.MalformedPolicy from None
-            # resource policies must name a Resource per statement — an
-            # omitted Resource would otherwise match every object
-            # (reference validates this at PutBucketPolicy time)
-            if not pol.statements or any(not s.resources for s in pol.statements):
-                raise s3err.MalformedPolicy
-            setattr(bm, attr, doc)
-        else:
-            setattr(bm, attr, body.decode())
-        await self._run(self.buckets.set, bucket, bm)
-        return web.Response(status=200 if attr != "policy" else 204)
-
-    # -- ACL / misc compat surface (reference cmd/acl-handlers.go,
-    # bucket-handlers.go requestPayment/logging/policyStatus) ----------------
-
-    def _owner_id(self) -> str:
-        # deterministic canonical owner id for this deployment (the
-        # reference serves a fixed owner id + "minio" display name)
-        return hashlib.sha256(self.root_user.encode()).hexdigest()
-
-    def _owner_xml(self) -> str:
-        return (
-            f"<Owner><ID>{self._owner_id()}</ID>"
-            f"<DisplayName>minio</DisplayName></Owner>"
-        )
-
-    async def get_acl(self, request, bucket: str, key: str) -> web.Response:
-        """Canned-ACL world: everything is owner FULL_CONTROL (reference
-        GetBucketACLHandler / GetObjectACLHandler)."""
-        if not await self._run(self.store.bucket_exists, bucket):
-            raise s3err.NoSuchBucket
-        if key:
-            # missing objects must 404, same as a GET
-            await self._run(
-                self.store.get_object_info, bucket,
-                listing.encode_dir_object(key),
-                request.rel_url.query.get("versionId", ""),
-            )
-        owner = self._owner_xml()
-        oid = self._owner_id()
-        xml = (
-            '<?xml version="1.0" encoding="UTF-8"?>'
-            '<AccessControlPolicy xmlns="http://s3.amazonaws.com/doc/2006-03-01/">'
-            f"{owner}<AccessControlList><Grant>"
-            '<Grantee xmlns:xsi="http://www.w3.org/2001/XMLSchema-instance" '
-            'xsi:type="CanonicalUser">'
-            f"<ID>{oid}</ID><DisplayName>minio</DisplayName></Grantee>"
-            "<Permission>FULL_CONTROL</Permission></Grant></AccessControlList>"
-            "</AccessControlPolicy>"
-        )
-        return web.Response(body=xml.encode(), content_type="application/xml")
-
-    async def put_acl(self, request, bucket: str, key: str, body: bytes) -> web.Response:
-        """Only the private canned ACL (or an equivalent single
-        FULL_CONTROL grant document) is accepted; anything else is
-        NotImplemented — bucket policies are the access-control system
-        (reference PutBucketACLHandler/PutObjectACLHandler)."""
-        if not await self._run(self.store.bucket_exists, bucket):
-            raise s3err.NoSuchBucket
-        if key:
-            # a missing object must 404, matching the GET side
-            await self._run(
-                self.store.get_object_info, bucket,
-                listing.encode_dir_object(key),
-                request.rel_url.query.get("versionId", ""),
-            )
-        canned = request.headers.get("x-amz-acl", "")
-        if canned:
-            if canned != "private":
-                raise s3err.NotImplemented_
-            return web.Response(status=200)
-        try:
-            root = ET.fromstring(body)
-        except ET.ParseError:
-            raise s3err.MalformedXML from None
-        grants = [el for el in root.iter() if el.tag.split("}")[-1] == "Grant"]
-        if len(grants) != 1:
-            raise s3err.NotImplemented_
-        perm = next(
-            (el.text for el in grants[0] if el.tag.split("}")[-1] == "Permission"),
-            "",
-        )
-        if perm != "FULL_CONTROL":
-            raise s3err.NotImplemented_
-        return web.Response(status=200)
-
-    async def get_policy_status(self, request, bucket: str) -> web.Response:
-        """Whether anonymous requests are allowed by the bucket policy
-        (reference GetBucketPolicyStatusHandler)."""
-        if not await self._run(self.store.bucket_exists, bucket):
-            raise s3err.NoSuchBucket
-        bm = self.buckets.get(bucket)
-        public = False
-        for st in (bm.policy or {}).get("Statement", []):
-            principal = st.get("Principal", "")
-            aws = principal.get("AWS", "") if isinstance(principal, dict) else principal
-            if isinstance(aws, list):
-                aws = "*" if "*" in aws else ""
-            if st.get("Effect") == "Allow" and aws == "*":
-                public = True
-        xml = (
-            '<?xml version="1.0" encoding="UTF-8"?>'
-            '<PolicyStatus xmlns="http://s3.amazonaws.com/doc/2006-03-01/">'
-            f"<IsPublic>{'true' if public else 'false'}</IsPublic></PolicyStatus>"
-        )
-        return web.Response(body=xml.encode(), content_type="application/xml")
-
-    async def get_request_payment(self, request, bucket: str) -> web.Response:
-        if not await self._run(self.store.bucket_exists, bucket):
-            raise s3err.NoSuchBucket
-        xml = (
-            '<?xml version="1.0" encoding="UTF-8"?>'
-            '<RequestPaymentConfiguration xmlns="http://s3.amazonaws.com/doc/2006-03-01/">'
-            "<Payer>BucketOwner</Payer></RequestPaymentConfiguration>"
-        )
-        return web.Response(body=xml.encode(), content_type="application/xml")
-
-    async def put_request_payment(self, request, bucket: str, body: bytes) -> web.Response:
-        if not await self._run(self.store.bucket_exists, bucket):
-            raise s3err.NoSuchBucket
-        if b"Requester" in body:
-            raise s3err.NotImplemented_  # only BucketOwner payment exists
-        return web.Response(status=200)
-
-    async def get_bucket_logging(self, request, bucket: str) -> web.Response:
-        if not await self._run(self.store.bucket_exists, bucket):
-            raise s3err.NoSuchBucket
-        # access logging rides the audit/notification planes; the S3 call
-        # reports it disabled, like the reference
-        xml = (
-            '<?xml version="1.0" encoding="UTF-8"?>'
-            '<BucketLoggingStatus xmlns="http://s3.amazonaws.com/doc/2006-03-01/" />'
-        )
-        return web.Response(body=xml.encode(), content_type="application/xml")
-
-    async def delete_bucket_simple(self, request, bucket, sub) -> web.Response:
-        attr = {"tagging": "tags", "ownershipControls": "ownership"}.get(sub, sub)
-        bm = self.buckets.get(bucket)
-        setattr(bm, attr, None if attr != "tags" else {})
-        await self._run(self.buckets.set, bucket, bm)
-        return web.Response(status=204)
-
-    # -- listing ---------------------------------------------------------------
-
-    async def list_objects(self, request, bucket: str) -> web.Response:
-        q = request.rel_url.query
-        v2 = q.get("list-type") == "2"
-        url_encode = q.get("encoding-type") == "url"
-        prefix = q.get("prefix", "")
-        delimiter = q.get("delimiter", "")
-        try:
-            max_keys = int(q.get("max-keys", "1000"))
-        except ValueError:
-            raise s3err.InvalidMaxKeys from None
-        if v2:
-            marker = q.get("continuation-token", "") or q.get("start-after", "")
-        else:
-            marker = q.get("marker", "")
-        res = await self._run(
-            listing.list_objects, self.store, bucket, prefix, marker, delimiter, max_keys
-        )
-        def enc(s: str) -> str:
-            # encoding-type=url: keys percent-encoded so control chars in
-            # names survive XML (reference s3EncodeName)
-            return urllib.parse.quote(s, safe="/") if url_encode else escape(s)
-
-        contents = "".join(
-            f"<Contents><Key>{enc(o.name)}</Key>"
-            f"<LastModified>{_iso8601(o.mod_time)}</LastModified>"
-            f'<ETag>"{o.etag}"</ETag><Size>{o.size}</Size>'
-            f"<StorageClass>STANDARD</StorageClass></Contents>"
-            for o in res.objects
-        )
-        prefixes = "".join(
-            f"<CommonPrefixes><Prefix>{enc(p)}</Prefix></CommonPrefixes>"
-            for p in res.prefixes
-        )
-        common = (
-            f"<Name>{escape(bucket)}</Name><Prefix>{enc(prefix)}</Prefix>"
-            f"<MaxKeys>{max_keys}</MaxKeys>"
-            f"<Delimiter>{escape(delimiter)}</Delimiter>"
-            + ("<EncodingType>url</EncodingType>" if url_encode else "")
-            + f"<IsTruncated>{'true' if res.is_truncated else 'false'}</IsTruncated>"
-        )
-        if v2:
-            extra = f"<KeyCount>{len(res.objects) + len(res.prefixes)}</KeyCount>"
-            if res.is_truncated:
-                extra += f"<NextContinuationToken>{enc(res.next_marker)}</NextContinuationToken>"
-            xml = (
-                '<?xml version="1.0" encoding="UTF-8"?>'
-                '<ListBucketResult xmlns="http://s3.amazonaws.com/doc/2006-03-01/">'
-                f"{common}{extra}{contents}{prefixes}</ListBucketResult>"
-            )
-        else:
-            extra = ""
-            if res.is_truncated:
-                extra = f"<NextMarker>{enc(res.next_marker)}</NextMarker>"
-            xml = (
-                '<?xml version="1.0" encoding="UTF-8"?>'
-                '<ListBucketResult xmlns="http://s3.amazonaws.com/doc/2006-03-01/">'
-                f"{common}{extra}{contents}{prefixes}</ListBucketResult>"
-            )
-        return web.Response(body=xml.encode(), content_type="application/xml")
-
-    async def list_object_versions(self, request, bucket: str) -> web.Response:
-        q = request.rel_url.query
-        prefix = q.get("prefix", "")
-        delimiter = q.get("delimiter", "")
-        max_keys = int(q.get("max-keys", "1000"))
-        marker = q.get("key-marker", "")
-        vmarker = q.get("version-id-marker", "")
-        res = await self._run(
-            listing.list_objects,
-            self.store,
-            bucket,
-            prefix,
-            marker,
-            delimiter,
-            max_keys,
-            True,
-            vmarker,
-        )
-        body = []
-        for o in res.objects:
-            vid = o.version_id or "null"
-            tag = "DeleteMarker" if o.delete_marker else "Version"
-            entry = (
-                f"<{tag}><Key>{escape(o.name)}</Key><VersionId>{vid}</VersionId>"
-                f"<IsLatest>{'true' if o.is_latest else 'false'}</IsLatest>"
-                f"<LastModified>{_iso8601(o.mod_time)}</LastModified>"
-            )
-            if not o.delete_marker:
-                entry += f'<ETag>"{o.etag}"</ETag><Size>{o.size}</Size><StorageClass>STANDARD</StorageClass>'
-            entry += f"</{tag}>"
-            body.append(entry)
-        prefixes = "".join(
-            f"<CommonPrefixes><Prefix>{escape(p)}</Prefix></CommonPrefixes>"
-            for p in res.prefixes
-        )
-        xml = (
-            '<?xml version="1.0" encoding="UTF-8"?>'
-            '<ListVersionsResult xmlns="http://s3.amazonaws.com/doc/2006-03-01/">'
-            f"<Name>{escape(bucket)}</Name><Prefix>{escape(prefix)}</Prefix>"
-            f"<MaxKeys>{max_keys}</MaxKeys>"
-            f"<IsTruncated>{'true' if res.is_truncated else 'false'}</IsTruncated>"
-            f"{''.join(body)}{prefixes}</ListVersionsResult>"
-        )
-        return web.Response(body=xml.encode(), content_type="application/xml")
-
-    # -- objects ---------------------------------------------------------------
-
-    def _parity_for_storage_class(self, request) -> int | None:
-        """Per-request EC parity from x-amz-storage-class (reference
-        cmd/erasure-object.go:1299 + internal/config/storageclass):
-        STANDARD uses MINIO_STORAGE_CLASS_STANDARD when set,
-        REDUCED_REDUNDANCY uses MINIO_STORAGE_CLASS_RRS (default EC:2).
-        Unknown classes (e.g. tier names) keep the set default."""
-        sc = request.headers.get("x-amz-storage-class", "")
-        if not sc or sc == "STANDARD":
-            spec = os.environ.get("MINIO_STORAGE_CLASS_STANDARD", "")
-        elif sc == "REDUCED_REDUNDANCY":
-            spec = os.environ.get("MINIO_STORAGE_CLASS_RRS", "EC:2")
-        else:
-            return None
-        if not spec.startswith("EC:"):
-            return None
-        try:
-            p = int(spec[3:])
-        except ValueError:
-            return None
-        n = getattr(self.store, "n", 0)
-        if n < 2:
-            return None
-        return max(1, min(p, n // 2))
-
-    async def _proxy_get_remote(self, request, bucket, key, vid=""):
-        """Serve a not-yet-replicated object from a replication target.
-
-        Returns None when no target has it (or proxying is disabled /
-        this request already IS a proxy — loop breaker). Streams the
-        remote body chunk by chunk — a lagging multi-GB object must not
-        be buffered whole per request."""
-        if request.headers.get("x-minio-source-proxy-request") == "true":
-            return None
-        if os.environ.get("MINIO_TPU_REPLICATION_PROXY", "on") == "off":
-            return None
-        if not self.buckets.get(bucket).versioning:
-            # the reference requires versioning for replication; without it
-            # a hard delete leaves no local trace and proxying would
-            # resurrect deleted objects
-            return None
-        targets = self.repl_targets.list(bucket)
-        if not targets:
-            return None
-        # only proxy when the object has NO local trace: a local delete
-        # marker (or any version) means the 404 is authoritative — proxying
-        # would resurrect deleted objects from a lagging peer
-        try:
-            if await self._run(self.store.list_object_versions, bucket, key):
-                return None
-        except Exception:  # noqa: BLE001
-            return None
-        hdrs = {"x-minio-source-proxy-request": "true"}
-        rng = request.headers.get("Range")
-        if rng:
-            hdrs["Range"] = rng
-
-        import http.client as _hc
-
-        from .signature import sign_request
-
-        def open_remote():
-            """(status, resp-headers, http response) from the first target
-            that has the object, None otherwise."""
-            q = f"?versionId={urllib.parse.quote(vid)}" if vid else ""
-            for t in targets:
-                try:
-                    path = "/" + t.target_bucket + "/" + urllib.parse.quote(key, safe="/~-._") + q
-                    url = f"http://{t.endpoint.split('//')[-1]}{path}"
-                    signed = sign_request(
-                        "GET", url, dict(hdrs), "UNSIGNED-PAYLOAD",
-                        t.access_key, t.secret_key, self.region,
-                    )
-                    host = t.endpoint.split("//")[-1]
-                    conn = _hc.HTTPConnection(host, timeout=30)
-                    conn.request("GET", path, headers=signed)
-                    resp = conn.getresponse()
-                    if resp.status in (200, 206):
-                        return resp
-                    resp.read()
-                    conn.close()
-                except Exception:  # noqa: BLE001 — peer down: try the next
-                    continue
-            return None
-
-        resp = await self._run(open_remote)
-        if resp is None:
-            return None
-        out_headers = {
-            k.lower(): v for k, v in resp.getheaders()
-            if k.lower() in ("etag", "last-modified", "content-type",
-                             "content-range", "content-length",
-                             "x-amz-version-id")
-            or k.lower().startswith("x-amz-meta-")
-        }
-        sresp = web.StreamResponse(status=resp.status, headers=out_headers)
-        await sresp.prepare(request)
-        loop = asyncio.get_running_loop()
-        try:
-            while True:
-                chunk = await loop.run_in_executor(
-                    self._io_pool, resp.read, 1 << 20
-                )
-                if not chunk:
-                    break
-                await sresp.write(chunk)
-        finally:
-            resp.close()
-        await sresp.write_eof()
-        return sresp
-
-    async def _get_from_tier(self, request, bucket, key, oi) -> web.StreamResponse:
-        """Read-through GET of a transitioned object: bytes come from the
-        warm tier (reference streams transitioned objects from the tier
-        the same way, cmd/bucket-lifecycle.go getTransitionedObjectReader).
-        """
-        from ..ilm import tier as tiermod
-
-        tname = oi.user_defined.get(tiermod.TRANSITION_TIER_META, "")
-        rkey = oi.user_defined.get(tiermod.TRANSITION_KEY_META, "")
-        t = self.tiers.get(tname)
-        if t is None:
-            raise s3err.InternalError
-        self._check_preconditions(request, oi)
-        hdrs = {}
-        rng = self._parse_range(request, oi.size) if oi.size else None
-        if rng:
-            hdrs["Range"] = f"bytes={rng[0]}-{rng[1]}"
-
-        def fetch():
-            r = t.client().get_object(t.bucket, rkey, headers=hdrs)
-            if r.status not in (200, 206):
-                raise RuntimeError(f"tier read failed: HTTP {r.status}")
-            return r.body
-
-        body = await self._run(fetch)
-        headers = self._obj_headers(oi)
-        headers["x-amz-storage-class"] = tname
-        if rng:
-            start, end = rng
-            if len(body) == oi.size:
-                # tier ignored the Range header: slice locally rather than
-                # serving the whole object mislabeled as a range
-                body = body[start:end + 1]
-            headers["Content-Range"] = f"bytes {start}-{end}/{oi.size}"
-            return web.Response(status=206, body=body, headers=headers)
-        return web.Response(status=200, body=body, headers=headers)
-
-    async def restore_object(self, request, bucket: str, key: str, body: bytes) -> web.Response:
-        """POST /bucket/key?restore — bring a transitioned object's data
-        back locally for N days (reference RestoreObjectHandler)."""
-        from ..ilm import tier as tiermod
-
-        key = listing.encode_dir_object(key)
-        days = 1
-        if body:
-            try:
-                root = ET.fromstring(body)
-                for el in root.iter():
-                    if el.tag.split("}")[-1] == "Days" and el.text:
-                        days = max(1, int(el.text))
-            except ET.ParseError:
-                raise s3err.MalformedXML from None
-        oi = await self._run(self.store.get_object_info, bucket, key)
-        if not tiermod.is_transitioned(oi.user_defined):
-            raise s3err.InvalidObjectState
-        if _restored_locally(oi):
-            return web.Response(status=200)  # already restored
-        tname = oi.user_defined.get(tiermod.TRANSITION_TIER_META, "")
-        rkey = oi.user_defined.get(tiermod.TRANSITION_KEY_META, "")
-        t = self.tiers.get(tname)
-        if t is None:
-            raise s3err.InternalError
-
-        def pull_and_restore():
-            r = t.client().get_object(t.bucket, rkey)
-            if r.status != 200:
-                raise RuntimeError(f"tier read failed: HTTP {r.status}")
-            self.store.restore_object(bucket, key, r.body, days)
-
-        await self._run(pull_and_restore)
-        return web.Response(status=202)
-
-    def _obj_headers(self, oi: ObjectInfo) -> dict[str, str]:
-        from ..crypto import sse as ssemod
-
-        h = {
-            "ETag": f'"{oi.etag}"',
-            "Last-Modified": _http_date(oi.mod_time),
-            "Accept-Ranges": "bytes",
-            "Content-Type": oi.content_type or "application/octet-stream",
-        }
-        if oi.version_id:
-            h["x-amz-version-id"] = oi.version_id
-        for k, v in oi.user_defined.items():
-            if k.startswith("x-amz-meta-") or k in ("cache-control", "content-disposition", "content-encoding", "content-language", "expires"):
-                h[k] = v
-        from ..utils import checksum as _cks
-
-        for calgo in _cks.ALGOS:
-            v = oi.user_defined.get(f"{_cks.META_PREFIX}{calgo}")
-            if v:
-                h[f"x-amz-checksum-{calgo}"] = v
-        raw_tags = oi.user_defined.get(self.TAGS_META)
-        if raw_tags:
-            h["x-amz-tagging-count"] = str(
-                len(urllib.parse.parse_qsl(raw_tags, keep_blank_values=True))
-            )
-        from ..ilm import tier as tiermod
-
-        tname = oi.user_defined.get(tiermod.TRANSITION_TIER_META)
-        if tname:
-            h["x-amz-storage-class"] = tname
-            if _restored_locally(oi):
-                exp = float(oi.user_defined[tiermod.RESTORE_EXPIRY_META])
-                h["x-amz-restore"] = (
-                    'ongoing-request="false", expiry-date="'
-                    + _http_date(int(exp * 1e9)) + '"'
-                )
-        algo = oi.user_defined.get(ssemod.META_ALGO)
-        if algo == "SSE-S3":
-            h["x-amz-server-side-encryption"] = "AES256"
-        elif algo == "SSE-KMS":
-            h["x-amz-server-side-encryption"] = "aws:kms"
-            h["x-amz-server-side-encryption-aws-kms-key-id"] = oi.user_defined.get(
-                ssemod.META_KMS_KEY_ID, ""
-            )
-        elif algo == "SSE-C":
-            h["x-amz-server-side-encryption-customer-algorithm"] = "AES256"
-            h["x-amz-server-side-encryption-customer-key-MD5"] = oi.user_defined.get(
-                ssemod.META_SSEC_KEY_MD5, ""
-            )
-        return h
-
-    @staticmethod
-    def _eval_preconditions(headers, oi: ObjectInfo, prefix: str, none_match_err) -> None:
-        """Shared If-Match/If-None-Match/If-(Un)Modified-Since evaluation.
-        Header precedence follows RFC 7232 (and AWS's documented copy
-        combinations): an If-Match that evaluates TRUE suppresses
-        If-Unmodified-Since, and a present If-None-Match suppresses
-        If-Modified-Since. GET/HEAD use the bare names with 304 on the
-        None-Match side; CopyObject/UploadPartCopy use the
-        x-amz-copy-source-if-* set where every failure is 412
-        (cmd/object-handlers.go checkCopyObjectPreconditions)."""
-        etag = f'"{oi.etag}"'
-        im = headers.get(f"{prefix}If-Match")
-        if im:
-            if im.strip() not in (etag, "*", oi.etag):
-                raise s3err.PreconditionFailed
-        else:
-            ius = headers.get(f"{prefix}If-Unmodified-Since")
-            if ius:
-                try:
-                    t = parsedate_to_datetime(ius)
-                    if oi.mod_time / 1e9 > t.timestamp():
-                        raise s3err.PreconditionFailed
-                except (ValueError, TypeError):
-                    pass
-        inm = headers.get(f"{prefix}If-None-Match")
-        if inm:
-            if inm.strip() in (etag, "*", oi.etag):
-                raise none_match_err
-        else:
-            ims = headers.get(f"{prefix}If-Modified-Since")
-            if ims:
-                try:
-                    t = parsedate_to_datetime(ims)
-                    if oi.mod_time / 1e9 <= t.timestamp():
-                        raise none_match_err
-                except (ValueError, TypeError):
-                    pass
-
-    def _check_preconditions(self, request, oi: ObjectInfo) -> None:
-        self._eval_preconditions(request.headers, oi, "", s3err.NotModified)
-
-    @staticmethod
-    def _incoming_size(request, body: bytes | None) -> int:
-        """Logical size of an incoming write for quota purposes: buffered
-        body length, else the decoded payload length for aws-chunked
-        streams (the wire Content-Length includes chunk framing), else
-        Content-Length."""
-        if body is not None:
-            return len(body)
-        dec = request.headers.get("x-amz-decoded-content-length")
-        if dec:
-            try:
-                return int(dec)
-            except ValueError:
-                pass
-        try:
-            return int(request.headers.get("Content-Length", "0") or 0)
-        except ValueError:
-            return 0
-
-    def _enforce_quota(self, bucket: str, size: int) -> None:
-        """Hard bucket quota on the write path (reference
-        cmd/bucket-quota.go:103-139 enforceBucketQuotaHard): the incoming
-        size plus the scanner-accounted bucket usage must stay under the
-        configured quota. Usage freshness matches the reference: the data
-        scanner's last crawl."""
-        if size < 0:
-            return
-        q = int(self.buckets.get(bucket).quota or 0)
-        if q <= 0:
-            return
-        if size >= q:
-            raise s3err.AdminBucketQuotaExceeded
-        bg = getattr(self, "background", None)
-        usage = bg.usage.buckets.get(bucket) if bg is not None else None
-        if usage and usage.get("size", 0) > 0 and usage["size"] + size >= q:
-            raise s3err.AdminBucketQuotaExceeded
-
-    @staticmethod
-    def _put_precond(request):
-        """Conditional writes (reference checkPreconditionsPUT,
-        cmd/object-handlers.go:2017): If-None-Match: * fails when the key
-        exists; If-Match: <etag> fails unless the CURRENT etag matches.
-        Runs under the namespace write lock inside the erasure layer."""
-        inm = request.headers.get("If-None-Match", "").strip()
-        im = request.headers.get("If-Match", "").strip()
-        if not inm and not im:
-            return None
-
-        def check(cur) -> None:
-            if inm and cur is not None and (
-                inm == "*" or inm in (f'"{cur.etag}"', cur.etag)
-            ):
-                raise s3err.PreconditionFailed
-            if im:
-                if cur is None or im not in ("*", f'"{cur.etag}"', cur.etag):
-                    raise s3err.PreconditionFailed
-
-        return check
-
-    async def put_object(
-        self, request, bucket: str, key: str, body: bytes | None
-    ) -> web.Response:
-        key = listing.encode_dir_object(key)
-        bm = self.buckets.get(bucket)
-        precond = self._put_precond(request)
-        self._enforce_quota(bucket, self._incoming_size(request, body))
-        # overwriting an unversioned transitioned object orphans its warm-
-        # tier data unless swept (reference enforces this via objSweeper)
-        sweep_ud = None if bm.versioning else await self._run(
-            self._tier_sweep_snapshot, bucket, key, ""
-        )
-        from . import transforms
-
-        ct = request.headers.get("Content-Type")
-        if body is None and (
-            _bucket_sse_algo(bm.encryption) or transforms.compression_enabled()
-        ):
-            # a transform needs the whole payload: fall back to buffering
-            # (the body is still unread on the socket)
-            body = await request.read() if request.body_exists else b""
-            if request.headers.get("x-amz-content-sha256") == \
-                    signature.STREAMING_UNSIGNED_TRAILER:
-                # the wire body is aws-chunked: decode + verify trailers
-                # before transforming, or the framing would be stored
-                body = self._decode_trailer_body(request, body)
-        md5_hdr = request.headers.get("Content-MD5")
-        if md5_hdr:
-            import base64
-
-            if base64.b64encode(hashlib.md5(body).digest()).decode() != md5_hdr:
-                raise s3err.BadDigest
-        checksum_meta = _verify_checksum_headers(request.headers, body or b"")
-        # trailers verified during buffered aws-chunked decode persist too
-        checksum_meta.update(request.get("trailer_checksum_meta") or {})
-        user_defined = {}
-        if ct:
-            user_defined["content-type"] = ct
-        for k, v in request.headers.items():
-            lk = k.lower()
-            if lk.startswith("x-amz-meta-") or lk in (
-                "cache-control", "content-disposition", "content-encoding",
-                "content-language", "expires", "x-amz-storage-class",
-            ):
-                user_defined[lk] = v
-        if request.headers.get("x-amz-tagging"):
-            # tag set supplied at PUT time (reference PutObjectHandler
-            # parses x-amz-tagging into the version's tag metadata)
-            user_defined[self.TAGS_META] = self._tagging_header_meta(
-                request.headers["x-amz-tagging"]
-            )
-        if body is None:
-            # streaming path: body flows HTTP -> erasure encode -> drives
-            user_defined.update(checksum_meta)
-            sc_parity = self._parity_for_storage_class(request)
-            oi = await self._run_streaming_put(
-                request,
-                lambda rd: self.store.put_object(
-                    bucket, key, rd, user_defined, None, bm.versioning,
-                    parity=sc_parity, check_precond=precond,
-                ),
-            )
-            headers = {"ETag": f'"{oi.etag}"'}
-            tr = request.get("trailer_checksum_meta")
-            if tr:
-                # verified trailer checksum: persist + echo (reference
-                # internal/hash checksum trailers)
-                await self._run(
-                    self.store.update_object_metadata, bucket, key,
-                    oi.version_id, lambda md: md.update(tr),
-                )
-                for mk, mv in tr.items():
-                    headers[mk.replace("x-minio-internal-", "x-amz-")] = mv
-            if oi.version_id:
-                headers["x-amz-version-id"] = oi.version_id
-            from ..events import notify as ev
-
-            self.notifier.notify(
-                ev.OBJECT_CREATED_PUT, bucket, listing.decode_dir_object(key),
-                oi.size, oi.etag, oi.version_id, request.get("access_key", ""),
-            )
-            self._queue_repl(request, bucket, key, oi.version_id, "put")
-            await self._tier_sweep(sweep_ud)
-            return web.Response(status=200, headers=headers)
-        # transparent compression + server-side encryption
-        req_headers = {k.lower(): v for k, v in request.headers.items()}
-        try:
-            tr = transforms.encode_for_store(
-                body, key, ct or "", req_headers,
-                _bucket_sse_algo(bm.encryption), self.kms, bucket,
-            )
-        except Exception as e:
-            from ..crypto.sse import CryptoError
-
-            if isinstance(e, CryptoError):
-                raise s3err.InvalidArgument from None
-            raise
-        if tr.metadata:
-            user_defined.update(tr.metadata)
-            body = tr.data
-        user_defined.update(checksum_meta)
-        oi = await self._run(
-            lambda: self.store.put_object(
-                bucket, key, body, user_defined, None, bm.versioning,
-                parity=self._parity_for_storage_class(request),
-                check_precond=precond,
-            )
-        )
-        headers = {"ETag": f'"{oi.etag}"'}
-        headers.update(tr.response_headers)
-        for k, v in checksum_meta.items():
-            headers[k.replace("x-minio-internal-", "x-amz-")] = v
-        if oi.version_id:
-            headers["x-amz-version-id"] = oi.version_id
-        from ..events import notify as ev
-
-        self.notifier.notify(
-            ev.OBJECT_CREATED_PUT, bucket, listing.decode_dir_object(key),
-            oi.size, oi.etag, oi.version_id, request.get("access_key", ""),
-        )
-        self._queue_repl(request, bucket, key, oi.version_id, "put")
-        await self._tier_sweep(sweep_ud)
-        return web.Response(status=200, headers=headers)
-
-    def _tier_sweep_snapshot(self, bucket: str, key: str, vid: str) -> dict | None:
-        """Pre-delete/overwrite snapshot of a transitioned version's tier
-        pointers (reference cmd/tier-sweeper.go newObjSweeper +
-        SetTransitionState): returns the metadata needed to sweep the
-        warm tier after the local version goes away, or None.
-
-        vid == "" means the NULL version (what an unversioned/suspended
-        write or delete actually replaces) — NOT the latest: on a
-        versioning-suspended bucket the latest may be a surviving named
-        version whose warm data must not be swept."""
-        from ..ilm import tier as tiermod
-
-        if not self.tiers.list():
-            return None  # no tiers configured: nothing to sweep, zero cost
-        try:
-            if vid:
-                oi = self.store.get_object_info(bucket, key, vid)
-            else:
-                oi = next(
-                    (v for v in self.store.list_object_versions(bucket, key)
-                     if not v.version_id),
-                    None,
-                )
-                if oi is None:
-                    return None  # no null version to replace
-        except Exception:  # noqa: BLE001 — no prior version
-            return None
-        if getattr(oi, "delete_marker", False) or not tiermod.is_transitioned(
-            oi.user_defined
-        ):
-            return None
-        return dict(oi.user_defined)
-
-    async def _tier_sweep(self, sweep_ud: dict | None) -> None:
-        """Fire-and-forget: the remote delete (5s timeouts when the tier is
-        down) must not hold up the S3 response; failures land in the
-        persisted journal the scanner retries (the reference routes all
-        sweeps through its async tier journal for the same reason)."""
-        if sweep_ud:
-            from ..ilm import tier as tiermod
-
-            asyncio.get_running_loop().run_in_executor(
-                self._io_pool, tiermod.sweep_remote, self.tiers, sweep_ud
-            )
-
-    def _parse_copy_source(self, request, access_key: str) -> tuple[str, str, str]:
-        """Parse x-amz-copy-source and AUTHORIZE the read on it — the
-        destination PutObject grant must not leak other buckets (or IAM
-        records under .minio.sys) through the copy path."""
-        src = urllib.parse.unquote(request.headers["x-amz-copy-source"])
-        if src.startswith("/"):
-            src = src[1:]
-        src_vid = ""
-        if "?versionId=" in src:
-            src, src_vid = src.split("?versionId=", 1)
-        if "/" not in src:
-            raise s3err.InvalidArgument
-        src_bucket, src_key = src.split("/", 1)
-        if src_bucket.startswith(".minio.sys") or not src_key:
-            raise s3err.AccessDenied
-        src_key = listing.encode_dir_object(src_key)
-        action = "s3:GetObjectVersion" if src_vid else "s3:GetObject"
-        self._authorize(access_key, action, src_bucket, src_key)
-        return src_bucket, src_key, src_vid
-
-    def _check_copy_preconditions(self, request, oi: ObjectInfo) -> None:
-        self._eval_preconditions(
-            request.headers, oi, "x-amz-copy-source-", s3err.PreconditionFailed
-        )
-
-    async def copy_object(self, request, bucket: str, key: str) -> web.Response:
-        from ..crypto.sse import CryptoError
-        from . import transforms
-
-        src_bucket, src_key, src_vid = self._parse_copy_source(
-            request, request.get("access_key", "")
-        )
-        oi, handle = await self._run(
-            self.store.open_object, src_bucket, src_key, src_vid
-        )
-        from .transforms import logical_size as _logical
-
-        try:
-            # pre-read failures (412, quota) must release the source
-            # namespace read lock immediately, not wait out the lock TTL
-            self._check_copy_preconditions(request, oi)
-            self._enforce_quota(bucket, _logical(oi.user_defined, oi.size))
-            data = await self._run(lambda: b"".join(handle.read(0, -1)))
-        finally:
-            handle.close()
-        req_headers = {k.lower(): v for k, v in request.headers.items()}
-        # decode the SOURCE pipeline: sealed keys are bound to the source
-        # bucket/key context and must never be copied verbatim
-        if transforms.is_transformed(oi.user_defined):
-            src_headers = dict(req_headers)
-            # SSE-C sources present their key under the copy-source header set
-            from ..crypto import sse as ssemod
-
-            for h in ("algorithm", "key", "key-md5"):
-                v = req_headers.get(
-                    f"x-amz-copy-source-server-side-encryption-customer-{h}"
-                )
-                if v:
-                    src_headers[
-                        f"x-amz-server-side-encryption-customer-{h}"
-                    ] = v
-            try:
-                data = await self._run(
-                    transforms.decode_full, data, oi.user_defined, src_headers,
-                    src_bucket, src_key, self.kms,
-                )
-            except CryptoError:
-                raise s3err.AccessDenied from None
-        directive = request.headers.get("x-amz-metadata-directive", "COPY")
-        # copying an object onto itself without changing anything is an
-        # error (reference cmd/object-handlers.go isTargetSameAsSource):
-        # REPLACE directives, new SSE attributes, or a storage-class change
-        # make it a legal metadata update
-        if (
-            src_bucket == bucket
-            and src_key == listing.encode_dir_object(key)
-            and not src_vid
-            and directive != "REPLACE"
-            and request.headers.get("x-amz-tagging-directive", "COPY") != "REPLACE"
-            and not request.headers.get("x-amz-server-side-encryption")
-            and not request.headers.get(
-                "x-amz-server-side-encryption-customer-algorithm"
-            )
-            and not request.headers.get("x-amz-storage-class")
-        ):
-            raise s3err.InvalidCopyDest
-        user_defined = {
-            k: v for k, v in oi.user_defined.items()
-            if not k.startswith("x-minio-internal-")
-        }
-        user_defined["content-type"] = oi.content_type
-        if directive == "REPLACE":
-            user_defined = {
-                k.lower(): v
-                for k, v in request.headers.items()
-                if k.lower().startswith("x-amz-meta-")
-            }
-            if request.headers.get("Content-Type"):
-                user_defined["content-type"] = request.headers["Content-Type"]
-        # tag set travels by its OWN directive, independent of metadata
-        # (reference: x-amz-tagging-directive on CopyObject)
-        if request.headers.get("x-amz-tagging-directive", "COPY") == "REPLACE":
-            user_defined.pop(self.TAGS_META, None)
-            if request.headers.get("x-amz-tagging"):
-                user_defined[self.TAGS_META] = self._tagging_header_meta(
-                    request.headers["x-amz-tagging"]
-                )
-        elif oi.user_defined.get(self.TAGS_META):
-            user_defined[self.TAGS_META] = oi.user_defined[self.TAGS_META]
-        bm = self.buckets.get(bucket)
-        # re-encode for the destination (its SSE headers / bucket default)
-        try:
-            tr = transforms.encode_for_store(
-                data, key, user_defined.get("content-type", ""), req_headers,
-                _bucket_sse_algo(bm.encryption), self.kms, bucket,
-            )
-        except CryptoError:
-            raise s3err.InvalidArgument from None
-        if tr.metadata:
-            user_defined.update(tr.metadata)
-            data = tr.data
-        new_oi = await self._run(
-            self.store.put_object,
-            bucket,
-            listing.encode_dir_object(key),
-            data,
-            user_defined,
-            None,
-            bm.versioning,
-        )
-        xml = (
-            '<?xml version="1.0" encoding="UTF-8"?>'
-            f'<CopyObjectResult><ETag>"{new_oi.etag}"</ETag>'
-            f"<LastModified>{_iso8601(new_oi.mod_time)}</LastModified></CopyObjectResult>"
-        )
-        headers = {}
-        if new_oi.version_id:
-            headers["x-amz-version-id"] = new_oi.version_id
-        from ..events import notify as ev
-
-        self.notifier.notify(
-            ev.OBJECT_CREATED_COPY, bucket, listing.decode_dir_object(key),
-            new_oi.size, new_oi.etag, new_oi.version_id,
-        )
-        self._queue_repl(request, 
-            bucket, listing.encode_dir_object(key), new_oi.version_id, "put"
-        )
-        return web.Response(body=xml.encode(), content_type="application/xml", headers=headers)
-
-    def _parse_range(self, request, size: int) -> tuple[int, int] | None:
-        rng = request.headers.get("Range")
-        if not rng or not rng.startswith("bytes="):
-            return None
-        request["_range_object_size"] = size  # for the 416 Content-Range
-        spec = rng[len("bytes=") :]
-        if "," in spec:
-            raise s3err.NotImplemented_
-        start_s, _, end_s = spec.partition("-")
-        try:
-            if start_s == "":
-                n = int(end_s)
-                if n == 0:
-                    raise s3err.InvalidRange
-                start = max(size - n, 0)
-                end = size - 1
-            else:
-                start = int(start_s)
-                end = int(end_s) if end_s else size - 1
-        except ValueError:
-            return None  # malformed range is ignored per RFC
-        if start >= size or start > end:
-            raise s3err.InvalidRange
-        return start, min(end, size - 1)
-
-    async def get_object(self, request, bucket: str, key: str) -> web.StreamResponse:
-        key = listing.encode_dir_object(key)
-        vid = request.rel_url.query.get("versionId", "")
-        if vid == "null":
-            vid = ""
-        try:
-            oi, handle = await self._run(self.store.open_object, bucket, key, vid)
-        except (quorum.ObjectNotFound, quorum.VersionNotFound):
-            # not (yet) here: replication lag in an active-active pair —
-            # proxy the read to a remote target rather than 404ing
-            # (reference cmd/bucket-replication.go:2334 proxyGetToReplicationTarget)
-            resp = await self._proxy_get_remote(request, bucket, key, vid)
-            if resp is not None:
-                return resp
-            raise
-        from ..ilm import tier as tiermod
-        from . import transforms
-
-        if tiermod.is_transitioned(oi.user_defined) and not _restored_locally(oi):
-            handle.close()
-            return await self._get_from_tier(request, bucket, key, oi)
-        if transforms.is_transformed(oi.user_defined):
-            return await self._get_transformed(request, bucket, key, oi, handle)
-        try:
-            self._check_preconditions(request, oi)
-            rng = self._parse_range(request, oi.size) if oi.size else None
-            headers = self._obj_headers(oi)
-            if rng:
-                start, end = rng
-                it = handle.read(start, end - start + 1)
-                headers["Content-Range"] = f"bytes {start}-{end}/{oi.size}"
-                resp = web.StreamResponse(status=206, headers=headers)
-                resp.content_length = end - start + 1
-            else:
-                it = handle.read()
-                resp = web.StreamResponse(status=200, headers=headers)
-                resp.content_length = oi.size
-        except BaseException:
-            handle.close()  # preconditions/range failures must not leak the rlock
-            raise
-        await resp.prepare(request)
-        loop = asyncio.get_running_loop()
-        sentinel = object()
-        nxt = lambda: next(it, sentinel)  # noqa: E731
-        try:
-            while True:
-                chunk = await loop.run_in_executor(self._io_pool, nxt)
-                if chunk is sentinel:
-                    break
-                await resp.write(chunk)
-        finally:
-            handle.close()  # release the namespace read lock promptly
-        await resp.write_eof()
-        return resp
-
-    async def get_object_attributes(self, request, bucket, key) -> web.Response:
-        """GetObjectAttributes (reference cmd/object-handlers.go:988):
-        ETag/Checksum/ObjectParts/StorageClass/ObjectSize, filtered by the
-        x-amz-object-attributes header."""
-        import json as _json
-
-        from ..utils import checksum as _cks
-
-        key = listing.encode_dir_object(key)
-        vid = request.rel_url.query.get("versionId", "")
-        if vid == "null":
-            vid = ""
-        want = {
-            a.strip() for a in
-            request.headers.get("x-amz-object-attributes", "").split(",") if a.strip()
-        }
-        if not want:
-            raise s3err.InvalidArgument
-        try:
-            oi = await self._run(self.store.get_object_info, bucket, key, vid)
-        except (quorum.ObjectNotFound, quorum.VersionNotFound):
-            raise s3err.NoSuchKey from None
-        if oi.delete_marker:
-            raise s3err.NoSuchKey
-        self._check_preconditions(request, oi)
-        from . import transforms
-        from ..ilm import tier as tiermod
-
-        parts_xml = ""
-        if "ObjectParts" in want:
-            stored = oi.user_defined.get(_cks.PART_CHECKSUMS_META)
-            per_part = _json.loads(stored) if stored else {}
-            if "-" in oi.etag:  # multipart object
-                try:
-                    max_parts = int(
-                        request.rel_url.query.get("max-parts", "1000") or 1000
-                    )
-                    marker = int(
-                        request.rel_url.query.get("part-number-marker", "0") or 0
-                    )
-                except ValueError:
-                    raise s3err.InvalidArgument from None
-                nparts = int(oi.etag.rsplit("-", 1)[-1])
-                body_parts = []
-                emitted = 0
-                for pn in range(1, nparts + 1):
-                    if pn <= marker:
-                        continue
-                    if emitted >= max_parts:
-                        break
-                    cx = "".join(
-                        f"<Checksum{a.upper()}>{escape(v)}</Checksum{a.upper()}>"
-                        for a, v in per_part.get(str(pn), {}).items()
-                    )
-                    body_parts.append(f"<Part><PartNumber>{pn}</PartNumber>{cx}</Part>")
-                    emitted += 1
-                parts_xml = (
-                    f"<ObjectParts><TotalPartsCount>{nparts}</TotalPartsCount>"
-                    f"<PartNumberMarker>{marker}</PartNumberMarker>"
-                    f"<MaxParts>{max_parts}</MaxParts>"
-                    f"<IsTruncated>{'true' if marker + emitted < nparts else 'false'}"
-                    f"</IsTruncated>" + "".join(body_parts) + "</ObjectParts>"
-                )
-        cks_xml = ""
-        if "Checksum" in want:
-            fields = []
-            for algo in _cks.ALGOS:
-                v = oi.user_defined.get(f"{_cks.META_PREFIX}{algo}")
-                if v:
-                    tag = "Checksum" + algo.upper()
-                    fields.append(f"<{tag}>{escape(v)}</{tag}>")
-            if fields:
-                cks_xml = "<Checksum>" + "".join(fields) + "</Checksum>"
-        etag_xml = f"<ETag>{escape(oi.etag)}</ETag>" if "ETag" in want else ""
-        size_xml = (
-            f"<ObjectSize>{transforms.logical_size(oi.user_defined, oi.size)}"
-            "</ObjectSize>" if "ObjectSize" in want else ""
-        )
-        sc = oi.user_defined.get(tiermod.TRANSITION_TIER_META) or \
-            oi.user_defined.get("x-amz-storage-class", "STANDARD")
-        sc_xml = (
-            f"<StorageClass>{escape(sc)}</StorageClass>"
-            if "StorageClass" in want else ""
-        )
-        xml = (
-            '<?xml version="1.0" encoding="UTF-8"?>'
-            '<GetObjectAttributesResponse xmlns='
-            '"http://s3.amazonaws.com/doc/2006-03-01/">'
-            + etag_xml + cks_xml + parts_xml + sc_xml + size_xml
-            + "</GetObjectAttributesResponse>"
-        )
-        headers = {"Last-Modified": _http_date(oi.mod_time)}
-        if oi.version_id:
-            headers["x-amz-version-id"] = oi.version_id
-        return web.Response(
-            body=xml.encode(), content_type="application/xml", headers=headers
-        )
-
-    async def _get_transformed(self, request, bucket, key, oi, handle) -> web.Response:
-        """GET for compressed/encrypted objects: decode through the
-        transform pipeline (ranges map to packets for SSE-only)."""
-        from ..crypto.sse import CryptoError
-        from . import transforms
-
-        try:
-            self._check_preconditions(request, oi)
-            logical = transforms.logical_size(oi.user_defined, oi.size)
-            rng = self._parse_range(request, logical) if logical else None
-            req_headers = {k.lower(): v for k, v in request.headers.items()}
-
-            def read_fn(off, ln):
-                # multiple per-part range reads over ONE handle: the outer
-                # finally owns the close, each read must keep the lock
-                return b"".join(handle.read(off, ln, close_when_done=False))
-
-            def decode():
-                if rng:
-                    start, end = rng
-                    return transforms.decode_range(
-                        read_fn, oi.size, oi.user_defined, req_headers,
-                        bucket, key, self.kms, start, end - start + 1,
-                    )
-                return transforms.decode_full(
-                    read_fn(0, oi.size), oi.user_defined, req_headers,
-                    bucket, key, self.kms,
-                )
-
-            try:
-                data = await self._run(decode)
-            except CryptoError:
-                raise s3err.AccessDenied from None
-            headers = self._obj_headers(oi)
-            if rng:
-                start, end = rng
-                headers["Content-Range"] = f"bytes {start}-{end}/{logical}"
-                return web.Response(status=206, headers=headers, body=data)
-            return web.Response(status=200, headers=headers, body=data)
-        finally:
-            handle.close()
-
-    async def head_object(self, request, bucket: str, key: str) -> web.Response:
-        key = listing.encode_dir_object(key)
-        vid = request.rel_url.query.get("versionId", "")
-        if vid == "null":
-            vid = ""
-        oi = await self._run(self.store.get_object_info, bucket, key, vid)
-        if oi.delete_marker:
-            return web.Response(status=405, headers={"x-amz-delete-marker": "true"})
-        self._check_preconditions(request, oi)
-        from . import transforms
-
-        headers = self._obj_headers(oi)
-        headers["Content-Length"] = str(transforms.logical_size(oi.user_defined, oi.size))
-        return web.Response(status=200, headers=headers)
-
-    async def delete_object(self, request, bucket: str, key: str) -> web.Response:
-        key = listing.encode_dir_object(key)
-        vid = request.rel_url.query.get("versionId", "")
-        if vid == "null":
-            vid = ""
-        bm = self.buckets.get(bucket)
-        headers = {}
-        await self._run(
-            self._check_object_lock, bucket, key, vid,
-            # the IAM resource must use the CLIENT's key form, matching the
-            # raw key the multi-delete path passes
-            self._bypass_governance(
-                request, bucket, listing.decode_dir_object(key)
-            ),
-        )
-        # deleting a version (or the sole unversioned copy) of a
-        # transitioned object must sweep its warm-tier data (tier GC)
-        sweep_ud = None
-        if vid or not bm.versioning:
-            sweep_ud = await self._run(self._tier_sweep_snapshot, bucket, key, vid)
-        try:
-            oi = await self._run(
-                self.store.delete_object, bucket, key, vid, bm.versioning
-            )
-            if not oi.delete_marker:
-                await self._tier_sweep(sweep_ud)
-            if oi.delete_marker:
-                headers["x-amz-delete-marker"] = "true"
-            if oi.version_id:
-                headers["x-amz-version-id"] = oi.version_id
-            from ..events import notify as ev
-
-            self.notifier.notify(
-                ev.OBJECT_REMOVED_MARKER if oi.delete_marker else ev.OBJECT_REMOVED_DELETE,
-                bucket, listing.decode_dir_object(key),
-                version_id=oi.version_id, user=request.get("access_key", ""),
-            )
-            if not vid:
-                # only logical deletes replicate; removing a SPECIFIC old
-                # version must never delete the replica's live object
-                self._queue_repl(request, bucket, key, "", "delete")
-        except (quorum.ObjectNotFound, quorum.VersionNotFound):
-            pass  # S3 deletes are idempotent
-        return web.Response(status=204, headers=headers)
-
-    async def delete_multiple(self, request, bucket: str, body: bytes) -> web.Response:
-        try:
-            root = ET.fromstring(body)
-        except ET.ParseError:
-            raise s3err.MalformedXML from None
-        quiet = False
-        targets = []
-        for el in root:
-            tag = el.tag.split("}")[-1]
-            if tag == "Quiet":
-                quiet = (el.text or "").lower() == "true"
-            elif tag == "Object":
-                k, v = "", ""
-                for sub in el:
-                    stag = sub.tag.split("}")[-1]
-                    if stag == "Key":
-                        k = sub.text or ""
-                    elif stag == "VersionId":
-                        v = sub.text or ""
-                targets.append((k, v))
-        bm = self.buckets.get(bucket)
-        ak = request.get("access_key", "")
-        results = []
-        for k, v in targets[:1000]:
-            # per-object authorization: a Deny on a key prefix must hold
-            # through multi-delete exactly as through single DELETE
-            try:
-                self._authorize(
-                    ak,
-                    "s3:DeleteObjectVersion" if v else "s3:DeleteObject",
-                    bucket,
-                    k,
-                )
-            except s3err.APIError:
-                results.append((k, v, s3err.AccessDenied, None))
-                continue
-            try:
-                # retention/legal hold protects versions through
-                # multi-delete exactly as through single DELETE
-                # (including the governance-bypass header)
-                await self._run(
-                    self._check_object_lock, bucket,
-                    listing.encode_dir_object(k), "" if v == "null" else v,
-                    self._bypass_governance(request, bucket, k),
-                )
-                vv = "" if v == "null" else v
-                sweep_ud = None
-                if vv or not bm.versioning:  # this delete removes data
-                    sweep_ud = await self._run(
-                        self._tier_sweep_snapshot, bucket,
-                        listing.encode_dir_object(k), vv,
-                    )
-                oi = await self._run(
-                    self.store.delete_object,
-                    bucket,
-                    listing.encode_dir_object(k),
-                    vv,
-                    bm.versioning,
-                )
-                if not oi.delete_marker:
-                    await self._tier_sweep(sweep_ud)
-                results.append((k, v, None, oi))
-            except (quorum.ObjectNotFound, quorum.VersionNotFound):
-                results.append((k, v, None, None))
-            except s3err.APIError as e:
-                results.append((k, v, e, None))  # e.g. retention AccessDenied
-            except Exception:  # noqa: BLE001
-                results.append((k, v, s3err.InternalError, None))
-        parts = []
-        for k, v, err, oi in results:
-            if err is None:
-                if not quiet:
-                    e = f"<Deleted><Key>{escape(k)}</Key>"
-                    if v:
-                        e += f"<VersionId>{escape(v)}</VersionId>"
-                    if oi is not None and oi.delete_marker and oi.version_id:
-                        e += f"<DeleteMarker>true</DeleteMarker><DeleteMarkerVersionId>{oi.version_id}</DeleteMarkerVersionId>"
-                    parts.append(e + "</Deleted>")
-            else:
-                parts.append(
-                    f"<Error><Key>{escape(k)}</Key><Code>{err.code}</Code>"
-                    f"<Message>{escape(err.description)}</Message></Error>"
-                )
-        xml = (
-            '<?xml version="1.0" encoding="UTF-8"?>'
-            '<DeleteResult xmlns="http://s3.amazonaws.com/doc/2006-03-01/">'
-            f"{''.join(parts)}</DeleteResult>"
-        )
-        return web.Response(body=xml.encode(), content_type="application/xml")
-
-    # -- multipart -------------------------------------------------------------
-
-    async def new_multipart(self, request, bucket, key) -> web.Response:
-        from ..crypto.sse import CryptoError
-        from . import transforms
-
-        bm = self.buckets.get(bucket)
-        key = listing.encode_dir_object(key)
-        user_defined = {}
-        if request.headers.get("Content-Type"):
-            user_defined["content-type"] = request.headers["Content-Type"]
-        for k, v in request.headers.items():
-            if k.lower().startswith("x-amz-meta-"):
-                user_defined[k.lower()] = v
-        if request.headers.get("x-amz-tagging"):
-            user_defined[self.TAGS_META] = self._tagging_header_meta(
-                request.headers["x-amz-tagging"]
-            )
-        sse_resp: dict[str, str] = {}
-        try:
-            req_headers = {k.lower(): v for k, v in request.headers.items()}
-            sse = transforms.multipart_sse_init(
-                req_headers, _bucket_sse_algo(bm.encryption), self.kms,
-                bucket, key,
-            )
-        except CryptoError:
-            # SSE-C multipart needs the customer key on every part read —
-            # refuse loudly rather than silently storing plaintext
-            raise s3err.NotImplemented_ from None
-        if sse is not None:
-            sse_meta, sse_resp = sse
-            user_defined.update(sse_meta)
-        upload_id = await self._run(
-            self.mp.new_upload, bucket, key, user_defined,
-            self._parity_for_storage_class(request)
-        )
-        xml = (
-            '<?xml version="1.0" encoding="UTF-8"?>'
-            '<InitiateMultipartUploadResult xmlns="http://s3.amazonaws.com/doc/2006-03-01/">'
-            f"<Bucket>{escape(bucket)}</Bucket><Key>{escape(key)}</Key>"
-            f"<UploadId>{upload_id}</UploadId></InitiateMultipartUploadResult>"
-        )
-        return web.Response(
-            body=xml.encode(), content_type="application/xml", headers=sse_resp
-        )
-
-    async def put_object_part(self, request, bucket, key, body) -> web.Response:
-        from ..erasure import multipart as mp_mod
-
-        key = listing.encode_dir_object(key)
-        q = request.rel_url.query
-        try:
-            part_number = int(q["partNumber"])
-        except (KeyError, ValueError):
-            raise s3err.InvalidArgument from None
-        upload_id = q.get("uploadId", "")
-        self._enforce_quota(bucket, self._incoming_size(request, body))
-        try:
-            if body is None:
-                # streaming part upload (multipart is how huge objects
-                # arrive: each part flows straight into its erasure stream)
-                etag = await self._run_streaming_put(
-                    request,
-                    lambda rd: self.mp.put_part(
-                        bucket, key, upload_id, part_number, rd
-                    ),
-                )
-                tr = request.get("trailer_checksum_meta")
-                if tr:
-                    await self._run(
-                        self.mp.update_part_metadata, bucket, key,
-                        upload_id, part_number, tr,
-                    )
-            else:
-                checksum_meta = _verify_checksum_headers(request.headers, body)
-                checksum_meta.update(request.get("trailer_checksum_meta") or {})
-                etag = await self._run(
-                    self.mp.put_part, bucket, key, upload_id, part_number, body,
-                    checksum_meta or None,
-                )
-        except mp_mod.UploadNotFound:
-            raise s3err.NoSuchUpload from None
-        except mp_mod.InvalidPart:
-            raise s3err.InvalidPart from None
-        headers = {"ETag": f'"{etag}"'}
-        for hk in request.headers:
-            if hk.lower().startswith("x-amz-checksum-"):
-                headers[hk] = request.headers[hk]
-        # trailer-mode uploads carry the checksum in the trailer, not a
-        # header: echo the VERIFIED value so SDK response validation sees it
-        from ..utils import checksum as _cks
-
-        for mk, mv in (request.get("trailer_checksum_meta") or {}).items():
-            algo = mk[len(_cks.META_PREFIX):]
-            headers.setdefault(f"x-amz-checksum-{algo}", mv)
-        return web.Response(status=200, headers=headers)
-
-    async def upload_part_copy(self, request, bucket, key) -> web.Response:
-        from ..erasure import multipart as mp_mod
-
-        key = listing.encode_dir_object(key)
-        q = request.rel_url.query
-        try:
-            part_number = int(q["partNumber"])
-        except (KeyError, ValueError):
-            raise s3err.InvalidArgument from None
-        upload_id = q.get("uploadId", "")
-        src_bucket, src_key, src_vid = self._parse_copy_source(
-            request, request.get("access_key", "")
-        )
-        oi, handle = await self._run(
-            self.store.open_object, src_bucket, src_key, src_vid
-        )
-        from . import transforms
-
-        try:
-            # any pre-read failure (412, quota) must release the source
-            # namespace read lock, not wait out the 120s TTL
-            self._check_copy_preconditions(request, oi)
-            self._enforce_quota(
-                bucket, transforms.logical_size(oi.user_defined, oi.size)
-            )
-            # transformed (SSE/compressed) sources must decode to logical
-            # bytes: ranges apply to plaintext, and the destination part
-            # re-transforms for its own upload
-            logical = transforms.logical_size(oi.user_defined, oi.size)
-            offset, length = 0, logical
-            crange = request.headers.get("x-amz-copy-source-range", "")
-            if crange.startswith("bytes="):
-                try:
-                    a, _, b = crange[len("bytes=") :].partition("-")
-                    offset = int(a)
-                    length = int(b) - offset + 1
-                except ValueError:
-                    raise s3err.InvalidArgument from None
-                if offset < 0 or length <= 0 or offset + length > logical:
-                    raise s3err.InvalidRange
-            if transforms.is_transformed(oi.user_defined):
-                req_headers = {k.lower(): v for k, v in request.headers.items()}
-
-                def read_fn(off, ln):
-                    return b"".join(handle.read(off, ln, close_when_done=False))
-
-                data = await self._run(
-                    transforms.decode_range, read_fn, oi.size,
-                    oi.user_defined, req_headers, src_bucket, src_key,
-                    self.kms, offset, length,
-                )
-            else:
-                data = await self._run(
-                    lambda: b"".join(handle.read(offset, length))
-                )
-        finally:
-            handle.close()
-        try:
-            etag = await self._run(
-                self.mp.put_part, bucket, key, upload_id, part_number, data
-            )
-        except mp_mod.UploadNotFound:
-            raise s3err.NoSuchUpload from None
-        xml = (
-            '<?xml version="1.0" encoding="UTF-8"?>'
-            f'<CopyPartResult><ETag>"{etag}"</ETag>'
-            f"<LastModified>{_iso8601(oi.mod_time)}</LastModified></CopyPartResult>"
-        )
-        return web.Response(body=xml.encode(), content_type="application/xml")
-
-    async def complete_multipart(self, request, bucket, key, body) -> web.Response:
-        from ..erasure import multipart as mp_mod
-
-        key = listing.encode_dir_object(key)
-        upload_id = request.rel_url.query.get("uploadId", "")
-        try:
-            root = ET.fromstring(body)
-        except ET.ParseError:
-            raise s3err.MalformedXML from None
-        parts = []
-        part_checksums: dict[int, dict[str, str]] = {}
-        for el in root:
-            if el.tag.split("}")[-1] == "Part":
-                n, etag = 0, ""
-                cks_vals: dict[str, str] = {}
-                for sub in el:
-                    t = sub.tag.split("}")[-1]
-                    if t == "PartNumber":
-                        n = int(sub.text or "0")
-                    elif t == "ETag":
-                        etag = (sub.text or "").strip()
-                    elif t.startswith("Checksum"):
-                        cks_vals[t[len("Checksum"):].lower()] = (sub.text or "").strip()
-                parts.append((n, etag))
-                if cks_vals:
-                    part_checksums[n] = cks_vals
-        bm = self.buckets.get(bucket)
-        try:
-            oi = await self._run(
-                self.mp.complete, bucket, key, upload_id, parts, bm.versioning,
-                part_checksums or None, self._put_precond(request),
-            )
-        except mp_mod.UploadNotFound:
-            raise s3err.NoSuchUpload from None
-        except mp_mod.InvalidPartOrder:
-            raise s3err.InvalidPartOrder from None
-        except mp_mod.InvalidPart:
-            raise s3err.InvalidPart from None
-        xml = (
-            '<?xml version="1.0" encoding="UTF-8"?>'
-            '<CompleteMultipartUploadResult xmlns="http://s3.amazonaws.com/doc/2006-03-01/">'
-            f"<Location>/{escape(bucket)}/{escape(key)}</Location>"
-            f"<Bucket>{escape(bucket)}</Bucket><Key>{escape(key)}</Key>"
-            f'<ETag>"{oi.etag}"</ETag></CompleteMultipartUploadResult>'
-        )
-        headers = {}
-        if oi.version_id:
-            headers["x-amz-version-id"] = oi.version_id
-        from ..events import notify as ev
-
-        self.notifier.notify(
-            ev.OBJECT_CREATED_MULTIPART, bucket, listing.decode_dir_object(key),
-            oi.size, oi.etag, oi.version_id, request.get("access_key", ""),
-        )
-        self._queue_repl(request, bucket, key, oi.version_id, "put")
-        return web.Response(body=xml.encode(), content_type="application/xml", headers=headers)
-
-    async def abort_multipart(self, request, bucket, key) -> web.Response:
-        from ..erasure import multipart as mp_mod
-
-        key = listing.encode_dir_object(key)
-        upload_id = request.rel_url.query.get("uploadId", "")
-        try:
-            await self._run(self.mp.abort, bucket, key, upload_id)
-        except mp_mod.UploadNotFound:
-            raise s3err.NoSuchUpload from None
-        return web.Response(status=204)
-
-    async def list_parts(self, request, bucket, key) -> web.Response:
-        from ..erasure import multipart as mp_mod
-
-        key = listing.encode_dir_object(key)
-        q = request.rel_url.query
-        upload_id = q.get("uploadId", "")
-        try:
-            max_parts = int(q.get("max-parts", "1000"))
-            marker = int(q.get("part-number-marker", "0"))
-        except ValueError:
-            raise s3err.InvalidArgument from None
-        if max_parts < 0 or marker < 0:
-            raise s3err.InvalidArgument
-        max_parts = min(max_parts, 1000)
-        try:
-            parts, truncated = await self._run(
-                self.mp.list_parts, bucket, key, upload_id, max_parts, marker
-            )
-        except mp_mod.UploadNotFound:
-            raise s3err.NoSuchUpload from None
-        items = "".join(
-            f"<Part><PartNumber>{p.number}</PartNumber>"
-            f'<ETag>"{p.etag}"</ETag><Size>{p.size}</Size>'
-            f"<LastModified>{_iso8601(p.mod_time)}</LastModified></Part>"
-            for p in parts
-        )
-        next_marker = (
-            f"<NextPartNumberMarker>{parts[-1].number}</NextPartNumberMarker>"
-            if truncated and parts
-            else ""
-        )
-        xml = (
-            '<?xml version="1.0" encoding="UTF-8"?>'
-            '<ListPartsResult xmlns="http://s3.amazonaws.com/doc/2006-03-01/">'
-            f"<Bucket>{escape(bucket)}</Bucket><Key>{escape(key)}</Key>"
-            f"<UploadId>{upload_id}</UploadId><MaxParts>{max_parts}</MaxParts>"
-            f"<PartNumberMarker>{marker}</PartNumberMarker>{next_marker}"
-            f"<IsTruncated>{'true' if truncated else 'false'}</IsTruncated>"
-            f"{items}</ListPartsResult>"
-        )
-        return web.Response(body=xml.encode(), content_type="application/xml")
-
     def _health(self, request, key: str) -> web.Response:
         """Liveness/readiness/cluster health
         (reference cmd/healthcheck-handler.go)."""
@@ -3029,437 +757,6 @@ class S3Server:
                     )
             return web.Response(status=200)
         return web.Response(status=404)
-
-    async def get_object_lambda(self, request, bucket, key) -> web.Response:
-        """Object lambda: transform a GET through a user webhook
-        (reference cmd/object-lambda-handlers.go). Targets come from
-        MINIO_LAMBDA_WEBHOOK_ENABLE_<ID>/..._ENDPOINT_<ID>."""
-        import base64
-        import urllib.request as _ur
-
-        arn = request.rel_url.query.get("lambdaArn", "")
-        ident = arn.rsplit(":", 2)[-2] if arn.count(":") >= 2 else arn
-        endpoint = os.environ.get(f"MINIO_LAMBDA_WEBHOOK_ENDPOINT_{ident.upper()}", "")
-        enabled = os.environ.get(
-            f"MINIO_LAMBDA_WEBHOOK_ENABLE_{ident.upper()}", ""
-        ) in ("on", "true", "1")
-        if not endpoint or not enabled:
-            raise s3err.InvalidArgument
-        key_enc = listing.encode_dir_object(key)
-        oi, it = await self._run(self.store.get_object, bucket, key_enc)
-        payload = {
-            "getObjectContext": {
-                "inputS3Url": f"/{bucket}/{key}",
-                "bucket": bucket,
-                "key": key,
-                "content": base64.b64encode(b"".join(it)).decode(),
-            },
-            "userRequest": {"headers": dict(request.headers)},
-        }
-        import json as _json
-
-        def call():
-            req = _ur.Request(
-                endpoint, data=_json.dumps(payload).encode(),
-                headers={"Content-Type": "application/json"},
-            )
-            return _ur.urlopen(req, timeout=30).read()
-
-        try:
-            out = await self._run(call)
-        except Exception:  # noqa: BLE001
-            raise s3err.InternalError from None
-        try:
-            body = base64.b64decode(_json.loads(out)["content"])
-        except (ValueError, KeyError):
-            body = out  # raw transformed bytes are accepted too
-        return web.Response(body=body, content_type=oi.content_type)
-
-    async def post_policy_upload(self, request, bucket: str, body: bytes) -> web.Response:
-        """POST object (browser form upload) with V4 POST-policy signature
-        (reference cmd/post-policy.go)."""
-        import base64
-        import hmac as _hmac
-        import json as _json
-
-        ctype = request.headers.get("Content-Type", "")
-        if "boundary=" not in ctype:
-            raise s3err.MalformedXML
-        boundary = (
-            ctype.split("boundary=", 1)[1].split(";", 1)[0].strip().strip('"').encode()
-        )
-        fields, file_data = _parse_form_data(body, boundary)
-        key = fields.get("key", "")
-        if not key:
-            raise s3err.InvalidArgument
-        if "${filename}" in key:
-            key = key.replace("${filename}", fields.get("__filename", "upload"))
-
-        policy_b64 = fields.get("policy", "")
-        ak = ""
-        if policy_b64:
-            cred = fields.get("x-amz-credential", "")
-            sig = fields.get("x-amz-signature", "")
-            parts = cred.split("/")
-            if len(parts) < 5 or parts[-1] != "aws4_request":
-                raise s3err.AccessDenied
-            ak = "/".join(parts[:-4])
-            secret = self.iam.lookup_secret(ak)
-            if secret is None:
-                raise s3err.InvalidAccessKeyId
-            skey = signature.signing_key(secret, parts[-4], parts[-3], parts[-2])
-            want = _hmac.new(skey, policy_b64.encode(), hashlib.sha256).hexdigest()
-            if not _hmac.compare_digest(want, sig):
-                raise s3err.SignatureDoesNotMatch
-            try:
-                pol = _json.loads(base64.b64decode(policy_b64))
-            except ValueError:
-                raise s3err.AccessDenied from None
-            import datetime as _dt
-
-            exp = pol.get("expiration", "")
-            if exp:
-                try:
-                    t = _dt.datetime.fromisoformat(exp.replace("Z", "+00:00"))
-                except ValueError:
-                    raise s3err.AccessDenied from None
-                if _dt.datetime.now(_dt.timezone.utc) > t:
-                    raise s3err.AccessDenied
-            for cond in pol.get("conditions", []):
-                if isinstance(cond, dict):
-                    for ck, cv in cond.items():
-                        if ck == "bucket" and cv != bucket:
-                            raise s3err.AccessDenied
-                        if ck == "key" and cv != key:
-                            raise s3err.AccessDenied
-                elif isinstance(cond, list) and len(cond) == 3:
-                    op, name, val = cond
-                    if str(op) == "content-length-range":
-                        try:
-                            lo, hi = int(name), int(val)
-                        except (TypeError, ValueError):
-                            raise s3err.AccessDenied from None
-                        if not lo <= len(file_data) <= hi:
-                            raise s3err.EntityTooLarge
-                        continue
-                    name = str(name).lstrip("$")
-                    have = {"bucket": bucket, "key": key}.get(name, fields.get(name, ""))
-                    if op == "eq" and have != val:
-                        raise s3err.AccessDenied
-                    if op == "starts-with" and not str(have).startswith(str(val)):
-                        raise s3err.AccessDenied
-        self._authorize(ak, "s3:PutObject", bucket, key)
-        user_defined = {
-            k: v for k, v in fields.items() if k.startswith("x-amz-meta-")
-        }
-        ct = fields.get("Content-Type") or fields.get("content-type") or ""
-        if ct:
-            user_defined["content-type"] = ct
-        bm = self.buckets.get(bucket)
-        # same pipeline as PUT: bucket-default SSE/compression apply here too
-        from ..crypto.sse import CryptoError
-        from . import transforms
-
-        try:
-            tr = transforms.encode_for_store(
-                file_data, key, ct, {}, _bucket_sse_algo(bm.encryption),
-                self.kms, bucket,
-            )
-        except CryptoError:
-            raise s3err.InvalidArgument from None
-        if tr.metadata:
-            user_defined.update(tr.metadata)
-            file_data = tr.data
-        oi = await self._run(
-            self.store.put_object, bucket, listing.encode_dir_object(key),
-            file_data, user_defined, None, bm.versioning,
-        )
-        from ..events import notify as ev
-
-        self.notifier.notify(
-            "s3:ObjectCreated:Post", bucket, key, oi.size, oi.etag,
-            oi.version_id, ak,
-        )
-        self._queue_repl(request, 
-            bucket, listing.encode_dir_object(key), oi.version_id, "put"
-        )
-        try:
-            status = int(fields.get("success_action_status", "204"))
-        except ValueError:
-            status = 204
-        if status not in (200, 201, 204):
-            status = 204
-        headers = {"ETag": f'"{oi.etag}"'}
-        if status == 201:
-            xml = (
-                '<?xml version="1.0" encoding="UTF-8"?>'
-                f"<PostResponse><Bucket>{escape(bucket)}</Bucket>"
-                f"<Key>{escape(key)}</Key><ETag>&quot;{oi.etag}&quot;</ETag>"
-                "</PostResponse>"
-            )
-            return web.Response(
-                status=201, body=xml.encode(), content_type="application/xml",
-                headers=headers,
-            )
-        return web.Response(status=status, headers=headers)
-
-    # -- object lock: retention + legal hold ----------------------------------
-
-    RETENTION_META = "x-minio-internal-retention"  # "<mode>|<iso-until>"
-    LEGALHOLD_META = "x-minio-internal-legalhold"
-
-    def _require_lock_bucket(self, bucket: str) -> None:
-        if not self.buckets.get(bucket).object_lock:
-            raise s3err.InvalidArgument  # lock config required on bucket
-
-    @staticmethod
-    def _parse_retain_until(until: str):
-        """Aware datetime or raises MalformedXML (naive/garbage dates must
-        never be stored: they'd poison every later delete)."""
-        import datetime as _dt
-
-        try:
-            t = _dt.datetime.fromisoformat(until.replace("Z", "+00:00"))
-        except ValueError:
-            raise s3err.MalformedXML from None
-        if t.tzinfo is None:
-            raise s3err.MalformedXML
-        return t
-
-    async def put_object_retention(self, request, bucket, key, body) -> web.Response:
-        import datetime as _dt
-
-        self._require_lock_bucket(bucket)
-        key = listing.encode_dir_object(key)
-        vid = request.rel_url.query.get("versionId", "")
-        try:
-            root = ET.fromstring(body)
-            mode = until = ""
-            for el in root.iter():
-                if el.tag.endswith("Mode"):
-                    mode = el.text or ""
-                elif el.tag.endswith("RetainUntilDate"):
-                    until = (el.text or "").strip()
-            if mode not in ("GOVERNANCE", "COMPLIANCE") or not until:
-                raise s3err.MalformedXML
-        except ET.ParseError:
-            raise s3err.MalformedXML from None
-        new_until = self._parse_retain_until(until)
-        # COMPLIANCE retention can never be shortened or weakened
-        oi = await self._run(self.store.get_object_info, bucket, key, vid)
-        existing = oi.user_defined.get(self.RETENTION_META, "")
-        if existing:
-            old_mode, old_until_s = existing.split("|", 1)
-            try:
-                old_until = self._parse_retain_until(old_until_s)
-            except s3err.APIError:
-                old_until = None
-            if (
-                old_mode == "COMPLIANCE"
-                and old_until is not None
-                and _dt.datetime.now(_dt.timezone.utc) < old_until
-                and (mode != "COMPLIANCE" or new_until < old_until)
-            ):
-                raise s3err.AccessDenied
-        val = "{}|{}".format(
-            mode,
-            new_until.astimezone(_dt.timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ"),
-        )
-        await self._run(
-            self.store.update_object_metadata, bucket, key, vid,
-            lambda md: md.__setitem__(self.RETENTION_META, val),
-        )
-        return web.Response(status=200)
-
-    async def get_object_retention(self, request, bucket, key) -> web.Response:
-        key = listing.encode_dir_object(key)
-        vid = request.rel_url.query.get("versionId", "")
-        oi = await self._run(self.store.get_object_info, bucket, key, vid)
-        raw = oi.user_defined.get(self.RETENTION_META, "")
-        if not raw:
-            raise s3err.ObjectLockConfigurationNotFoundError
-        mode, until = raw.split("|", 1)
-        xml = (
-            '<?xml version="1.0" encoding="UTF-8"?>'
-            f"<Retention><Mode>{escape(mode)}</Mode>"
-            f"<RetainUntilDate>{escape(until)}</RetainUntilDate></Retention>"
-        )
-        return web.Response(body=xml.encode(), content_type="application/xml")
-
-    async def put_legal_hold(self, request, bucket, key, body) -> web.Response:
-        self._require_lock_bucket(bucket)
-        key = listing.encode_dir_object(key)
-        vid = request.rel_url.query.get("versionId", "")
-        try:
-            root = ET.fromstring(body)
-            status = ""
-            for el in root.iter():
-                if el.tag.endswith("Status"):
-                    status = (el.text or "").strip()
-        except ET.ParseError:
-            raise s3err.MalformedXML from None
-        if status not in ("ON", "OFF"):
-            # malformed input must never silently CLEAR an active hold
-            raise s3err.MalformedXML
-        await self._run(
-            self.store.update_object_metadata, bucket, key, vid,
-            lambda md: md.__setitem__(self.LEGALHOLD_META, status),
-        )
-        return web.Response(status=200)
-
-    async def get_legal_hold(self, request, bucket, key) -> web.Response:
-        key = listing.encode_dir_object(key)
-        vid = request.rel_url.query.get("versionId", "")
-        oi = await self._run(self.store.get_object_info, bucket, key, vid)
-        status = oi.user_defined.get(self.LEGALHOLD_META, "OFF")
-        xml = (
-            '<?xml version="1.0" encoding="UTF-8"?>'
-            f"<LegalHold><Status>{status}</Status></LegalHold>"
-        )
-        return web.Response(body=xml.encode(), content_type="application/xml")
-
-    def _check_object_lock(self, bucket: str, key: str, vid: str,
-                           bypass_governance: bool = False) -> None:
-        """Block data-destroying deletes while retention/legal hold is
-        active (reference: enforceRetentionForDeletion). GOVERNANCE
-        retention may be bypassed by a caller holding
-        s3:BypassGovernanceRetention who sent the bypass header;
-        COMPLIANCE and legal hold can never be bypassed."""
-        if not vid:
-            # on a VERSIONED bucket this only adds a marker; on an
-            # unversioned one it destroys the latest version — guard it
-            if self.buckets.get(bucket).versioning:
-                return
-        try:
-            oi = self.store.get_object_info(bucket, key, vid)
-        except Exception:  # noqa: BLE001 — missing version: nothing to guard
-            return
-        if oi.user_defined.get(self.LEGALHOLD_META) == "ON":
-            raise s3err.AccessDenied
-        raw = oi.user_defined.get(self.RETENTION_META, "")
-        if raw:
-            import datetime as _dt
-
-            mode, until = raw.split("|", 1)
-            if mode == "GOVERNANCE" and bypass_governance:
-                return
-            try:
-                t = _dt.datetime.fromisoformat(until.replace("Z", "+00:00"))
-            except ValueError:
-                raise s3err.AccessDenied from None
-            if t.tzinfo is None or _dt.datetime.now(_dt.timezone.utc) < t:
-                raise s3err.AccessDenied
-
-    def _bypass_governance(self, request, bucket: str, key: str) -> bool:
-        """True iff the caller asked to bypass GOVERNANCE retention and
-        holds s3:BypassGovernanceRetention (reference
-        cmd/object-handlers.go x-amz-bypass-governance-retention)."""
-        if request.headers.get(
-            "x-amz-bypass-governance-retention", ""
-        ).lower() != "true":
-            return False
-        ak = request.get("access_key", "")
-        if not ak:
-            return False
-        return self.iam.is_allowed(
-            ak, "s3:BypassGovernanceRetention", f"{bucket}/{key}"
-        )
-
-    # -- object tagging --------------------------------------------------------
-
-    from ..erasure.set import TAGS_META_KEY as TAGS_META
-
-    @staticmethod
-    def _validate_tags(pairs) -> dict[str, str]:
-        """Enforce the S3 tag-set rules on (key, value) pairs (reference
-        pkg tags.ParseObjectTags): <=10 tags, unique keys, key 1-128
-        chars, value <=256 chars."""
-        if len(pairs) > 10:
-            raise s3err.InvalidTag
-        tags: dict[str, str] = {}
-        for k, v in pairs:
-            if not k or len(k) > 128 or len(v) > 256 or k in tags:
-                raise s3err.InvalidTag
-            tags[k] = v
-        return tags
-
-    @classmethod
-    def _tagging_header_meta(cls, header_value: str) -> str:
-        """x-amz-tagging header (urlencoded) -> validated stored form."""
-        pairs = urllib.parse.parse_qsl(header_value, keep_blank_values=True)
-        return urllib.parse.urlencode(cls._validate_tags(pairs))
-
-    async def put_object_tagging(self, request, bucket, key, body) -> web.Response:
-        key = listing.encode_dir_object(key)
-        vid = request.rel_url.query.get("versionId", "")
-        try:
-            root = ET.fromstring(body)
-        except ET.ParseError:
-            raise s3err.MalformedXML from None
-        pairs = []
-        for el in root.iter():
-            if el.tag.endswith("Tag"):
-                k = v = ""
-                for sub in el:
-                    if sub.tag.endswith("Key"):
-                        k = sub.text or ""
-                    elif sub.tag.endswith("Value"):
-                        v = sub.text or ""
-                pairs.append((k, v))
-        tags = self._validate_tags(pairs)
-        await self._run(self.store.set_object_tags, bucket, key, tags, vid)
-        return web.Response(status=200)
-
-    async def get_object_tagging(self, request, bucket, key) -> web.Response:
-        key = listing.encode_dir_object(key)
-        vid = request.rel_url.query.get("versionId", "")
-        tags = await self._run(self.store.get_object_tags, bucket, key, vid)
-        items = "".join(
-            f"<Tag><Key>{escape(k)}</Key><Value>{escape(v)}</Value></Tag>"
-            for k, v in tags.items()
-        )
-        xml = (
-            '<?xml version="1.0" encoding="UTF-8"?>'
-            f"<Tagging><TagSet>{items}</TagSet></Tagging>"
-        )
-        return web.Response(body=xml.encode(), content_type="application/xml")
-
-    async def delete_object_tagging(self, request, bucket, key) -> web.Response:
-        key = listing.encode_dir_object(key)
-        vid = request.rel_url.query.get("versionId", "")
-        await self._run(self.store.set_object_tags, bucket, key, {}, vid)
-        return web.Response(status=204)
-
-    async def select_object_content(self, request, bucket, key, body) -> web.Response:
-        """SelectObjectContent: SQL over CSV/JSON objects
-        (reference cmd/object-handlers.go:105 + internal/s3select)."""
-        from ..s3select import engine
-        from . import transforms
-
-        key = listing.encode_dir_object(key)
-        oi, handle = await self._run(self.store.open_object, bucket, key, "")
-        try:
-            req_headers = {k.lower(): v for k, v in request.headers.items()}
-
-            def load() -> bytes:
-                raw = b"".join(handle.read())
-                if transforms.is_transformed(oi.user_defined):
-                    return transforms.decode_full(
-                        raw, oi.user_defined, req_headers, bucket, key, self.kms
-                    )
-                return raw
-
-            data = await self._run(load)
-        finally:
-            handle.close()
-        try:
-            stream = await self._run(engine.run_select, body, data)
-        except engine.SelectError:
-            raise s3err.InvalidArgument from None
-        return web.Response(
-            body=stream, content_type="application/octet-stream"
-        )
-
     # -- admin helpers ---------------------------------------------------------
 
     def server_info(self) -> dict:
@@ -3487,59 +784,6 @@ class S3Server:
                 except Exception:  # noqa: BLE001
                     failed += 1
         return {"scanned": scanned, "healed": healed, "failed": failed}
-
-    async def list_multipart_uploads(self, request, bucket) -> web.Response:
-        q = request.rel_url.query
-        prefix = q.get("prefix", "")
-        key_marker = q.get("key-marker", "")
-        uid_marker = q.get("upload-id-marker", "")
-        try:
-            max_uploads = min(max(int(q.get("max-uploads", "1000")), 0), 1000)
-        except ValueError:
-            raise s3err.InvalidArgument from None
-        if max_uploads == 0:
-            # an empty page with no next marker cannot progress: report it
-            # as NON-truncated (same discipline as ListParts max-parts=0)
-            return web.Response(
-                body=(
-                    '<?xml version="1.0" encoding="UTF-8"?>'
-                    '<ListMultipartUploadsResult xmlns="http://s3.amazonaws.com/doc/2006-03-01/">'
-                    f"<Bucket>{escape(bucket)}</Bucket><Prefix>{escape(prefix)}</Prefix>"
-                    "<MaxUploads>0</MaxUploads>"
-                    "<IsTruncated>false</IsTruncated></ListMultipartUploadsResult>"
-                ).encode(),
-                content_type="application/xml",
-            )
-        uploads = sorted(await self._run(self.mp.list_uploads, bucket, prefix))
-        if key_marker:
-            # marker semantics (cmd/erasure-multipart.go ListMultipartUploads):
-            # strictly after (key_marker, uid_marker)
-            uploads = [
-                (k, u) for k, u in uploads
-                if k > key_marker or (k == key_marker and uid_marker and u > uid_marker)
-            ]
-        page = uploads[:max_uploads]
-        truncated = len(uploads) > len(page)
-        items = "".join(
-            f"<Upload><Key>{escape(k)}</Key><UploadId>{uid}</UploadId></Upload>"
-            for k, uid in page
-        )
-        next_markers = (
-            f"<NextKeyMarker>{escape(page[-1][0])}</NextKeyMarker>"
-            f"<NextUploadIdMarker>{page[-1][1]}</NextUploadIdMarker>"
-            if truncated and page
-            else ""
-        )
-        xml = (
-            '<?xml version="1.0" encoding="UTF-8"?>'
-            '<ListMultipartUploadsResult xmlns="http://s3.amazonaws.com/doc/2006-03-01/">'
-            f"<Bucket>{escape(bucket)}</Bucket><Prefix>{escape(prefix)}</Prefix>"
-            f"<KeyMarker>{escape(key_marker)}</KeyMarker>"
-            f"<MaxUploads>{max_uploads}</MaxUploads>{next_markers}"
-            f"<IsTruncated>{'true' if truncated else 'false'}</IsTruncated>"
-            f"{items}</ListMultipartUploadsResult>"
-        )
-        return web.Response(body=xml.encode(), content_type="application/xml")
 
 
 def make_object_layer(
