@@ -87,14 +87,39 @@ class KESKMS:
 
     # -- KMS interface (mirrors crypto/sse.py KMS) -------------------------
 
-    def create_key(self, name: str | None = None) -> None:
-        self._request("POST", f"/v1/key/create/{name or self.key_id}")
+    def create_key(self, name: str | None = None,
+                   material: bytes | None = None) -> None:
+        target = name or self.key_id
+        if material is not None:
+            self._request(
+                "POST", f"/v1/key/import/{target}",
+                {"bytes": base64.b64encode(material).decode()},
+            )
+            return
+        self._request("POST", f"/v1/key/create/{target}")
 
-    def generate_key(self, context: str) -> tuple[bytes, bytes]:
+    def list_keys(self, pattern: str = "*") -> list:
+        out = self._request("GET", f"/v1/key/list/{pattern or '*'}")
+        # KES answers a list of {name, ...} descriptors
+        if isinstance(out, list):
+            return sorted(
+                str(e.get("name", "")) for e in out if isinstance(e, dict)
+            )
+        return sorted(out.get("keys", []))
+
+    def key_status(self, name: str) -> dict:
+        out = self._request("GET", f"/v1/key/describe/{name}")
+        return {"key-id": name, **out}
+
+    def delete_key(self, name: str) -> None:
+        self._request("DELETE", f"/v1/key/delete/{name}")
+
+    def generate_key(self, context: str, key_name: str | None = None) -> tuple[bytes, bytes]:
         """-> (plaintext 32B DEK, sealed blob to store in metadata)."""
         ctx = base64.b64encode(context.encode()).decode()
         out = self._request(
-            "POST", f"/v1/key/generate/{self.key_id}", {"context": ctx}
+            "POST", f"/v1/key/generate/{key_name or self.key_id}",
+            {"context": ctx},
         )
         try:
             return (
@@ -104,10 +129,10 @@ class KESKMS:
         except (KeyError, ValueError):
             raise CryptoError("malformed KES generate response") from None
 
-    def seal(self, key: bytes, context: str) -> bytes:
+    def seal(self, key: bytes, context: str, key_name: str | None = None) -> bytes:
         out = self._request(
             "POST",
-            f"/v1/key/encrypt/{self.key_id}",
+            f"/v1/key/encrypt/{key_name or self.key_id}",
             {
                 "plaintext": base64.b64encode(key).decode(),
                 "context": base64.b64encode(context.encode()).decode(),
@@ -118,10 +143,10 @@ class KESKMS:
         except (KeyError, ValueError):
             raise CryptoError("malformed KES encrypt response") from None
 
-    def unseal(self, sealed: bytes, context: str) -> bytes:
+    def unseal(self, sealed: bytes, context: str, key_name: str | None = None) -> bytes:
         out = self._request(
             "POST",
-            f"/v1/key/decrypt/{self.key_id}",
+            f"/v1/key/decrypt/{key_name or self.key_id}",
             {
                 "ciphertext": base64.b64encode(sealed).decode(),
                 "context": base64.b64encode(context.encode()).decode(),

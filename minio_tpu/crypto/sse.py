@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import base64
 import hashlib
+import json
 import os
 import secrets
 
@@ -63,6 +64,8 @@ class KMS:
     internal/kms/secret-key.go). Key spec: 'name:base64(32 bytes)'."""
 
     def __init__(self, key_spec: str | None = None, store=None):
+        self._store = store
+        self._keys: dict[str, bytes] = {}
         spec = key_spec or os.environ.get("MINIO_KMS_SECRET_KEY", "")
         if spec:
             # a configured-but-malformed spec must fail loudly: silently
@@ -150,19 +153,148 @@ class KMS:
             if mtx is not None:
                 mtx.unlock()
 
-    def generate_key(self, context: str) -> tuple[bytes, bytes]:
+    # -- named keyring ------------------------------------------------------
+    # The reference's KMS API manages named master keys (key/create,
+    # key/list, key/status — cmd/kms-handlers.go); the builtin backend
+    # persists each named key sealed under the default master key, so the
+    # master stays the single root of trust (MinKMS seals its key store
+    # under a KEK the same way, internal/kms/conn.go).
+
+    _KEYRING_PATH = "config/kms/keyring.json"
+
+    _RING_TTL = 5.0  # seconds; keeps cross-node delete_key convergent
+
+    def _keyring(self, fresh: bool = False) -> dict[str, str]:
+        """Persisted name -> hex(sealed material) map.
+
+        Cached with a short TTL: the data path calls this per seal/unseal,
+        but a key deleted via ANOTHER node must stop working here within
+        the TTL, not live forever in a process-local cache."""
+        store = getattr(self, "_store", None)
+        if store is None:
+            return {}
+        import time as _time
+
+        now = _time.monotonic()
+        cached = getattr(self, "_ring_cache", None)
+        if not fresh and cached is not None and now < cached[1]:
+            return cached[0]
+        from ..erasure.quorum import ObjectNotFound
+
+        try:
+            _, it = store.get_object(".minio.sys", self._KEYRING_PATH)
+            ring = json.loads(b"".join(it).decode())
+        except ObjectNotFound:
+            ring = {}
+        except ValueError:
+            raise CryptoError(
+                "persisted KMS keyring is corrupt; refusing to overwrite"
+            ) from None
+        self._ring_cache = (ring, now + self._RING_TTL)
+        return ring
+
+    def _save_keyring(self, ring: dict[str, str]) -> None:
+        self._store.put_object(
+            ".minio.sys", self._KEYRING_PATH, json.dumps(ring).encode()
+        )
+
+    def _named_material(self, name: str) -> bytes:
+        """Material for key `name`; the default key id maps to the master.
+
+        The keyring (TTL-cached) is the source of truth on every call —
+        the unsealed-material cache is keyed by the sealed blob, so a
+        deleted key expires with the ring and a re-created key of the
+        same name never serves stale material."""
+        if not name or name == self.key_id:
+            return self._master
+        sealed_hex = self._keyring().get(name)
+        if sealed_hex is None:
+            raise CryptoError(f"key does not exist: {name}")
+        cached = self._keys.get(name)
+        if cached is not None and cached[0] == sealed_hex:
+            return cached[1]
+        key = self.unseal(bytes.fromhex(sealed_hex), f"kms-key/{name}")
+        self._keys[name] = (sealed_hex, key)
+        return key
+
+    def create_key(self, name: str, material: bytes | None = None) -> None:
+        """Create (or import, when material is given) a named key."""
+        if not name or "/" in name or len(name) > 80:
+            raise CryptoError(f"invalid key name: {name!r}")
+        if getattr(self, "_store", None) is None:
+            raise CryptoError("named keys need a persistent backend")
+        if material is not None and len(material) != 32:
+            raise CryptoError("imported key material must be 32 bytes")
+        mtx = _ns_mutex(self._store, ".minio.sys", self._KEYRING_PATH + ".w")
+        if mtx is not None and not mtx.lock(timeout=30.0):
+            raise CryptoError("could not lock KMS keyring")
+        try:
+            ring = self._keyring(fresh=True)
+            if name == self.key_id or name in ring:
+                raise CryptoError(f"key already exists: {name}")
+            key = material if material is not None else secrets.token_bytes(32)
+            ring[name] = self.seal(key, f"kms-key/{name}").hex()
+            self._save_keyring(ring)
+            self._ring_cache = None
+            self._keys[name] = (ring[name], key)
+        finally:
+            if mtx is not None:
+                mtx.unlock()
+
+    def _key_exists(self, name: str) -> bool:
+        return name == self.key_id or name in self._keyring()
+
+    def list_keys(self, pattern: str = "*") -> list[str]:
+        import fnmatch
+
+        names = {self.key_id, *self._keyring()}
+        pattern = pattern or "*"
+        return sorted(n for n in names if fnmatch.fnmatch(n, pattern))
+
+    def key_status(self, name: str) -> dict:
+        if not self._key_exists(name):
+            raise CryptoError(f"key does not exist: {name}")
+        return {"key-id": name, "encryption": "AES-256-GCM", "status": "ok"}
+
+    def delete_key(self, name: str) -> None:
+        if name == self.key_id:
+            raise CryptoError("cannot delete the default master key")
+        mtx = _ns_mutex(self._store, ".minio.sys", self._KEYRING_PATH + ".w")
+        if mtx is not None and not mtx.lock(timeout=30.0):
+            raise CryptoError("could not lock KMS keyring")
+        try:
+            ring = self._keyring(fresh=True)
+            if name not in ring:
+                raise CryptoError(f"key does not exist: {name}")
+            del ring[name]
+            self._save_keyring(ring)
+            self._ring_cache = None
+            self._keys.pop(name, None)
+        finally:
+            if mtx is not None:
+                mtx.unlock()
+
+    # -- data-key operations -------------------------------------------------
+
+    def generate_key(self, context: str, key_name: str | None = None) -> tuple[bytes, bytes]:
         """(plaintext_key, sealed_key) bound to a context string."""
         plain = secrets.token_bytes(32)
-        return plain, self.seal(plain, context)
+        return plain, self.seal(plain, context, key_name)
 
-    def seal(self, key: bytes, context: str) -> bytes:
+    def seal(self, key: bytes, context: str, key_name: str | None = None) -> bytes:
+        master = (
+            self._named_material(key_name) if key_name else self._master
+        )
         nonce = secrets.token_bytes(NONCE_SIZE)
-        ct = AESGCM(self._master).encrypt(nonce, key, context.encode())
+        ct = AESGCM(master).encrypt(nonce, key, context.encode())
         return nonce + ct
 
-    def unseal(self, sealed: bytes, context: str) -> bytes:
+    def unseal(self, sealed: bytes, context: str, key_name: str | None = None) -> bytes:
+        master = (
+            self._named_material(key_name) if key_name else self._master
+        )
         try:
-            return AESGCM(self._master).decrypt(
+            return AESGCM(master).decrypt(
                 sealed[:NONCE_SIZE], sealed[NONCE_SIZE:], context.encode()
             )
         except Exception:

@@ -96,6 +96,7 @@ class MultipartManager:
     def put_part(
         self, bucket: str, obj: str, upload_id: str, part_number: int, data: bytes,
         extra_meta: dict[str, str] | None = None,
+        transform_ctx=None,
     ) -> str:
         if not 1 <= part_number <= 10000:
             raise InvalidPart(f"part number {part_number}")
@@ -106,7 +107,7 @@ class MultipartManager:
         plain_after = None  # streamed transforms know the size only at EOF
         if self.part_transform is not None:
             transformed = self.part_transform(
-                bucket, obj, up.user_defined, part_number, data
+                bucket, obj, up.user_defined, part_number, data, transform_ctx
             )
             if transformed is not None:
                 data, plain = transformed
@@ -390,8 +391,9 @@ class MultipartRouter:
 
     def __init__(self, store, part_transform=None):
         self.store = store  # ServerPools or anything with .pools/.get_hashed_set
-        # optional hook(bucket, obj, upload_meta, part#, data) ->
-        # (stored_bytes, plain_size) | None — the server wires SSE here
+        # optional hook(bucket, obj, upload_meta, part#, data, ctx) ->
+        # (stored_bytes, plain_size) | None — the server wires SSE here;
+        # ctx carries per-request state (SSE-C customer key headers)
         self.part_transform = part_transform
 
     def _pools(self):
@@ -431,10 +433,10 @@ class MultipartRouter:
         return f"{pool_idx}{POOL_SEP}{raw}"
 
     def put_part(self, bucket, obj, upload_id, part_number, data,
-                 extra_meta=None) -> str:
+                 extra_meta=None, transform_ctx=None) -> str:
         pidx, raw = self._split(upload_id)
         return self._mgr(obj, pidx).put_part(
-            bucket, obj, raw, part_number, data, extra_meta
+            bucket, obj, raw, part_number, data, extra_meta, transform_ctx
         )
 
     def update_part_metadata(self, bucket, obj, upload_id, part_number, extra):

@@ -174,24 +174,32 @@ class S3Server(
 
     # -- plumbing ------------------------------------------------------------
 
-    def _mp_part_transform(self, bucket, obj, up_meta, part_number, data):
+    def _mp_part_transform(self, bucket, obj, up_meta, part_number, data,
+                           ctx=None):
         """SSE hook for multipart parts: encrypt each part as its own
         packet stream under the upload's OEK. None = no transform.
         Returns (stored, plain_size | size_getter): streamed parts encrypt
-        packet-by-packet and report their plaintext size after the fact."""
+        packet-by-packet and report their plaintext size after the fact.
+        `ctx` carries the part request's headers — SSE-C uploads re-present
+        the customer key on every part (cmd/erasure-multipart.go:575)."""
         from ..crypto import sse as ssemod
         from . import transforms
 
         if ssemod.META_ALGO not in up_meta:
             return None
+        # SSE-C validation (key present + MD5 match vs the upload's) happens
+        # inside _unseal_oek, which both encrypt paths invoke eagerly — a
+        # missing/mismatched customer key raises before any data is stored
+        headers = ctx or {}
         if isinstance(data, (bytes, bytearray)):
             enc = transforms.encrypt_part(
-                bytes(data), up_meta, part_number, self.kms, bucket, obj
+                bytes(data), up_meta, part_number, self.kms, bucket, obj,
+                headers,
             )
             return enc, len(data)
         count = [0]
         gen = transforms.encrypt_part_iter(
-            data, up_meta, part_number, self.kms, bucket, obj, count
+            data, up_meta, part_number, self.kms, bucket, obj, count, headers
         )
         return gen, (lambda: count[0])
 
@@ -537,13 +545,10 @@ class S3Server(
 
         # admin + STS + KMS planes
         if bucket == "minio" and key.startswith("kms/"):
-            if not ak or not self.iam.is_allowed(ak, "kms:Status", ""):
-                raise s3err.AccessDenied
-            import json as _json
+            from .kms_handlers import handle_kms
 
-            return web.Response(
-                body=_json.dumps(self.kms.status()).encode(),
-                content_type="application/json",
+            return await handle_kms(
+                self, request, ak, key[len("kms/"):], body
             )
         if bucket == "minio" and key.startswith("admin/"):
             from .admin import handle_admin

@@ -44,9 +44,7 @@ class MultipartHandlersMixin:
                 bucket, key,
             )
         except CryptoError:
-            # SSE-C multipart needs the customer key on every part read —
-            # refuse loudly rather than silently storing plaintext
-            raise s3err.NotImplemented_ from None
+            raise s3err.InvalidArgument from None
         if sse is not None:
             sse_meta, sse_resp = sse
             user_defined.update(sse_meta)
@@ -75,6 +73,11 @@ class MultipartHandlersMixin:
             raise s3err.InvalidArgument from None
         upload_id = q.get("uploadId", "")
         self._enforce_quota(bucket, self._incoming_size(request, body))
+        # SSE-C uploads re-present the customer key on every part; thread
+        # the request headers through as the part-transform context
+        part_ctx = {k.lower(): v for k, v in request.headers.items()}
+        from ..crypto.sse import CryptoError
+
         try:
             if body is None:
                 # streaming part upload (multipart is how huge objects
@@ -82,7 +85,8 @@ class MultipartHandlersMixin:
                 etag = await self._run_streaming_put(
                     request,
                     lambda rd: self.mp.put_part(
-                        bucket, key, upload_id, part_number, rd
+                        bucket, key, upload_id, part_number, rd,
+                        transform_ctx=part_ctx,
                     ),
                 )
                 tr = request.get("trailer_checksum_meta")
@@ -96,12 +100,15 @@ class MultipartHandlersMixin:
                 checksum_meta.update(request.get("trailer_checksum_meta") or {})
                 etag = await self._run(
                     self.mp.put_part, bucket, key, upload_id, part_number, body,
-                    checksum_meta or None,
+                    checksum_meta or None, part_ctx,
                 )
         except mp_mod.UploadNotFound:
             raise s3err.NoSuchUpload from None
         except mp_mod.InvalidPart:
             raise s3err.InvalidPart from None
+        except CryptoError:
+            # missing/mismatched SSE-C key on an encrypted upload
+            raise s3err.InvalidArgument from None
         headers = {"ETag": f'"{etag}"'}
         for hk in request.headers:
             if hk.lower().startswith("x-amz-checksum-"):
@@ -157,27 +164,58 @@ class MultipartHandlersMixin:
                     raise s3err.InvalidRange
             if transforms.is_transformed(oi.user_defined):
                 req_headers = {k.lower(): v for k, v in request.headers.items()}
+                # SSE-C sources present their key under the copy-source
+                # header set; remap so the source decode sees it (and not
+                # the DESTINATION upload's key riding the same request)
+                src_headers = dict(req_headers)
+                for _h in ("algorithm", "key", "key-md5"):
+                    _v = req_headers.get(
+                        "x-amz-copy-source-server-side-encryption-customer-"
+                        + _h
+                    )
+                    src_headers.pop(
+                        "x-amz-server-side-encryption-customer-" + _h, None
+                    )
+                    if _v:
+                        src_headers[
+                            "x-amz-server-side-encryption-customer-" + _h
+                        ] = _v
+                req_headers = src_headers
 
                 def read_fn(off, ln):
                     return b"".join(handle.read(off, ln, close_when_done=False))
 
-                data = await self._run(
-                    transforms.decode_range, read_fn, oi.size,
-                    oi.user_defined, req_headers, src_bucket, src_key,
-                    self.kms, offset, length,
-                )
+                from ..crypto.sse import CryptoError as _CryptoError
+
+                try:
+                    data = await self._run(
+                        transforms.decode_range, read_fn, oi.size,
+                        oi.user_defined, req_headers, src_bucket, src_key,
+                        self.kms, offset, length,
+                    )
+                except _CryptoError:
+                    # missing/wrong copy-source SSE-C key
+                    raise s3err.InvalidArgument from None
             else:
                 data = await self._run(
                     lambda: b"".join(handle.read(offset, length))
                 )
         finally:
             handle.close()
+        from ..crypto.sse import CryptoError
+
         try:
+            # destination SSE-C headers (x-amz-server-side-encryption-
+            # customer-*) ride the same request; thread them through so a
+            # part copy into an SSE-C upload can seal under the upload key
             etag = await self._run(
-                self.mp.put_part, bucket, key, upload_id, part_number, data
+                self.mp.put_part, bucket, key, upload_id, part_number, data,
+                None, {k.lower(): v for k, v in request.headers.items()},
             )
         except mp_mod.UploadNotFound:
             raise s3err.NoSuchUpload from None
+        except CryptoError:
+            raise s3err.InvalidArgument from None
         xml = (
             '<?xml version="1.0" encoding="UTF-8"?>'
             f'<CopyPartResult><ETag>"{etag}"</ETag>'

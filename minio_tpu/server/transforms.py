@@ -110,29 +110,19 @@ def encode_for_store(
     if ssec_key or sse_algo:
         import secrets as _secrets
 
-        base_iv = _secrets.token_bytes(ssemod.NONCE_SIZE)
         context = f"{bucket}/{key}"
         if ssec_key:
-            oek = _secrets.token_bytes(32)
-            sealed = ssemod.AESGCM(ssec_key).encrypt(base_iv, oek, context.encode())
-            meta[ssemod.META_ALGO] = "SSE-C"
-            import base64 as _b64
-            import hashlib as _hashlib
-
-            meta[ssemod.META_SSEC_KEY_MD5] = _b64.b64encode(
-                _hashlib.md5(ssec_key).digest()
-            ).decode()
-            resp["x-amz-server-side-encryption-customer-algorithm"] = "AES256"
-            resp["x-amz-server-side-encryption-customer-key-MD5"] = meta[
-                ssemod.META_SSEC_KEY_MD5
-            ]
+            oek, base_iv, sealed, m2, r2 = _ssec_setup(ssec_key, context)
+            meta.update(m2)
+            resp.update(r2)
         else:
+            base_iv = _secrets.token_bytes(ssemod.NONCE_SIZE)
             oek, sealed, m2, r2 = _sse_s3_kms_setup(sse_algo, headers, kms, context)
             meta.update(m2)
             resp.update(r2)
+            meta[ssemod.META_SEALED_KEY] = sealed.hex()
+            meta[ssemod.META_IV] = base_iv.hex()
         meta.setdefault(ssemod.META_ACTUAL_SIZE, str(len(body)))
-        meta[ssemod.META_SEALED_KEY] = sealed.hex()
-        meta[ssemod.META_IV] = base_iv.hex()
         data = ssemod.encrypt_stream(data, oek, base_iv)
     return TransformResult(data, meta, resp)
 
@@ -155,24 +145,54 @@ def part_iv(base_iv: bytes, part_number: int) -> bytes:
     ).digest()[: ssemod.NONCE_SIZE]
 
 
+def _ssec_setup(
+    ssec_key: bytes, context: str
+) -> tuple[bytes, bytes, bytes, dict, dict]:
+    """Shared SSE-C key sealing: fresh OEK sealed under the customer key.
+    Single source of truth for single PUTs and multipart initiation.
+    Returns (oek, base_iv, sealed, metadata, response headers)."""
+    import base64 as _b64
+    import hashlib as _hashlib
+    import secrets as _secrets
+
+    base_iv = _secrets.token_bytes(ssemod.NONCE_SIZE)
+    oek = _secrets.token_bytes(32)
+    sealed = ssemod.AESGCM(ssec_key).encrypt(base_iv, oek, context.encode())
+    key_md5 = _b64.b64encode(_hashlib.md5(ssec_key).digest()).decode()
+    meta = {
+        ssemod.META_ALGO: "SSE-C",
+        ssemod.META_SSEC_KEY_MD5: key_md5,
+        ssemod.META_SEALED_KEY: sealed.hex(),
+        ssemod.META_IV: base_iv.hex(),
+    }
+    resp = {
+        "x-amz-server-side-encryption-customer-algorithm": "AES256",
+        "x-amz-server-side-encryption-customer-key-MD5": key_md5,
+    }
+    return oek, base_iv, sealed, meta, resp
+
+
 def _sse_s3_kms_setup(
     sse_algo: str, headers, kms: ssemod.KMS, context: str
 ) -> tuple[bytes, bytes, dict, dict]:
     """Shared SSE-S3/SSE-KMS key generation + metadata/response headers —
     single source of truth for single PUTs and multipart initiation."""
-    oek, sealed = kms.generate_key(context)
     meta: dict[str, str] = {}
     resp: dict[str, str] = {}
     if sse_algo == "aws:kms":
-        meta[ssemod.META_ALGO] = "SSE-KMS"
-        meta[ssemod.META_KMS_KEY_ID] = headers.get(
+        key_id = headers.get(
             "x-amz-server-side-encryption-aws-kms-key-id", kms.key_id
         )
+        # seal under the REQUESTED named key, so deleting that key cuts
+        # off exactly the objects encrypted with it (reference
+        # cmd/encryption-v1.go newEncryptMetadata keyID plumbing)
+        oek, sealed = kms.generate_key(context, key_id)
+        meta[ssemod.META_ALGO] = "SSE-KMS"
+        meta[ssemod.META_KMS_KEY_ID] = key_id
         resp["x-amz-server-side-encryption"] = "aws:kms"
-        resp["x-amz-server-side-encryption-aws-kms-key-id"] = meta[
-            ssemod.META_KMS_KEY_ID
-        ]
+        resp["x-amz-server-side-encryption-aws-kms-key-id"] = key_id
     else:
+        oek, sealed = kms.generate_key(context)
         meta[ssemod.META_ALGO] = "SSE-S3"
         resp["x-amz-server-side-encryption"] = "AES256"
     return oek, sealed, meta, resp
@@ -183,18 +203,27 @@ def multipart_sse_init(
     bucket: str, key: str,
 ) -> tuple[dict, dict] | None:
     """SSE setup at CreateMultipartUpload (reference encrypts multipart
-    per part under one object key, cmd/encryption-v1.go + multipart
-    handlers). Returns (upload metadata, response headers) or None when
-    no encryption applies. SSE-C multipart stays unsupported."""
-    if ssemod.parse_ssec_headers(headers):
-        raise ssemod.CryptoError("SSE-C multipart is not supported")
+    per part under one object key, cmd/encryption-v1.go +
+    cmd/erasure-multipart.go:575). Returns (upload metadata, response
+    headers) or None when no encryption applies.
+
+    SSE-C: the customer key seals a fresh OEK at initiation; every
+    UploadPart must re-present the same key (AWS semantics) — the key
+    itself is never stored, only its MD5 for mismatch detection."""
+    import secrets as _secrets
+
+    ssec_key = ssemod.parse_ssec_headers(headers)
+    if ssec_key:
+        _oek, _iv, _sealed, meta, resp = _ssec_setup(
+            ssec_key, f"{bucket}/{key}"
+        )
+        del _oek  # re-unsealed per part from the presented key
+        return meta, resp
     sse_algo = headers.get("x-amz-server-side-encryption", "")
     if not sse_algo and bucket_encryption_algo:
         sse_algo = bucket_encryption_algo
     if not sse_algo:
         return None
-    import secrets as _secrets
-
     base_iv = _secrets.token_bytes(ssemod.NONCE_SIZE)
     oek, sealed, meta, resp = _sse_s3_kms_setup(
         sse_algo, headers, kms, f"{bucket}/{key}"
@@ -207,21 +236,21 @@ def multipart_sse_init(
 
 def encrypt_part(
     data: bytes, upload_meta: dict, part_number: int, kms: ssemod.KMS,
-    bucket: str, key: str,
+    bucket: str, key: str, headers=None,
 ) -> bytes:
-    oek = _unseal_oek(upload_meta, {}, bucket, key, kms)
+    oek = _unseal_oek(upload_meta, headers or {}, bucket, key, kms)
     base_iv = bytes.fromhex(upload_meta[ssemod.META_IV])
     return ssemod.encrypt_stream(data, oek, part_iv(base_iv, part_number))
 
 
 def encrypt_part_iter(
     chunks, upload_meta: dict, part_number: int, kms: ssemod.KMS,
-    bucket: str, key: str, plain_count: list,
+    bucket: str, key: str, plain_count: list, headers=None,
 ):
     """Streaming variant: yields sealed packets; plain_count[0] gets the
     plaintext size when the source is exhausted (5 GiB parts must not
     buffer in RAM)."""
-    oek = _unseal_oek(upload_meta, {}, bucket, key, kms)
+    oek = _unseal_oek(upload_meta, headers or {}, bucket, key, kms)
     base_iv = bytes.fromhex(upload_meta[ssemod.META_IV])
     return ssemod.encrypt_packets_iter(
         chunks, oek, part_iv(base_iv, part_number), plain_count
@@ -296,7 +325,16 @@ def _unseal_oek(user_defined: dict, headers, bucket: str, key: str, kms: ssemod.
             return ssemod.AESGCM(ssec_key).decrypt(base_iv, sealed, context.encode())
         except Exception:
             raise ssemod.CryptoError("SSE-C unseal failed") from None
-    return kms.unseal(sealed, context)
+    kid = user_defined.get(ssemod.META_KMS_KEY_ID) or None
+    try:
+        return kms.unseal(sealed, context, kid)
+    except ssemod.CryptoError:
+        if not kid or kid == kms.key_id:
+            raise
+        # legacy objects (pre-keyring) recorded the requested key id in
+        # metadata but sealed the OEK under the default master key — fall
+        # back so an upgrade never bricks existing SSE-KMS data
+        return kms.unseal(sealed, context)
 
 
 def decode_full(
