@@ -100,17 +100,86 @@ class EtcdKV:
     def delete(self, key: str) -> None:
         self._call("/v3/kv/deleterange", {"key": self._b64(key.encode())})
 
+    @staticmethod
+    def _range_end(prefix: bytes) -> bytes:
+        """etcd prefix-range successor (prefix with last byte +1)."""
+        return prefix[:-1] + bytes([prefix[-1] + 1])
+
     def list(self, prefix: str) -> dict[str, bytes]:
-        """All keys under prefix (range_end = prefix with last byte +1)."""
+        """All keys under prefix."""
         p = prefix.encode()
-        end = p[:-1] + bytes([p[-1] + 1])
         out = self._call("/v3/kv/range", {
-            "key": self._b64(p), "range_end": self._b64(end)})
+            "key": self._b64(p), "range_end": self._b64(self._range_end(p))})
         result = {}
         for kv in out.get("kvs") or []:
             k = base64.b64decode(kv.get("key", "")).decode()
             result[k] = base64.b64decode(kv.get("value", ""))
         return result
+
+    def watch_prefix(self, prefix: str, on_event, stop) -> None:
+        """Server-streaming watch on a key prefix over the JSON gateway
+        (POST /v3/watch, newline-delimited {"result": {...}} frames —
+        grpc-gateway's rendering of the Watch RPC). Calls `on_event()`
+        for every frame carrying events; reconnects until `stop` is set.
+
+        The reference pairs its periodic IAM refresh with an etcd watch
+        the same way (cmd/iam-etcd-store.go watch + cmd/iam.go:246).
+
+        Robustness: a revision cursor rides each redial (start_revision =
+        last seen + 1) so events landing in the reconnect gap are replayed,
+        not lost; endpoints rotate on failure like _call's balancer."""
+        p = prefix.encode()
+        revision = 0  # last revision seen; 0 = start from "now"
+        ep_idx = 0
+        while not stop.is_set():
+            req: dict = {
+                "key": self._b64(p),
+                "range_end": self._b64(self._range_end(p)),
+            }
+            if revision:
+                req["start_revision"] = str(revision + 1)
+            payload = json.dumps({"create_request": req}).encode()
+            with self._mu:
+                ep = self.endpoints[ep_idx % len(self.endpoints)]
+            host, port, tls = ep
+            cls = (http.client.HTTPSConnection if tls
+                   else http.client.HTTPConnection)
+            conn = cls(host, port, timeout=30)
+            ok = False
+            try:
+                conn.request("POST", "/v3/watch", body=payload,
+                             headers={"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                if resp.status != 200:
+                    raise EtcdError(f"watch: HTTP {resp.status}")
+                while not stop.is_set():
+                    line = resp.readline()
+                    if not line:
+                        break  # stream closed: reconnect
+                    ok = True
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        frame = json.loads(line)
+                    except ValueError:
+                        continue  # partial/keepalive frame
+                    result = frame.get("result") or {}
+                    rev = (result.get("header") or {}).get("revision")
+                    if rev:
+                        try:
+                            revision = max(revision, int(rev))
+                        except ValueError:
+                            pass
+                    if result.get("events"):
+                        on_event()
+            except (OSError, EtcdError, http.client.HTTPException):
+                pass  # gateway restart / timeout: back off and redial
+            finally:
+                conn.close()
+            if not ok:
+                ep_idx += 1  # rotate endpoints when a dial yields nothing
+            stop.wait(1.0)
 
 
 class EtcdIAMStore:
@@ -136,3 +205,9 @@ class EtcdIAMStore:
 
     def delete_object(self, bucket: str, obj: str, *a, **kw):
         self.kv.delete(self._key(obj))
+
+    def watch_changes(self, on_change, stop) -> None:
+        """Blocking watch over the IAM key prefix; IAMSys runs this in its
+        watcher thread so another cluster's writes trigger an immediate
+        cache reload instead of waiting out the refresh interval."""
+        self.kv.watch_prefix(KEY_PREFIX, on_change, stop)

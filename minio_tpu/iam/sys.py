@@ -87,6 +87,7 @@ class IAMSys:
     # -- persistence -------------------------------------------------------
 
     def _save(self, name: str, payload: dict) -> None:
+        self._mutations = getattr(self, "_mutations", 0) + 1
         self.store.put_object(
             SYSTEM_BUCKET, f"{IAM_PREFIX}/{name}.json", json.dumps(payload).encode()
         )
@@ -106,16 +107,67 @@ class IAMSys:
             return {}  # never configured — any OTHER error propagates
 
     def load(self) -> None:
+        # read ALL documents before swapping ANY in: a store error halfway
+        # must never leave fresh users paired with stale policies (torn
+        # cache), and holding the lock across store IO would block auth
+        muts = getattr(self, "_mutations", 0)
+        users_doc = self._load_doc("users")
+        groups_doc = self._load_doc("groups")
+        pol_doc = self._load_doc("policies")
+        ldap_doc = self._load_doc("ldap_policy_map")
         with self._lock:
-            users = self._load_doc("users")
-            self.users = {k: UserIdentity.from_dict(v) for k, v in users.items()}
-            self.groups = self._load_doc("groups")
-            pol = self._load_doc("policies")
+            if self._loaded and getattr(self, "_mutations", 0) != muts:
+                # a local write landed mid-read; this snapshot is stale —
+                # skip the swap, the next refresh tick re-reads
+                return
+            self.users = {
+                k: UserIdentity.from_dict(v) for k, v in users_doc.items()
+            }
+            self.groups = groups_doc
             self.policies = dict(CANNED_POLICIES)
-            for k, v in pol.items():
+            for k, v in pol_doc.items():
                 self.policies[k] = Policy.from_dict(v)
-            self.ldap_policy_map = self._load_doc("ldap_policy_map")
+            self.ldap_policy_map = ldap_doc
             self._loaded = True
+
+    def start_refresh(self, interval: float = 120.0) -> None:
+        """Background IAM cache refresh (reference cmd/iam.go:246: the IAM
+        sys re-loads every refresh interval so writes from other nodes —
+        or other CLUSTERS sharing an etcd identity plane — propagate
+        without restart). When the store exposes watch_changes (etcd), a
+        watcher thread reloads immediately on change; the periodic pass
+        stays as the fallback for missed events."""
+        if getattr(self, "_refresh_stop", None) is not None:
+            return
+        self._refresh_stop = threading.Event()
+        stop = self._refresh_stop
+
+        def reload_once():
+            try:
+                self.load()
+            except Exception:  # noqa: BLE001 — store briefly unavailable
+                pass  # next tick / next event retries
+
+        if interval > 0:
+            def periodic():
+                while not stop.wait(interval):
+                    reload_once()
+
+            threading.Thread(
+                target=periodic, daemon=True, name="iam-refresh"
+            ).start()
+        watch = getattr(self.store, "watch_changes", None)
+        if watch is not None:
+            threading.Thread(
+                target=watch, args=(reload_once, stop), daemon=True,
+                name="iam-watch",
+            ).start()
+
+    def stop_refresh(self) -> None:
+        ev = getattr(self, "_refresh_stop", None)
+        if ev is not None:
+            ev.set()
+            self._refresh_stop = None
 
     def _persist_users(self) -> None:
         self._save("users", {k: u.to_dict() for k, u in self.users.items()})

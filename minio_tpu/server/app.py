@@ -108,6 +108,17 @@ class S3Server(
         # would wipe stored identities on the next persist (first boot is
         # fine — missing documents load as empty)
         self.iam.load()
+        # periodic refresh + etcd watch: IAM writes from peer nodes and
+        # etcd-sharing clusters converge without restart (cmd/iam.go:246)
+        _refresh_raw = os.environ.get("MINIO_TPU_IAM_REFRESH", "120")
+        try:
+            _refresh = float(_refresh_raw)
+        except ValueError:
+            raise SystemExit(
+                f"MINIO_TPU_IAM_REFRESH={_refresh_raw!r}: want seconds "
+                "as a number (0 disables the periodic refresh)"
+            ) from None
+        self.iam.start_refresh(_refresh)
         self.verifier = signature.SigV4Verifier(self.iam.lookup_secret, self.region)
         from ..batch.jobs import BatchJobPool
         from ..crypto.sse import KMS
@@ -202,6 +213,19 @@ class S3Server(
             data, up_meta, part_number, self.kms, bucket, obj, count, headers
         )
         return gen, (lambda: count[0])
+
+    def close(self) -> None:
+        """Stop background workers (IAM refresh/watch, scanner) — for
+        embedders and tests that start/stop servers within one process;
+        without this, watcher threads keep dialing dead backends."""
+        iam = getattr(self, "iam", None)
+        if iam is not None:
+            iam.stop_refresh()
+        if self.background is not None:
+            try:
+                self.background.stop()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
 
     def _queue_repl(self, request, bucket, key, version_id, op) -> None:
         """Queue a bucket-replication task unless this write IS a replica
@@ -1078,6 +1102,7 @@ def main(argv: list[str] | None = None) -> None:
         if cert_watcher is not None:
             cert_watcher.cancel()
         await runner.cleanup()  # close listeners, drain in-flight requests
+        srv.close()  # stop IAM refresh/watch + scanner threads
 
     try:
         _asyncio.run(_serve())

@@ -21,20 +21,68 @@ from test_s3_api import ServerThread
 
 class _FakeEtcd(BaseHTTPRequestHandler):
     """The v3 JSON gateway surface EtcdKV drives: kv/put, kv/range
-    (point + prefix), kv/deleterange — base64 keys/values, like real etcd."""
+    (point + prefix), kv/deleterange, plus the server-streaming /v3/watch
+    — base64 keys/values and newline-delimited result frames, like the
+    real grpc-gateway."""
 
     store: dict[bytes, bytes] = {}
+    watchers: list = []  # (prefix_bytes, queue)
     protocol_version = "HTTP/1.1"
 
     def log_message(self, *a):
         pass
 
+    def _chunk(self, blob: bytes) -> None:
+        self.wfile.write(f"{len(blob):x}\r\n".encode() + blob + b"\r\n")
+        self.wfile.flush()
+
+    def _serve_watch(self, body) -> None:
+        import queue as _queue
+
+        req = body.get("create_request", {})
+        prefix = base64.b64decode(req.get("key", ""))
+        q: _queue.Queue = _queue.Queue()
+        _FakeEtcd.watchers.append((prefix, q))
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        try:
+            self._chunk(json.dumps({"result": {"created": True}}).encode()
+                        + b"\n")
+            while True:
+                try:
+                    ev = q.get(timeout=0.5)
+                except _queue.Empty:
+                    continue
+                self._chunk(json.dumps(
+                    {"result": {"events": [ev]}}).encode() + b"\n")
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass
+        finally:
+            _FakeEtcd.watchers.remove((prefix, q))
+
+    @classmethod
+    def _notify(cls, key: bytes, value: bytes | None) -> None:
+        ev = {"type": "PUT" if value is not None else "DELETE",
+              "kv": {"key": base64.b64encode(key).decode()}}
+        if value is not None:
+            ev["kv"]["value"] = base64.b64encode(value).decode()
+        for prefix, q in list(cls.watchers):
+            if key.startswith(prefix):
+                q.put(ev)
+
     def do_POST(self):
         body = json.loads(self.rfile.read(int(self.headers["Content-Length"])))
+        if self.path == "/v3/watch":
+            self._serve_watch(body)
+            return
         key = base64.b64decode(body.get("key", ""))
         out: dict = {}
         if self.path == "/v3/kv/put":
-            self.store[key] = base64.b64decode(body.get("value", ""))
+            val = base64.b64decode(body.get("value", ""))
+            self.store[key] = val
+            self._notify(key, val)
         elif self.path == "/v3/kv/range":
             if "range_end" in body:
                 end = base64.b64decode(body["range_end"])
@@ -50,7 +98,10 @@ class _FakeEtcd(BaseHTTPRequestHandler):
                 ] if key in self.store else []
             out = {"kvs": kvs, "count": str(len(kvs))}
         elif self.path == "/v3/kv/deleterange":
-            out = {"deleted": str(int(self.store.pop(key, None) is not None))}
+            existed = self.store.pop(key, None) is not None
+            if existed:
+                self._notify(key, None)
+            out = {"deleted": str(int(existed))}
         else:
             self.send_response(404)
             self.end_headers()
@@ -96,17 +147,22 @@ def test_iam_store_adapter(etcd):
 
 
 def test_two_clusters_share_identities(etcd, tmp_path):
-    """A user created on cluster 1 authenticates on cluster 2: the IAM
-    plane lives in etcd, not in either cluster's drives."""
+    """A user created on cluster 1 authenticates on cluster 2 WITHOUT any
+    manual reload: the IAM plane lives in etcd and the etcd watch (plus
+    periodic refresh fallback, reference cmd/iam.go:246) converges
+    cluster 2's cache automatically."""
+    import time
+
     os.environ["MINIO_ETCD_ENDPOINTS"] = etcd
+    os.environ["MINIO_TPU_IAM_REFRESH"] = "2"  # fallback; watch is primary
     try:
         s1 = ServerThread([str(tmp_path / f"c1d{i}") for i in range(4)])
         s2 = ServerThread([str(tmp_path / f"c2d{i}") for i in range(4)])
     finally:
         os.environ.pop("MINIO_ETCD_ENDPOINTS", None)
+        os.environ.pop("MINIO_TPU_IAM_REFRESH", None)
     try:
         c1 = S3Client(f"127.0.0.1:{s1.port}")
-        c2 = S3Client(f"127.0.0.1:{s2.port}")
         r = c1.request("PUT", "/minio/admin/v3/add-user",
                        query={"accessKey": "shared-user"},
                        body=b'{"secretKey": "shared-secret"}')
@@ -121,11 +177,24 @@ def test_two_clusters_share_identities(etcd, tmp_path):
                           "userOrGroup": "shared-user", "isGroup": "false"})
         # the IAM documents landed in etcd, not on drives
         assert any(k.startswith(b"minio_tpu/iam/") for k in _FakeEtcd.store)
-        # cluster 2 reloads IAM from etcd and the user just works
-        s2.srv.iam.load()
+        # cluster 2 converges on its own — no s2.srv.iam.load() here
         u2 = S3Client(f"127.0.0.1:{s2.port}", "shared-user", "shared-secret")
-        assert u2.make_bucket("cross-cluster").status == 200
+        deadline = time.time() + 10
+        r = u2.make_bucket("cross-cluster")
+        while r.status != 200 and time.time() < deadline:
+            time.sleep(0.25)
+            r = u2.make_bucket("cross-cluster")
+        assert r.status == 200, "cluster 2 never saw the etcd-written user"
         assert u2.put_object("cross-cluster", "o", b"x").status == 200
+        # deletes propagate too: drop the user on c1, c2 locks it out
+        c1.request("DELETE", "/minio/admin/v3/remove-user",
+                   query={"accessKey": "shared-user"})
+        deadline = time.time() + 10
+        r = u2.put_object("cross-cluster", "o2", b"x")
+        while r.status == 200 and time.time() < deadline:
+            time.sleep(0.25)
+            r = u2.put_object("cross-cluster", "o2", b"x")
+        assert r.status == 403, "cluster 2 kept serving a deleted user"
     finally:
         s1.stop()
         s2.stop()

@@ -45,6 +45,7 @@ class ServerThread:
         self.loop.run_forever()
 
     def stop(self):
+        self.srv.close()  # IAM refresh/watch + scanner threads
         self.loop.call_soon_threadsafe(self.loop.stop)
 
 
