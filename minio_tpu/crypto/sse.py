@@ -65,7 +65,8 @@ class KMS:
 
     def __init__(self, key_spec: str | None = None, store=None):
         self._store = store
-        self._keys: dict[str, bytes] = {}
+        # name -> (sealed-hex fingerprint, unsealed 32-byte material)
+        self._keys: dict[str, tuple[str, bytes]] = {}
         spec = key_spec or os.environ.get("MINIO_KMS_SECRET_KEY", "")
         if spec:
             # a configured-but-malformed spec must fail loudly: silently

@@ -60,6 +60,7 @@ def issue_cert(
     sans: list[str] | None = None,
     client: bool = False,
     days: int = 30,
+    server_only: bool = False,
 ):
     """Issue a leaf cert signed by the CA.
 
@@ -73,9 +74,12 @@ def issue_cert(
             san_entries.append(x509.IPAddress(ipaddress.ip_address(s)))
         except ValueError:
             san_entries.append(x509.DNSName(s))
-    eku = [ExtendedKeyUsageOID.CLIENT_AUTH] if client else [
-        ExtendedKeyUsageOID.SERVER_AUTH, ExtendedKeyUsageOID.CLIENT_AUTH,
-    ]
+    if server_only:
+        eku = [ExtendedKeyUsageOID.SERVER_AUTH]
+    elif client:
+        eku = [ExtendedKeyUsageOID.CLIENT_AUTH]
+    else:
+        eku = [ExtendedKeyUsageOID.SERVER_AUTH, ExtendedKeyUsageOID.CLIENT_AUTH]
     b = (
         x509.CertificateBuilder()
         .subject_name(_name(cn))
@@ -111,6 +115,26 @@ def cert_common_name(der: bytes) -> str:
 
 def cert_serial(der: bytes) -> int:
     return x509.load_der_x509_certificate(der).serial_number
+
+
+def cert_is_client_auth(der: bytes) -> bool:
+    """True when the leaf's ExtendedKeyUsage grants TLS client auth.
+
+    The reference (cmd/sts-handlers.go:884-893) accepts only certificates
+    whose EKU lists ClientAuth or Any; a certificate without the extension
+    has an empty usage list there and is rejected too.
+    """
+    cert = x509.load_der_x509_certificate(der)
+    try:
+        eku = cert.extensions.get_extension_for_class(
+            x509.ExtendedKeyUsage
+        ).value
+    except x509.ExtensionNotFound:
+        return False
+    return (
+        ExtendedKeyUsageOID.CLIENT_AUTH in eku
+        or ExtendedKeyUsageOID.ANY_EXTENDED_KEY_USAGE in eku
+    )
 
 
 def cert_not_after(der: bytes) -> float:

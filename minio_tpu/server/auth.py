@@ -97,25 +97,47 @@ class RequestAuthMixin:
         self._check_session_token(ak, headers, {})
         return ak, body
 
-    def _decode_trailer_body(self, request, body: bytes) -> bytes:
-        """Decode a buffered aws-chunked STREAMING-UNSIGNED-PAYLOAD-TRAILER
-        body; verify every x-amz-checksum trailer against the decoded
-        payload and record it for storage (small uploads must get the
-        same integrity behavior as streamed ones)."""
+    @staticmethod
+    def _declared_trailer_algo(request) -> str:
+        """The x-amz-trailer checksum algorithm, '' if none declared.
+
+        Shared by the buffered and streaming decode paths so the contract
+        can't diverge: a declared trailer we can't verify must not be
+        accepted silently (integrity was requested) -> InvalidArgument.
+        """
         from ..utils import checksum as cks
 
+        t = request.headers.get("x-amz-trailer", "").strip().lower()
+        if not t:
+            return ""
+        if t.startswith(cks.HEADER) and t[len(cks.HEADER):] in cks.ALGOS:
+            return t[len(cks.HEADER):]
+        raise s3err.InvalidArgument
+
+    def _decode_trailer_body(self, request, body: bytes) -> bytes:
+        """Decode a buffered aws-chunked STREAMING-UNSIGNED-PAYLOAD-TRAILER
+        body; verify the declared x-amz-checksum trailer against the
+        decoded payload and record it for storage — the same integrity
+        contract as the streamed path (undeclared extra trailers are
+        ignored there too)."""
+        from ..utils import checksum as cks
+
+        algo = self._declared_trailer_algo(request)
         dec = _AwsChunkedDecoder()
         data = dec.feed(body)
-        meta: dict[str, str] = {}
-        for k, v in dec.trailers.items():
-            if k.startswith(cks.HEADER):
-                algo = k[len(cks.HEADER):]
-                if algo in cks.ALGOS:
-                    if cks.compute(algo, data) != v:
-                        raise s3err.InvalidDigest
-                    meta[f"{cks.META_PREFIX}{algo}"] = v
-        if meta:
-            request["trailer_checksum_meta"] = meta
+        expect = request.headers.get("x-amz-decoded-content-length")
+        try:
+            if expect is not None and len(data) != int(expect):
+                raise s3err.IncompleteBody
+        except ValueError:
+            raise s3err.InvalidArgument from None
+        if algo:
+            want = dec.trailers.get(f"{cks.HEADER}{algo}")
+            if want is None or cks.compute(algo, data) != want:
+                raise s3err.InvalidDigest
+            request["trailer_checksum_meta"] = {
+                f"{cks.META_PREFIX}{algo}": want
+            }
         return data
 
     def _streamable_put(self, request: web.Request) -> bool:
@@ -226,14 +248,9 @@ class RequestAuthMixin:
             from ..utils import checksum as cks
 
             decoder = _AwsChunkedDecoder()
-            t = request.headers.get("x-amz-trailer", "").strip().lower()
-            if t.startswith(cks.HEADER) and t[len(cks.HEADER):] in cks.ALGOS:
-                trailer_algo = t[len(cks.HEADER):]
+            trailer_algo = self._declared_trailer_algo(request)
+            if trailer_algo:
                 hasher = cks.Hasher(trailer_algo)
-            elif t:
-                # a declared trailer we can't verify must not be accepted
-                # silently (integrity was requested)
-                raise s3err.InvalidArgument
 
         expect = int(
             request.headers.get("x-amz-decoded-content-length")

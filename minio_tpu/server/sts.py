@@ -102,6 +102,10 @@ async def _certificate(server, request: web.Request, form: dict) -> web.Response
     # check the leaf is client-auth capable and extract identity
     from ..crypto import x509util
 
+    if not x509util.cert_is_client_auth(der):
+        # reference rejects certs whose EKU lists neither ClientAuth nor
+        # Any — a chain-valid server-only cert must not mint credentials
+        raise s3err.AccessDenied
     cn = x509util.cert_common_name(der)
     if not cn:
         raise s3err.AccessDenied

@@ -145,6 +145,55 @@ def test_streaming_trailer_checksum(rig):
     assert c.head_object("cks-bkt", "streamed-bad.bin").status == 404
 
 
+def test_buffered_trailer_parity_with_streaming(rig):
+    """Small (buffered) STREAMING-UNSIGNED-PAYLOAD-TRAILER uploads get the
+    same integrity contract as streamed ones: unsupported declared
+    trailers are rejected, decoded length is enforced, and a declared but
+    absent trailer fails."""
+    st, c = rig
+    payload = b"tiny-buffered-trailer-body"
+
+    def chunked(data: bytes, trailers: dict[str, str]) -> bytes:
+        out = bytearray()
+        out += f"{len(data):x}\r\n".encode() + data + b"\r\n0\r\n"
+        for k, v in trailers.items():
+            out += f"{k}:{v}\r\n".encode()
+        out += b"\r\n"
+        return bytes(out)
+
+    def put(name, body, trailer, declen):
+        return c.request(
+            "PUT", f"/cks-bkt/{name}", body=body, unsigned_payload=True,
+            headers={
+                "x-amz-content-sha256": "STREAMING-UNSIGNED-PAYLOAD-TRAILER",
+                "x-amz-trailer": trailer,
+                "x-amz-decoded-content-length": str(declen),
+                "Content-Encoding": "aws-chunked",
+            },
+        )
+
+    want = cks.compute("sha256", payload)
+    ok_wire = chunked(payload, {"x-amz-checksum-sha256": want})
+    r = put("buf-ok.bin", ok_wire, "x-amz-checksum-sha256", len(payload))
+    assert r.status == 200, r.body
+    assert c.head_object("cks-bkt", "buf-ok.bin").headers.get(
+        "x-amz-checksum-sha256") == want
+    # unsupported declared trailer algorithm -> InvalidArgument
+    r = put("buf-unsup.bin", chunked(payload, {"x-amz-checksum-md5sum": "x"}),
+            "x-amz-checksum-md5sum", len(payload))
+    assert r.status == 400, r.status
+    # decoded length mismatch -> IncompleteBody
+    r = put("buf-short.bin", ok_wire, "x-amz-checksum-sha256",
+            len(payload) + 5)
+    assert r.status == 400, r.status
+    # declared trailer never sent -> InvalidDigest
+    r = put("buf-absent.bin", chunked(payload, {}), "x-amz-checksum-sha256",
+            len(payload))
+    assert r.status == 400, r.status
+    for name in ("buf-unsup.bin", "buf-short.bin", "buf-absent.bin"):
+        assert c.head_object("cks-bkt", name).status == 404
+
+
 # ------------------------------------------------- multipart + attributes
 
 

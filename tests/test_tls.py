@@ -252,6 +252,65 @@ def test_sts_certificate_requires_client_cert(tls_cluster):
     assert resp.status == 403
 
 
+def test_sts_certificate_rejects_server_only_eku(tls_cluster):
+    """A chain-valid cert whose EKU lacks ClientAuth (server-only) must not
+    mint credentials even when its CN matches a policy (reference
+    cmd/sts-handlers.go:884-893 rejects non-client-auth EKUs)."""
+    cli1 = tls_cluster["cli1"]
+    policy = {
+        "Version": "2012-10-17",
+        "Statement": [{"Effect": "Allow", "Action": ["s3:*"],
+                       "Resource": ["arn:aws:s3:::*"]}],
+    }
+    r = cli1.request(
+        "PUT", "/minio/admin/v3/add-canned-policy",
+        query={"name": "cert-rw"}, body=json.dumps(policy).encode(),
+    )
+    assert r.status == 200
+    ca_key, ca_cert = tls_cluster["ca"]
+    base = tls_cluster["base"]
+    srv_pem, srv_key = x509util.issue_cert(
+        ca_key, ca_cert, "cert-rw", server_only=True
+    )
+    with open(base / "srvonly.crt", "wb") as f:
+        f.write(srv_pem)
+    with open(base / "srvonly.key", "wb") as f:
+        f.write(srv_key)
+    p1 = tls_cluster["ports"][0]
+    ctx = ssl.create_default_context(cafile=tls_cluster["ca_file"])
+    ctx.load_cert_chain(str(base / "srvonly.crt"), str(base / "srvonly.key"))
+    conn = http.client.HTTPSConnection("127.0.0.1", p1, timeout=10,
+                                       context=ctx)
+    form = urllib.parse.urlencode({
+        "Action": "AssumeRoleWithCertificate", "Version": "2011-06-15",
+        "DurationSeconds": "900",
+    })
+    # rejection may land at either layer: OpenSSL's server-side purpose
+    # check kills the handshake outright, or (if the handshake were
+    # permissive) the STS handler's EKU check returns 403 — both mean no
+    # credentials were minted
+    try:
+        conn.request("POST", "/", body=form.encode(), headers={
+            "Content-Type": "application/x-www-form-urlencoded"})
+        resp = conn.getresponse()
+        assert resp.status == 403, resp.read().decode()
+    except (ssl.SSLError, ConnectionError):
+        pass
+
+    # the handler-level check (reference cmd/sts-handlers.go:884-893) must
+    # also hold on its own for a non-client-auth DER
+    from cryptography.hazmat.primitives import serialization as _ser
+    from cryptography import x509 as _x509
+
+    der = _x509.load_pem_x509_certificate(srv_pem).public_bytes(
+        _ser.Encoding.DER)
+    assert not x509util.cert_is_client_auth(der)
+    client_der = _x509.load_pem_x509_certificate(
+        open(tls_cluster["client_cert"][0], "rb").read()
+    ).public_bytes(_ser.Encoding.DER)
+    assert x509util.cert_is_client_auth(client_der)
+
+
 def test_cert_hot_reload(tls_cluster):
     """Rotate public.crt/private.key on disk: new handshakes serve the new
     certificate (new serial) without a restart, and the cluster still
