@@ -18,10 +18,16 @@ import json
 import os
 import ssl
 
-from .sse import CryptoError
+from .sse import (
+    CryptoError,
+    KMSBackendError,
+    KMSMetrics,
+    counted_kms_op,
+    raise_for_kms_status,
+)
 
 
-class KESKMS:
+class KESKMS(KMSMetrics):
     def __init__(
         self,
         endpoint: str,
@@ -76,17 +82,21 @@ class KESKMS:
             r = conn.getresponse()
             data = r.read()
             if r.status not in (200, 201):
-                raise CryptoError(
-                    f"KES {method} {path}: HTTP {r.status} {data[:200]!r}"
+                # typed mapping on the upstream status — never on message
+                # text (reference internal/kms/errors.go Code field)
+                raise_for_kms_status(
+                    r.status,
+                    f"KES {method} {path}: HTTP {r.status} {data[:200]!r}",
                 )
             return json.loads(data) if data else {}
         except (OSError, ValueError) as e:
-            raise CryptoError(f"KES unreachable: {e}") from None
+            raise KMSBackendError(f"KES unreachable: {e}", status=502) from None
         finally:
             conn.close()
 
     # -- KMS interface (mirrors crypto/sse.py KMS) -------------------------
 
+    @counted_kms_op
     def create_key(self, name: str | None = None,
                    material: bytes | None = None) -> None:
         target = name or self.key_id
@@ -98,6 +108,7 @@ class KESKMS:
             return
         self._request("POST", f"/v1/key/create/{target}")
 
+    @counted_kms_op
     def list_keys(self, pattern: str = "*") -> list:
         out = self._request("GET", f"/v1/key/list/{pattern or '*'}")
         # KES answers a list of {name, ...} descriptors
@@ -107,13 +118,16 @@ class KESKMS:
             )
         return sorted(out.get("keys", []))
 
+    @counted_kms_op
     def key_status(self, name: str) -> dict:
         out = self._request("GET", f"/v1/key/describe/{name}")
         return {"key-id": name, **out}
 
+    @counted_kms_op
     def delete_key(self, name: str) -> None:
         self._request("DELETE", f"/v1/key/delete/{name}")
 
+    @counted_kms_op
     def generate_key(self, context: str, key_name: str | None = None) -> tuple[bytes, bytes]:
         """-> (plaintext 32B DEK, sealed blob to store in metadata)."""
         ctx = base64.b64encode(context.encode()).decode()
@@ -129,6 +143,7 @@ class KESKMS:
         except (KeyError, ValueError):
             raise CryptoError("malformed KES generate response") from None
 
+    @counted_kms_op
     def seal(self, key: bytes, context: str, key_name: str | None = None) -> bytes:
         out = self._request(
             "POST",
@@ -143,6 +158,7 @@ class KESKMS:
         except (KeyError, ValueError):
             raise CryptoError("malformed KES encrypt response") from None
 
+    @counted_kms_op
     def unseal(self, sealed: bytes, context: str, key_name: str | None = None) -> bytes:
         out = self._request(
             "POST",
@@ -163,9 +179,15 @@ class KESKMS:
 
 
 def from_env_or_config(cfg=None, store=None):
-    """KMS factory: KES when configured (env wins, then the kms_kes
-    subsystem), else the builtin single-master-key KMS."""
+    """KMS factory, reference precedence (internal/kms/config.go:104):
+    MinKMS when MINIO_KMS_SERVER is set, else KES when configured (env
+    wins, then the kms_kes subsystem), else the builtin KMS."""
     from .sse import KMS
+
+    if os.environ.get("MINIO_KMS_SERVER", ""):
+        from .minkms import from_env
+
+        return from_env()
 
     def setting(env: str, cfg_key: str) -> str:
         # per-field merge: env wins, the kms_kes subsystem fills the rest

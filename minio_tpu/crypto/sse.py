@@ -15,10 +15,13 @@ Wire format per packet: nonce(12) || ciphertext(plain_len + 16 tag).
 from __future__ import annotations
 
 import base64
+import functools
 import hashlib
 import json
 import os
 import secrets
+import threading
+import time
 
 from cryptography.hazmat.primitives.ciphers.aead import AESGCM
 
@@ -38,7 +41,144 @@ META_PART_SIZES = "x-minio-internal-sse-part-sizes"  # [[part#, plain_size]..]
 
 
 class CryptoError(Exception):
-    pass
+    """Base KMS/SSE error. `status` is the HTTP code the API plane must
+    answer with and `api_code` the client-visible error id — typed, so
+    handlers never string-match messages (reference internal/kms/errors.go
+    carries Code+APICode on every KMS error the same way)."""
+
+    status = 400
+    api_code = "kms:Error"
+
+
+class KeyExistsError(CryptoError):
+    status = 409
+    api_code = "kms:KeyAlreadyExists"
+
+
+class KeyNotFoundError(CryptoError):
+    status = 404
+    api_code = "kms:KeyNotFound"
+
+
+class KMSPermissionError(CryptoError):
+    status = 403
+    api_code = "kms:NotAuthorized"
+
+
+class KMSBackendError(CryptoError):
+    """KMS-side failure (unreachable, lock/corruption, upstream 5xx) —
+    NOT client error; defaults to 500 unless the upstream supplied a
+    specific code."""
+
+    status = 500
+    api_code = "kms:BackendFailed"
+
+    def __init__(self, msg: str, status: int | None = None):
+        super().__init__(msg)
+        if status is not None and 400 <= status < 600:
+            self.status = status
+
+
+def raise_for_kms_status(status: int, msg: str) -> None:
+    """Map an upstream KMS HTTP status onto the typed hierarchy — shared
+    by every remote backend so the mapping can't drift between them."""
+    if status == 404:
+        raise KeyNotFoundError(msg)
+    if status == 409:
+        raise KeyExistsError(msg)
+    if status == 403:
+        raise KMSPermissionError(msg)
+    raise KMSBackendError(msg, status=status)
+
+
+# request-latency histogram bucket upper bounds, seconds (reference
+# internal/kms/kms.go defaultLatencyBuckets 10ms..10s + the +Inf
+# overflow bucket, so hung requests are never dropped from the histogram)
+KMS_LATENCY_BUCKETS = (
+    0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, float("inf"),
+)
+
+
+_METRICS_INIT_LOCK = threading.Lock()
+
+
+class KMSMetrics:
+    """Real request counters shared by every KMS backend (reference
+    internal/kms/kms.go:264 updateMetrics: reqOK/reqErr/reqFail + latency
+    histogram). Lazily initialized so backends need no __init__ hook."""
+
+    def _kms_metric_state(self):
+        lock = self.__dict__.get("_metric_lock")
+        if lock is None:
+            with _METRICS_INIT_LOCK:
+                lock = self.__dict__.get("_metric_lock")
+                if lock is None:
+                    self._metric_requests = 0
+                    self._metric_errors = 0
+                    self._metric_fails = 0
+                    self._metric_latency = [0] * len(KMS_LATENCY_BUCKETS)
+                    # set last: the unlocked fast path must never see the
+                    # lock before the counters exist
+                    self._metric_lock = lock = threading.Lock()
+        return lock
+
+    def _note_kms_op(self, err: Exception | None, latency: float) -> None:
+        with self._kms_metric_state():
+            self._metric_requests += 1
+            for i, ub in enumerate(KMS_LATENCY_BUCKETS):
+                if latency < ub:
+                    self._metric_latency[i] += 1
+                    break
+            if err is None:
+                return
+            # 5xx = the KMS failed; anything else = the request was bad
+            # (the reference's reqFail vs reqErr split)
+            if getattr(err, "status", 500) >= 500:
+                self._metric_fails += 1
+            else:
+                self._metric_errors += 1
+
+    def kms_metrics(self) -> dict:
+        with self._kms_metric_state():
+            reqs = self._metric_requests
+            errs = self._metric_errors
+            fails = self._metric_fails
+            latency = {
+                f"{ub}": n
+                for ub, n in zip(KMS_LATENCY_BUCKETS, self._metric_latency)
+            }
+        return {
+            "requestOK": reqs - errs - fails,
+            "requestErr": errs,
+            "requestFail": fails,
+            "requestActive": 0,
+            "latency": latency,
+        }
+
+
+def counted_kms_op(fn):
+    """Wrap a KMS operation so every top-level call lands in the backend's
+    counters; nested ops (create_key -> seal) count once, like the
+    reference counting per KMS front-door call."""
+
+    @functools.wraps(fn)
+    def wrapped(self, *args, **kwargs):
+        local = self.__dict__.setdefault("_kms_op_local", threading.local())
+        if getattr(local, "active", False):
+            return fn(self, *args, **kwargs)
+        local.active = True
+        t0 = time.monotonic()
+        try:
+            out = fn(self, *args, **kwargs)
+        except Exception as e:
+            self._note_kms_op(e, time.monotonic() - t0)
+            raise
+        finally:
+            local.active = False
+        self._note_kms_op(None, time.monotonic() - t0)
+        return out
+
+    return wrapped
 
 
 def _ns_mutex(store, bucket: str, obj: str):
@@ -59,7 +199,7 @@ def _ns_mutex(store, bucket: str, obj: str):
     return ns.new(bucket, obj) if ns is not None else None
 
 
-class KMS:
+class KMS(KMSMetrics):
     """Builtin single-master-key KMS (reference: MINIO_KMS_SECRET_KEY,
     internal/kms/secret-key.go). Key spec: 'name:base64(32 bytes)'."""
 
@@ -188,7 +328,7 @@ class KMS:
         except ObjectNotFound:
             ring = {}
         except ValueError:
-            raise CryptoError(
+            raise KMSBackendError(
                 "persisted KMS keyring is corrupt; refusing to overwrite"
             ) from None
         self._ring_cache = (ring, now + self._RING_TTL)
@@ -210,7 +350,7 @@ class KMS:
             return self._master
         sealed_hex = self._keyring().get(name)
         if sealed_hex is None:
-            raise CryptoError(f"key does not exist: {name}")
+            raise KeyNotFoundError(f"key does not exist: {name}")
         cached = self._keys.get(name)
         if cached is not None and cached[0] == sealed_hex:
             return cached[1]
@@ -218,6 +358,7 @@ class KMS:
         self._keys[name] = (sealed_hex, key)
         return key
 
+    @counted_kms_op
     def create_key(self, name: str, material: bytes | None = None) -> None:
         """Create (or import, when material is given) a named key."""
         if not name or "/" in name or len(name) > 80:
@@ -228,11 +369,11 @@ class KMS:
             raise CryptoError("imported key material must be 32 bytes")
         mtx = _ns_mutex(self._store, ".minio.sys", self._KEYRING_PATH + ".w")
         if mtx is not None and not mtx.lock(timeout=30.0):
-            raise CryptoError("could not lock KMS keyring")
+            raise KMSBackendError("could not lock KMS keyring")
         try:
             ring = self._keyring(fresh=True)
             if name == self.key_id or name in ring:
-                raise CryptoError(f"key already exists: {name}")
+                raise KeyExistsError(f"key already exists: {name}")
             key = material if material is not None else secrets.token_bytes(32)
             ring[name] = self.seal(key, f"kms-key/{name}").hex()
             self._save_keyring(ring)
@@ -245,6 +386,7 @@ class KMS:
     def _key_exists(self, name: str) -> bool:
         return name == self.key_id or name in self._keyring()
 
+    @counted_kms_op
     def list_keys(self, pattern: str = "*") -> list[str]:
         import fnmatch
 
@@ -252,21 +394,23 @@ class KMS:
         pattern = pattern or "*"
         return sorted(n for n in names if fnmatch.fnmatch(n, pattern))
 
+    @counted_kms_op
     def key_status(self, name: str) -> dict:
         if not self._key_exists(name):
-            raise CryptoError(f"key does not exist: {name}")
+            raise KeyNotFoundError(f"key does not exist: {name}")
         return {"key-id": name, "encryption": "AES-256-GCM", "status": "ok"}
 
+    @counted_kms_op
     def delete_key(self, name: str) -> None:
         if name == self.key_id:
             raise CryptoError("cannot delete the default master key")
         mtx = _ns_mutex(self._store, ".minio.sys", self._KEYRING_PATH + ".w")
         if mtx is not None and not mtx.lock(timeout=30.0):
-            raise CryptoError("could not lock KMS keyring")
+            raise KMSBackendError("could not lock KMS keyring")
         try:
             ring = self._keyring(fresh=True)
             if name not in ring:
-                raise CryptoError(f"key does not exist: {name}")
+                raise KeyNotFoundError(f"key does not exist: {name}")
             del ring[name]
             self._save_keyring(ring)
             self._ring_cache = None
@@ -277,11 +421,13 @@ class KMS:
 
     # -- data-key operations -------------------------------------------------
 
+    @counted_kms_op
     def generate_key(self, context: str, key_name: str | None = None) -> tuple[bytes, bytes]:
         """(plaintext_key, sealed_key) bound to a context string."""
         plain = secrets.token_bytes(32)
         return plain, self.seal(plain, context, key_name)
 
+    @counted_kms_op
     def seal(self, key: bytes, context: str, key_name: str | None = None) -> bytes:
         master = (
             self._named_material(key_name) if key_name else self._master
@@ -290,6 +436,7 @@ class KMS:
         ct = AESGCM(master).encrypt(nonce, key, context.encode())
         return nonce + ct
 
+    @counted_kms_op
     def unseal(self, sealed: bytes, context: str, key_name: str | None = None) -> bytes:
         master = (
             self._named_material(key_name) if key_name else self._master

@@ -36,20 +36,6 @@ def _check_key_name(name: str) -> None:
         raise s3err.InvalidArgument
 
 
-def _crypto_http_status(msg: str) -> int:
-    if "already exists" in msg:
-        return 409
-    if "does not exist" in msg:
-        return 404
-    # KES backend errors carry the upstream HTTP code in the message
-    m = re.search(r"HTTP (\d{3})", msg)
-    if m:
-        code = int(m.group(1))
-        if 400 <= code < 600:
-            return code
-    return 400
-
-
 def _json_resp(payload, status: int = 200) -> web.Response:
     return web.Response(
         body=json.dumps(payload).encode(), status=status,
@@ -68,15 +54,17 @@ async def handle_kms(server, request: web.Request, ak: str, sub: str,
 
     if op == "status" and m == "GET":
         _allowed(server, ak, "kms:Status")
-        return _json_resp(server.kms.status())
+        try:
+            # io-pool: KES/MinKMS status probes remote endpoints and must
+            # never block the event loop
+            return _json_resp(await server._run(server.kms.status))
+        except CryptoError as e:
+            return _json_resp(
+                {"message": str(e), "apiCode": e.api_code}, status=e.status
+            )
     if op == "metrics" and m == "GET":
         _allowed(server, ak, "kms:Metrics")
-        reqs = getattr(server.kms, "_metric_requests", 0)
-        errs = getattr(server.kms, "_metric_errors", 0)
-        return _json_resp({
-            "requestOK": reqs - errs, "requestErr": errs,
-            "requestFail": 0, "requestActive": 0,
-        })
+        return _json_resp(server.kms.kms_metrics())
     if op == "apis" and m == "GET":
         _allowed(server, ak, "kms:API")
         return _json_resp([
@@ -138,6 +126,9 @@ async def handle_kms(server, request: web.Request, ak: str, sub: str,
             await server._run(server.kms.delete_key, key_id)
             return web.Response(status=200)
     except CryptoError as e:
-        msg = str(e)
-        return _json_resp({"message": msg}, status=_crypto_http_status(msg))
+        # typed mapping: every CryptoError subclass carries its HTTP
+        # status + API code (reference internal/kms/errors.go Error)
+        return _json_resp(
+            {"message": str(e), "apiCode": e.api_code}, status=e.status
+        )
     raise s3err.NotImplemented_

@@ -62,6 +62,46 @@ def test_key_lifecycle(cli):
                 query={"key-id": "tenant-a"}).status == 404
 
 
+def test_metrics_report_real_counters(cli):
+    """The /v1/metrics endpoint reports the backend's actual request
+    counters: a successful op bumps requestOK, a failed one requestErr."""
+    before = json.loads(_kms(cli, "GET", "metrics").body)
+    assert _kms(cli, "POST", "key/create",
+                query={"key-id": "metrics-probe"}).status == 200
+    assert _kms(cli, "POST", "key/create",
+                query={"key-id": "metrics-probe"}).status == 409
+    after = json.loads(_kms(cli, "GET", "metrics").body)
+    assert after["requestOK"] == before["requestOK"] + 1
+    assert after["requestErr"] == before["requestErr"] + 1
+    assert sum(after["latency"].values()) > sum(before["latency"].values())
+    assert _kms(cli, "DELETE", "key/delete",
+                query={"key-id": "metrics-probe"}).status == 200
+
+
+def test_typed_error_statuses():
+    """Status mapping rides the error TYPE, not message text: an
+    unrelated backend failure must surface as 500, not collapse to 400
+    (the old substring matcher's failure mode)."""
+    from minio_tpu.crypto.sse import (
+        CryptoError,
+        KeyExistsError,
+        KeyNotFoundError,
+        KMSBackendError,
+        KMSPermissionError,
+    )
+
+    assert KeyExistsError("any wording at all").status == 409
+    assert KeyNotFoundError("any wording at all").status == 404
+    assert KMSPermissionError("nope").status == 403
+    assert KMSBackendError("could not lock KMS keyring").status == 500
+    assert KMSBackendError("upstream said", status=503).status == 503
+    assert CryptoError("plain client error").status == 400
+    # all typed errors remain CryptoError for existing except-clauses
+    for cls in (KeyExistsError, KeyNotFoundError, KMSPermissionError,
+                KMSBackendError):
+        assert issubclass(cls, CryptoError)
+
+
 def test_key_import(cli):
     material = os.urandom(32)
     r = _kms(cli, "POST", "key/import", query={"key-id": "imported"},
