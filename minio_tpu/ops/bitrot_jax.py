@@ -20,6 +20,7 @@ reference's golden chain (/root/reference/cmd/bitrot.go:228-229).
 from __future__ import annotations
 
 import functools
+import threading
 
 import numpy as np
 
@@ -289,6 +290,13 @@ def _select_hash_fn():
 _fused_dec_cooldown = 0
 _fused_dec_backoff = 8
 
+# served-traffic observability: lets integration tests (and the admin
+# plane) assert the decode mega-kernel actually carried degraded reads.
+# Lock-guarded: concurrent degraded GETs reconstruct on server worker
+# threads, and a bare += would drop counts.
+decode_stats = {"fused": 0, "blocks": 0, "failures": 0}
+_decode_stats_lock = threading.Lock()
+
 
 def _try_fused_decode(codec, survivors, present, missing, key):
     """Chunk-major fused reconstruct+verify+hash when shapes allow.
@@ -323,10 +331,15 @@ def _try_fused_decode(codec, survivors, present, missing, key):
         rebuilt = fp.unpack_chunk_major(np.asarray(rebuilt_cm))[:b]
         digs = np.asarray(digests)[:b]
         _fused_dec_backoff = 8
+        with _decode_stats_lock:
+            decode_stats["fused"] += 1
+            decode_stats["blocks"] += b
         return rebuilt, digs[:, d:, :], digs[:, :d, :]
     except Exception:  # noqa: BLE001 — lowering/device failure: XLA path
         _fused_dec_cooldown = _fused_dec_backoff
         _fused_dec_backoff = min(_fused_dec_backoff * 2, 1024)
+        with _decode_stats_lock:
+            decode_stats["failures"] += 1
         return None
 
 

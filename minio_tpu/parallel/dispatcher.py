@@ -129,6 +129,7 @@ class TpuDispatcher:
                 fp.pack_chunk_major(all_blocks), d, p
             )
             self._fused_backoff = 8  # healthy again: reset the backoff
+            self.stats["fused"] = self.stats.get("fused", 0) + 1
             return (
                 fp.unpack_chunk_major(np.asarray(parity_cm)),
                 np.asarray(digests),
