@@ -174,6 +174,63 @@ def _bench_decode(jax, jnp, np) -> float:
     return gib * iters / statistics.median(times)
 
 
+def _bench_qos_p99(np) -> dict:
+    """Secondary metric: p99 foreground single-block encode latency via
+    the priority-aware dispatcher (parallel/dispatcher.py), with and
+    without saturating background load. QoS regressions (foreground
+    blocks delayed behind background batches) show up as the `bg_on`
+    number diverging from `bg_off` across BENCH_*.json rounds."""
+    import threading
+
+    from minio_tpu.ops.rs_jax import get_tpu_codec
+    from minio_tpu.parallel.dispatcher import PRI_BACKGROUND, TpuDispatcher
+    from minio_tpu.qos.context import background_context
+
+    codec = get_tpu_codec(D, P)
+    disp = TpuDispatcher(codec, N)
+    rng = np.random.default_rng(11)
+    fg_blk = rng.integers(0, 256, size=(1, D, N), dtype=np.uint8)
+    bg_blk = rng.integers(0, 256, size=(8, D, N), dtype=np.uint8)
+    disp.encode(fg_blk)  # warm/compile both shapes
+    disp.encode(bg_blk, priority=PRI_BACKGROUND)
+
+    def fg_p99(bg_load: bool, samples: int = 60) -> float:
+        stop = threading.Event()
+        flooders = []
+        if bg_load:
+            def flood():
+                with background_context():
+                    while not stop.is_set():
+                        disp.encode(bg_blk)
+
+            flooders = [threading.Thread(target=flood) for _ in range(2)]
+            for t in flooders:
+                t.start()
+            time.sleep(0.1)  # saturation established
+        lats = []
+        try:
+            for _ in range(samples):
+                t0 = time.perf_counter()
+                disp.encode(fg_blk)
+                lats.append(time.perf_counter() - t0)
+        finally:
+            stop.set()
+            for t in flooders:
+                t.join()
+        lats.sort()
+        return lats[min(len(lats) - 1, int(len(lats) * 0.99))]
+
+    off = fg_p99(False)
+    on = fg_p99(True)
+    return {
+        "qos_metric": "fg_encode_p99_ms",
+        "qos_fg_p99_ms_bg_off": round(off * 1e3, 3),
+        "qos_fg_p99_ms_bg_on": round(on * 1e3, 3),
+        "qos_fg_deferred_behind_bg": disp.stats["fg_deferred_behind_bg"],
+        "qos_bg_blocks": disp.stats["bg_blocks"],
+    }
+
+
 def main() -> None:
     import jax
     import jax.numpy as jnp
@@ -219,6 +276,10 @@ def main() -> None:
         anchor = _measure_native_anchor(np)
     except Exception:  # noqa: BLE001 — anchor must not sink the line
         anchor = 0.0
+    try:
+        qos = _bench_qos_p99(np)
+    except Exception:  # noqa: BLE001 — QoS metric must not sink the line
+        qos = {}
     print(
         json.dumps(
             {
@@ -235,6 +296,7 @@ def main() -> None:
                 "spread_max": round(max(spread), 2),
                 "decode_metric": "rs_decode_verify_ec8_2lost_gibps",
                 "decode_value": round(decode_gibps, 2),
+                **qos,
             }
         )
     )

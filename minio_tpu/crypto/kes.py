@@ -184,17 +184,36 @@ def from_env_or_config(cfg=None, store=None):
     wins, then the kms_kes subsystem), else the builtin KMS."""
     from .sse import KMS
 
-    if os.environ.get("MINIO_KMS_SERVER", ""):
-        from .minkms import from_env
-
-        return from_env()
-
     def setting(env: str, cfg_key: str) -> str:
         # per-field merge: env wins, the kms_kes subsystem fills the rest
         v = os.environ.get(env, "")
         if not v and cfg is not None:
             v = cfg.get("kms_kes", cfg_key)
         return v
+
+    present = []
+    if os.environ.get("MINIO_KMS_SERVER", ""):
+        present.append("MinKMS (MINIO_KMS_SERVER)")
+    # KES counts whether configured by env OR the kms_kes config
+    # subsystem — an endpoint from either source makes it live
+    if setting("MINIO_KMS_KES_ENDPOINT", "endpoint"):
+        present.append("KES (MINIO_KMS_KES_ENDPOINT / kms_kes endpoint)")
+    if os.environ.get("MINIO_KMS_SECRET_KEY", ""):
+        present.append("static key (MINIO_KMS_SECRET_KEY)")
+    if len(present) > 1:
+        # mirrors the reference kms.IsPresent() contract: more than one
+        # KMS backend configured is an operator error that must fail
+        # loudly at boot — silently picking one by precedence could
+        # encrypt under a key the operator never intended (e.g. a
+        # migration that leaves the old static key exported)
+        raise CryptoError(
+            "ambiguous KMS configuration: " + " and ".join(present)
+            + " are both set — configure exactly one backend"
+        )
+    if os.environ.get("MINIO_KMS_SERVER", ""):
+        from .minkms import from_env
+
+        return from_env()
 
     endpoint = setting("MINIO_KMS_KES_ENDPOINT", "endpoint")
     key_name = setting("MINIO_KMS_KES_KEY_NAME", "key_name")

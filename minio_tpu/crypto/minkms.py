@@ -147,7 +147,16 @@ class MinKMS(KMSMetrics):
         ) from None
 
     def _key_path(self, op: str, name: str) -> str:
-        return f"/v1/key/{op}/{self.enclave}/{name}"
+        # percent-encode both path segments: a key name with reserved
+        # characters ('/', '?', '#', spaces) must reach the server as ONE
+        # segment and earn a typed error, not silently address a
+        # different path
+        import urllib.parse
+
+        return (
+            f"/v1/key/{op}/{urllib.parse.quote(self.enclave, safe='')}"
+            f"/{urllib.parse.quote(name, safe='')}"
+        )
 
     # -- KMS interface (mirrors crypto/sse.py KMS) -------------------------
 
@@ -167,9 +176,13 @@ class MinKMS(KMSMetrics):
     def list_keys(self, pattern: str = "*") -> list:
         # MinKMS lists by prefix (reference kmsConn.ListKeys req.Prefix);
         # translate the glob the API plane accepts into a prefix
+        import urllib.parse
+
         prefix = pattern.split("*", 1)[0].split("?", 1)[0]
         out = self._request(
-            "GET", f"/v1/key/list/{self.enclave}?prefix={prefix}"
+            "GET",
+            f"/v1/key/list/{urllib.parse.quote(self.enclave, safe='')}"
+            f"?prefix={urllib.parse.quote(prefix, safe='')}",
         )
         items = out.get("items", out) if isinstance(out, dict) else out
         import fnmatch
@@ -246,7 +259,9 @@ class MinKMS(KMSMetrics):
             try:
                 self._one_request(target, "GET", "/version", None)
                 online.append(label)
-            except (OSError, CryptoError):
+            except (OSError, http.client.HTTPException, CryptoError):
+                # HTTPException: the endpoint answered non-HTTP garbage
+                # (BadStatusLine et al.) — offline, not an untyped 500
                 offline.append(label)
         return {
             "name": "MinKMS",

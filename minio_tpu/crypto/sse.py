@@ -23,7 +23,10 @@ import secrets
 import threading
 import time
 
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+try:
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+except ImportError:  # gated dep: SSE raises a typed error at use time
+    AESGCM = None
 
 PACKET_SIZE = 64 * 1024  # plaintext bytes per sealed packet
 NONCE_SIZE = 12
@@ -77,6 +80,18 @@ class KMSBackendError(CryptoError):
         super().__init__(msg)
         if status is not None and 400 <= status < 600:
             self.status = status
+
+
+def _aesgcm(key: bytes):
+    """AESGCM constructor behind the gated `cryptography` dependency: a
+    deployment without it serves unencrypted traffic normally and answers
+    SSE requests with a typed error instead of an import-time crash."""
+    if AESGCM is None:
+        raise KMSBackendError(
+            "server-side encryption needs the 'cryptography' package, "
+            "which is not installed"
+        )
+    return AESGCM(key)
 
 
 def raise_for_kms_status(status: int, msg: str) -> None:
@@ -433,7 +448,7 @@ class KMS(KMSMetrics):
             self._named_material(key_name) if key_name else self._master
         )
         nonce = secrets.token_bytes(NONCE_SIZE)
-        ct = AESGCM(master).encrypt(nonce, key, context.encode())
+        ct = _aesgcm(master).encrypt(nonce, key, context.encode())
         return nonce + ct
 
     @counted_kms_op
@@ -442,7 +457,7 @@ class KMS(KMSMetrics):
             self._named_material(key_name) if key_name else self._master
         )
         try:
-            return AESGCM(master).decrypt(
+            return _aesgcm(master).decrypt(
                 sealed[:NONCE_SIZE], sealed[NONCE_SIZE:], context.encode()
             )
         except Exception:
@@ -466,7 +481,7 @@ def encrypt_packets_iter(chunks, key: bytes, base_iv: bytes, plain_count: list):
     """Incrementally seal a chunk iterator into the packet stream; appends
     the total plaintext size into plain_count[0] when exhausted (streamed
     SSE parts must never buffer the whole part)."""
-    aes = AESGCM(key)
+    aes = _aesgcm(key)
     buf = bytearray()
     idx = 0
     total = 0
@@ -486,7 +501,7 @@ def encrypt_packets_iter(chunks, key: bytes, base_iv: bytes, plain_count: list):
 
 def encrypt_stream(data: bytes, key: bytes, base_iv: bytes) -> bytes:
     """Seal data into the packet stream."""
-    aes = AESGCM(key)
+    aes = _aesgcm(key)
     out = bytearray()
     for pi, off in enumerate(range(0, len(data), PACKET_SIZE)):
         chunk = data[off : off + PACKET_SIZE]
@@ -497,7 +512,7 @@ def encrypt_stream(data: bytes, key: bytes, base_iv: bytes) -> bytes:
 
 
 def decrypt_stream(stored: bytes, key: bytes, base_iv: bytes) -> bytes:
-    aes = AESGCM(key)
+    aes = _aesgcm(key)
     out = bytearray()
     pi = 0
     off = 0
@@ -542,7 +557,7 @@ def decrypt_packets(
     stored: bytes, key: bytes, base_iv: bytes, first_packet: int
 ) -> bytes:
     """Decrypt a run of packets starting at `first_packet`."""
-    aes = AESGCM(key)
+    aes = _aesgcm(key)
     out = bytearray()
     off = 0
     pi = first_packet

@@ -142,15 +142,18 @@ class BackgroundOps:
                 yield s
 
     def _disk_monitor_loop(self) -> None:
+        from ..qos.context import background_context
+
         interval = float(os.environ.get("MINIO_TPU_DISK_MONITOR_INTERVAL", "10"))
         if interval <= 0:
             return
-        while not self._stop.is_set():
-            try:
-                self.check_fresh_disks()
-            except Exception:  # noqa: BLE001 — monitor must never die
-                pass
-            self._stop.wait(interval)
+        with background_context():  # drain-heal blocks ride the bg TPU lane
+            while not self._stop.is_set():
+                try:
+                    self.check_fresh_disks()
+                except Exception:  # noqa: BLE001 — monitor must never die
+                    pass
+                self._stop.wait(interval)
 
     @staticmethod
     def _drive_root(disk) -> str | None:
@@ -307,12 +310,18 @@ class BackgroundOps:
     # -- scanner -----------------------------------------------------------
 
     def _scan_loop(self) -> None:
-        while not self._stop.is_set():
-            try:
-                self.scan_once()
-            except Exception:  # noqa: BLE001 — scanner must never die
-                pass
-            self._stop.wait(self.scan_interval)
+        from ..qos.context import background_context
+
+        # QoS: scanner work (ILM transitions re-encode via put, deep
+        # verify heals) must never displace foreground stripe blocks in
+        # the TPU batch window
+        with background_context():
+            while not self._stop.is_set():
+                try:
+                    self.scan_once()
+                except Exception:  # noqa: BLE001 — scanner must never die
+                    pass
+                self._stop.wait(self.scan_interval)
 
     def scan_once(self) -> DataUsage:
         """One full namespace crawl: usage accounting + heal detection.
@@ -519,13 +528,16 @@ class BackgroundOps:
     # -- heal workers ------------------------------------------------------
 
     def _heal_loop(self) -> None:
-        while not self._stop.is_set():
-            item = self.mrf.get(timeout=1.0)
-            if item is None:
-                continue
-            bucket, obj = item
-            try:
-                self.store.heal_object(bucket, obj)
-                self.stats["heals_done"] += 1
-            except Exception:  # noqa: BLE001
-                self.stats["heals_failed"] += 1
+        from ..qos.context import background_context
+
+        with background_context():  # heal blocks ride the bg TPU lane
+            while not self._stop.is_set():
+                item = self.mrf.get(timeout=1.0)
+                if item is None:
+                    continue
+                bucket, obj = item
+                try:
+                    self.store.heal_object(bucket, obj)
+                    self.stats["heals_done"] += 1
+                except Exception:  # noqa: BLE001
+                    self.stats["heals_failed"] += 1

@@ -94,6 +94,19 @@ class PoolManager:
         return self.decoms.get(pool_index) or self.load_checkpoint(pool_index)
 
     def _drain(self, st: DecomStatus) -> None:
+        with self._bg_ctx():
+            self._drain_inner(st)
+
+    @staticmethod
+    def _bg_ctx():
+        # QoS: decommission re-PUTs whole objects — their stripe blocks
+        # ride the TPU dispatcher's background lane (leftover batch
+        # capacity only), never displacing foreground traffic
+        from ..qos.context import background_context
+
+        return background_context()
+
+    def _drain_inner(self, st: DecomStatus) -> None:
         src = self.pools.pools[st.pool_index]
         others = [
             p for i, p in enumerate(self.pools.pools) if i != st.pool_index
@@ -169,31 +182,35 @@ class PoolManager:
             }
 
         def loop():
-            st = self._rebalance_state
-            while not self._rebalance_stop.is_set():
-                usage = self.pool_usage()
-                spread = max(u["usedPct"] for u in usage) - min(
-                    u["usedPct"] for u in usage
-                )
-                st["spread_pct"] = round(spread, 2)
-                if spread <= threshold_pct:
-                    st["state"] = "done"
-                    return
-                try:
-                    out = self.start_rebalance(max_objects=200)
-                except Exception as e:  # noqa: BLE001
-                    st["state"] = "failed"
-                    st["error"] = str(e)
-                    return
-                st["moved"] += out.get("moved", 0)
-                st["passes"] += 1
-                if out.get("moved", 0) == 0:
-                    st["state"] = "done"  # nothing movable: converged
-                    return
-            st["state"] = "stopped"
+            with self._bg_ctx():
+                self._rebalance_loop(threshold_pct)
 
         _threading.Thread(target=loop, daemon=True, name="rebalance").start()
         return dict(self._rebalance_state)
+
+    def _rebalance_loop(self, threshold_pct: float) -> None:
+        st = self._rebalance_state
+        while not self._rebalance_stop.is_set():
+            usage = self.pool_usage()
+            spread = max(u["usedPct"] for u in usage) - min(
+                u["usedPct"] for u in usage
+            )
+            st["spread_pct"] = round(spread, 2)
+            if spread <= threshold_pct:
+                st["state"] = "done"
+                return
+            try:
+                out = self.start_rebalance(max_objects=200)
+            except Exception as e:  # noqa: BLE001
+                st["state"] = "failed"
+                st["error"] = str(e)
+                return
+            st["moved"] += out.get("moved", 0)
+            st["passes"] += 1
+            if out.get("moved", 0) == 0:
+                st["state"] = "done"  # nothing movable: converged
+                return
+        st["state"] = "stopped"
 
     def stop_rebalance(self) -> dict:
         self._rebalance_stop.set()

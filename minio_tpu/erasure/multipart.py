@@ -18,7 +18,7 @@ from ..storage import errors
 from ..storage.datatypes import FileInfo, ObjectPartInfo, now_ns
 from ..utils.hashing import hash_order
 from .quorum import ObjectNotFound, QuorumError, reduce_quorum_errs
-from .set import ErasureSet
+from .set import ErasureSet, _lock_dyn
 from .types import ObjectInfo
 
 MP_VOLUME = ".minio.sys/multipart"
@@ -312,7 +312,9 @@ class MultipartManager:
         # the final commit must exclude concurrent put/delete of the same
         # object (same namespace write lock put_object takes)
         mtx = self.es.ns.new(bucket, obj)
-        if not mtx.lock(30.0):
+        # same adaptive deadline as put_object: under contention both
+        # planes loosen together (and both feed the estimator)
+        if not _lock_dyn(mtx, write=True):
             # server-side contention is retryable, not a client error
             raise QuorumError(f"namespace lock timeout completing {bucket}/{obj}")
         if check_precond is not None:

@@ -48,6 +48,32 @@ from .types import BucketInfo, ObjectInfo
 
 TMP_VOLUME = ".minio.sys/tmp"
 DIGEST = bitrot_io.DIGEST_SIZE
+
+# namespace-lock deadline adapts to observed acquisition behaviour
+# (qos/dyntimeout.py — the reference's globalOperationTimeout dynamic
+# timeout): a contended cluster earns a looser deadline instead of
+# spurious quorum errors, relaxing back once healthy. The floor equals
+# the historical fixed deadline (30 s): healthy near-zero waits must
+# never shrink the deadline below what lock HOLD times need — a holder
+# legitimately runs seconds of encode+disk I/O (the reference keeps a
+# 5-minute floor on its operation timeout for the same reason).
+from ..qos.dyntimeout import DynamicTimeout
+
+NS_LOCK_TIMEOUT = DynamicTimeout(30.0, minimum_s=30.0, name="ns-lock")
+
+
+def _lock_dyn(mtx, write: bool = True) -> bool:
+    """Acquire the namespace lock under the adaptive deadline, feeding the
+    wait duration (or the timeout) back into the estimator."""
+    import time as _time
+
+    t0 = _time.monotonic()
+    ok = (mtx.lock if write else mtx.rlock)(NS_LOCK_TIMEOUT.timeout())
+    if ok:
+        NS_LOCK_TIMEOUT.log_success(_time.monotonic() - t0)
+    else:
+        NS_LOCK_TIMEOUT.log_failure()
+    return ok
 # single source for the internal tag metadata key: the S3 layer stores it,
 # the ILM scanner filters on it, this layer round-trips it
 TAGS_META_KEY = "x-minio-internal-tags"
@@ -260,7 +286,7 @@ class ErasureSet:
         if not self.bucket_exists(bucket) and not bucket.startswith(".minio.sys"):
             raise BucketNotFound(bucket)
         mtx = self.ns.new(bucket, obj)
-        if not mtx.lock(30.0):
+        if not _lock_dyn(mtx, write=True):
             raise QuorumError(f"namespace write lock timeout on {bucket}/{obj}")
         try:
             if check_precond is not None:
@@ -597,7 +623,7 @@ class ErasureSet:
         """One quorum metadata read under a namespace read lock; the handle
         serves any number of ranged reads without re-reading metadata."""
         mtx = self.ns.new(bucket, obj)
-        if not mtx.rlock(30.0):
+        if not _lock_dyn(mtx, write=False):
             raise QuorumError(f"namespace read lock timeout on {bucket}/{obj}")
         try:
             fi, metas, _, _ = self._quorum_fileinfo(
@@ -924,7 +950,7 @@ class ErasureSet:
         - unversioned -> remove the null version entirely
         """
         mtx = self.ns.new(bucket, obj)
-        if not mtx.lock(30.0):
+        if not _lock_dyn(mtx, write=True):
             raise QuorumError(f"namespace write lock timeout on {bucket}/{obj}")
         try:
             return self._delete_object_locked(bucket, obj, version_id, versioned)
@@ -969,7 +995,7 @@ class ErasureSet:
         namespace write lock. `mutate(metadata_dict)` edits in place.
         Serves tagging, retention, and legal holds."""
         mtx = self.ns.new(bucket, obj)
-        if not mtx.lock(30.0):
+        if not _lock_dyn(mtx, write=True):
             raise QuorumError(f"lock timeout updating {bucket}/{obj}")
         try:
             # read_data=True: the rewrite below persists the FileInfo as-is,
@@ -1006,7 +1032,7 @@ class ErasureSet:
         re-stubs an already-transitioned object whose restored copy
         expired (data is already in the tier)."""
         mtx = self.ns.new(bucket, obj)
-        if not mtx.lock(30.0):
+        if not _lock_dyn(mtx, write=True):
             raise QuorumError(f"lock timeout transitioning {bucket}/{obj}")
         try:
             from ..ilm.tier import RESTORE_EXPIRY_META, TRANSITION_KEY_META, TRANSITION_TIER_META
@@ -1061,7 +1087,7 @@ class ErasureSet:
         import time as _time
 
         mtx = self.ns.new(bucket, obj)
-        if not mtx.lock(30.0):
+        if not _lock_dyn(mtx, write=True):
             raise QuorumError(f"lock timeout restoring {bucket}/{obj}")
         try:
             from ..ilm.tier import RESTORE_EXPIRY_META, TRANSITION_TIER_META
@@ -1149,7 +1175,7 @@ class ErasureSet:
         concurrent overwrite of the same object.
         """
         mtx = self.ns.new(bucket, obj)
-        if not mtx.lock(30.0):
+        if not _lock_dyn(mtx, write=True):
             raise QuorumError(f"namespace lock timeout healing {bucket}/{obj}")
         try:
             return self._heal_object_locked(bucket, obj, version_id, lock=mtx)

@@ -12,16 +12,29 @@ accelerator changes about the architecture.
 Latency contract: a block waits at most `window` (default 2 ms) before
 dispatch; an idle queue dispatches immediately. p99 PUT latency gains the
 window; throughput gains the full batch width of the MXU/VPU.
+
+Priority lanes (qos/): foreground blocks (S3 PUT/GET handlers) and
+background blocks (heal, scanner, decommission, rebalance — marked via
+``qos.background_context()``) queue separately. Batch assembly always
+drains foreground first; background work rides along only in leftover
+batch capacity, capped at a fraction of the batch so a bg-heavy dispatch
+cannot stretch foreground latency, with starvation protection: a
+background block older than ``MINIO_TPU_QOS_BG_MAX_AGE_MS`` promotes to
+the foreground lane so saturating PUT traffic cannot park heals forever.
+The ``fg_deferred_behind_bg`` stat witnesses the invariant that no
+foreground block ever waits behind background batch slots (it stays 0).
 """
 
 from __future__ import annotations
 
 import os
-import queue
 import threading
+from collections import deque
 from concurrent.futures import Future
 
 import numpy as np
+
+from ..qos.context import PRI_BACKGROUND, PRI_FOREGROUND, current_priority
 
 
 class TpuDispatcher:
@@ -45,6 +58,22 @@ class TpuDispatcher:
         while p2 * 2 <= mb:
             p2 *= 2
         self.max_blocks = p2
+        # background lane policy: bg blocks fill at most this many slots of
+        # any one dispatch, and a bg block older than max_age promotes to
+        # the foreground lane (starvation protection). Malformed env
+        # values fall back to defaults — a QoS tuning typo must not take
+        # down the encode plane (the dispatcher builds lazily on first PUT)
+        try:
+            frac = float(os.environ.get("MINIO_TPU_QOS_BG_FRACTION", "0.5"))
+        except ValueError:
+            frac = 0.5
+        self.bg_max_blocks = max(1, min(self.max_blocks, int(self.max_blocks * frac)))
+        try:
+            self.bg_max_age = (
+                float(os.environ.get("MINIO_TPU_QOS_BG_MAX_AGE_MS", "50")) / 1e3
+            )
+        except ValueError:
+            self.bg_max_age = 0.05
         self._fused_enabled = (
             os.environ.get("MINIO_TPU_FUSED_CM", "1") != "0"
         )
@@ -53,48 +82,131 @@ class TpuDispatcher:
         self._fused_cooldown = 0   # dispatches to skip before re-probing
         self._fused_backoff = 8    # next cooldown length, doubles to a cap
         self._encode_and_hash = encode_and_hash
-        self._q: queue.Queue = queue.Queue()
-        self._carry: tuple | None = None
-        self.stats = {"dispatches": 0, "blocks": 0, "max_batch": 0}
+        self._cv = threading.Condition()
+        # lanes hold (blocks, fut, priority, t_enqueue); unconsumed items
+        # stay at the head, so no separate carry slot is needed
+        self._fg: deque = deque()
+        self._bg: deque = deque()
+        # every key pre-seeded: observers (aggregate_stats, metrics) read
+        # this dict from other threads, and a lazily-inserted key would
+        # race their iteration ("dict changed size during iteration")
+        self.stats = {
+            "dispatches": 0, "blocks": 0, "max_batch": 0,
+            "fg_blocks": 0, "bg_blocks": 0, "bg_forced": 0,
+            "bg_batch_max": 0, "fg_deferred_behind_bg": 0,
+            "fused": 0, "fused_failures": 0,
+        }
         self._thread = threading.Thread(
             target=self._loop, daemon=True,
             name=f"tpu-dispatch-{codec.data_shards}+{codec.parity_shards}",
         )
         self._thread.start()
 
-    def submit(self, blocks: np.ndarray) -> Future:
-        """blocks: [k, d, n] -> Future of (shards [k, t, n], digests [k, t, 32])."""
+    def submit(self, blocks: np.ndarray, priority: int | None = None) -> Future:
+        """blocks: [k, d, n] -> Future of (shards [k, t, n], digests [k, t, 32]).
+
+        priority: PRI_FOREGROUND / PRI_BACKGROUND; None resolves from the
+        qos context (background planes run under ``background_context()``).
+        """
+        if priority is None:
+            priority = current_priority()
         fut: Future = Future()
-        self._q.put((blocks, fut))
+        item = (blocks, fut, priority, _monotonic())
+        with self._cv:
+            (self._bg if priority == PRI_BACKGROUND else self._fg).append(item)
+            self._cv.notify()
         return fut
 
-    def encode(self, blocks: np.ndarray):
-        return self.submit(blocks).result()
+    def encode(self, blocks: np.ndarray, priority: int | None = None):
+        return self.submit(blocks, priority).result()
 
     # -- worker ------------------------------------------------------------
 
-    def _collect(self) -> list[tuple[np.ndarray, Future]]:
-        if self._carry is not None:
-            batch = [self._carry]
-            self._carry = None
-        else:
-            batch = [self._q.get()]  # block until work arrives
-        total = batch[0][0].shape[0]
-        if self._q.empty():
-            return batch  # idle queue: dispatch immediately, no added latency
-        deadline = _monotonic() + self.window
-        while total < self.max_blocks:
-            timeout = deadline - _monotonic()
-            try:
-                item = self._q.get(timeout=max(timeout, 0)) if timeout > 0 else self._q.get_nowait()
-            except queue.Empty:
+    @staticmethod
+    def _drain_locked(dq: deque, batch: list, room: int, force: bool = False) -> int:
+        """Move whole items from `dq` into `batch` while they fit `room`
+        blocks; an oversize head stays queued (next dispatch) unless
+        `force` and the batch is still empty — the first item of a
+        dispatch may exceed the cap, exactly like the old carry logic.
+        Returns blocks taken. Caller holds self._cv."""
+        took = 0
+        while dq:
+            k = dq[0][0].shape[0]
+            if k > room - took and not (force and not batch):
                 break
-            k = item[0].shape[0]
-            if total + k > self.max_blocks:
-                self._carry = item  # don't overshoot the HBM shard cap
-                break
-            batch.append(item)
-            total += k
+            batch.append(dq.popleft())
+            took += k
+        return took
+
+    def _promote_aged_locked(self, now: float) -> None:
+        """Starvation protection: background items older than bg_max_age
+        move to the foreground lane (they have waited long enough that
+        'leftover capacity only' would become 'never')."""
+        while self._bg and now - self._bg[0][3] > self.bg_max_age:
+            item = self._bg.popleft()
+            self._fg.append(item)
+            self.stats["bg_forced"] += item[0].shape[0]
+
+    def _collect(self) -> list[tuple]:
+        batch: list[tuple] = []
+        total = 0
+        with self._cv:
+            while not self._fg and not self._bg:
+                self._cv.wait()
+            self._promote_aged_locked(_monotonic())
+            total += self._drain_locked(
+                self._fg, batch, self.max_blocks - total, force=True
+            )
+        # the straggler window opens only on evidence of CONCURRENT
+        # foreground traffic (>= 2 genuinely-foreground items queued
+        # together, the old single-queue contract — age-promoted bg items
+        # don't count). Pending or promoted bg work must not hold a lone
+        # fg block hostage for the window — that would be exactly the
+        # "foreground delayed by background" regression this lane exists
+        # to prevent; bg fills leftover capacity below either way.
+        native_fg = sum(1 for it in batch if it[2] == PRI_FOREGROUND)
+        if native_fg > 1 and total < self.max_blocks:
+            deadline = _monotonic() + self.window
+            while total < self.max_blocks:
+                timeout = deadline - _monotonic()
+                if timeout <= 0:
+                    break
+                with self._cv:
+                    if not self._fg:
+                        self._cv.wait(timeout)
+                    self._promote_aged_locked(_monotonic())
+                    took = self._drain_locked(
+                        self._fg, batch, self.max_blocks - total
+                    )
+                    total += took
+                    if self._fg and took == 0:
+                        # head item cannot fit the remaining room, which
+                        # never grows: stop burning the window (and the
+                        # CPU — waiting here would spin on every notify)
+                        break
+        with self._cv:
+            # late fg arrivals still beat queued bg work — drained first
+            # under the same lock that grants bg its leftover slots
+            self._promote_aged_locked(_monotonic())
+            total += self._drain_locked(
+                self._fg, batch, self.max_blocks - total, force=not batch
+            )
+            if self._fg:
+                room = 0  # fg still queued (capacity-bound): bg gets nothing
+            else:
+                room = min(self.max_blocks - total, self.bg_max_blocks)
+            took_bg = self._drain_locked(
+                self._bg, batch, room, force=not batch
+            )
+            total += took_bg
+            if took_bg:
+                self.stats["bg_batch_max"] = max(
+                    self.stats["bg_batch_max"], took_bg
+                )
+                if self._fg:
+                    # defensive witness for the acceptance invariant; by
+                    # construction this never fires
+                    self.stats["fg_deferred_behind_bg"] += 1
         return batch
 
     @staticmethod
@@ -129,7 +241,7 @@ class TpuDispatcher:
                 fp.pack_chunk_major(all_blocks), d, p
             )
             self._fused_backoff = 8  # healthy again: reset the backoff
-            self.stats["fused"] = self.stats.get("fused", 0) + 1
+            self.stats["fused"] += 1
             return (
                 fp.unpack_chunk_major(np.asarray(parity_cm)),
                 np.asarray(digests),
@@ -139,14 +251,14 @@ class TpuDispatcher:
             # hiccup must not degrade the server until restart
             self._fused_cooldown = self._fused_backoff
             self._fused_backoff = min(self._fused_backoff * 2, 1024)
-            self.stats["fused_failures"] = self.stats.get("fused_failures", 0) + 1
+            self.stats["fused_failures"] += 1
             return None
 
     def _loop(self) -> None:
         while True:
             batch = self._collect()
             try:
-                all_blocks = np.concatenate([b for b, _ in batch], axis=0)
+                all_blocks = np.concatenate([b for b, _, _, _ in batch], axis=0)
                 k = all_blocks.shape[0]
                 bucket = self._bucket(k)
                 if bucket < 16 and self._fused_enabled and self._fused_cooldown == 0:
@@ -182,12 +294,18 @@ class TpuDispatcher:
                 self.stats["blocks"] += k
                 self.stats["max_batch"] = max(self.stats["max_batch"], k)
                 off = 0
-                for blocks, fut in batch:
-                    k = blocks.shape[0]
-                    fut.set_result((shards[off : off + k], digests[off : off + k]))
-                    off += k
+                for blocks, fut, pri, _ in batch:
+                    kk = blocks.shape[0]
+                    if pri == PRI_BACKGROUND:
+                        self.stats["bg_blocks"] += kk
+                    else:
+                        self.stats["fg_blocks"] += kk
+                    fut.set_result(
+                        (shards[off : off + kk], digests[off : off + kk])
+                    )
+                    off += kk
             except Exception as e:  # noqa: BLE001 — fail all waiters
-                for _, fut in batch:
+                for _, fut, _, _ in batch:
                     if not fut.done():
                         fut.set_exception(e)
 
@@ -211,3 +329,15 @@ def get_dispatcher(codec, n: int) -> TpuDispatcher:
             if d is None:
                 d = _dispatchers[key] = TpuDispatcher(codec, n)
     return d
+
+
+def aggregate_stats() -> dict[str, int]:
+    """Summed stats across every live dispatcher (metrics/admin plane)."""
+    out: dict[str, int] = {}
+    for d in list(_dispatchers.values()):
+        for k, v in d.stats.items():
+            if k in ("max_batch", "bg_batch_max"):
+                out[k] = max(out.get(k, 0), v)
+            else:
+                out[k] = out.get(k, 0) + v
+    return out

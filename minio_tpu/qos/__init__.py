@@ -1,0 +1,62 @@
+"""QoS subsystem: admission control, dynamic timeouts, latency tracking,
+and request-priority context for the TPU dispatch plane.
+
+Four cooperating pieces, mirroring the reference's serving-robustness
+plumbing that had no equivalent here:
+
+- admission (admission.py): per-API-class inflight caps with a bounded
+  wait deadline, answering S3 ``SlowDown`` (503) on overflow — the
+  analogue of ``globalAPIConfig.getRequestsPool`` + the maxClients
+  throttle in cmd/handler-api.go.
+- dynamic timeouts (dyntimeout.py): deadlines that adapt to observed
+  success/failure durations (cmd/dynamic-timeouts.go); consumed by the
+  namespace-lock plane in erasure/set.py.
+- last-minute latency (lastminute.py): a ring of per-second buckets
+  recording per-API count/ttfb/duration (cmd/last-minute.go), feeding
+  /minio/metrics/v3/api/qos and the admin inflight-requests endpoint.
+- priority context (context.py): marks background planes (heal, scanner,
+  decommission, rebalance) so their stripe blocks ride the TPU batch
+  dispatcher's background lane and never displace foreground PUT/GET
+  blocks (parallel/dispatcher.py).
+"""
+
+from __future__ import annotations
+
+from .admission import (  # noqa: F401
+    CLASS_ADMIN,
+    CLASS_BACKGROUND,
+    CLASS_S3,
+    AdmissionController,
+    ClassPolicy,
+)
+from .context import (  # noqa: F401
+    PRI_BACKGROUND,
+    PRI_FOREGROUND,
+    background_context,
+    current_priority,
+    in_background,
+)
+from .dyntimeout import DynamicTimeout  # noqa: F401
+from .lastminute import LastMinuteLatency  # noqa: F401
+
+
+class QoS:
+    """Per-server QoS facade: one admission controller + one last-minute
+    latency ring. Dynamic timeouts and dispatch priorities are shared
+    process-wide (module-level), matching the reference's globals."""
+
+    def __init__(self, admission: AdmissionController | None = None):
+        self.admission = (
+            admission if admission is not None else AdmissionController.from_env()
+        )
+        self.last_minute = LastMinuteLatency()
+
+    def snapshot(self) -> dict:
+        """Combined state for the admin inflight-requests endpoint."""
+        from . import dyntimeout
+
+        return {
+            "admission": self.admission.snapshot(),
+            "lastMinute": self.last_minute.totals(),
+            "dynamicTimeouts": dyntimeout.snapshot(),
+        }

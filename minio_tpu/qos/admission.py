@@ -1,0 +1,214 @@
+"""Admission control: per-API-class inflight caps with bounded waiting.
+
+Mirrors the reference's request throttle (cmd/handler-api.go maxClients +
+``globalAPIConfig.getRequestsPool``): a request that finds its class at
+the inflight cap waits up to a deadline for a slot; a class whose wait
+queue is itself full rejects immediately. Either rejection surfaces as S3
+``SlowDown`` (503) at the server layer — bounded latency instead of
+unbounded queueing.
+
+Classes (the reference throttles S3 data-plane and admin separately):
+
+- ``s3``          — foreground object/bucket data plane
+- ``admin``       — /minio/admin + /minio/kms planes
+- ``background``  — reserved for server-classified background traffic;
+                    never chosen from client-controlled wire signals
+                    (classification runs pre-auth, so a header-routed
+                    class would be attacker-selectable)
+
+Env knobs (all optional):
+
+- ``MINIO_TPU_API_REQUESTS_MAX``       s3 inflight cap (0/unset = auto:
+                                       max(256, 32*cpus); -1 = unlimited)
+- ``MINIO_TPU_API_REQUESTS_DEADLINE``  wait deadline seconds (default 10)
+- ``MINIO_TPU_API_ADMIN_REQUESTS_MAX`` admin inflight cap (default 64)
+- ``MINIO_TPU_API_BG_REQUESTS_MAX``    background inflight cap (default 64)
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass
+
+CLASS_S3 = "s3"
+CLASS_ADMIN = "admin"
+CLASS_BACKGROUND = "background"
+
+
+@dataclass(frozen=True)
+class ClassPolicy:
+    max_inflight: int  # <= 0: unlimited (inflight still counted)
+    max_waiters: int  # queue bound; beyond it requests reject instantly
+    deadline_s: float  # max time a request may wait for a slot
+
+
+class _ClassState:
+    __slots__ = (
+        "policy", "inflight", "waiting",
+        "admitted", "rejected_full", "rejected_timeout",
+    )
+
+    def __init__(self, policy: ClassPolicy):
+        self.policy = policy
+        self.inflight = 0
+        self.waiting = 0
+        self.admitted = 0
+        self.rejected_full = 0
+        self.rejected_timeout = 0
+
+
+class AdmissionController:
+    def __init__(self, policies: dict[str, ClassPolicy] | None = None):
+        self._cv = threading.Condition()
+        self._cls: dict[str, _ClassState] = {
+            name: _ClassState(pol) for name, pol in (policies or {}).items()
+        }
+
+    @classmethod
+    def from_env(cls) -> "AdmissionController":
+        def _int(name: str, default: int) -> int:
+            try:
+                return int(os.environ.get(name, "") or default)
+            except ValueError:
+                return default
+
+        cpus = os.cpu_count() or 1
+        s3_max = _int("MINIO_TPU_API_REQUESTS_MAX", 0)
+        if s3_max == 0:  # auto-size, like the reference's memory heuristic
+            s3_max = max(256, 32 * cpus)
+        try:
+            deadline = float(os.environ.get("MINIO_TPU_API_REQUESTS_DEADLINE", "10"))
+        except ValueError:
+            deadline = 10.0
+        admin_max = _int("MINIO_TPU_API_ADMIN_REQUESTS_MAX", 64)
+        bg_max = _int("MINIO_TPU_API_BG_REQUESTS_MAX", 64)
+
+        def policy(mx: int) -> ClassPolicy:
+            # wait queue bounded at 4x the cap: overflow beyond it answers
+            # 503 immediately instead of stacking waiters without bound
+            return ClassPolicy(
+                max_inflight=mx,
+                max_waiters=max(4 * mx, 0),
+                deadline_s=max(deadline, 0.0),
+            )
+
+        return cls({
+            CLASS_S3: policy(s3_max),
+            CLASS_ADMIN: policy(admin_max),
+            CLASS_BACKGROUND: policy(bg_max),
+        })
+
+    def _state(self, name: str) -> _ClassState:
+        st = self._cls.get(name)
+        if st is None:  # unknown class: unlimited, but still observable
+            st = self._cls[name] = _ClassState(
+                ClassPolicy(max_inflight=0, max_waiters=0, deadline_s=0.0)
+            )
+        return st
+
+    # -- slot protocol -----------------------------------------------------
+
+    def try_acquire(self, name: str) -> bool:
+        """Non-blocking fast path (safe to call from an event loop).
+        Refuses while waiters are parked even if a slot is free: fresh
+        arrivals must not barge ahead of requests already burning their
+        deadline, or sustained saturation would preferentially 503 the
+        oldest requests."""
+        with self._cv:
+            st = self._state(name)
+            if st.policy.max_inflight <= 0 or (
+                st.inflight < st.policy.max_inflight and st.waiting == 0
+            ):
+                st.inflight += 1
+                st.admitted += 1
+                return True
+            return False
+
+    def begin_wait(self, name: str) -> float | None:
+        """Reserve a waiter slot and start the deadline clock (cheap,
+        non-blocking — async servers call this on the event loop BEFORE
+        handing the blocking wait to a worker thread, so executor queue
+        time counts against the deadline and the waiter cap is enforced
+        immediately, not when a thread happens to pick the task up).
+        Returns the absolute monotonic deadline, or None when the wait
+        queue is full (caller answers SlowDown now)."""
+        with self._cv:
+            st = self._state(name)
+            if st.waiting >= st.policy.max_waiters:
+                st.rejected_full += 1
+                return None
+            st.waiting += 1
+            return time.monotonic() + st.policy.deadline_s
+
+    def finish_wait(self, name: str, deadline: float) -> bool:
+        """Blocking companion of begin_wait: wait for a slot until the
+        absolute `deadline`. Always consumes the waiter reservation."""
+        with self._cv:
+            st = self._state(name)
+            try:
+                while True:
+                    pol = st.policy  # re-read: set_policy retunes waiters
+                    if pol.max_inflight <= 0 or st.inflight < pol.max_inflight:
+                        st.inflight += 1
+                        st.admitted += 1
+                        return True
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        st.rejected_timeout += 1
+                        return False
+                    self._cv.wait(remaining)
+            finally:
+                st.waiting -= 1
+
+    def abort_wait(self, name: str) -> None:
+        """Undo a begin_wait reservation whose finish_wait will never run
+        (the executor task was cancelled before starting)."""
+        with self._cv:
+            st = self._state(name)
+            if st.waiting > 0:
+                st.waiting -= 1
+
+    def acquire(self, name: str, deadline_s: float | None = None) -> bool:
+        """Blocking acquire: wait up to the class deadline for a slot.
+        False = the caller must answer SlowDown (503)."""
+        if self.try_acquire(name):
+            return True
+        deadline = self.begin_wait(name)
+        if deadline is None:
+            return False
+        if deadline_s is not None:
+            deadline = time.monotonic() + deadline_s
+        return self.finish_wait(name, deadline)
+
+    def release(self, name: str) -> None:
+        with self._cv:
+            st = self._state(name)
+            if st.inflight > 0:
+                st.inflight -= 1
+            self._cv.notify_all()
+
+    # -- observability -----------------------------------------------------
+
+    def snapshot(self) -> dict[str, dict]:
+        with self._cv:
+            return {
+                name: {
+                    "inflight": st.inflight,
+                    "waiting": st.waiting,
+                    "admitted": st.admitted,
+                    "rejectedFull": st.rejected_full,
+                    "rejectedTimeout": st.rejected_timeout,
+                    "maxInflight": st.policy.max_inflight,
+                    "maxWaiters": st.policy.max_waiters,
+                    "deadlineSeconds": st.policy.deadline_s,
+                }
+                for name, st in self._cls.items()
+            }
+
+    def set_policy(self, name: str, policy: ClassPolicy) -> None:
+        """Runtime retune (admin/config plane; tests)."""
+        with self._cv:
+            self._state(name).policy = policy
+            self._cv.notify_all()

@@ -1,0 +1,45 @@
+"""Request-priority context: which plane is the current thread working for?
+
+Foreground (S3 PUT/GET handlers) is the default; background planes (heal
+workers, the data scanner, fresh-disk drain heal, decommission drain,
+rebalance) wrap their work loops in ``background_context()``. The TPU
+batch dispatcher resolves a block's priority from this context at
+``submit()`` time, so the erasure coder and every layer between the
+server and the device stay priority-agnostic.
+
+A ``contextvars.ContextVar`` rather than a thread-local: each thread
+starts from a fresh context (default: foreground), and async code that
+ever moves encode work onto the event loop inherits the right value.
+"""
+
+from __future__ import annotations
+
+import contextvars
+from contextlib import contextmanager
+
+PRI_FOREGROUND = 0
+PRI_BACKGROUND = 1
+
+_BACKGROUND: contextvars.ContextVar[bool] = contextvars.ContextVar(
+    "minio_tpu_qos_background", default=False
+)
+
+
+@contextmanager
+def background_context():
+    """Mark the enclosing work as background for QoS purposes: its stripe
+    blocks ride the dispatcher's background lane (leftover batch capacity
+    only, with starvation protection)."""
+    token = _BACKGROUND.set(True)
+    try:
+        yield
+    finally:
+        _BACKGROUND.reset(token)
+
+
+def in_background() -> bool:
+    return bool(_BACKGROUND.get())
+
+
+def current_priority() -> int:
+    return PRI_BACKGROUND if _BACKGROUND.get() else PRI_FOREGROUND

@@ -1,0 +1,83 @@
+"""Dynamic timeouts: deadlines that adapt to observed behaviour.
+
+Port of the reference's cmd/dynamic-timeouts.go: every completed guarded
+operation logs its duration (or a failure sentinel for a timeout); each
+full log window of LOG_SIZE entries triggers one adjustment —
+
+- more than 33% timeouts  -> grow the deadline by 25% (capped);
+- fewer than 10% timeouts -> shrink halfway toward 125% of the slowest
+  observed success (floored at the configured minimum).
+
+A struggling cluster (slow drives, lock contention) automatically earns
+looser deadlines instead of failing hard; a healthy one converges back
+down so stuck operations are detected quickly.
+"""
+
+from __future__ import annotations
+
+import threading
+
+LOG_SIZE = 16
+INCREASE_THRESHOLD_PCT = 0.33
+DECREASE_THRESHOLD_PCT = 0.10
+MAX_TIMEOUT_S = 24 * 3600.0
+
+_FAILURE = float("inf")  # sentinel log entry for a timed-out operation
+
+_registry_mu = threading.Lock()
+_registry: dict[str, "DynamicTimeout"] = {}
+
+
+class DynamicTimeout:
+    def __init__(self, timeout_s: float, minimum_s: float = 0.1, name: str = ""):
+        if timeout_s <= 0:
+            raise ValueError("dynamic timeout needs a positive initial value")
+        self._mu = threading.Lock()
+        self._timeout = max(timeout_s, minimum_s)
+        self.minimum = minimum_s
+        self._log: list[float] = []
+        self.adjustments = 0
+        self.name = name
+        if name:
+            with _registry_mu:
+                _registry[name] = self
+
+    def timeout(self) -> float:
+        """Current deadline in seconds."""
+        with self._mu:
+            return self._timeout
+
+    def log_success(self, duration_s: float) -> None:
+        self._log_entry(max(duration_s, 0.0))
+
+    def log_failure(self) -> None:
+        self._log_entry(_FAILURE)
+
+    def _log_entry(self, duration_s: float) -> None:
+        with self._mu:
+            self._log.append(duration_s)
+            if len(self._log) >= LOG_SIZE:
+                self._adjust()
+                self._log.clear()
+
+    def _adjust(self) -> None:
+        # called under self._mu with a full window
+        failures = sum(1 for d in self._log if d == _FAILURE)
+        slowest = max((d for d in self._log if d != _FAILURE), default=0.0)
+        fail_pct = failures / len(self._log)
+        if fail_pct > INCREASE_THRESHOLD_PCT:
+            self._timeout = min(self._timeout * 1.25, MAX_TIMEOUT_S)
+            self.adjustments += 1
+        elif fail_pct < DECREASE_THRESHOLD_PCT:
+            target = slowest * 1.25
+            if target < self._timeout:
+                # move halfway toward the target: smooth convergence, no
+                # cliff when one fast window follows a slow spell
+                self._timeout = max((self._timeout + target) / 2, self.minimum)
+                self.adjustments += 1
+
+
+def snapshot() -> dict[str, float]:
+    """Named dynamic timeouts -> current deadline seconds (metrics/admin)."""
+    with _registry_mu:
+        return {name: dt.timeout() for name, dt in _registry.items()}

@@ -1,0 +1,97 @@
+"""Last-minute latency tracking: a ring of per-second buckets.
+
+Port of the reference's cmd/last-minute.go ``lastMinuteLatency``: each of
+the WINDOW (60) buckets accumulates per-API {count, total duration, max
+duration, total ttfb} for one wall-clock second; reads merge the live
+window, and stale buckets are zeroed lazily as time advances — O(1) per
+observation, no timers.
+
+Feeds the /minio/metrics/v3/api/qos exposition and the admin
+inflight-requests endpoint.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+WINDOW = 60  # seconds
+
+
+class AccElem:
+    __slots__ = ("n", "total", "max", "ttfb")
+
+    def __init__(self):
+        self.n = 0
+        self.total = 0.0
+        self.max = 0.0
+        self.ttfb = 0.0
+
+    def add(self, dur: float, ttfb: float) -> None:
+        self.n += 1
+        self.total += dur
+        self.ttfb += ttfb
+        if dur > self.max:
+            self.max = dur
+
+    def merge(self, other: "AccElem") -> None:
+        self.n += other.n
+        self.total += other.total
+        self.ttfb += other.ttfb
+        if other.max > self.max:
+            self.max = other.max
+
+
+class LastMinuteLatency:
+    def __init__(self, clock=time.monotonic):
+        self._clock = clock
+        self._mu = threading.Lock()
+        # bucket[i] = {api: AccElem} for second (last_sec - delta)
+        self._buckets: list[dict[str, AccElem]] = [dict() for _ in range(WINDOW)]
+        self._last_sec = int(clock())
+
+    def _forward(self, sec: int) -> None:
+        """Advance the window to `sec`, zeroing buckets that fell out.
+        Called under self._mu."""
+        step = sec - self._last_sec
+        if step <= 0:
+            return
+        if step >= WINDOW:
+            for b in self._buckets:
+                b.clear()
+        else:
+            for i in range(1, step + 1):
+                self._buckets[(self._last_sec + i) % WINDOW].clear()
+        self._last_sec = sec
+
+    def add(self, api: str, dur: float, ttfb: float | None = None) -> None:
+        sec = int(self._clock())
+        with self._mu:
+            self._forward(sec)
+            bucket = self._buckets[sec % WINDOW]
+            elem = bucket.get(api)
+            if elem is None:
+                elem = bucket[api] = AccElem()
+            elem.add(dur, dur if ttfb is None else ttfb)
+
+    def totals(self) -> dict[str, dict[str, float]]:
+        """Merged per-API stats over the trailing minute."""
+        sec = int(self._clock())
+        merged: dict[str, AccElem] = {}
+        with self._mu:
+            self._forward(sec)
+            for bucket in self._buckets:
+                for api, elem in bucket.items():
+                    acc = merged.get(api)
+                    if acc is None:
+                        acc = merged[api] = AccElem()
+                    acc.merge(elem)
+        return {
+            api: {
+                "count": acc.n,
+                "avg_seconds": acc.total / acc.n if acc.n else 0.0,
+                "max_seconds": acc.max,
+                "ttfb_avg_seconds": acc.ttfb / acc.n if acc.n else 0.0,
+            }
+            for api, acc in sorted(merged.items())
+        }

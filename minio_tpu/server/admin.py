@@ -520,6 +520,16 @@ async def handle_admin(server, request: web.Request, access_key: str, subpath: s
         authz("admin:OBDInfo")
         bg = server.background
         return _json(bg.stats if bg else {})
+    if op == "inflight-requests" and m == "GET":
+        # QoS observability (`mc admin top api` analogue): per-class
+        # admission state, last-minute per-API latency, adaptive
+        # deadlines, and the TPU dispatcher's priority-lane counters
+        authz("admin:OBDInfo")
+        from ..parallel import dispatcher as dmod
+
+        snap = server.qos.snapshot()
+        snap["dispatcher"] = dmod.aggregate_stats()
+        return _json(snap)
     if op == "top/locks" and m == "GET":
         authz("admin:TopLocksInfo")
         # aggregate lock tables reachable from this node

@@ -65,10 +65,20 @@ class S3Server(
         # long-poll waits (trace/listen subscribers) get their own pool so
         # they can never starve the I/O pool
         self._longpoll_pool = _TPE(max_workers=64, thread_name_prefix="longpoll")
+        # admission waits get a small dedicated pool: a class at its cap
+        # must not occupy long-poll or I/O threads, and since begin_wait
+        # starts the deadline clock on the event loop, tasks that outwait
+        # their deadline in this pool's queue reject instantly on start
+        self._admit_pool = _TPE(max_workers=16, thread_name_prefix="qos-admit")
         self.region = region
         self.started_at = _time.time()
         self.metrics = Metrics()
         self.trace = TracePubSub()
+        from ..qos import QoS
+
+        # QoS plane: admission control (per-class inflight caps -> 503
+        # SlowDown on overflow) + last-minute per-API latency ring
+        self.qos = QoS()
         self.background = None
         self.root_user = os.environ.get("MINIO_ROOT_USER", "minioadmin")
         self.root_pass = os.environ.get("MINIO_ROOT_PASSWORD", "minioadmin")
@@ -323,15 +333,51 @@ class S3Server(
             request.match_info["bucket"] = vb
             return
 
+    async def _admit(self, qos_class: str) -> bool:
+        """Admission control for one request: lock-only fast path on the
+        event loop; contended classes reserve a waiter slot (bounded —
+        queue-full rejects here, before any thread is consumed) and park
+        the blocking deadline wait on the dedicated admission pool.
+        Cancellation-safe: a client that disconnects mid-wait hands any
+        slot the worker still grants straight back, so caps never leak."""
+        adm = self.qos.admission
+        if adm.try_acquire(qos_class):
+            return True
+        deadline = adm.begin_wait(qos_class)
+        if deadline is None:
+            return False  # wait queue full: SlowDown immediately
+        # submit + wrap (not run_in_executor): on cancellation the asyncio
+        # wrapper is marked cancelled even while the worker keeps running,
+        # so the reclaim callback must ride the CONCURRENT future, whose
+        # terminal state says what finish_wait actually did
+        cf = self._admit_pool.submit(adm.finish_wait, qos_class, deadline)
+        try:
+            return await asyncio.wrap_future(cf)
+        except asyncio.CancelledError:
+            def _reclaim(f):
+                try:
+                    if f.cancelled():
+                        # finish_wait never ran: undo the reservation
+                        adm.abort_wait(qos_class)
+                    elif f.exception() is None and f.result():
+                        adm.release(qos_class)  # granted to a dead request
+                except Exception:  # noqa: BLE001 — teardown best-effort
+                    pass
+
+            cf.add_done_callback(_reclaim)
+            raise
+
     async def _entry(self, request: web.Request) -> web.StreamResponse:
         import time as _time
 
+        from .handler_utils import classify_qos_class
         from .metrics import classify_api, trace_record
 
         self._apply_vhost_style(request)
         t0 = _time.perf_counter()
         request["_t0"] = t0  # TTFB measured at response prepare time
         resp: web.StreamResponse | None = None
+        qos_class: str | None = None
         self.metrics.inflight += 1  # single-threaded event loop: no race
         try:
             origin = request.headers.get("Origin", "")
@@ -340,9 +386,24 @@ class S3Server(
             ):
                 resp = await self._cors_preflight(request, origin)
                 return resp
+            cls = classify_qos_class(
+                request.match_info.get("bucket", ""),
+                request.match_info.get("key", ""),
+                request.headers,
+            )
+            if cls is not None:
+                if not await self._admit(cls):
+                    # over the class cap past the bounded wait deadline:
+                    # S3 SlowDown (503), never unbounded queueing
+                    resp = self._err_response(request, s3err.SlowDown)
+                    resp.headers["Retry-After"] = "1"
+                    return resp
+                qos_class = cls  # acquired: release in finally
             resp = await self._entry_inner(request)
             return resp
         finally:
+            if qos_class is not None:
+                self.qos.admission.release(qos_class)
             self.metrics.inflight -= 1
             dur = _time.perf_counter() - t0
             status = resp.status if resp is not None else 500
@@ -359,6 +420,7 @@ class S3Server(
                 bucket=request.match_info.get("bucket", ""),
                 ttfb=request.get("_ttfb"),
             )
+            self.qos.last_minute.add(api, dur, ttfb=request.get("_ttfb"))
             if self.trace.active:
                 self.trace.publish(trace_record(request, status, dur, rx, tx))
             audit = getattr(self, "audit", None)

@@ -131,6 +131,29 @@ def _route_conditions(q) -> dict[str, str]:
     return {"s3:prefix": q.get("prefix", ""), "s3:delimiter": q.get("delimiter", "")}
 
 
+def classify_qos_class(bucket: str, key: str, headers=None) -> str | None:
+    """Request -> admission-control class (qos/admission.py), or None for
+    planes that must never throttle: health probes (throttled liveness
+    checks would flap the orchestrator), metrics scrapes, the embedded
+    console, and internode RPC (storage/lock/grid ride their own routes,
+    but any /minio/* path that is not admin or KMS stays exempt too).
+
+    Classification runs PRE-auth (the reference's maxClients throttle
+    does too), so it must never trust client-controlled signals: routing
+    e.g. the replication-marker header into its own class would let any
+    unauthenticated sender pick its admission pool. Request headers are
+    accepted for future use but ignored today; the background class is
+    fed by server-side planes (heal/scan/decommission), not by wire
+    classification."""
+    from ..qos.admission import CLASS_ADMIN, CLASS_S3
+
+    if bucket == "minio":
+        if key.startswith("admin/") or key.startswith("kms/"):
+            return CLASS_ADMIN
+        return None
+    return CLASS_S3
+
+
 def _parse_form_data(body: bytes, boundary: bytes) -> tuple[dict[str, str], bytes]:
     """Minimal multipart/form-data parser for POST-policy uploads.
 

@@ -32,9 +32,18 @@ from __future__ import annotations
 import os
 import struct
 
-from cryptography.exceptions import InvalidTag
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM, ChaCha20Poly1305
-from cryptography.hazmat.primitives.kdf.argon2 import Argon2id
+try:
+    from cryptography.exceptions import InvalidTag
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM, ChaCha20Poly1305
+    from cryptography.hazmat.primitives.kdf.argon2 import Argon2id
+
+    _HAVE_CRYPTO = True
+except ImportError:  # gated dep: encrypted madmin framing unavailable;
+    # the plain-JSON admin plane (our own SDK) still works
+    _HAVE_CRYPTO = False
+
+    class InvalidTag(Exception):  # type: ignore[no-redef]
+        pass
 
 AES_GCM_ID = 0x00
 C20P1305_ID = 0x01
@@ -50,12 +59,22 @@ class MadminCryptError(Exception):
 
 
 def _derive_key(password: str, salt: bytes) -> bytes:
+    if not _HAVE_CRYPTO:
+        raise MadminCryptError(
+            "madmin encrypted framing needs the 'cryptography' package, "
+            "which is not installed"
+        )
     return Argon2id(
         salt=salt, length=32, iterations=1, lanes=4, memory_cost=64 * 1024
     ).derive(password.encode())
 
 
 def _aead(aead_id: int, key: bytes):
+    if not _HAVE_CRYPTO:
+        raise MadminCryptError(
+            "madmin encrypted framing needs the 'cryptography' package, "
+            "which is not installed"
+        )
     if aead_id == AES_GCM_ID:
         return AESGCM(key)
     if aead_id == C20P1305_ID:

@@ -660,9 +660,67 @@ def _g_audit(server) -> list[str]:
     return out
 
 
+def _g_api_qos(server) -> list[str]:
+    """QoS plane: admission-control state per class, last-minute per-API
+    latency (qos/lastminute.py ring), dynamic-timeout deadlines, and the
+    TPU dispatcher's priority-lane counters. The dispatcher series are
+    the wire-visible proof of the batching policy: fg/bg block totals,
+    forced (anti-starvation) promotions, and the invariant witness
+    ``fg_deferred_behind_bg`` (always 0 when foreground never waits
+    behind background batch slots)."""
+    out: list[str] = []
+    qos = getattr(server, "qos", None)
+    if qos is None:
+        return out
+    snap = qos.admission.snapshot()
+    _fmt(out, "minio_api_qos_inflight", "gauge",
+         [({"class": c}, s["inflight"]) for c, s in sorted(snap.items())],
+         "In-flight requests per admission class")
+    _fmt(out, "minio_api_qos_waiting", "gauge",
+         [({"class": c}, s["waiting"]) for c, s in sorted(snap.items())])
+    _fmt(out, "minio_api_qos_max_inflight", "gauge",
+         [({"class": c}, s["maxInflight"]) for c, s in sorted(snap.items())])
+    _fmt(out, "minio_api_qos_admitted_total", "counter",
+         [({"class": c}, s["admitted"]) for c, s in sorted(snap.items())])
+    _fmt(out, "minio_api_qos_rejected_total", "counter",
+         [({"class": c, "reason": r}, s[k])
+          for c, s in sorted(snap.items())
+          for r, k in (("queue_full", "rejectedFull"),
+                       ("deadline", "rejectedTimeout"))])
+    lm = qos.last_minute.totals()
+    _fmt(out, "minio_api_qos_last_minute_requests", "gauge",
+         [({"name": a}, v["count"]) for a, v in lm.items()])
+    _fmt(out, "minio_api_qos_last_minute_avg_seconds", "gauge",
+         [({"name": a}, f"{v['avg_seconds']:.6f}") for a, v in lm.items()])
+    _fmt(out, "minio_api_qos_last_minute_max_seconds", "gauge",
+         [({"name": a}, f"{v['max_seconds']:.6f}") for a, v in lm.items()])
+    _fmt(out, "minio_api_qos_last_minute_ttfb_avg_seconds", "gauge",
+         [({"name": a}, f"{v['ttfb_avg_seconds']:.6f}") for a, v in lm.items()])
+    from ..qos import dyntimeout
+
+    _fmt(out, "minio_tpu_dynamic_timeout_seconds", "gauge",
+         [({"name": n}, f"{v:.3f}")
+          for n, v in sorted(dyntimeout.snapshot().items())])
+    from ..parallel import dispatcher as dmod
+
+    ds = dmod.aggregate_stats()
+    _fmt(out, "minio_tpu_dispatch_blocks_total", "counter",
+         [({"class": "foreground"}, ds.get("fg_blocks", 0)),
+          ({"class": "background"}, ds.get("bg_blocks", 0))],
+         "Stripe blocks dispatched per priority lane")
+    _fmt(out, "minio_tpu_dispatch_bg_forced_blocks_total", "counter",
+         [({}, ds.get("bg_forced", 0))])
+    _fmt(out, "minio_tpu_dispatch_bg_batch_max_blocks", "gauge",
+         [({}, ds.get("bg_batch_max", 0))])
+    _fmt(out, "minio_tpu_dispatch_fg_deferred_behind_bg_total", "counter",
+         [({}, ds.get("fg_deferred_behind_bg", 0))])
+    return out
+
+
 # collector path -> renderer; bucket paths live in V3_BUCKET_GROUPS
 V3_GROUPS = {
     "/api/requests": _g_api_requests,
+    "/api/qos": _g_api_qos,
     "/system/network/internode": _g_system_network,
     "/system/drive": _g_system_drive,
     "/system/memory": _g_system_memory,

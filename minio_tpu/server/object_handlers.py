@@ -203,10 +203,16 @@ class ObjectHandlersMixin:
             raise s3err.InternalError
 
         def pull_and_restore():
-            r = t.client().get_object(t.bucket, rkey)
-            if r.status != 200:
-                raise RuntimeError(f"tier read failed: HTTP {r.status}")
-            self.store.restore_object(bucket, key, r.body, days)
+            from ..qos.context import background_context
+
+            # QoS: a restore re-encodes the whole object from the warm
+            # tier (202 Accepted semantics) — its stripe blocks ride the
+            # TPU dispatcher's background lane, not the foreground window
+            with background_context():
+                r = t.client().get_object(t.bucket, rkey)
+                if r.status != 200:
+                    raise RuntimeError(f"tier read failed: HTTP {r.status}")
+                self.store.restore_object(bucket, key, r.body, days)
 
         await self._run(pull_and_restore)
         return web.Response(status=202)

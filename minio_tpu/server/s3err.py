@@ -94,3 +94,8 @@ NotificationNotFound = APIError("NoSuchConfiguration", "The specified configurat
 AdminBucketQuotaExceeded = APIError(
     "XMinioAdminBucketQuotaExceeded", "Bucket quota exceeded", 400
 )
+SlowDown = APIError(
+    "SlowDown",
+    "Resource requested is unreadable, please reduce your request rate",
+    503,
+)

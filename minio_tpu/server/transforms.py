@@ -157,7 +157,7 @@ def _ssec_setup(
 
     base_iv = _secrets.token_bytes(ssemod.NONCE_SIZE)
     oek = _secrets.token_bytes(32)
-    sealed = ssemod.AESGCM(ssec_key).encrypt(base_iv, oek, context.encode())
+    sealed = ssemod._aesgcm(ssec_key).encrypt(base_iv, oek, context.encode())
     key_md5 = _b64.b64encode(_hashlib.md5(ssec_key).digest()).decode()
     meta = {
         ssemod.META_ALGO: "SSE-C",
@@ -322,7 +322,7 @@ def _unseal_oek(user_defined: dict, headers, bucket: str, key: str, kms: ssemod.
         ):
             raise ssemod.CryptoError("SSE-C key does not match object key")
         try:
-            return ssemod.AESGCM(ssec_key).decrypt(base_iv, sealed, context.encode())
+            return ssemod._aesgcm(ssec_key).decrypt(base_iv, sealed, context.encode())
         except Exception:
             raise ssemod.CryptoError("SSE-C unseal failed") from None
     kid = user_defined.get(ssemod.META_KMS_KEY_ID) or None

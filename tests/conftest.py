@@ -20,11 +20,22 @@ TPU_LANE = os.environ.get("MINIO_TPU_TEST_TPU") == "1"
 
 if not TPU_LANE:
     os.environ["JAX_PLATFORMS"] = "cpu"
+    # 8 virtual CPU devices: the config knob exists only on newer jax;
+    # XLA_FLAGS (read at first backend init, which happens after this
+    # import) covers older versions
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
 
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
+    try:
+        jax.config.update("jax_num_cpu_devices", 8)
+    except AttributeError:  # older jax: XLA_FLAGS above already did it
+        pass
 
 
 def pytest_configure(config):

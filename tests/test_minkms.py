@@ -19,6 +19,8 @@ os.environ.setdefault("MINIO_TPU_BACKEND", "numpy")
 os.environ.setdefault("MINIO_TPU_SCAN_INTERVAL", "0")
 
 import pytest
+
+pytest.importorskip("cryptography")  # gated dep: skip, don't abort collection
 from cryptography.hazmat.primitives.ciphers.aead import AESGCM
 
 from minio_tpu.client import S3Client

@@ -12,6 +12,7 @@ os.environ.setdefault("MINIO_TPU_BACKEND", "numpy")
 
 import pytest
 
+pytest.importorskip("cryptography")  # ssh transport needs it; skip, don't abort collection
 from minio_tpu.client import S3Client
 from minio_tpu.server import sftp as sftpmod
 from minio_tpu.server import ssh as sshmod

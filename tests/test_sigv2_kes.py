@@ -322,3 +322,33 @@ def test_kes_partial_config_fails_loudly(monkeypatch):
     monkeypatch.delenv("MINIO_KMS_KES_KEY_NAME", raising=False)
     with pytest.raises(CryptoError):
         from_env_or_config()
+
+
+def test_multi_kms_config_ambiguity_fails_loudly(monkeypatch):
+    """More than one configured KMS backend (any pair, env or config
+    subsystem) must abort boot instead of silently winning by
+    precedence (reference kms.IsPresent contract)."""
+    from minio_tpu.crypto.kes import from_env_or_config
+    from minio_tpu.crypto.sse import CryptoError
+
+    for env in ("MINIO_KMS_SERVER", "MINIO_KMS_KES_ENDPOINT",
+                "MINIO_KMS_SECRET_KEY"):
+        monkeypatch.delenv(env, raising=False)
+    # env pair: MinKMS + static key
+    monkeypatch.setenv("MINIO_KMS_SERVER", "http://127.0.0.1:1")
+    monkeypatch.setenv("MINIO_KMS_SECRET_KEY", "k:" + "A" * 43 + "=")
+    with pytest.raises(CryptoError, match="ambiguous"):
+        from_env_or_config()
+    # config-subsystem KES + env static key: the guard must see through
+    # the kms_kes store, not just the env surface
+    monkeypatch.delenv("MINIO_KMS_SERVER")
+
+    class _Cfg:
+        @staticmethod
+        def get(sub, key):
+            if (sub, key) == ("kms_kes", "endpoint"):
+                return "https://kes.example:7373"
+            return ""
+
+    with pytest.raises(CryptoError, match="ambiguous"):
+        from_env_or_config(cfg=_Cfg())

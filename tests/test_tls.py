@@ -23,6 +23,7 @@ import urllib.request
 import pytest
 
 from minio_tpu.client import S3Client
+pytest.importorskip("cryptography")  # x509util needs it; skip, don't abort collection
 from minio_tpu.crypto import x509util
 from tests.test_s3_api import _free_port
 
