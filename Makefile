@@ -1,0 +1,16 @@
+# Developer entry points. `make check` is the static gate every PR must
+# pass (tier-1 enforces the same thing via tests/test_analysis.py).
+
+PY ?= python
+
+.PHONY: check test docs
+
+check:
+	$(PY) -m minio_tpu.analysis minio_tpu/ --strict
+
+test:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
+		--continue-on-collection-errors -p no:cacheprovider
+
+docs:
+	$(PY) -m minio_tpu.analysis --gen-config-docs docs/CONFIG.md

@@ -1,0 +1,82 @@
+"""miniovet CLI.
+
+    python -m minio_tpu.analysis [paths...] [--strict] [--select rule[,rule]]
+    python -m minio_tpu.analysis --gen-config-docs [PATH]
+    python -m minio_tpu.analysis --list-rules
+
+Findings print as ``file:line: rule: message`` (clickable); exit status
+is non-zero when anything is found. ``--strict`` additionally fails on
+unused ``# miniovet: ignore[...]`` pragmas. With no paths, the installed
+``minio_tpu`` package is analyzed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from . import ALL_RULES, analyze_paths
+from .knobs import generate_config_md
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="miniovet", description=__doc__)
+    ap.add_argument("paths", nargs="*", help="files or directories")
+    ap.add_argument(
+        "--strict", action="store_true",
+        help="also fail on unused ignore-pragmas",
+    )
+    ap.add_argument(
+        "--select", default=None,
+        help="comma-separated rule ids to run (default: all)",
+    )
+    ap.add_argument(
+        "--list-rules", action="store_true", help="list rule ids and exit"
+    )
+    ap.add_argument(
+        "--gen-config-docs", nargs="?", const="docs/CONFIG.md", default=None,
+        metavar="PATH",
+        help="write docs/CONFIG.md from the knob registry and exit "
+             "('-' prints to stdout)",
+    )
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule_id in sorted(ALL_RULES):
+            print(rule_id)
+        return 0
+
+    if args.gen_config_docs is not None:
+        content = generate_config_md() + "\n"
+        if args.gen_config_docs == "-":
+            sys.stdout.write(content)
+        else:
+            os.makedirs(
+                os.path.dirname(args.gen_config_docs) or ".", exist_ok=True
+            )
+            with open(args.gen_config_docs, "w", encoding="utf-8") as fh:
+                fh.write(content)
+            print(f"wrote {args.gen_config_docs}")
+        return 0
+
+    paths = args.paths or [os.path.dirname(os.path.dirname(__file__))]
+    rules = None
+    if args.select:
+        rules = [r.strip() for r in args.select.split(",") if r.strip()]
+        unknown = set(rules) - set(ALL_RULES)
+        if unknown:
+            ap.error(f"unknown rules: {', '.join(sorted(unknown))}")
+    findings = analyze_paths(paths, rules=rules)
+    if not args.strict and rules is None:
+        findings = [f for f in findings if f.rule != "pragma"]
+    for f in findings:
+        print(f)
+    n = len(findings)
+    rule_word = "finding" if n == 1 else "findings"
+    print(f"miniovet: {n} {rule_word}", file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,308 @@
+"""Central registry of every MINIO_* config knob the code reads.
+
+The ``knob`` rule fails the gate on any env read not declared here, and
+``docs/CONFIG.md`` is generated from this file (``python -m
+minio_tpu.analysis --gen-config-docs``) — so the docs can never drift
+from what the code actually reads.
+
+Prefix knobs (names ending in ``_``) are families instantiated per
+target id, e.g. ``MINIO_NOTIFY_WEBHOOK_ENABLE_PRIMARY``.
+
+This module must stay import-light (stdlib only): the analyzer and the
+docs generator both run without jax/numpy installed.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class Knob:
+    name: str
+    default: str | None      # canonical inline default ("" = empty, None = no default)
+    description: str
+    subsystem: str
+    prefix: bool = False     # name is a family prefix (per-target-id suffix)
+
+
+def _k(name: str, default: str | None, subsystem: str, description: str) -> Knob:
+    return Knob(name, default, description, subsystem, prefix=name.endswith("_"))
+
+
+_ALL: list[Knob] = [
+    # -- cluster ----------------------------------------------------------
+    _k("MINIO_TPU_GRID", "1", "cluster",
+       "Use the persistent internode grid (muxed websocket-style "
+       "connections) instead of per-call HTTP; 0 falls back."),
+    _k("MINIO_TPU_LOCK_REFRESH_S", "10", "cluster",
+       "Interval between distributed-lock refreshes; a holder that "
+       "misses refreshes loses the lock at TTL expiry."),
+    # -- erasure / object layer ------------------------------------------
+    _k("MINIO_TPU_BACKEND", "jax", "erasure",
+       "Erasure codec backend: `jax` (TPU/XLA bit-plane kernels) or "
+       "`numpy` (pure-CPU reference path)."),
+    _k("MINIO_TPU_DECODE_MIN_SHARDS", "64", "erasure",
+       "Minimum missing-shard batch before reconstruct runs on the "
+       "device; smaller heal batches decode on CPU."),
+    _k("MINIO_TPU_DEVICE_HEAL", "0", "erasure",
+       "Route heal-plane reconstruct+hash through the fused device "
+       "kernel (1) instead of the CPU path (0)."),
+    _k("MINIO_TPU_DISK_MONITOR_INTERVAL", "10", "erasure",
+       "Seconds between background disk health probes (offline-disk "
+       "detection and auto-heal triggering)."),
+    _k("MINIO_TPU_METACACHE_MAX_KEYS", "200000", "erasure",
+       "Cap on cached listing entries per metacache bucket scan."),
+    _k("MINIO_TPU_METACACHE_TTL", "15", "erasure",
+       "Seconds a bucket-listing metacache stays valid before a "
+       "rescan."),
+    _k("MINIO_TPU_NATIVE_PLANE", "auto", "erasure",
+       "Native (C) data-plane helpers: `auto` probes, `on` requires, "
+       "`off` disables."),
+    _k("MINIO_TPU_READ_SPAN_MB", "16", "erasure",
+       "Bytes of contiguous shard data one GET read span covers before "
+       "the next span is scheduled."),
+    _k("MINIO_TPU_READ_WINDOW", "8", "erasure",
+       "Read-ahead window (spans) for streaming GETs."),
+    _k("MINIO_TPU_READ_WORKERS", "32", "erasure",
+       "Worker threads per erasure set for parallel shard reads."),
+    _k("MINIO_TPU_STREAM_BATCH_MB", "64", "erasure",
+       "Stripe bytes accumulated before a streaming PUT flushes a "
+       "batched device encode."),
+    # -- events / notifications ------------------------------------------
+    _k("MINIO_NOTIFY_ELASTICSEARCH_ENABLE_", None, "events",
+       "Enable the Elasticsearch notify target with this id "
+       "(`on`/`true`/`1`)."),
+    _k("MINIO_NOTIFY_ELASTICSEARCH_INDEX_", "minio-events", "events",
+       "Elasticsearch index receiving bucket events."),
+    _k("MINIO_NOTIFY_ELASTICSEARCH_URL_", "", "events",
+       "Elasticsearch base URL for the target."),
+    _k("MINIO_NOTIFY_FILE_ENABLE_", None, "events",
+       "Enable the append-to-file notify target with this id."),
+    _k("MINIO_NOTIFY_FILE_PATH_", "", "events",
+       "File path the file notify target appends JSON events to."),
+    _k("MINIO_NOTIFY_KAFKA_BROKERS_", "", "events",
+       "Comma-separated Kafka brokers (first is used) for the target."),
+    _k("MINIO_NOTIFY_KAFKA_ENABLE_", None, "events",
+       "Enable the Kafka notify target with this id."),
+    _k("MINIO_NOTIFY_KAFKA_TOPIC_", "minio-events", "events",
+       "Kafka topic receiving bucket events."),
+    _k("MINIO_NOTIFY_MQTT_BROKER_", "", "events",
+       "MQTT broker URL for the target."),
+    _k("MINIO_NOTIFY_MQTT_ENABLE_", None, "events",
+       "Enable the MQTT notify target with this id."),
+    _k("MINIO_NOTIFY_MQTT_TOPIC_", "minio-events", "events",
+       "MQTT topic bucket events publish to."),
+    _k("MINIO_NOTIFY_MYSQL_DSN_STRING_", "", "events",
+       "MySQL DSN (user:pass@tcp(host:port)/db) for the target."),
+    _k("MINIO_NOTIFY_MYSQL_ENABLE_", None, "events",
+       "Enable the MySQL notify target with this id."),
+    _k("MINIO_NOTIFY_MYSQL_TABLE_", "minio_events", "events",
+       "MySQL table bucket events insert into."),
+    _k("MINIO_NOTIFY_NATS_ADDRESS_", "", "events",
+       "NATS server address (host:port) for the target."),
+    _k("MINIO_NOTIFY_NATS_ENABLE_", None, "events",
+       "Enable the NATS notify target with this id."),
+    _k("MINIO_NOTIFY_NATS_SUBJECT_", "minio-events", "events",
+       "NATS subject bucket events publish to."),
+    _k("MINIO_NOTIFY_NSQ_ENABLE_", None, "events",
+       "Enable the NSQ notify target with this id."),
+    _k("MINIO_NOTIFY_NSQ_NSQD_ADDRESS_", "", "events",
+       "nsqd address (host:port) for the target."),
+    _k("MINIO_NOTIFY_NSQ_TOPIC_", "minio-events", "events",
+       "NSQ topic bucket events publish to."),
+    _k("MINIO_NOTIFY_POSTGRES_CONNECTION_STRING_", "", "events",
+       "Postgres connection string for the target."),
+    _k("MINIO_NOTIFY_POSTGRES_ENABLE_", None, "events",
+       "Enable the Postgres notify target with this id."),
+    _k("MINIO_NOTIFY_POSTGRES_TABLE_", "minio_events", "events",
+       "Postgres table bucket events insert into."),
+    _k("MINIO_NOTIFY_REDIS_ADDRESS_", "", "events",
+       "Redis address (host:port) for the target."),
+    _k("MINIO_NOTIFY_REDIS_ENABLE_", None, "events",
+       "Enable the Redis notify target with this id."),
+    _k("MINIO_NOTIFY_REDIS_KEY_", "minio-events", "events",
+       "Redis key (list) bucket events push to."),
+    _k("MINIO_NOTIFY_WEBHOOK_AUTH_TOKEN_", "", "events",
+       "Bearer token sent with webhook notify posts."),
+    _k("MINIO_NOTIFY_WEBHOOK_ENABLE_", None, "events",
+       "Enable the HTTP webhook notify target with this id."),
+    _k("MINIO_NOTIFY_WEBHOOK_ENDPOINT_", "", "events",
+       "HTTP endpoint webhook notify posts events to."),
+    _k("MINIO_LAMBDA_WEBHOOK_ENABLE_", "", "events",
+       "Enable the object-lambda transform endpoint with this id."),
+    _k("MINIO_LAMBDA_WEBHOOK_ENDPOINT_", "", "events",
+       "HTTP endpoint object-lambda GETs are transformed through."),
+    # -- iam / identity ---------------------------------------------------
+    _k("MINIO_ETCD_ENDPOINTS", "", "iam",
+       "Comma-separated etcd endpoints; when set, IAM documents live in "
+       "etcd so peer deployments share one identity plane."),
+    _k("MINIO_IDENTITY_OPENID_CLAIM_NAME", "policy", "iam",
+       "JWT claim carrying the policy name for OpenID STS logins."),
+    _k("MINIO_IDENTITY_OPENID_CLIENT_ID", "", "iam",
+       "OAuth client id checked against the token audience."),
+    _k("MINIO_IDENTITY_OPENID_CONFIG_URL", "", "iam",
+       "OpenID discovery document URL (…/.well-known/openid-configuration)."),
+    _k("MINIO_IDENTITY_OPENID_JWKS_URL", "", "iam",
+       "JWKS URL for OpenID token signature validation (overrides "
+       "discovery)."),
+    _k("MINIO_IDENTITY_TLS_ENABLE", None, "iam",
+       "Enable STS AssumeRoleWithCertificate over mutual TLS "
+       "(`on`/`true`/`1`)."),
+    _k("MINIO_ROOT_PASSWORD", "minioadmin", "iam",
+       "Root (admin) secret key."),
+    _k("MINIO_ROOT_USER", "minioadmin", "iam",
+       "Root (admin) access key."),
+    # -- kms / crypto -----------------------------------------------------
+    _k("MINIO_KMS_API_KEY", "", "kms",
+       "MinKMS API key used to authenticate this server."),
+    _k("MINIO_KMS_CAPATH", "", "kms",
+       "CA bundle path for verifying the MinKMS server certificate."),
+    _k("MINIO_KMS_ENCLAVE", "default", "kms",
+       "MinKMS enclave (key namespace) this deployment uses."),
+    _k("MINIO_KMS_KES_API_KEY", None, "kms",
+       "KES API key (enclave identity) for the KES backend."),
+    _k("MINIO_KMS_KES_CAPATH", None, "kms",
+       "CA bundle path for verifying the KES server certificate."),
+    _k("MINIO_KMS_KES_CERT_FILE", None, "kms",
+       "Client TLS certificate for mTLS with KES."),
+    _k("MINIO_KMS_KES_ENDPOINT", None, "kms",
+       "KES server endpoint; selects the KES backend when set."),
+    _k("MINIO_KMS_KES_KEY_FILE", None, "kms",
+       "Client TLS private key for mTLS with KES."),
+    _k("MINIO_KMS_KES_KEY_NAME", None, "kms",
+       "Default KES master key name for SSE-KMS."),
+    _k("MINIO_KMS_SECRET_KEY", "", "kms",
+       "Static local master key (name:base64key); the single-node KMS "
+       "backend."),
+    _k("MINIO_KMS_SERVER", "", "kms",
+       "MinKMS server endpoint; selects the MinKMS backend when set."),
+    _k("MINIO_KMS_SSE_KEY", "", "kms",
+       "Default MinKMS key name for SSE-KMS when the request names "
+       "none."),
+    # -- qos --------------------------------------------------------------
+    _k("MINIO_TPU_API_ADMIN_REQUESTS_MAX", None, "qos",
+       "Admin-API inflight cap (helper default 64)."),
+    _k("MINIO_TPU_API_BG_REQUESTS_MAX", None, "qos",
+       "Background-plane inflight cap (helper default 64)."),
+    _k("MINIO_TPU_API_REQUESTS_DEADLINE", "10", "qos",
+       "Seconds an admission waiter may queue before answering 503 "
+       "SlowDown."),
+    _k("MINIO_TPU_API_REQUESTS_MAX", None, "qos",
+       "S3-API inflight cap; 0/unset auto-sizes to max(256, 32*cpus), "
+       "-1 is unlimited."),
+    _k("MINIO_TPU_QOS_BG_FRACTION", "0.5", "qos",
+       "Max fraction of one TPU dispatch batch background blocks may "
+       "occupy."),
+    _k("MINIO_TPU_QOS_BG_MAX_AGE_MS", "50", "qos",
+       "Age at which a queued background block promotes to the "
+       "foreground lane (starvation protection)."),
+    # -- server / s3 api --------------------------------------------------
+    _k("MINIO_AUDIT_KAFKA_BROKERS", "", "server",
+       "Comma-separated Kafka brokers for audit records (first is "
+       "used)."),
+    _k("MINIO_AUDIT_KAFKA_ENABLE", "", "server",
+       "Enable audit-to-Kafka (`on`/`true`/`1`)."),
+    _k("MINIO_AUDIT_KAFKA_TOPIC", "minio-audit", "server",
+       "Kafka topic receiving audit records."),
+    _k("MINIO_AUDIT_WEBHOOK_AUTH_TOKEN_", "", "server",
+       "Bearer token sent with audit webhook posts."),
+    _k("MINIO_AUDIT_WEBHOOK_ENABLE_", None, "server",
+       "Enable the audit webhook target with this id."),
+    _k("MINIO_AUDIT_WEBHOOK_ENDPOINT_", "", "server",
+       "HTTP endpoint audit records post to."),
+    _k("MINIO_COMPRESSION_ENABLE", "off", "server",
+       "Transparent object compression (`on` enables; incompressible "
+       "types are skipped)."),
+    _k("MINIO_DOMAIN", "", "server",
+       "Virtual-host-style S3 domain(s), comma-separated; empty serves "
+       "path-style only."),
+    _k("MINIO_PROMETHEUS_AUTH_TYPE", "jwt", "server",
+       "Metrics endpoint auth: `jwt` (admin-signed bearer) or `public`."),
+    _k("MINIO_SFTP_AUTHORIZED_KEYS", None, "server",
+       "Path to an authorized_keys file for SFTP public-key logins."),
+    _k("MINIO_STORAGE_CLASS_RRS", "EC:2", "server",
+       "Parity for REDUCED_REDUNDANCY objects (`EC:n`)."),
+    _k("MINIO_STORAGE_CLASS_STANDARD", "", "server",
+       "Parity for STANDARD objects (`EC:n`); empty uses the pool "
+       "default."),
+    _k("MINIO_TPU_CERTS_DIR", "", "server",
+       "Directory with public.crt/private.key enabling the TLS "
+       "listener."),
+    _k("MINIO_TPU_HTTP_READBUF", None, "server",
+       "aiohttp per-connection read buffer bytes (throughput knob for "
+       "streaming PUTs)."),
+    _k("MINIO_TPU_IAM_REFRESH", "120", "server",
+       "Seconds between IAM document refreshes (0 disables)."),
+    _k("MINIO_TPU_IO_THREADS", "64", "server",
+       "Dedicated store-I/O executor threads; undersizing can deadlock "
+       "writers behind lock holders."),
+    _k("MINIO_TPU_PUT_CHUNK_MB", "4", "server",
+       "Chunk size the streaming-PUT body pump hands to the erasure "
+       "layer."),
+    _k("MINIO_TPU_REPLICATION_PROXY", "on", "server",
+       "Proxy GETs for not-yet-replicated objects to the replication "
+       "source (`off` disables)."),
+    _k("MINIO_TPU_SCAN_INTERVAL", "300", "server",
+       "Seconds between background data-scanner sweeps."),
+    _k("MINIO_TPU_STREAM_MIN_BYTES", None, "server",
+       "Content-Length floor below which a PUT buffers instead of "
+       "streaming."),
+    # -- storage ----------------------------------------------------------
+    _k("MINIO_TPU_FSYNC", "0", "storage",
+       "fsync shard files on write (1) instead of trusting the page "
+       "cache (0)."),
+    _k("MINIO_TPU_ODIRECT", "off", "storage",
+       "O_DIRECT for large sequential shard I/O (`on`/`off`)."),
+    # -- tpu / ops --------------------------------------------------------
+    _k("MINIO_TPU_BATCH_WINDOW_MS", "2", "tpu",
+       "Straggler window a stripe block may wait for batch-mates before "
+       "the fused encode dispatches."),
+    _k("MINIO_TPU_FUSED_CM", "1", "tpu",
+       "Chunk-major fused encode/decode+hash mega-kernel (0 forces the "
+       "row-major XLA path)."),
+    _k("MINIO_TPU_NO_NATIVE", None, "tpu",
+       "Set to disable loading the native helper extension entirely."),
+    _k("MINIO_TPU_PALLAS", "1", "tpu",
+       "Pallas TPU kernels for hash/encode (0 forces plain XLA "
+       "lowering)."),
+]
+
+KNOBS: dict[str, Knob] = {k.name: k for k in _ALL if not k.prefix}
+PREFIX_KNOBS: dict[str, Knob] = {k.name: k for k in _ALL if k.prefix}
+
+
+def generate_config_md() -> str:
+    """docs/CONFIG.md content: one table per subsystem."""
+    by_sub: dict[str, list[Knob]] = {}
+    for k in _ALL:
+        by_sub.setdefault(k.subsystem, []).append(k)
+    out = [
+        "# Configuration knobs",
+        "",
+        "Generated from `minio_tpu/analysis/knobs.py` by",
+        "`python -m minio_tpu.analysis --gen-config-docs` — do not edit by",
+        "hand. The `knob` rule of `miniovet` fails the build when the code",
+        "reads a `MINIO_*` variable not declared there, so this file lists",
+        "every knob the code actually reads.",
+        "",
+        "Names ending in `_` are families: the suffix is a target id,",
+        "e.g. `MINIO_NOTIFY_WEBHOOK_ENABLE_PRIMARY`.",
+        "",
+    ]
+    for sub in sorted(by_sub):
+        out.append(f"## {sub}")
+        out.append("")
+        out.append("| Knob | Default | Description |")
+        out.append("|---|---|---|")
+        for k in sorted(by_sub[sub], key=lambda k: k.name):
+            if k.default is None:
+                default = "_(none)_"
+            elif k.default == "":
+                default = "_(empty)_"
+            else:
+                default = f"`{k.default}`"
+            name = f"`{k.name}<ID>`" if k.prefix else f"`{k.name}`"
+            out.append(f"| {name} | {default} | {k.description} |")
+        out.append("")
+    return "\n".join(out)

@@ -1,0 +1,136 @@
+"""Knob-registry rule: every ``MINIO_*`` env var read must be declared.
+
+The registry (analysis/knobs.py) is the single source of truth for
+config knobs — name, default, description, owning subsystem — and
+docs/CONFIG.md is generated from it (``python -m minio_tpu.analysis
+--gen-config-docs``). An undeclared read fails the gate; a read whose
+inline default disagrees with the declared default fails too (two call
+sites silently disagreeing about a default is how the QoS fraction bug
+class happens).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from .core import Finding, dotted_name, rule
+from .knobs import KNOBS, PREFIX_KNOBS
+
+_KNOB_RE = re.compile(r"^MINIO_[A-Z0-9_]*$")
+
+# call attrs that read from an env mapping; .get/.pop/.setdefault cover
+# os.environ and its local aliases/copies, startswith covers the
+# iterate-environ-and-match pattern in events/audit
+_READ_ATTRS = {"get", "pop", "setdefault", "startswith"}
+
+
+def _knob_literal(node: ast.AST) -> tuple[str, bool] | None:
+    """(name, is_prefix) when `node` is a MINIO_* key expression."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        # bare "MINIO_" is the whole namespace (startswith scans over
+        # environ), not a knob
+        if _KNOB_RE.match(node.value) and node.value != "MINIO_":
+            return node.value, node.value.endswith("_")
+        return None
+    if isinstance(node, ast.JoinedStr) and node.values:
+        head = node.values[0]
+        if (
+            isinstance(head, ast.Constant)
+            and isinstance(head.value, str)
+            and _KNOB_RE.match(head.value)
+            and len(node.values) > 1
+        ):
+            return head.value, True
+    return None
+
+
+def _declared(name: str, prefix: bool) -> bool:
+    if prefix:
+        return name in PREFIX_KNOBS
+    if name in KNOBS:
+        return True
+    return any(name.startswith(p) for p in PREFIX_KNOBS)
+
+
+def _default_literal(call: ast.Call, key_index: int) -> str | None:
+    if len(call.args) > key_index + 1:
+        d = call.args[key_index + 1]
+        if isinstance(d, ast.Constant) and isinstance(d.value, str):
+            return d.value
+    return None
+
+
+@rule("knob")
+def check_knobs(tree: ast.AST, ctx) -> Iterator[Finding]:
+    findings: list[Finding] = []
+
+    def report_undeclared(node: ast.AST, name: str, prefix: bool,
+                          default: str | None) -> None:
+        kind = "prefix knob" if prefix else "knob"
+        seen = "" if default is None else f" (default seen: {default!r})"
+        findings.append(
+            Finding(
+                ctx.path, node.lineno, "knob",
+                f"undeclared {kind} `{name}`{seen}: declare it in "
+                "minio_tpu/analysis/knobs.py with a default and "
+                "description, then regenerate docs/CONFIG.md",
+            )
+        )
+
+    def check_key(node: ast.AST, key: ast.AST, call: ast.Call | None,
+                  key_index: int = 0) -> None:
+        lit = _knob_literal(key)
+        if lit is None:
+            return
+        name, prefix = lit
+        default = (
+            _default_literal(call, key_index) if call is not None else None
+        )
+        if not _declared(name, prefix):
+            report_undeclared(node, name, prefix, default)
+            return
+        if default is not None:
+            declared = PREFIX_KNOBS.get(name) if prefix else KNOBS.get(name)
+            if declared is not None and declared.default != default:
+                findings.append(
+                    Finding(
+                        ctx.path, node.lineno, "knob",
+                        f"knob `{name}` read with default {default!r} but "
+                        f"registry declares {declared.default!r}; align "
+                        "the call site or the registry",
+                    )
+                )
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            fname = dotted_name(node.func) or ""
+            is_env_call = (
+                (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _READ_ATTRS
+                )
+                or fname.endswith("getenv")
+            )
+            if is_env_call and node.args:
+                check_key(node, node.args[0], node)
+            elif node.args:
+                # project helpers (`setting(...)`, `_int(...)`) read env
+                # through wrappers: any knob literal in call args still
+                # needs a declaration (no default compare — the second
+                # arg may be a config key, not a default)
+                for a in node.args:
+                    lit = _knob_literal(a)
+                    if lit is not None and not _declared(*lit):
+                        report_undeclared(node, lit[0], lit[1], None)
+        elif isinstance(node, ast.Subscript):
+            base = dotted_name(node.value) or ""
+            if base.endswith("environ"):
+                check_key(node, node.slice, None)
+        elif isinstance(node, ast.Compare):
+            for side in [node.left, *node.comparators]:
+                lit = _knob_literal(side)
+                if lit is not None and not _declared(*lit):
+                    report_undeclared(node, lit[0], lit[1], None)
+    return findings

@@ -140,6 +140,8 @@ def verify_peers(
             except (OSError, ValueError) as e:
                 last = f"unreachable: {e}"
             if attempt < retries - 1:
+                # miniovet: ignore[blocking] -- peer-probe retry backoff;
+                # runs on a bootstrap ThreadPoolExecutor worker, not the loop
                 time.sleep(retry_delay)
         return last
 

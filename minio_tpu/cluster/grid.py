@@ -280,6 +280,8 @@ class GridServer:
             body = msgpack.packb([False, type(e).__name__, str(e)])
         try:
             await send_frame(_frame(T_RESP, mux, body))
+        except asyncio.CancelledError:
+            raise
         except Exception:  # noqa: BLE001 — peer went away mid-response
             pass
 
@@ -296,7 +298,9 @@ class GridServer:
                 await send_frame(
                     _frame(T_STR_ERR, mux, msgpack.packb([type(e).__name__, str(e)]))
                 )
-            except Exception:  # noqa: BLE001
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — peer went away mid-error
                 pass
         finally:
             streams.pop(mux, None)
@@ -649,6 +653,8 @@ class GridClient:
         peer wedge) is detected here instead of stalling the next RPC for
         its full timeout."""
         while True:
+            # miniovet: ignore[blocking] -- keepalive pacing on the
+            # dedicated daemon ping thread, not the event loop
             time.sleep(self._ping_interval)
             with self._lock:
                 if self._ws is not ws or self._closed:
@@ -728,6 +734,8 @@ class GridClient:
         while time.monotonic() - start < timeout:
             if self._last_pong >= start:
                 return True
+            # miniovet: ignore[blocking] -- blocking client API: pong
+            # arrives on the reader thread; callers run in executors
             time.sleep(0.01)
         return False
 

@@ -302,6 +302,8 @@ class DRWMutex:
                 return False
             # jitter breaks the lockstep livelock of two symmetric
             # contenders (the reference randomizes dsync retry timing)
+            # miniovet: ignore[blocking] -- dsync retry jitter; lock
+            # acquisition runs on storage executor threads, never the loop
             time.sleep(backoff * (0.5 + random.random()))
             backoff = min(backoff * 2, 0.25)
 

@@ -131,6 +131,8 @@ class StorageRESTServer:
         try:
             result = await loop.run_in_executor(None, self._call, drive, op, body)
             return web.Response(body=result)
+        except asyncio.CancelledError:
+            raise
         except Exception as e:  # noqa: BLE001 — typed errors cross the wire
             return _pack_err(e)
 

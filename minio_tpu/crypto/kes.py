@@ -179,9 +179,11 @@ class KESKMS(KMSMetrics):
 
 
 def from_env_or_config(cfg=None, store=None):
-    """KMS factory, reference precedence (internal/kms/config.go:104):
-    MinKMS when MINIO_KMS_SERVER is set, else KES when configured (env
-    wins, then the kms_kes subsystem), else the builtin KMS."""
+    """KMS factory (reference internal/kms/config.go:104): MinKMS, KES
+    (env wins, then the kms_kes subsystem), or the builtin KMS. Unlike
+    the reference's silent precedence, configuring MORE than one backend
+    raises CryptoError — an operator who set both almost certainly
+    believes the ignored one is active."""
     from .sse import KMS
 
     def setting(env: str, cfg_key: str) -> str:

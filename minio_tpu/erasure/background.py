@@ -359,7 +359,9 @@ class BackgroundOps:
                     self.mrf.add(b.name, raw)
                     self.stats["heals_queued"] += 1
                 if self.object_sleep:
-                    time.sleep(self.object_sleep)  # adaptive pacing analogue
+                    # miniovet: ignore[blocking] -- adaptive pacing analogue
+                    # on the scanner daemon thread
+                    time.sleep(self.object_sleep)
             usage[b.name] = bucket_usage
         self.usage.buckets = usage
         self.usage.last_update = time.time()

@@ -631,14 +631,17 @@ class ErasureSet:
             )
             if fi.deleted:
                 raise ObjectNotFound(f"{bucket}/{obj}")
+            oi = self._to_object_info(bucket, obj, fi)
+            # the read lock stays held while the handle streams (the
+            # reference holds GetObject's lock until the reader closes) and
+            # is refreshed during long streams; the TTL backstops abandoned
+            # handles
+            return oi, ObjectHandle(self, bucket, obj, fi, metas, mutex=mtx)
         except BaseException:
+            # everything up to handle construction releases on failure; a
+            # raise after lock ownership transferred would double-release
             mtx.runlock()
             raise
-        oi = self._to_object_info(bucket, obj, fi)
-        # the read lock stays held while the handle streams (the reference
-        # holds GetObject's lock until the reader closes) and is refreshed
-        # during long streams; the TTL backstops abandoned handles
-        return oi, ObjectHandle(self, bucket, obj, fi, metas, mutex=mtx)
 
     def get_object(
         self,

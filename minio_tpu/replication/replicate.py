@@ -232,6 +232,8 @@ class ReplicationPool:
 
         deadline = time.monotonic() + timeout
         while any(not q_.empty() for q_ in self._qs) and time.monotonic() < deadline:
+            # miniovet: ignore[blocking] -- drain() is a blocking helper
+            # for tests/shutdown; worker threads do the actual replication
             time.sleep(0.05)
 
 

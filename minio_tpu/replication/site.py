@@ -471,6 +471,8 @@ class SiteReplicationSys:
         # objects: replay through the bucket-replication plane once the
         # create has had a moment to land on the peers
         def later():
+            # miniovet: ignore[blocking] -- settle delay before resync
+            # replay; later() runs on its own daemon thread
             time.sleep(1.0)
             for bucket in self._local_buckets():
                 try:

@@ -612,6 +612,10 @@ class S3Server(
             return self._err_response(request, s3err.NoSuchVersion)
         except quorum.QuorumError:
             return self._err_response(request, s3err.InternalError)
+        except asyncio.CancelledError:
+            # client disconnect: propagate so aiohttp abandons the request
+            # instead of logging a 500 for work nobody is waiting on
+            raise
         except Exception:  # noqa: BLE001
             import traceback
 
@@ -1084,6 +1088,8 @@ def main(argv: list[str] | None = None) -> None:
                     flush=True,
                 )
                 return
+            except asyncio.CancelledError:
+                raise  # server shutdown mid-bootstrap
             except Exception as e:  # noqa: BLE001 — peers may still be booting
                 last = e
                 await asyncio.sleep(1)

@@ -42,6 +42,11 @@ class BucketHandlersMixin:
     async def put_bucket(self, request, bucket: str) -> web.Response:
         if not BUCKET_NAME_RE.match(bucket) or ".." in bucket:
             raise s3err.InvalidBucketName
+        if bucket == "minio":
+            # reserved (reference isReservedOrInvalidBucket): /minio/* is
+            # the control plane, and a user bucket by that name would ride
+            # its QoS-exempt routing
+            raise s3err.InvalidBucketName
         await self._run(self.store.make_bucket, bucket)
         lock_enabled = request.headers.get("x-amz-bucket-object-lock-enabled", "") == "true"
         if lock_enabled:

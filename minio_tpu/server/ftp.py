@@ -258,6 +258,8 @@ class FTPGateway:
         try:
             oi = await self._run(self.store.get_object_info, bucket, key)
             await s.send(f"213 {oi.size}")
+        except asyncio.CancelledError:
+            raise
         except Exception:  # noqa: BLE001
             await s.send("550 No such file")
 
@@ -268,6 +270,8 @@ class FTPGateway:
             return
         try:
             oi, handle = await self._run(self.store.open_object, bucket, key)
+        except asyncio.CancelledError:
+            raise
         except Exception:  # noqa: BLE001
             await s.send("550 No such file")
             return
@@ -337,6 +341,8 @@ class FTPGateway:
         try:
             await self._run(self.store.put_object, bucket, key, b"".join(chunks))
             await s.send("226 Transfer complete")
+        except asyncio.CancelledError:
+            raise
         except Exception:  # noqa: BLE001
             await s.send("550 Store failed")
 
@@ -348,6 +354,8 @@ class FTPGateway:
         try:
             await self._run(self.store.delete_object, bucket, key)
             await s.send("250 Deleted")
+        except asyncio.CancelledError:
+            raise
         except Exception:  # noqa: BLE001
             await s.send("550 No such file")
 
@@ -366,6 +374,8 @@ class FTPGateway:
             else:
                 await self._run(self.store.make_bucket, bucket)
             await s.send("257 Created")
+        except asyncio.CancelledError:
+            raise
         except Exception:  # noqa: BLE001
             await s.send("550 Create failed")
 
@@ -384,6 +394,8 @@ class FTPGateway:
             else:
                 await self._run(self.store.delete_bucket, bucket)
             await s.send("250 Removed")
+        except asyncio.CancelledError:
+            raise
         except Exception:  # noqa: BLE001
             await s.send("550 Remove failed")
 

@@ -135,8 +135,10 @@ def classify_qos_class(bucket: str, key: str, headers=None) -> str | None:
     """Request -> admission-control class (qos/admission.py), or None for
     planes that must never throttle: health probes (throttled liveness
     checks would flap the orchestrator), metrics scrapes, the embedded
-    console, and internode RPC (storage/lock/grid ride their own routes,
-    but any /minio/* path that is not admin or KMS stays exempt too).
+    console, and internode RPC (grid/lock/storage). Only those known
+    planes are exempt — an unrecognized key under /minio/* classifies as
+    ordinary s3 traffic, so the reserved bucket name can never become an
+    unthrottled data lane.
 
     Classification runs PRE-auth (the reference's maxClients throttle
     does too), so it must never trust client-controlled signals: routing
@@ -150,7 +152,17 @@ def classify_qos_class(bucket: str, key: str, headers=None) -> str | None:
     if bucket == "minio":
         if key.startswith("admin/") or key.startswith("kms/"):
             return CLASS_ADMIN
-        return None
+        if (
+            key == "console"
+            or key.startswith(("console/", "health/", "metrics/v3",
+                               "grid/", "lock/", "storage/"))
+            or key in ("v2/metrics/cluster", "v2/metrics/node")
+        ):
+            return None
+        # anything else under /minio/* is ordinary S3 traffic ("minio" is
+        # a reserved bucket name, but pre-existing data must not ride an
+        # unthrottled lane)
+        return CLASS_S3
     return CLASS_S3
 
 

@@ -78,7 +78,9 @@ class ObjectHandlersMixin:
         try:
             if await self._run(self.store.list_object_versions, bucket, key):
                 return None
-        except Exception:  # noqa: BLE001
+        except asyncio.CancelledError:
+            raise
+        except Exception:  # noqa: BLE001 — degraded listing: don't proxy
             return None
         hdrs = {"x-minio-source-proxy-request": "true"}
         rng = request.headers.get("Range")
@@ -1032,6 +1034,8 @@ class ObjectHandlersMixin:
                 results.append((k, v, None, None))
             except s3err.APIError as e:
                 results.append((k, v, e, None))  # e.g. retention AccessDenied
+            except asyncio.CancelledError:
+                raise
             except Exception:  # noqa: BLE001
                 results.append((k, v, s3err.InternalError, None))
         parts = []
@@ -1094,7 +1098,9 @@ class ObjectHandlersMixin:
 
         try:
             out = await self._run(call)
-        except Exception:  # noqa: BLE001
+        except asyncio.CancelledError:
+            raise
+        except Exception:  # noqa: BLE001 — lambda endpoint down/unreachable
             raise s3err.InternalError from None
         try:
             body = base64.b64decode(_json.loads(out)["content"])

@@ -43,6 +43,8 @@ def sample_cpu(duration: float, hz: float = 100.0) -> str:
                 f = f.f_back
             if parts:
                 stacks[";".join(reversed(parts))] += 1
+        # miniovet: ignore[blocking] -- sampler pacing; the admin profile
+        # endpoint runs this whole function in a long-poll executor thread
         time.sleep(interval)
     return "\n".join(f"{s} {n}" for s, n in stacks.most_common()) + "\n"
 
@@ -55,6 +57,8 @@ def sample_mem(duration: float, top: int = 50) -> str:
     if started_here:
         tracemalloc.start(10)
     try:
+        # miniovet: ignore[blocking] -- tracemalloc accumulation window;
+        # runs in a long-poll executor thread like sample_stacks
         time.sleep(duration)
         snap = tracemalloc.take_snapshot()
         lines = []

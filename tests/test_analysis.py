@@ -1,0 +1,56 @@
+"""Tier-1 gate: miniovet must be clean over the whole package.
+
+Runs every rule (strict: unused pragmas count) across minio_tpu/ and
+asserts zero findings, and pins the CLI contract the Makefile and CI
+rely on: exit 0 on the clean tree, non-zero once a violation exists,
+findings in clickable ``file:line: rule: message`` form, and
+docs/CONFIG.md in sync with the knob registry.
+"""
+
+import os
+import subprocess
+import sys
+
+import minio_tpu
+from minio_tpu.analysis import analyze_paths
+from minio_tpu.analysis.knobs import generate_config_md
+
+PKG_DIR = os.path.dirname(minio_tpu.__file__)
+REPO_ROOT = os.path.dirname(PKG_DIR)
+
+
+def test_package_is_clean():
+    findings = analyze_paths([PKG_DIR])
+    assert findings == [], "\n" + "\n".join(str(f) for f in findings)
+
+
+def test_cli_exit_codes_and_format(tmp_path):
+    clean = tmp_path / "clean.py"
+    clean.write_text("import asyncio\n\nasync def f():\n    await asyncio.sleep(0)\n")
+    r = subprocess.run(
+        [sys.executable, "-m", "minio_tpu.analysis", str(clean)],
+        capture_output=True, text=True, cwd=REPO_ROOT,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+
+    bad = tmp_path / "bad.py"
+    bad.write_text("import time\n\nasync def f():\n    time.sleep(1)\n")
+    r = subprocess.run(
+        [sys.executable, "-m", "minio_tpu.analysis", str(bad)],
+        capture_output=True, text=True, cwd=REPO_ROOT,
+    )
+    assert r.returncode == 1
+    line = r.stdout.strip().splitlines()[0]
+    # clickable file:line: rule: message form
+    assert line.startswith(f"{bad}:4: blocking: "), line
+
+
+def test_config_docs_in_sync():
+    path = os.path.join(REPO_ROOT, "docs", "CONFIG.md")
+    with open(path, "r", encoding="utf-8") as fh:
+        on_disk = fh.read()
+    expected = generate_config_md() + "\n"
+    assert on_disk == expected, (
+        "docs/CONFIG.md is stale; regenerate with "
+        "`python -m minio_tpu.analysis --gen-config-docs`"
+    )

@@ -1,0 +1,477 @@
+"""Unit tests for the miniovet rules: one known-bad and one known-good
+fixture snippet per rule, plus pragma semantics (a pragma suppresses
+exactly one line, and unused pragmas surface under strict mode)."""
+
+import textwrap
+
+from minio_tpu.analysis import analyze_source
+
+
+def run(src, relpath="server/app.py", rules=None):
+    return analyze_source(
+        textwrap.dedent(src), path=relpath, rules=rules, relpath=relpath
+    )
+
+
+def rules_hit(src, relpath="server/app.py", rules=None):
+    return {f.rule for f in run(src, relpath, rules)}
+
+
+# -- blocking --------------------------------------------------------------
+
+BAD_BLOCKING = """
+    import time
+
+    async def handler(request):
+        time.sleep(1)
+        return 200
+"""
+
+GOOD_BLOCKING = """
+    import asyncio
+
+    async def handler(request):
+        await asyncio.sleep(1)
+        return 200
+"""
+
+
+def test_blocking_bad():
+    fs = run(BAD_BLOCKING, rules=["blocking"])
+    assert len(fs) == 1 and fs[0].rule == "blocking"
+    assert "time.sleep" in fs[0].message
+    assert fs[0].line == 5
+
+
+def test_blocking_good():
+    assert run(GOOD_BLOCKING, rules=["blocking"]) == []
+
+
+def test_blocking_catches_requests_subprocess_and_file_io():
+    src = """
+        import requests, subprocess
+
+        async def handler(p):
+            requests.get("http://x")
+            subprocess.run(["ls"])
+            open("/etc/hosts").read()
+    """
+    fs = run(src, rules=["blocking"])
+    assert len(fs) == 3
+
+
+def test_blocking_sync_code_only_flags_time_sleep():
+    src = """
+        import time, requests
+
+        def worker():
+            requests.get("http://x")  # fine: blocking thread
+            time.sleep(1)             # must be classified
+    """
+    fs = run(src, rules=["blocking"])
+    assert len(fs) == 1 and "time.sleep" in fs[0].message
+
+
+def test_blocking_nested_sync_def_not_flagged():
+    # a nested sync def is typically an executor target; only the async
+    # body itself is the event loop's frame
+    src = """
+        import requests
+
+        async def handler(p):
+            def call():
+                return requests.get("http://x")
+            return await run_in_executor(call)
+    """
+    assert run(src, rules=["blocking"]) == []
+
+
+# -- cancellation ----------------------------------------------------------
+
+BAD_CANCELLATION = """
+    async def handler(request):
+        try:
+            await do_work(request)
+        except Exception:
+            return error_response()
+"""
+
+GOOD_CANCELLATION = """
+    import asyncio
+
+    async def handler(request):
+        try:
+            await do_work(request)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            return error_response()
+"""
+
+
+def test_cancellation_bad():
+    fs = run(BAD_CANCELLATION, rules=["cancellation"])
+    assert len(fs) == 1 and fs[0].rule == "cancellation"
+    assert fs[0].line == 5
+
+
+def test_cancellation_good():
+    assert run(GOOD_CANCELLATION, rules=["cancellation"]) == []
+
+
+def test_cancellation_reraise_is_ok():
+    src = """
+        async def handler(request):
+            try:
+                await do_work(request)
+            except Exception:
+                log()
+                raise
+    """
+    assert run(src, rules=["cancellation"]) == []
+
+
+def test_cancellation_sync_try_not_flagged():
+    # no await in the try body: cancellation cannot be delivered there
+    src = """
+        async def handler(request):
+            try:
+                parse(request)
+            except Exception:
+                return None
+            await send(request)
+    """
+    assert run(src, rules=["cancellation"]) == []
+
+
+def test_cancellation_bare_except_flagged():
+    src = """
+        async def handler(request):
+            try:
+                await do_work(request)
+            except:
+                pass
+    """
+    fs = run(src, rules=["cancellation"])
+    assert len(fs) == 1 and "bare" in fs[0].message
+
+
+# -- hostsync --------------------------------------------------------------
+
+BAD_HOSTSYNC = """
+    import numpy as np
+
+    def encode_step(blocks):
+        parity = compute(blocks)
+        return np.asarray(parity)
+"""
+
+GOOD_HOSTSYNC = """
+    import jax.numpy as jnp
+
+    def encode_step(blocks):
+        data = jnp.asarray(blocks, dtype=jnp.uint8)
+        return compute(data)
+"""
+
+
+def test_hostsync_bad_in_hot_path():
+    fs = run(BAD_HOSTSYNC, relpath="ops/rs_jax.py", rules=["hostsync"])
+    assert len(fs) == 1 and fs[0].rule == "hostsync"
+    assert "np.asarray" in fs[0].message
+
+
+def test_hostsync_good_in_hot_path():
+    assert run(GOOD_HOSTSYNC, relpath="ops/rs_jax.py", rules=["hostsync"]) == []
+
+
+def test_hostsync_ignores_cold_files():
+    assert run(BAD_HOSTSYNC, relpath="server/app.py", rules=["hostsync"]) == []
+
+
+def test_hostsync_boundary_function_whitelisted():
+    src = """
+        import numpy as np
+
+        def _loop(self):
+            return np.asarray(self.batch)
+    """
+    assert run(src, relpath="parallel/dispatcher.py", rules=["hostsync"]) == []
+
+
+def test_hostsync_float_on_name_flagged():
+    src = """
+        def encode_step(x):
+            return float(x)
+    """
+    fs = run(src, relpath="ops/rs_jax.py", rules=["hostsync"])
+    assert len(fs) == 1
+
+
+# -- gf-dtype --------------------------------------------------------------
+
+BAD_GF_DTYPE = """
+    import numpy as np
+
+    def make(n):
+        stripe = np.zeros((16, n))
+        return stripe
+"""
+
+GOOD_GF_DTYPE = """
+    import numpy as np
+
+    def make(n):
+        stripe = np.zeros((16, n), dtype=np.uint8)
+        return stripe
+"""
+
+
+def test_gf_dtype_bad():
+    fs = run(BAD_GF_DTYPE, relpath="ops/gf.py", rules=["gf-dtype"])
+    assert len(fs) == 1 and "dtype" in fs[0].message
+
+
+def test_gf_dtype_good():
+    assert run(GOOD_GF_DTYPE, relpath="ops/gf.py", rules=["gf-dtype"]) == []
+
+
+def test_gf_dtype_wrong_dtype_flagged():
+    src = """
+        import numpy as np
+        MUL_TABLE = np.zeros((256, 256), dtype=np.float32)
+    """
+    fs = run(src, relpath="ops/gf.py", rules=["gf-dtype"])
+    assert len(fs) == 1 and "uint8" in fs[0].message
+
+
+def test_gf_dtype_blockspec_tiling():
+    bad = """
+        import jax.experimental.pallas as pl
+        spec = pl.BlockSpec((8, 100), lambda i: (0, 0))
+    """
+    good = """
+        import jax.experimental.pallas as pl
+        spec = pl.BlockSpec((8, 128), lambda i: (0, 0))
+    """
+    assert rules_hit(bad, "ops/rs_pallas.py", ["gf-dtype"]) == {"gf-dtype"}
+    assert run(good, "ops/rs_pallas.py", rules=["gf-dtype"]) == []
+
+
+def test_gf_dtype_int_weight_tables_allowed():
+    # bit-plane weights are int8 into the MXU by design: name doesn't
+    # match the byte-domain patterns
+    src = """
+        import numpy as np
+
+        def build(r, k):
+            w = np.zeros((8 * r, 8 * k), dtype=np.int8)
+            return w
+    """
+    assert run(src, relpath="ops/rs_jax.py", rules=["gf-dtype"]) == []
+
+
+# -- lock-discipline -------------------------------------------------------
+
+BAD_LOCK = """
+    def put(self, bucket, obj):
+        mtx = self.ns.new(bucket, obj)
+        if not _lock_dyn(mtx, write=True):
+            raise TimeoutError
+        do_write(bucket, obj)
+        mtx.unlock()
+"""
+
+GOOD_LOCK = """
+    def put(self, bucket, obj):
+        mtx = self.ns.new(bucket, obj)
+        if not _lock_dyn(mtx, write=True):
+            raise TimeoutError
+        try:
+            do_write(bucket, obj)
+        finally:
+            mtx.unlock()
+"""
+
+
+def test_lock_bad():
+    fs = run(BAD_LOCK, relpath="erasure/set.py", rules=["lock-discipline"])
+    assert len(fs) == 1 and "_lock_dyn" in fs[0].message
+
+
+def test_lock_good():
+    assert run(GOOD_LOCK, relpath="erasure/set.py", rules=["lock-discipline"]) == []
+
+
+def test_lock_ownership_transfer_pattern_ok():
+    # open_object hands the held lock to the streaming handle: releases
+    # in a broad handler + re-raise, success path returns inside the try
+    src = """
+        def open_object(self, bucket, obj):
+            mtx = self.ns.new(bucket, obj)
+            if not _lock_dyn(mtx, write=False):
+                raise TimeoutError
+            try:
+                fi = self._quorum_fileinfo(bucket, obj)
+                return Handle(fi, mutex=mtx)
+            except BaseException:
+                mtx.runlock()
+                raise
+    """
+    assert run(src, relpath="erasure/set.py", rules=["lock-discipline"]) == []
+
+
+def test_lock_transfer_with_trailing_statement_flagged():
+    # the pre-fix open_object shape: statements after the try run with
+    # the lock held but unprotected
+    src = """
+        def open_object(self, bucket, obj):
+            mtx = self.ns.new(bucket, obj)
+            if not _lock_dyn(mtx, write=False):
+                raise TimeoutError
+            try:
+                fi = self._quorum_fileinfo(bucket, obj)
+            except BaseException:
+                mtx.runlock()
+                raise
+            oi = self._to_object_info(bucket, obj, fi)
+            return Handle(oi, mutex=mtx)
+    """
+    fs = run(src, relpath="erasure/set.py", rules=["lock-discipline"])
+    assert len(fs) == 1
+
+
+def test_await_under_sync_lock_flagged():
+    src = """
+        async def send(self, frame):
+            with self._lock:
+                await self.ws.send(frame)
+    """
+    fs = run(src, rules=["lock-discipline"])
+    assert len(fs) == 1 and "await" in fs[0].message
+
+
+def test_async_lock_ok():
+    src = """
+        async def send(self, frame):
+            async with self._lock:
+                await self.ws.send(frame)
+    """
+    assert run(src, rules=["lock-discipline"]) == []
+
+
+# -- knob ------------------------------------------------------------------
+
+BAD_KNOB = """
+    import os
+    v = os.environ.get("MINIO_TPU_TOTALLY_NEW_KNOB", "1")
+"""
+
+GOOD_KNOB = """
+    import os
+    v = os.environ.get("MINIO_TPU_BATCH_WINDOW_MS", "2")
+"""
+
+
+def test_knob_undeclared():
+    fs = run(BAD_KNOB, rules=["knob"])
+    assert len(fs) == 1 and "undeclared" in fs[0].message
+
+
+def test_knob_declared():
+    assert run(GOOD_KNOB, rules=["knob"]) == []
+
+
+def test_knob_default_mismatch():
+    src = """
+        import os
+        v = os.environ.get("MINIO_TPU_BATCH_WINDOW_MS", "250")
+    """
+    fs = run(src, rules=["knob"])
+    assert len(fs) == 1 and "registry declares" in fs[0].message
+
+
+def test_knob_prefix_family():
+    good = """
+        import os
+        for k, v in os.environ.items():
+            if k.startswith("MINIO_NOTIFY_WEBHOOK_ENABLE_"):
+                ep = os.environ.get(f"MINIO_NOTIFY_WEBHOOK_ENDPOINT_{k}", "")
+    """
+    bad = """
+        import os
+        for k, v in os.environ.items():
+            if k.startswith("MINIO_NOTIFY_CARRIERPIGEON_ENABLE_"):
+                pass
+    """
+    assert run(good, rules=["knob"]) == []
+    fs = run(bad, rules=["knob"])
+    assert len(fs) == 1 and "prefix knob" in fs[0].message
+
+
+def test_knob_wrapper_helper_read_needs_declaration():
+    src = """
+        v = setting("MINIO_TPU_NOT_A_REAL_KNOB", "cfgkey")
+    """
+    fs = run(src, rules=["knob"])
+    assert len(fs) == 1 and "undeclared" in fs[0].message
+
+
+# -- pragmas ---------------------------------------------------------------
+
+def test_pragma_suppresses_exactly_one_line():
+    src = """
+        import time
+
+        async def handler(request):
+            time.sleep(1)  # miniovet: ignore[blocking] -- test fixture
+            time.sleep(2)
+            return 200
+    """
+    fs = run(src, rules=["blocking"])
+    assert len(fs) == 1
+    assert fs[0].line == 6  # only the unannotated sleep
+
+def test_pragma_on_preceding_comment_line():
+    src = """
+        import time
+
+        def worker():
+            # miniovet: ignore[blocking] -- daemon pacing
+            # (reason continues on a second comment line)
+            time.sleep(1)
+    """
+    assert run(src, rules=["blocking"]) == []
+
+
+def test_pragma_wrong_rule_does_not_suppress():
+    src = """
+        import time
+
+        async def handler(request):
+            time.sleep(1)  # miniovet: ignore[hostsync]
+    """
+    fs = run(src, rules=["blocking"])
+    assert len(fs) == 1
+
+
+def test_unused_pragma_reported_in_strict():
+    src = """
+        x = 1  # miniovet: ignore[blocking]
+    """
+    fs = run(src)  # default rule set includes the pragma pseudo-rule
+    assert [f.rule for f in fs] == ["pragma"]
+
+
+def test_pragma_mention_in_docstring_is_not_a_pragma():
+    src = '''
+        def f():
+            """Annotate sites with `# miniovet: ignore[blocking]`."""
+            return 1
+    '''
+    assert run(src) == []
+
+
+def test_syntax_error_reported_as_parse_finding():
+    fs = analyze_source("def f(:\n", path="x.py")
+    assert len(fs) == 1 and fs[0].rule == "parse"

@@ -198,3 +198,22 @@ def test_read_window_one(tmp_path, monkeypatch):
     shutil.rmtree(tmp_path / "w1" / "wbk")
     _, it = s.get_object("wbk", "obj")
     assert b"".join(it) == data
+
+
+def test_open_object_failure_after_metadata_releases_lock(es, monkeypatch):
+    """Regression (miniovet lock-discipline): a failure between the quorum
+    metadata read and handle construction must release the namespace read
+    lock — it used to run outside the release-on-error try, stranding the
+    lock until TTL expiry."""
+    es.put_object("bkt", "locked-obj", b"x" * 1024)
+    monkeypatch.setattr(
+        type(es), "_to_object_info",
+        lambda self, *a, **k: (_ for _ in ()).throw(RuntimeError("boom")),
+    )
+    with pytest.raises(RuntimeError):
+        es.open_object("bkt", "locked-obj")
+    monkeypatch.undo()
+    # a stranded read lock would make this write-lock acquire time out
+    mtx = es.ns.new("bkt", "locked-obj")
+    assert mtx.lock(timeout=0.5)
+    mtx.unlock()

@@ -153,6 +153,15 @@ def test_classify_qos_class_ignores_client_headers():
     assert classify_qos_class("minio", "admin/v3/info") == CLASS_ADMIN
     assert classify_qos_class("minio", "kms/key/list") == CLASS_ADMIN
     assert classify_qos_class("bkt", "obj") == CLASS_S3
+    # internode RPC planes stay unthrottled (they carry the locks/storage
+    # traffic that foreground requests are already waiting on)
+    assert classify_qos_class("minio", "grid/v1") is None
+    assert classify_qos_class("minio", "lock/v1/lock") is None
+    assert classify_qos_class("minio", "storage/v1/0/readfile") is None
+    # but an unrecognized key under the reserved bucket is ordinary s3
+    # traffic: objects in a bucket named "minio" must not dodge admission
+    assert classify_qos_class("minio", "obj1") == CLASS_S3
+    assert classify_qos_class("minio", "") == CLASS_S3
     # pre-auth classification must never trust wire signals: the
     # replication marker does not buy a different admission pool
     assert classify_qos_class(

@@ -75,6 +75,14 @@ def test_invalid_bucket_name(cli):
     assert cli.make_bucket("AB").status == 400
 
 
+def test_reserved_bucket_name_minio(cli):
+    # "minio" is the control plane's path namespace AND is QoS-exempt on
+    # its known routes; a user bucket by that name is rejected like the
+    # reference's isReservedOrInvalidBucket
+    assert cli.make_bucket("minio").status == 400
+    assert not cli.bucket_exists("minio")
+
+
 def test_put_get_roundtrip(cli):
     cli.make_bucket("data")
     body = os.urandom(256 * 1024)
