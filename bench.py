@@ -222,12 +222,25 @@ def _bench_qos_p99(np) -> dict:
 
     off = fg_p99(False)
     on = fg_p99(True)
+    # dispatcher efficiency stats (obs/): host orchestration vs device
+    # execute split + batch occupancy — recorded in the BENCH trajectory
+    # so kernel-time regressions and host-plumbing regressions are
+    # distinguishable across rounds
+    st = disp.stats
+    n_disp = max(st["dispatches"], 1)
+    n_items = max(sum(st["queue_wait_hist"]), 1)
     return {
         "qos_metric": "fg_encode_p99_ms",
         "qos_fg_p99_ms_bg_off": round(off * 1e3, 3),
         "qos_fg_p99_ms_bg_on": round(on * 1e3, 3),
-        "qos_fg_deferred_behind_bg": disp.stats["fg_deferred_behind_bg"],
-        "qos_bg_blocks": disp.stats["bg_blocks"],
+        "qos_fg_deferred_behind_bg": st["fg_deferred_behind_bg"],
+        "qos_bg_blocks": st["bg_blocks"],
+        "dispatch_occupancy_pct": round(st["occupancy_pct_sum"] / n_disp, 1),
+        "dispatch_device_ms_avg": round(st["device_s"] / n_disp * 1e3, 3),
+        "dispatch_host_ms_avg": round(st["host_s"] / n_disp * 1e3, 3),
+        "dispatch_queue_wait_ms_avg": round(
+            st["queue_wait_s"] / n_items * 1e3, 3
+        ),
     }
 
 

@@ -23,6 +23,10 @@ bug classes this reproduction actually hits:
                       the central registry (analysis/knobs.py), from
                       which docs/CONFIG.md is generated; declared
                       defaults must match the read site.
+- ``span``            obs trace spans may only be opened via the
+                      context-manager API (``with obs.span(...)``);
+                      an orphaned start would leak the trace context
+                      token on any non-finally exit path.
 
 Run it as ``python -m minio_tpu.analysis [paths] [--strict]`` (see
 __main__.py) or ``make check``; tier-1 enforces a clean tree via

@@ -248,6 +248,9 @@ _ALL: list[Knob] = [
     _k("MINIO_TPU_STREAM_MIN_BYTES", None, "server",
        "Content-Length floor below which a PUT buffers instead of "
        "streaming."),
+    _k("MINIO_TPU_TRACE_BUFFER", "1000", "server",
+       "Per-subscriber trace stream queue depth; a consumer slower than "
+       "the record rate drops (counted) records beyond it."),
     # -- storage ----------------------------------------------------------
     _k("MINIO_TPU_FSYNC", "0", "storage",
        "fsync shard files on write (1) instead of trusting the page "

@@ -21,6 +21,7 @@ from typing import BinaryIO, Iterator
 import msgpack
 from aiohttp import web
 
+from .. import obs
 from ..storage import errors
 from ..storage.datatypes import DiskInfo, FileInfo, VolInfo
 from ..storage.interface import StorageAPI
@@ -81,10 +82,17 @@ class StorageRESTServer:
         internal/grid; bulk shard bodies stay on HTTP)."""
 
         def call(payload: bytes) -> bytes:
-            drive_idx, op, body = msgpack.unpackb(payload, raw=False)
+            parts = msgpack.unpackb(payload, raw=False)
+            drive_idx, op, body = parts[0], parts[1], parts[2]
+            # 4th element (optional, newer callers): trace request id —
+            # the span context crosses the grid hop with the payload
+            req_id = parts[3] if len(parts) > 3 else ""
             drive = self.drives.get(drive_idx)
             if drive is None:
                 raise errors.DiskNotFound("bad drive index")
+            if req_id:
+                with obs.request_context(req_id):
+                    return self._call(drive, op, body)
             return self._call(drive, op, body)
 
         async def walkdir(payload: bytes, stream) -> None:
@@ -127,9 +135,19 @@ class StorageRESTServer:
         body = await request.read()
         import asyncio
 
+        # the caller's trace request id rides an internode header so the
+        # serving node's spans join the same tree
+        req_id = request.headers.get("x-minio-reqid", "")
+
+        def run():
+            if req_id:
+                with obs.request_context(req_id):
+                    return self._call(drive, op, body)
+            return self._call(drive, op, body)
+
         loop = asyncio.get_running_loop()
         try:
-            result = await loop.run_in_executor(None, self._call, drive, op, body)
+            result = await loop.run_in_executor(None, run)
             return web.Response(body=result)
         except asyncio.CancelledError:
             raise
@@ -137,6 +155,16 @@ class StorageRESTServer:
             return _pack_err(e)
 
     def _call(self, drive: XLStorage, op: str, body: bytes) -> bytes:
+        # serving-node storage span: the registry serves RAW drives (the
+        # calling side owns the HealthCheckedDisk wrapper), so this is
+        # where remote ops become visible on the node that executes them
+        with obs.span(
+            obs.TYPE_STORAGE, f"rpc.{op}",
+            drive=getattr(drive, "endpoint", ""),
+        ):
+            return self._call_inner(drive, op, body)
+
+    def _call_inner(self, drive: XLStorage, op: str, body: bytes) -> bytes:
         args = msgpack.unpackb(body, raw=False) if body else {}
 
         if op == "diskinfo":
@@ -284,15 +312,25 @@ class StorageRESTClient(StorageAPI):
 
     def _rpc(self, op: str, args: dict | None = None) -> bytes:
         body = msgpack.packb(args or {})
+        # trace context crosses the internode hop: as a 4th payload element
+        # on the grid, as a header on HTTP. The server accepts both payload
+        # arities, but a tracing caller does require a server that knows the
+        # 4-element form — all internode planes already assume one code
+        # version cluster-wide (bootstrap verifies config consistency)
+        req_id = obs.current_request_id()
         if op not in self._BULK_OPS:
             g = self._gate.client()
             if g is not None:
                 from .grid import GridConnectError, GridError, RemoteError
 
+                payload = (
+                    [self.drive_index, op, body, req_id]
+                    if req_id else [self.drive_index, op, body]
+                )
                 try:
                     return g.call(
                         "storage.call",
-                        msgpack.packb([self.drive_index, op, body]),
+                        msgpack.packb(payload),
                         retry=op in self._RETRYABLE,
                     )
                 except RemoteError as e:
@@ -314,11 +352,11 @@ class StorageRESTClient(StorageAPI):
         for attempt in attempts:
             conn = self._conn()
             try:
-                conn.request(
-                    "POST", path, body=body,
-                    headers={"x-minio-token": self.token,
-                             "Content-Type": "application/msgpack"},
-                )
+                hdrs = {"x-minio-token": self.token,
+                        "Content-Type": "application/msgpack"}
+                if req_id:
+                    hdrs["x-minio-reqid"] = req_id
+                conn.request("POST", path, body=body, headers=hdrs)
                 resp = conn.getresponse()
                 data = resp.read()
                 # internode accounting covers the HTTP plane too (bulk

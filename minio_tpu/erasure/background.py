@@ -324,6 +324,20 @@ class BackgroundOps:
                 self._stop.wait(self.scan_interval)
 
     def scan_once(self) -> DataUsage:
+        """One full namespace crawl (traced as one ``scanner`` span —
+        the heal/ILM work it triggers nests under it)."""
+        from .. import obs
+
+        before = self.stats["objects_scanned"]
+        with obs.span(obs.TYPE_SCANNER, "scanner.cycle") as sp:
+            usage = self._scan_once_inner()
+            sp.set(
+                objectsScanned=self.stats["objects_scanned"] - before,
+                buckets=len(usage.buckets),
+            )
+            return usage
+
+    def _scan_once_inner(self) -> DataUsage:
         """One full namespace crawl: usage accounting + heal detection.
 
         Mirrors scanDataFolder (/root/reference/cmd/data-scanner.go:307);

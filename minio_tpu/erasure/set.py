@@ -19,6 +19,7 @@ from typing import Callable, Iterator
 
 import numpy as np
 
+from .. import obs
 from ..ops.bitrot import DEFAULT_BITROT_ALGO, fast_hash256
 from ..storage import errors
 from ..storage.datatypes import (
@@ -124,7 +125,9 @@ def _read_pool() -> ThreadPoolExecutor:
     if _READ_POOL is None:
         with _READ_POOL_LOCK:
             if _READ_POOL is None:
-                _READ_POOL = ThreadPoolExecutor(
+                # context-propagating: shard reads publish `storage` spans
+                # that must carry the caller's trace request id
+                _READ_POOL = obs.ContextPool(
                     max_workers=int(os.environ.get("MINIO_TPU_READ_WORKERS", "32")),
                     thread_name_prefix="shard-read",
                 )
@@ -166,7 +169,7 @@ class ErasureSet:
             default_parity if default_parity is not None else default_parity_count(self.n)
         )
         self.ns = ns_lock if ns_lock is not None else NamespaceLock()
-        self._pool = ThreadPoolExecutor(max_workers=max(4, self.n))
+        self._pool = obs.ContextPool(max_workers=max(4, self.n))
         self._coders: dict[tuple[int, int], ErasureCoder] = {}
         # read-path degradation hook (MRF heal-on-read, reference cmd/mrf.go)
         self.on_degraded = None
@@ -285,36 +288,40 @@ class ErasureSet:
         checkPreconditionsPUT) with no TOCTOU window."""
         if not self.bucket_exists(bucket) and not bucket.startswith(".minio.sys"):
             raise BucketNotFound(bucket)
-        mtx = self.ns.new(bucket, obj)
-        if not _lock_dyn(mtx, write=True):
-            raise QuorumError(f"namespace write lock timeout on {bucket}/{obj}")
-        try:
-            if check_precond is not None:
-                try:
-                    fi, _, _, _ = self._quorum_fileinfo(
-                        bucket, obj, "", read_data=False
-                    )
-                    cur = None if fi.deleted else self._to_object_info(
-                        bucket, obj, fi
-                    )
-                except (ObjectNotFound, VersionNotFound):
-                    cur = None
-                check_precond(cur)  # raises to abort before any write
-            # active refresh with loss abort: a partitioned holder must stop
-            # writing once the cluster no longer holds its lock (reference
-            # internal/dsync/drwmutex.go:340 refreshLock). Only long-running
-            # writes need it — a refresher thread per millisecond PUT would
-            # be pure overhead against the 120 s TTL.
-            long_running = not isinstance(data, (bytes, bytearray, memoryview)) \
-                or len(data) > (8 << 20)
-            if long_running:
-                mtx.start_refresher(write=True)
-            return self._put_object_locked(
-                bucket, obj, data, user_defined, version_id, versioned,
-                parity, distribution, allow_inline, lock=mtx,
-            )
-        finally:
-            mtx.unlock()
+        with obs.span(
+            obs.TYPE_INTERNAL, "erasure.put_object", bucket=bucket, object=obj
+        ):
+            mtx = self.ns.new(bucket, obj)
+            if not _lock_dyn(mtx, write=True):
+                raise QuorumError(f"namespace write lock timeout on {bucket}/{obj}")
+            try:
+                if check_precond is not None:
+                    try:
+                        fi, _, _, _ = self._quorum_fileinfo(
+                            bucket, obj, "", read_data=False
+                        )
+                        cur = None if fi.deleted else self._to_object_info(
+                            bucket, obj, fi
+                        )
+                    except (ObjectNotFound, VersionNotFound):
+                        cur = None
+                    check_precond(cur)  # raises to abort before any write
+                # active refresh with loss abort: a partitioned holder must
+                # stop writing once the cluster no longer holds its lock
+                # (reference internal/dsync/drwmutex.go:340 refreshLock).
+                # Only long-running writes need it — a refresher thread per
+                # millisecond PUT would be pure overhead against the 120 s
+                # TTL.
+                long_running = not isinstance(data, (bytes, bytearray, memoryview)) \
+                    or len(data) > (8 << 20)
+                if long_running:
+                    mtx.start_refresher(write=True)
+                return self._put_object_locked(
+                    bucket, obj, data, user_defined, version_id, versioned,
+                    parity, distribution, allow_inline, lock=mtx,
+                )
+            finally:
+                mtx.unlock()
 
     def _put_object_locked(
         self,
@@ -622,26 +629,30 @@ class ErasureSet:
     ) -> tuple[ObjectInfo, "ObjectHandle"]:
         """One quorum metadata read under a namespace read lock; the handle
         serves any number of ranged reads without re-reading metadata."""
-        mtx = self.ns.new(bucket, obj)
-        if not _lock_dyn(mtx, write=False):
-            raise QuorumError(f"namespace read lock timeout on {bucket}/{obj}")
-        try:
-            fi, metas, _, _ = self._quorum_fileinfo(
-                bucket, obj, version_id, read_data=True
-            )
-            if fi.deleted:
-                raise ObjectNotFound(f"{bucket}/{obj}")
-            oi = self._to_object_info(bucket, obj, fi)
-            # the read lock stays held while the handle streams (the
-            # reference holds GetObject's lock until the reader closes) and
-            # is refreshed during long streams; the TTL backstops abandoned
-            # handles
-            return oi, ObjectHandle(self, bucket, obj, fi, metas, mutex=mtx)
-        except BaseException:
-            # everything up to handle construction releases on failure; a
-            # raise after lock ownership transferred would double-release
-            mtx.runlock()
-            raise
+        with obs.span(
+            obs.TYPE_INTERNAL, "erasure.open_object", bucket=bucket, object=obj
+        ):
+            mtx = self.ns.new(bucket, obj)
+            if not _lock_dyn(mtx, write=False):
+                raise QuorumError(f"namespace read lock timeout on {bucket}/{obj}")
+            try:
+                fi, metas, _, _ = self._quorum_fileinfo(
+                    bucket, obj, version_id, read_data=True
+                )
+                if fi.deleted:
+                    raise ObjectNotFound(f"{bucket}/{obj}")
+                oi = self._to_object_info(bucket, obj, fi)
+                # the read lock stays held while the handle streams (the
+                # reference holds GetObject's lock until the reader closes)
+                # and is refreshed during long streams; the TTL backstops
+                # abandoned handles
+                return oi, ObjectHandle(self, bucket, obj, fi, metas, mutex=mtx)
+            except BaseException:
+                # everything up to handle construction releases on failure;
+                # a raise after lock ownership transferred would
+                # double-release
+                mtx.runlock()
+                raise
 
     def get_object(
         self,
@@ -670,6 +681,25 @@ class ErasureSet:
         return out
 
     def _read_range(
+        self,
+        bucket: str,
+        obj: str,
+        fi: FileInfo,
+        metas: list[FileInfo | None],
+        offset: int,
+        length: int,
+    ) -> Iterator[bytes]:
+        """Span shim over ``_read_range_inner``: the stripe verify +
+        reconstruct compute is the GET path's kernel stage, traced as one
+        ``tpu`` span covering the generator's whole life (entered at first
+        chunk, closed on exhaustion or client disconnect)."""
+        with obs.span(
+            obs.TYPE_TPU, "stripe.read-verify",
+            bucket=bucket, object=obj, offset=offset, bytes=length,
+        ):
+            yield from self._read_range_inner(bucket, obj, fi, metas, offset, length)
+
+    def _read_range_inner(
         self,
         bucket: str,
         obj: str,
@@ -952,13 +982,16 @@ class ErasureSet:
         - version id given -> remove exactly that version
         - unversioned -> remove the null version entirely
         """
-        mtx = self.ns.new(bucket, obj)
-        if not _lock_dyn(mtx, write=True):
-            raise QuorumError(f"namespace write lock timeout on {bucket}/{obj}")
-        try:
-            return self._delete_object_locked(bucket, obj, version_id, versioned)
-        finally:
-            mtx.unlock()
+        with obs.span(
+            obs.TYPE_INTERNAL, "erasure.delete_object", bucket=bucket, object=obj
+        ):
+            mtx = self.ns.new(bucket, obj)
+            if not _lock_dyn(mtx, write=True):
+                raise QuorumError(f"namespace write lock timeout on {bucket}/{obj}")
+            try:
+                return self._delete_object_locked(bucket, obj, version_id, versioned)
+            finally:
+                mtx.unlock()
 
     def _delete_object_locked(
         self, bucket: str, obj: str, version_id: str, versioned: bool
@@ -1177,13 +1210,18 @@ class ErasureSet:
         Holds the namespace write lock: healing must not interleave with a
         concurrent overwrite of the same object.
         """
-        mtx = self.ns.new(bucket, obj)
-        if not _lock_dyn(mtx, write=True):
-            raise QuorumError(f"namespace lock timeout healing {bucket}/{obj}")
-        try:
-            return self._heal_object_locked(bucket, obj, version_id, lock=mtx)
-        finally:
-            mtx.unlock()
+        with obs.span(
+            obs.TYPE_HEAL, "erasure.heal_object", bucket=bucket, object=obj
+        ) as hsp:
+            mtx = self.ns.new(bucket, obj)
+            if not _lock_dyn(mtx, write=True):
+                raise QuorumError(f"namespace lock timeout healing {bucket}/{obj}")
+            try:
+                res = self._heal_object_locked(bucket, obj, version_id, lock=mtx)
+                hsp.set(healed=len(res.get("healed", [])))
+                return res
+            finally:
+                mtx.unlock()
 
     def _heal_object_locked(
         self, bucket: str, obj: str, version_id: str, lock=None

@@ -1,0 +1,92 @@
+"""Trace stream filters — the reference's `mc admin trace` flags.
+
+``type=`` (comma-separated trace types), ``threshold=`` (minimum span
+duration; bare numbers are seconds, `ms`/`us`/`s`/`m` suffixes accepted
+like Go duration strings), ``err-only=`` (only failed spans). Filters
+are attached to the subscriber so records are matched once at publish
+time, before they consume queue space.
+"""
+
+from __future__ import annotations
+
+import re
+
+from .trace import TRACE_TYPES
+
+_DUR_RE = re.compile(r"^\s*([0-9]*\.?[0-9]+)\s*(ns|us|µs|ms|s|m|h)?\s*$")
+
+_UNIT_S = {
+    "ns": 1e-9, "us": 1e-6, "µs": 1e-6, "ms": 1e-3,
+    "s": 1.0, "m": 60.0, "h": 3600.0, None: 1.0, "": 1.0,
+}
+
+
+def parse_duration(text: str) -> float:
+    """Duration string -> seconds. Raises ValueError on garbage."""
+    m = _DUR_RE.match(text)
+    if not m:
+        raise ValueError(f"bad duration {text!r}")
+    return float(m.group(1)) * _UNIT_S[m.group(2)]
+
+
+_TRUTHY = ("on", "true", "1", "yes")
+
+
+class TraceFilter:
+    """Predicate over trace records built from stream query params."""
+
+    __slots__ = ("types", "threshold_ns", "err_only")
+
+    def __init__(self, types=None, threshold_s: float = 0.0,
+                 err_only: bool = False):
+        self.types = frozenset(types) if types else None
+        self.threshold_ns = int(threshold_s * 1e9)
+        self.err_only = err_only
+
+    @classmethod
+    def from_query(cls, q) -> "TraceFilter":
+        """Build from a query mapping; unknown trace types and malformed
+        thresholds raise ValueError (-> 400 InvalidArgument)."""
+        types = None
+        raw = q.get("type", "")
+        if raw:
+            types = {t.strip() for t in raw.split(",") if t.strip()}
+            unknown = types - TRACE_TYPES
+            if unknown:
+                raise ValueError(
+                    f"unknown trace type(s): {', '.join(sorted(unknown))}"
+                )
+        threshold = parse_duration(q.get("threshold", "0")) if q.get(
+            "threshold"
+        ) else 0.0
+        err_only = q.get("err-only", "").lower() in _TRUTHY
+        return cls(types=types, threshold_s=threshold, err_only=err_only)
+
+    @property
+    def is_noop(self) -> bool:
+        return self.types is None and not self.threshold_ns and not self.err_only
+
+    def match(self, rec: dict) -> bool:
+        if self.types is not None and rec.get("type") not in self.types:
+            return False
+        if self.threshold_ns and rec.get("durationNs", 0) < self.threshold_ns:
+            return False
+        if self.err_only:
+            if not rec.get("error") and rec.get("statusCode", 0) < 400:
+                return False
+        return True
+
+    def to_query(self) -> dict[str, str]:
+        """Round-trip back to query params (peer fan-out forwards the
+        caller's filters so peers pre-filter at the source). The
+        threshold goes out in integer nanoseconds — a float would render
+        sub-100µs values in exponent notation, which parse_duration
+        rejects."""
+        out: dict[str, str] = {}
+        if self.types is not None:
+            out["type"] = ",".join(sorted(self.types))
+        if self.threshold_ns:
+            out["threshold"] = f"{self.threshold_ns}ns"
+        if self.err_only:
+            out["err-only"] = "on"
+        return out

@@ -1,0 +1,21 @@
+"""ContextPool — a ThreadPoolExecutor that propagates contextvars.
+
+``loop.run_in_executor`` and plain ``ThreadPoolExecutor.submit`` run the
+callable in the worker's own (empty) context, which would drop the trace
+request id (and any other contextvar, e.g. the QoS background marker for
+code that submits from a background thread) at every thread hop. This
+pool snapshots the submitter's context and runs the task inside it —
+``contextvars.copy_context`` is an O(1) HAMT copy, so the idle-path cost
+is negligible.
+"""
+
+from __future__ import annotations
+
+import contextvars
+from concurrent.futures import ThreadPoolExecutor
+
+
+class ContextPool(ThreadPoolExecutor):
+    def submit(self, fn, /, *args, **kwargs):
+        ctx = contextvars.copy_context()
+        return super().submit(ctx.run, fn, *args, **kwargs)

@@ -1,0 +1,194 @@
+"""Span core: contextvar-propagated request ids + typed trace spans.
+
+Zero-cost-when-idle contract (the reference checks NumSubscribers before
+building a record): ``span()`` returns the shared ``NOOP_SPAN`` singleton
+— no Span object, no field dict copy, no clock read — unless a publisher
+is attached AND it has subscribers. Code on the hot path may therefore
+open spans unconditionally.
+
+The request context is a ``contextvars.ContextVar`` so it survives both
+``await`` hops and executor hops (``ContextPool``/``bind_context`` copy
+the context across thread boundaries; storage-REST carries it in an
+``x-minio-reqid`` header / grid payload field between nodes).
+"""
+
+from __future__ import annotations
+
+import contextvars
+import itertools
+import os
+import socket
+import time
+from contextlib import contextmanager
+
+TYPE_S3 = "s3"
+TYPE_INTERNAL = "internal"
+TYPE_STORAGE = "storage"
+TYPE_TPU = "tpu"
+TYPE_HEAL = "heal"
+TYPE_SCANNER = "scanner"
+TRACE_TYPES = frozenset(
+    {TYPE_S3, TYPE_INTERNAL, TYPE_STORAGE, TYPE_TPU, TYPE_HEAL, TYPE_SCANNER}
+)
+
+# (request_id, parent_span_id); spans nest by swapping the second slot
+_CTX: contextvars.ContextVar[tuple[str, int] | None] = contextvars.ContextVar(
+    "minio_tpu_trace_ctx", default=None
+)
+
+_span_ids = itertools.count(1)
+
+# the publishing TracePubSub (server/metrics.py) — module-level because
+# spans open deep in layers (dispatcher, storage wrappers) that have no
+# server reference; one process serves one node
+_publisher = None
+
+NODE = socket.gethostname()
+
+
+def set_publisher(pub) -> None:
+    global _publisher
+    _publisher = pub
+
+
+def publisher():
+    return _publisher
+
+
+def active() -> bool:
+    p = _publisher
+    return p is not None and p.active
+
+
+def new_request_id() -> str:
+    """An ``x-amz-request-id`` value: 16 uppercase hex chars (the
+    reference's mustGetRequestID is a time-based variant of the same)."""
+    return os.urandom(8).hex().upper()
+
+
+def set_request(request_id: str):
+    """Install `request_id` as the current trace context; returns the
+    token for ``reset_request``. Used at plane entries (S3 entry,
+    storage-REST server side); everything below inherits via contextvar
+    propagation."""
+    return _CTX.set((request_id, 0))
+
+
+def reset_request(token) -> None:
+    _CTX.reset(token)
+
+
+@contextmanager
+def request_context(request_id: str):
+    token = _CTX.set((request_id, 0))
+    try:
+        yield
+    finally:
+        _CTX.reset(token)
+
+
+def current_request_id() -> str:
+    ctx = _CTX.get()
+    return ctx[0] if ctx is not None else ""
+
+
+def bind_context(fn):
+    """Wrap `fn` so it runs under a snapshot of the CURRENT context —
+    for handing work to executors that don't propagate contextvars
+    (``loop.run_in_executor`` does not)."""
+    ctx = contextvars.copy_context()
+    return lambda *a, **kw: ctx.run(fn, *a, **kw)
+
+
+def publish(record: dict) -> None:
+    """Publish a pre-built record if anyone is listening (cheap guard
+    for non-span record sites like the dispatcher's batch records)."""
+    p = _publisher
+    if p is not None and p.active:
+        p.publish(record)
+
+
+class Span:
+    """One timed, typed trace record; context-manager only (see the
+    ``span`` miniovet rule). Publishes on exit with the error captured
+    from a propagating exception; never swallows it."""
+
+    __slots__ = (
+        "trace_type", "name", "fields", "req_id", "span_id", "parent_id",
+        "_t0", "_token",
+    )
+
+    def __init__(self, trace_type: str, name: str, fields: dict):
+        self.trace_type = trace_type
+        self.name = name
+        self.fields = fields
+        ctx = _CTX.get()
+        self.req_id = ctx[0] if ctx is not None else ""
+        self.parent_id = ctx[1] if ctx is not None else 0
+        self.span_id = next(_span_ids)
+        self._t0 = 0.0
+        self._token = None
+
+    def __enter__(self) -> "Span":
+        self._token = _CTX.set((self.req_id, self.span_id))
+        self._t0 = time.perf_counter()
+        return self
+
+    def set(self, **fields) -> None:
+        self.fields.update(fields)
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        dur = time.perf_counter() - self._t0
+        if self._token is not None:
+            try:
+                _CTX.reset(self._token)
+            except ValueError:
+                # generator spans may enter and exit under different
+                # context COPIES (each executor hop snapshots its own);
+                # the copy dies with the task, so a failed reset leaks
+                # nothing
+                pass
+        p = _publisher
+        if p is not None and p.active:
+            rec = {
+                "time": time.time(),
+                "type": self.trace_type,
+                "name": self.name,
+                "reqId": self.req_id,
+                "spanId": self.span_id,
+                "parentId": self.parent_id,
+                "node": NODE,
+                "durationNs": int(dur * 1e9),
+                "error": "" if exc is None else f"{type(exc).__name__}: {exc}",
+            }
+            rec.update(self.fields)
+            p.publish(rec)
+        return False  # propagate exceptions
+
+
+class _NoopSpan:
+    """Shared do-nothing span for the no-subscribers path; identity is
+    asserted by the zero-overhead test."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        return False
+
+    def set(self, **fields) -> None:
+        pass
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+def span(trace_type: str, name: str, **fields):
+    """A span of `trace_type` (one of TRACE_TYPES) for use in a ``with``
+    statement. Returns NOOP_SPAN unless tracing is active."""
+    p = _publisher
+    if p is None or not p.active:
+        return NOOP_SPAN
+    return Span(trace_type, name, fields)

@@ -29,12 +29,28 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from collections import deque
 from concurrent.futures import Future
 
 import numpy as np
 
+from .. import obs
 from ..qos.context import PRI_BACKGROUND, PRI_FOREGROUND, current_priority
+
+# fixed histogram edges (seconds) for the metrics-v3 /api/tpu group: the
+# queue-wait edges bracket the 2 ms batch window, the device edges the
+# sub-ms..100 ms kernel range
+QUEUE_WAIT_BUCKETS = (0.0005, 0.001, 0.002, 0.004, 0.008, 0.016, 0.05, 0.1, 0.5)
+DEVICE_TIME_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.5)
+
+
+def _hist_add(hist: list[int], edges: tuple, v: float) -> None:
+    for i, edge in enumerate(edges):
+        if v <= edge:
+            hist[i] += 1
+            return
+    hist[-1] += 1
 
 
 class TpuDispatcher:
@@ -95,6 +111,12 @@ class TpuDispatcher:
             "fg_blocks": 0, "bg_blocks": 0, "bg_forced": 0,
             "bg_batch_max": 0, "fg_deferred_behind_bg": 0,
             "fused": 0, "fused_failures": 0,
+            # kernel-level timing (metrics-v3 /api/tpu): host orchestration
+            # vs device execute split + per-item queue wait
+            "occupancy_pct_sum": 0.0, "host_s": 0.0, "device_s": 0.0,
+            "queue_wait_s": 0.0,
+            "queue_wait_hist": [0] * (len(QUEUE_WAIT_BUCKETS) + 1),
+            "device_time_hist": [0] * (len(DEVICE_TIME_BUCKETS) + 1),
         }
         self._thread = threading.Thread(
             target=self._loop, daemon=True,
@@ -111,7 +133,11 @@ class TpuDispatcher:
         if priority is None:
             priority = current_priority()
         fut: Future = Future()
-        item = (blocks, fut, priority, _monotonic())
+        # request id captured at submit time (contextvar — costs one read
+        # only while someone is tracing) so the batch record can name the
+        # requests it served
+        req_id = obs.current_request_id() if obs.active() else ""
+        item = (blocks, fut, priority, _monotonic(), req_id)
         with self._cv:
             (self._bg if priority == PRI_BACKGROUND else self._fg).append(item)
             self._cv.notify()
@@ -257,8 +283,16 @@ class TpuDispatcher:
     def _loop(self) -> None:
         while True:
             batch = self._collect()
+            t_start = _monotonic()
+            # per-item queue wait: submit -> dispatch start
+            max_wait = 0.0
+            for it in batch:
+                wait = max(t_start - it[3], 0.0)
+                max_wait = max(max_wait, wait)
+                self.stats["queue_wait_s"] += wait
+                _hist_add(self.stats["queue_wait_hist"], QUEUE_WAIT_BUCKETS, wait)
             try:
-                all_blocks = np.concatenate([b for b, _, _, _ in batch], axis=0)
+                all_blocks = np.concatenate([it[0] for it in batch], axis=0)
                 k = all_blocks.shape[0]
                 bucket = self._bucket(k)
                 if bucket < 16 and self._fused_enabled and self._fused_cooldown == 0:
@@ -276,7 +310,9 @@ class TpuDispatcher:
                         (bucket - k, *all_blocks.shape[1:]), dtype=np.uint8
                     )
                     all_blocks = np.concatenate([all_blocks, pad], axis=0)
+                t_dev = _monotonic()
                 fused = self._fused_cm(all_blocks)
+                was_fused = fused is not None
                 if fused is None:
                     # don't pay mega-kernel padding (16) on the XLA path:
                     # trim back to the natural power-of-two bucket
@@ -285,16 +321,26 @@ class TpuDispatcher:
                         all_blocks = all_blocks[:nb]
                     fused = self._encode_and_hash(self.codec, all_blocks)
                 parity, digests = fused
+                # np.asarray is the device sync point: execute + D2H land
+                # inside the device window, fan-out below is host time
                 parity = np.asarray(parity)[:k]
                 digests = np.asarray(digests)[:k]
+                device_s = _monotonic() - t_dev
                 shards = np.concatenate(
                     [all_blocks[:k], parity], axis=1
                 )  # [B, t, n]
                 self.stats["dispatches"] += 1
                 self.stats["blocks"] += k
                 self.stats["max_batch"] = max(self.stats["max_batch"], k)
+                occupancy = 100.0 * k / max(all_blocks.shape[0], 1)
+                self.stats["occupancy_pct_sum"] += occupancy
+                self.stats["device_s"] += device_s
+                _hist_add(
+                    self.stats["device_time_hist"], DEVICE_TIME_BUCKETS, device_s
+                )
                 off = 0
-                for blocks, fut, pri, _ in batch:
+                for it in batch:
+                    blocks, fut, pri = it[0], it[1], it[2]
                     kk = blocks.shape[0]
                     if pri == PRI_BACKGROUND:
                         self.stats["bg_blocks"] += kk
@@ -304,15 +350,36 @@ class TpuDispatcher:
                         (shards[off : off + kk], digests[off : off + kk])
                     )
                     off += kk
+                host_s = _monotonic() - t_start - device_s
+                self.stats["host_s"] += host_s
+                if obs.active():
+                    req_ids = sorted({it[4] for it in batch if it[4]})
+                    obs.publish({
+                        "time": time.time(),
+                        "type": obs.TYPE_TPU,
+                        "name": "dispatch.batch",
+                        "reqId": req_ids[0] if len(req_ids) == 1 else "",
+                        "reqIds": req_ids,
+                        "node": obs.trace.NODE,
+                        "durationNs": int((host_s + device_s) * 1e9),
+                        "deviceNs": int(device_s * 1e9),
+                        "hostNs": int(host_s * 1e9),
+                        "queueWaitMaxNs": int(max_wait * 1e9),
+                        "blocks": k,
+                        "bucket": int(all_blocks.shape[0]),
+                        "occupancyPct": round(occupancy, 1),
+                        "fused": was_fused,
+                        "shape": f"{self.codec.data_shards}+"
+                                 f"{self.codec.parity_shards}",
+                        "error": "",
+                    })
             except Exception as e:  # noqa: BLE001 — fail all waiters
-                for _, fut, _, _ in batch:
-                    if not fut.done():
-                        fut.set_exception(e)
+                for it in batch:
+                    if not it[1].done():
+                        it[1].set_exception(e)
 
 
 def _monotonic() -> float:
-    import time
-
     return time.monotonic()
 
 
@@ -331,13 +398,18 @@ def get_dispatcher(codec, n: int) -> TpuDispatcher:
     return d
 
 
-def aggregate_stats() -> dict[str, int]:
-    """Summed stats across every live dispatcher (metrics/admin plane)."""
-    out: dict[str, int] = {}
+def aggregate_stats() -> dict:
+    """Summed stats across every live dispatcher (metrics/admin plane).
+    Histogram lists sum element-wise; max-style gauges take the max."""
+    out: dict = {}
     for d in list(_dispatchers.values()):
         for k, v in d.stats.items():
             if k in ("max_batch", "bg_batch_max"):
                 out[k] = max(out.get(k, 0), v)
+            elif isinstance(v, list):
+                cur = out.setdefault(k, [0] * len(v))
+                for i, x in enumerate(v):
+                    cur[i] += x
             else:
                 out[k] = out.get(k, 0) + v
     return out

@@ -756,12 +756,101 @@ def storage_info_payload(server) -> dict:
     return out
 
 
+def _peer_trace_pump(server, peer: str, flt, sub, stop) -> None:
+    """Stream one peer's trace records into `sub`'s queue (cluster
+    fan-out: a single `mc admin trace`-style stream shows every node).
+    Filters forward with the request so peers drop records at the
+    source; `local=on` stops the fan-out from recursing."""
+    import http.client as _hc
+    import socket as _socket
+    import urllib.parse as _up
+
+    from .signature import sign_request
+
+    host, _, port = peer.rpartition(":")
+    qs = flt.to_query()
+    qs["local"] = "on"
+    path = "/minio/admin/v3/trace?" + _up.urlencode(qs)
+    url = f"http://{host}:{port}{path}"
+    conn = None
+    try:
+        signed = sign_request(
+            "GET", url, {}, b"", server.root_user, server.root_pass,
+            server.region,
+        )
+        conn = _hc.HTTPConnection(host, int(port), timeout=2.0)
+        conn.request("GET", path, headers=signed)
+        resp = conn.getresponse()
+        if resp.status != 200:
+            return
+        buf = b""
+        while not stop.is_set():
+            try:
+                chunk = resp.read1(1 << 16)
+            except (_socket.timeout, TimeoutError):
+                continue  # idle peer: re-check stop
+            if not chunk:
+                return  # peer closed its stream
+            buf += chunk
+            while b"\n" in buf:
+                line, buf = buf.split(b"\n", 1)
+                if not line.strip():
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if not isinstance(rec, dict) or "type" not in rec:
+                    continue  # peer's end-of-stream epitaph, not a record
+                try:
+                    sub.q.put_nowait(rec)
+                except Exception:  # noqa: BLE001 — slow consumer: count it
+                    sub.dropped += 1
+    except Exception:  # noqa: BLE001 — a dead peer mutes, not kills, the stream
+        pass
+    finally:
+        if conn is not None:
+            try:
+                conn.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+
+
 async def _stream_trace(server, request: web.Request) -> web.StreamResponse:
-    """Long-lived JSON-lines trace stream (`mc admin trace` analogue)."""
+    """Long-lived JSON-lines trace stream (`mc admin trace` analogue)
+    with the reference tracer's filters: ``type=`` (comma-separated
+    trace types), ``threshold=`` (minimum duration), ``err-only=on``.
+    Unless ``local=on``, records from every cluster peer merge into the
+    same stream."""
     import asyncio
     import queue as _queue
+    import threading as _threading
 
-    q = server.trace.subscribe()
+    from .. import obs
+
+    q = request.rel_url.query
+    try:
+        flt = obs.TraceFilter.from_query(q)
+    except ValueError:
+        raise s3err.InvalidArgument from None
+    sub = server.trace.subscribe(
+        filter=None if flt.is_noop else flt, label=request.remote or "trace"
+    )
+    stop = None
+    local_only = q.get("local", "").lower() in ("on", "true", "1")
+    peers = [] if local_only else (getattr(server, "peers", None) or [])
+    if peers:
+        stop = _threading.Event()
+        for peer in peers:
+            # dedicated daemon threads, NOT the long-poll pool: a pump
+            # lives as long as its stream, and a few streams on a large
+            # cluster would otherwise pin every pool worker and starve
+            # the trace/listen waits the pool exists to serve
+            _threading.Thread(
+                target=_peer_trace_pump,
+                args=(server, peer, flt, sub, stop),
+                daemon=True, name=f"trace-pump-{peer}",
+            ).start()
     resp = web.StreamResponse(headers={"Content-Type": "application/json"})
     await resp.prepare(request)
     loop = asyncio.get_running_loop()
@@ -769,7 +858,7 @@ async def _stream_trace(server, request: web.Request) -> web.StreamResponse:
         while True:
             try:
                 rec = await loop.run_in_executor(
-                    server._longpoll_pool, q.get, True, 1.0
+                    server._longpoll_pool, sub.q.get, True, 1.0
                 )
             except _queue.Empty:
                 continue
@@ -777,7 +866,20 @@ async def _stream_trace(server, request: web.Request) -> web.StreamResponse:
     except (ConnectionResetError, asyncio.CancelledError):
         pass
     finally:
-        server.trace.unsubscribe(q)
+        server.trace.unsubscribe(sub)
+        if stop is not None:
+            stop.set()
+        try:
+            # best-effort epitaph: how many records this subscriber lost
+            # to its own queue overflowing (visible when the server ends
+            # the stream; a vanished client just won't receive it)
+            await resp.write(
+                json.dumps({"dropped": sub.dropped}).encode() + b"\n"
+            )
+        except asyncio.CancelledError:
+            raise  # client disconnect mid-write: propagate
+        except Exception:  # noqa: BLE001 — client already gone
+            pass
     return resp
 
 

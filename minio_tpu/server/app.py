@@ -47,21 +47,25 @@ class S3Server(
 
         from concurrent.futures import ThreadPoolExecutor as _TPE
 
+        from ..obs import ContextPool as _CtxTPE
+
         self.kms = KMS()
         self.store = None
         self.streaming_puts = 0  # observability: bodies that never buffered
         # dedicated pool for streaming-body pumps: put_item can block on a
         # full queue, and parking it in the default executor would starve
         # the storage-REST plane that shares it
-        self._pump_pool = _TPE(
+        self._pump_pool = _CtxTPE(
             max_workers=8, thread_name_prefix="body-pump"
         )
         # store I/O runs on an ample dedicated pool: the default executor
         # on small machines has ~cpus+4 workers, and writers blocking on
         # namespace locks inside it can starve the reader that HOLDS the
-        # lock out of a thread to finish its stream (deadlock-by-pool)
+        # lock out of a thread to finish its stream (deadlock-by-pool).
+        # Context-propagating: the trace request id must survive the
+        # event-loop -> worker hop (run_in_executor drops contextvars)
         io_threads = int(os.environ.get("MINIO_TPU_IO_THREADS", "64"))
-        self._io_pool = _TPE(max_workers=io_threads, thread_name_prefix="s3io")
+        self._io_pool = _CtxTPE(max_workers=io_threads, thread_name_prefix="s3io")
         # long-poll waits (trace/listen subscribers) get their own pool so
         # they can never starve the I/O pool
         self._longpoll_pool = _TPE(max_workers=64, thread_name_prefix="longpoll")
@@ -74,6 +78,13 @@ class S3Server(
         self.started_at = _time.time()
         self.metrics = Metrics()
         self.trace = TracePubSub()
+        # deep-tracing spans (obs/) publish through this server's pubsub;
+        # module-level registration because spans open in layers with no
+        # server reference (dispatcher, storage wrappers) — one process
+        # serves one node
+        from .. import obs
+
+        obs.set_publisher(self.trace)
         from ..qos import QoS
 
         # QoS plane: admission control (per-class inflight caps -> 503
@@ -298,7 +309,10 @@ class S3Server(
             headers["Content-Range"] = f"bytes */{size}"
         return web.Response(
             status=err.http_status,
-            body=err.to_xml(resource=request.path),
+            body=err.to_xml(
+                resource=request.path,
+                request_id=request.get("_reqid", ""),
+            ),
             content_type="application/xml",
             headers=headers,
         )
@@ -340,42 +354,59 @@ class S3Server(
         the blocking deadline wait on the dedicated admission pool.
         Cancellation-safe: a client that disconnects mid-wait hands any
         slot the worker still grants straight back, so caps never leak."""
+        from .. import obs
+
         adm = self.qos.admission
         if adm.try_acquire(qos_class):
             return True
-        deadline = adm.begin_wait(qos_class)
-        if deadline is None:
-            return False  # wait queue full: SlowDown immediately
-        # submit + wrap (not run_in_executor): on cancellation the asyncio
-        # wrapper is marked cancelled even while the worker keeps running,
-        # so the reclaim callback must ride the CONCURRENT future, whose
-        # terminal state says what finish_wait actually did
-        cf = self._admit_pool.submit(adm.finish_wait, qos_class, deadline)
-        try:
-            return await asyncio.wrap_future(cf)
-        except asyncio.CancelledError:
-            def _reclaim(f):
-                try:
-                    if f.cancelled():
-                        # finish_wait never ran: undo the reservation
-                        adm.abort_wait(qos_class)
-                    elif f.exception() is None and f.result():
-                        adm.release(qos_class)  # granted to a dead request
-                except Exception:  # noqa: BLE001 — teardown best-effort
-                    pass
+        # contended: the parked wait is an `internal` span — attributes a
+        # slow p99 to admission queueing vs. actual work
+        with obs.span(
+            obs.TYPE_INTERNAL, "qos.admission-wait", **{"class": qos_class}
+        ) as sp:
+            deadline = adm.begin_wait(qos_class)
+            if deadline is None:
+                sp.set(rejected="queue_full")
+                return False  # wait queue full: SlowDown immediately
+            # submit + wrap (not run_in_executor): on cancellation the asyncio
+            # wrapper is marked cancelled even while the worker keeps running,
+            # so the reclaim callback must ride the CONCURRENT future, whose
+            # terminal state says what finish_wait actually did
+            cf = self._admit_pool.submit(adm.finish_wait, qos_class, deadline)
+            try:
+                granted = await asyncio.wrap_future(cf)
+                sp.set(granted=granted)
+                return granted
+            except asyncio.CancelledError:
+                def _reclaim(f):
+                    try:
+                        if f.cancelled():
+                            # finish_wait never ran: undo the reservation
+                            adm.abort_wait(qos_class)
+                        elif f.exception() is None and f.result():
+                            adm.release(qos_class)  # granted to a dead request
+                    except Exception:  # noqa: BLE001 — teardown best-effort
+                        pass
 
-            cf.add_done_callback(_reclaim)
-            raise
+                cf.add_done_callback(_reclaim)
+                raise
 
     async def _entry(self, request: web.Request) -> web.StreamResponse:
         import time as _time
 
+        from .. import obs
         from .handler_utils import classify_qos_class
         from .metrics import classify_api, trace_record
 
         self._apply_vhost_style(request)
         t0 = _time.perf_counter()
         request["_t0"] = t0  # TTFB measured at response prepare time
+        # per-request trace context: the generated x-amz-request-id rides a
+        # contextvar through every layer below (and the response header —
+        # set at prepare time so streamed bodies get it too)
+        req_id = obs.new_request_id()
+        request["_reqid"] = req_id
+        obs_token = obs.set_request(req_id)
         resp: web.StreamResponse | None = None
         qos_class: str | None = None
         self.metrics.inflight += 1  # single-threaded event loop: no race
@@ -402,6 +433,7 @@ class S3Server(
             resp = await self._entry_inner(request)
             return resp
         finally:
+            obs.trace.reset_request(obs_token)
             if qos_class is not None:
                 self.qos.admission.release(qos_class)
             self.metrics.inflight -= 1
@@ -414,7 +446,16 @@ class S3Server(
                 request.rel_url.query,
             )
             rx = int(request.headers.get("Content-Length") or 0)
-            tx = getattr(resp, "content_length", None) or 0 if resp else 0
+            # bytes counted at write time win: streamed responses (tier
+            # read-through, transformed GETs, proxies) have no (or a lying)
+            # content_length, and would otherwise meter as 0 bytes sent.
+            # `is not None`, NOT truthiness: StreamResponse is a Mapping,
+            # so a response with empty per-request storage is falsy — the
+            # old `if resp` zeroed tx for nearly every response
+            tx = request.get("_tx")
+            if tx is None and resp is not None:
+                tx = getattr(resp, "content_length", None) or 0
+            tx = tx or 0
             self.metrics.observe(
                 api, status, dur, rx, tx,
                 bucket=request.match_info.get("bucket", ""),
@@ -422,13 +463,18 @@ class S3Server(
             )
             self.qos.last_minute.add(api, dur, ttfb=request.get("_ttfb"))
             if self.trace.active:
-                self.trace.publish(trace_record(request, status, dur, rx, tx))
+                self.trace.publish(
+                    trace_record(request, status, dur, rx, tx,
+                                 req_id=req_id, api=api)
+                )
             audit = getattr(self, "audit", None)
             if audit is not None and audit.enabled:
                 from .audit import audit_record
 
                 audit.emit(
-                    audit_record(request, status, dur, request.get("access_key", ""))
+                    audit_record(request, status, dur,
+                                 request.get("access_key", ""),
+                                 rx=rx, tx=tx)
                 )
 
     @staticmethod
@@ -486,12 +532,17 @@ class S3Server(
 
     async def _ttfb_on_prepare(self, request: web.Request, response) -> None:
         """Metrics TTFB capture: first byte leaves at response-prepare time
-        for both buffered and streamed bodies."""
+        for both buffered and streamed bodies. The generated request id
+        rides the same hook so EVERY response carries it (S3 clients
+        correlate errors by x-amz-request-id)."""
         import time as _time
 
         t0 = request.get("_t0")
         if t0 is not None and "_ttfb" not in request:
             request["_ttfb"] = _time.perf_counter() - t0
+        req_id = request.get("_reqid")
+        if req_id:
+            response.headers.setdefault("x-amz-request-id", req_id)
 
     async def _cors_on_prepare(self, request: web.Request, response) -> None:
         origin = request.headers.get("Origin", "")

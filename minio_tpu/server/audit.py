@@ -75,19 +75,28 @@ class AuditLog:
                     self.stats["failed"] += 1
 
 
-def audit_record(request, status: int, dur: float, access_key: str) -> dict:
-    """madmin-style audit entry (reference internal/logger/audit.go)."""
+def audit_record(
+    request, status: int, dur: float, access_key: str,
+    rx: int = 0, tx: int = 0,
+) -> dict:
+    """madmin-style audit entry (reference internal/logger/audit.go).
+    Carries the generated x-amz-request-id so audit rows join against
+    trace streams and client-side error reports, and the bytes counted
+    at write time (streamed responses would otherwise audit as 0)."""
     import time
 
     return {
         "version": "1",
         "time": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "requestID": request.get("_reqid", ""),
         "api": {
             "name": request.method,
             "bucket": request.match_info.get("bucket", ""),
             "object": request.match_info.get("key", ""),
             "status": "OK" if status < 400 else "Error",
             "statusCode": status,
+            "rx": rx,
+            "tx": tx,
             "timeToResponseNs": int(dur * 1e9),
         },
         "remoteHost": request.remote or "",

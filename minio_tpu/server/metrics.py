@@ -194,49 +194,86 @@ class Metrics:
         return "\n".join(lines) + "\n"
 
 
+class TraceSub:
+    """One trace subscriber: bounded queue + optional server-side filter
+    + drop accounting (a slow consumer loses records, visibly)."""
+
+    __slots__ = ("q", "filter", "dropped", "label")
+
+    def __init__(self, maxsize: int, filter=None, label: str = ""):
+        import queue
+
+        self.q = queue.Queue(maxsize=maxsize)
+        self.filter = filter
+        self.dropped = 0
+        self.label = label
+
+
 class TracePubSub:
     """Fan-out of request trace records; zero-cost with no subscribers
-    (the reference checks NumSubscribers before building the record)."""
+    (the reference checks NumSubscribers before building the record).
+    Subscriber filters run at publish time so filtered-out records never
+    consume queue space; per-subscriber drops are counted, not silent."""
 
     def __init__(self):
         self._mu = threading.Lock()
-        self._subs: list = []
+        self._subs: list[TraceSub] = []
+        self.dropped_total = 0
 
     @property
     def active(self) -> bool:
         return bool(self._subs)
 
-    def subscribe(self):
-        import queue
-
-        q = queue.Queue(maxsize=1000)
+    def subscribe(self, filter=None, label: str = "") -> TraceSub:
+        maxsize = int(os.environ.get("MINIO_TPU_TRACE_BUFFER", "1000") or 1000)
+        sub = TraceSub(maxsize, filter=filter, label=label)
         with self._mu:
-            self._subs.append(q)
-        return q
+            self._subs.append(sub)
+        return sub
 
-    def unsubscribe(self, q) -> None:
+    def unsubscribe(self, sub: TraceSub) -> None:
         with self._mu:
-            if q in self._subs:
-                self._subs.remove(q)
+            if sub in self._subs:
+                self._subs.remove(sub)
 
     def publish(self, record: dict) -> None:
         with self._mu:
             subs = list(self._subs)
-        for q in subs:
+        for sub in subs:
+            if sub.filter is not None and not sub.filter.match(record):
+                continue
             try:
-                q.put_nowait(record)
+                sub.q.put_nowait(record)
             except Exception:  # noqa: BLE001 — slow subscriber drops records
-                pass
+                sub.dropped += 1
+                self.dropped_total += 1
+
+    def subscriber_stats(self) -> list[dict]:
+        with self._mu:
+            return [
+                {"label": s.label or f"sub-{i}", "dropped": s.dropped,
+                 "queued": s.q.qsize()}
+                for i, s in enumerate(self._subs)
+            ]
 
 
-def trace_record(request, status: int, dur: float, rx: int, tx: int) -> dict:
+def trace_record(
+    request, status: int, dur: float, rx: int, tx: int,
+    req_id: str = "", api: str = "",
+) -> dict:
+    from .. import obs
+
     return {
         "time": time.time(),
         "type": "s3",
+        "name": api or request.method,
+        "reqId": req_id,
+        "node": obs.trace.NODE,
         "method": request.method,
         "path": request.path,
         "query": request.rel_url.raw_query_string,
         "statusCode": status,
+        "error": "" if status < 400 else f"HTTP {status}",
         "durationNs": int(dur * 1e9),
         "rx": rx,
         "tx": tx,
@@ -717,10 +754,112 @@ def _g_api_qos(server) -> list[str]:
     return out
 
 
+def _hist_rows(edges, hist, label_key="le"):
+    """Cumulative (le, count) rows for a fixed-edge histogram list
+    (len(edges)+1 buckets, last is the +Inf overflow)."""
+    h = list(hist) + [0] * (len(edges) + 1 - len(hist))
+    cum = 0
+    rows = []
+    for i, edge in enumerate(edges):
+        cum += h[i]
+        rows.append(({label_key: str(edge)}, cum))
+    rows.append(({label_key: "+Inf"}, cum + h[len(edges)]))
+    return rows
+
+
+def _g_api_tpu(server) -> list[str]:
+    """TPU dispatcher plane: batch occupancy, queue-wait and device-time
+    histograms, host-vs-device time split, and the QoS lane counters —
+    the series that let the BENCH trajectory separate dispatcher
+    efficiency (host orchestration, batching) from raw kernel throughput
+    (device execute time)."""
+    from ..parallel import dispatcher as dmod
+
+    out: list[str] = []
+    ds = dmod.aggregate_stats()
+    dispatches = ds.get("dispatches", 0)
+    _fmt(out, "minio_tpu_dispatch_total", "counter", [({}, dispatches)],
+         "Fused encode dispatches")
+    _fmt(out, "minio_tpu_dispatch_blocks_total", "counter",
+         [({"class": "foreground"}, ds.get("fg_blocks", 0)),
+          ({"class": "background"}, ds.get("bg_blocks", 0))])
+    _fmt(out, "minio_tpu_batch_occupancy_avg_pct", "gauge",
+         [({}, f"{ds.get('occupancy_pct_sum', 0.0) / max(dispatches, 1):.2f}")],
+         "Mean filled fraction of the padded dispatch bucket")
+    _fmt(out, "minio_tpu_batch_max_blocks", "gauge", [({}, ds.get("max_batch", 0))])
+    _fmt(out, "minio_tpu_host_seconds_total", "counter",
+         [({}, f"{ds.get('host_s', 0.0):.6f}")],
+         "Host-side batch assembly + fan-out time")
+    _fmt(out, "minio_tpu_device_seconds_total", "counter",
+         [({}, f"{ds.get('device_s', 0.0):.6f}")],
+         "Device execute time (incl. transfers) per dispatch")
+    _fmt(out, "minio_tpu_queue_wait_seconds_total", "counter",
+         [({}, f"{ds.get('queue_wait_s', 0.0):.6f}")])
+    _fmt(out, "minio_tpu_queue_wait_seconds_distribution", "counter",
+         _hist_rows(dmod.QUEUE_WAIT_BUCKETS, ds.get("queue_wait_hist", [])),
+         "Per-item wait from submit to dispatch start")
+    _fmt(out, "minio_tpu_device_time_seconds_distribution", "counter",
+         _hist_rows(dmod.DEVICE_TIME_BUCKETS, ds.get("device_time_hist", [])),
+         "Per-dispatch device execute time")
+    _fmt(out, "minio_tpu_fused_dispatches_total", "counter",
+         [({}, ds.get("fused", 0))])
+    _fmt(out, "minio_tpu_fused_failures_total", "counter",
+         [({}, ds.get("fused_failures", 0))])
+    _fmt(out, "minio_tpu_dispatch_bg_forced_blocks_total", "counter",
+         [({}, ds.get("bg_forced", 0))])
+    _fmt(out, "minio_tpu_dispatch_fg_deferred_behind_bg_total", "counter",
+         [({}, ds.get("fg_deferred_behind_bg", 0))])
+    return out
+
+
+def _g_api_trace(server) -> list[str]:
+    """Trace pubsub health: subscriber count and per-subscriber dropped
+    records (publish never blocks; a slow consumer loses records and
+    these series make that visible)."""
+    out: list[str] = []
+    tr = getattr(server, "trace", None)
+    if tr is None:
+        return out
+    subs = tr.subscriber_stats()
+    _fmt(out, "minio_trace_subscribers", "gauge", [({}, len(subs))])
+    _fmt(out, "minio_trace_dropped_records_total", "counter",
+         [({}, tr.dropped_total)],
+         "Records dropped across all subscribers (queue full)")
+    _fmt(out, "minio_trace_subscriber_dropped_records", "gauge",
+         [({"subscriber": s["label"]}, s["dropped"]) for s in subs])
+    _fmt(out, "minio_trace_subscriber_queued_records", "gauge",
+         [({"subscriber": s["label"]}, s["queued"]) for s in subs])
+    return out
+
+
+def _g_system_drive_latency(server) -> list[str]:
+    """Per-drive, per-op latency (HealthCheckedDisk accounting): lets a
+    slow p99 GET be attributed to one laggy disk instead of the whole
+    quorum."""
+    from ..storage.health import HealthCheckedDisk
+
+    out: list[str] = []
+    counts, totals = [], []
+    for d in server.store.disks:
+        if not isinstance(d, HealthCheckedDisk):
+            continue
+        ep = str(getattr(d, "endpoint", "?"))
+        for op, (n, total_s) in sorted(d.op_stats_snapshot().items()):
+            counts.append(({"drive": ep, "api": op}, n))
+            totals.append(({"drive": ep, "api": op}, f"{total_s:.6f}"))
+    _fmt(out, "minio_system_drive_api_calls_total", "counter", counts,
+         "Storage API calls per drive and op")
+    _fmt(out, "minio_system_drive_api_seconds_total", "counter", totals)
+    return out
+
+
 # collector path -> renderer; bucket paths live in V3_BUCKET_GROUPS
 V3_GROUPS = {
     "/api/requests": _g_api_requests,
     "/api/qos": _g_api_qos,
+    "/api/tpu": _g_api_tpu,
+    "/api/trace": _g_api_trace,
+    "/system/drive/latency": _g_system_drive_latency,
     "/system/network/internode": _g_system_network,
     "/system/drive": _g_system_drive,
     "/system/memory": _g_system_memory,

@@ -128,6 +128,7 @@ class ObjectHandlersMixin:
         sresp = web.StreamResponse(status=resp.status, headers=out_headers)
         await sresp.prepare(request)
         loop = asyncio.get_running_loop()
+        request["_tx"] = 0
         try:
             while True:
                 chunk = await loop.run_in_executor(
@@ -136,6 +137,7 @@ class ObjectHandlersMixin:
                 if not chunk:
                     break
                 await sresp.write(chunk)
+                request["_tx"] += len(chunk)
         finally:
             resp.close()
         await sresp.write_eof()
@@ -757,12 +759,16 @@ class ObjectHandlersMixin:
         loop = asyncio.get_running_loop()
         sentinel = object()
         nxt = lambda: next(it, sentinel)  # noqa: E731
+        # bytes metered at write time: a client that disconnects mid-stream
+        # must be traced/audited with what actually left, not content_length
+        request["_tx"] = 0
         try:
             while True:
                 chunk = await loop.run_in_executor(self._io_pool, nxt)
                 if chunk is sentinel:
                     break
                 await resp.write(chunk)
+                request["_tx"] += len(chunk)
         finally:
             handle.close()  # release the namespace read lock promptly
         await resp.write_eof()

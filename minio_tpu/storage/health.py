@@ -17,6 +17,7 @@ import collections
 import threading
 import time
 
+from .. import obs
 from . import errors
 from .interface import StorageAPI
 
@@ -55,6 +56,9 @@ class HealthCheckedDisk(StorageAPI):
         self._probe_inflight = False
         self._latencies: collections.deque = collections.deque(maxlen=64)
         self.total_faults = 0
+        # per-op latency accounting (metrics-v3 /system/drive/latency):
+        # op name -> [calls, total seconds]
+        self._op_stats: dict[str, list] = {}
 
     # passthrough identity
     @property
@@ -99,14 +103,16 @@ class HealthCheckedDisk(StorageAPI):
             self._probe_inflight = True
             return True
 
-    def _ok(self, dt: float) -> None:
+    def _ok(self, dt: float, op: str | None = None) -> None:
         with self._mu:
             self._consecutive_faults = 0
             self._open_until = 0.0  # probe success closes the circuit
             self._probe_inflight = False
             self._latencies.append(dt)
+            if op is not None:
+                self._account_locked(op, dt)
 
-    def _fault(self) -> None:
+    def _fault(self, op: str | None = None, dt: float = 0.0) -> None:
         with self._mu:
             self._consecutive_faults += 1
             self.total_faults += 1
@@ -118,21 +124,40 @@ class HealthCheckedDisk(StorageAPI):
             elif self._consecutive_faults >= self._threshold:
                 self._open_until = time.monotonic() + self._cooldown
                 self._consecutive_faults = 0
+            if op is not None:
+                self._account_locked(op, dt)
+
+    def _account_locked(self, name: str, dt: float) -> None:
+        st = self._op_stats.get(name)
+        if st is None:
+            st = self._op_stats[name] = [0, 0.0]
+        st[0] += 1
+        st[1] += dt
+
+    def op_stats_snapshot(self) -> dict[str, tuple[int, float]]:
+        with self._mu:
+            return {op: (st[0], st[1]) for op, st in self._op_stats.items()}
 
     def _call(self, name: str, *a, **kw):
         if not self._enter():
             raise errors.DiskNotFound(f"{self.endpoint} (circuit open)")
-        t0 = time.monotonic()
-        try:
-            out = getattr(self._inner, name)(*a, **kw)
-        except _LOGICAL:
-            self._ok(time.monotonic() - t0)  # drive answered correctly
-            raise
-        except Exception:
-            self._fault()
-            raise
-        self._ok(time.monotonic() - t0)
-        return out
+        # every storage op is a `storage` trace span (the reference traces
+        # at its xlStorageDiskIDCheck wrapper too); obs.span is the shared
+        # no-op singleton unless someone is streaming traces. Op-latency
+        # accounting rides the breaker's existing critical section — this
+        # is the per-shard hot path, one lock acquisition per call.
+        with obs.span(obs.TYPE_STORAGE, name, drive=self.endpoint):
+            t0 = time.monotonic()
+            try:
+                out = getattr(self._inner, name)(*a, **kw)
+            except _LOGICAL:
+                self._ok(time.monotonic() - t0, op=name)  # drive answered
+                raise
+            except Exception:
+                self._fault(op=name, dt=time.monotonic() - t0)
+                raise
+            self._ok(time.monotonic() - t0, op=name)
+            return out
 
     def local_path(self, volume: str, path: str) -> str | None:
         # pure path math — no I/O, so no circuit involvement

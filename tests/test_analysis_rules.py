@@ -475,3 +475,85 @@ def test_pragma_mention_in_docstring_is_not_a_pragma():
 def test_syntax_error_reported_as_parse_finding():
     fs = analyze_source("def f(:\n", path="x.py")
     assert len(fs) == 1 and fs[0].rule == "parse"
+
+
+# -- span (obs tracing discipline) -----------------------------------------
+
+BAD_SPAN_NO_WITH = """
+    from minio_tpu import obs
+
+    def read_shard(self):
+        sp = obs.span(obs.TYPE_STORAGE, "readfile", drive="d0")
+        sp.__enter__()
+        return 1
+"""
+
+GOOD_SPAN_WITH = """
+    from minio_tpu import obs
+
+    def read_shard(self):
+        with obs.span(obs.TYPE_STORAGE, "readfile", drive="d0") as sp:
+            sp.set(bytes=1)
+        return 1
+"""
+
+
+def test_span_call_outside_with_flagged():
+    fs = run(BAD_SPAN_NO_WITH, rules=["span"])
+    assert len(fs) == 1 and fs[0].rule == "span"
+    assert "context-manager" in fs[0].message
+
+
+def test_span_in_with_ok():
+    assert run(GOOD_SPAN_WITH, rules=["span"]) == []
+
+
+def test_span_start_call_flagged_anywhere():
+    src = """
+        def f(tracer):
+            tracer.span_start("x")
+    """
+    fs = run(src, rules=["span"])
+    assert len(fs) == 1 and "span_start" in fs[0].message
+
+
+def test_imported_span_name_flagged():
+    src = """
+        from minio_tpu.obs import span
+
+        def f():
+            span("s3", "x")
+    """
+    fs = run(src, rules=["span"])
+    assert len(fs) == 1
+
+
+def test_bare_span_without_obs_import_not_flagged():
+    # an unrelated local helper also called `span` must not trip the rule
+    src = """
+        def span(a, b):
+            return a + b
+
+        def f():
+            return span(1, 2)
+    """
+    assert run(src, rules=["span"]) == []
+
+
+def test_direct_span_construction_flagged():
+    src = """
+        from minio_tpu import obs
+
+        def f():
+            return obs.Span("s3", "x", {})
+    """
+    fs = run(src, rules=["span"])
+    assert len(fs) == 1 and "Span construction" in fs[0].message
+
+
+def test_span_rule_exempts_obs_package():
+    src = """
+        def span(t, n, **fields):
+            return Span(t, n, fields)
+    """
+    assert run(src, relpath="obs/trace.py", rules=["span"]) == []

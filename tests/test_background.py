@@ -121,10 +121,13 @@ def test_trace_stream(server, cli):
 
     from minio_tpu.server.signature import sign_request
 
-    url = f"http://127.0.0.1:{server.port}/minio/admin/v3/trace"
+    # type=s3 filter: deep tracing emits internal/storage/tpu spans ahead
+    # of the request-level record, so an unfiltered stream's first line
+    # would be a sub-span
+    url = f"http://127.0.0.1:{server.port}/minio/admin/v3/trace?type=s3"
     headers = sign_request("GET", url, {}, b"", "minioadmin", "minioadmin")
     conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=10)
-    conn.request("GET", "/minio/admin/v3/trace", headers=headers)
+    conn.request("GET", "/minio/admin/v3/trace?type=s3", headers=headers)
     resp = conn.getresponse()
     assert resp.status == 200
 
@@ -138,6 +141,7 @@ def test_trace_stream(server, cli):
     t.join()
     rec = json.loads(line)
     assert rec["type"] == "s3" and "method" in rec
+    assert rec["reqId"]  # every request carries its generated id
     conn.close()
 
 

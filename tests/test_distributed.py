@@ -146,6 +146,55 @@ def test_profile_fans_out_to_peers(cluster):
     assert "cpu" in nodes[peer] and "error" not in nodes[peer]
 
 
+def test_trace_stream_fans_out_to_peers(cluster):
+    """One `mc admin trace`-style stream on node 1 shows S3 records for
+    requests served BY node 2 (the stream handler pumps every peer's
+    pre-filtered trace stream into its own)."""
+    import http.client
+    import json
+    import threading
+
+    from minio_tpu.server.signature import sign_request
+
+    p1 = cluster["ports"][0]
+    cli2 = cluster["cli2"]
+    path = "/minio/admin/v3/trace?type=s3"
+    url = f"http://127.0.0.1:{p1}{path}"
+    headers = sign_request("GET", url, {}, b"", "minioadmin", "minioadmin")
+    conn = http.client.HTTPConnection("127.0.0.1", p1, timeout=20)
+    conn.request("GET", path, headers=headers)
+    resp = conn.getresponse()
+    assert resp.status == 200
+
+    stop = threading.Event()
+
+    def traffic():
+        # repeat: the first GETs may land before the peer pump connects
+        deadline = time.time() + 15
+        while not stop.is_set() and time.time() < deadline:
+            cli2.get_object("shared", "from-n1")
+            time.sleep(0.3)
+
+    t = threading.Thread(target=traffic)
+    t.start()
+    found = False
+    deadline = time.time() + 15
+    try:
+        while time.time() < deadline:
+            line = resp.readline()
+            if not line:
+                break
+            rec = json.loads(line)
+            if rec.get("type") == "s3" and rec.get("path") == "/shared/from-n1":
+                found = True
+                break
+    finally:
+        stop.set()
+        t.join()
+        conn.close()
+    assert found, "node-2 request never appeared in node-1's trace stream"
+
+
 def test_node_failure_tolerance(cluster):
     cli1 = cluster["cli1"]
     body = os.urandom(300 * 1024)

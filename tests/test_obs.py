@@ -1,0 +1,419 @@
+"""Deep tracing (minio_tpu/obs): span trees from S3 entry to TPU kernel,
+filterable trace streaming, request-id propagation, kernel-level metrics.
+
+Covers the PR acceptance criteria: a GET on a striped object yields one
+span tree (s3 + tpu + storage records sharing the generated
+x-amz-request-id), the admin trace stream honors type/threshold/err-only,
+zero span allocation with no subscribers, and metrics v3 exposes the
+/api/tpu group with queue-wait and device-time histograms.
+"""
+
+import asyncio
+import json
+import os
+import threading
+import time
+
+os.environ.setdefault("MINIO_TPU_BACKEND", "numpy")
+
+import numpy as np
+import pytest
+
+from minio_tpu import obs
+from minio_tpu.client import S3Client
+from minio_tpu.obs import ContextPool, TraceFilter, parse_duration
+from minio_tpu.server.metrics import TracePubSub
+
+from test_s3_api import ServerThread
+
+SIZE = 300 * 1024  # > INLINE_DATA_THRESHOLD: forces a striped on-disk object
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    base = tmp_path_factory.mktemp("obsdrives")
+    st = ServerThread([str(base / f"d{i}") for i in range(4)])
+    yield st
+    st.stop()
+
+
+@pytest.fixture(scope="module")
+def cli(server):
+    c = S3Client(f"127.0.0.1:{server.port}")
+    c.make_bucket("obsbkt")
+    c.put_object("obsbkt", "striped", bytes(bytearray(range(256)) * (SIZE // 256)))
+    return c
+
+
+@pytest.fixture()
+def restore_publisher():
+    """Tests that swap the module-level publisher must put it back, or
+    every later test in the session publishes into the wrong pubsub."""
+    prev = obs.publisher()
+    yield
+    obs.set_publisher(prev)
+
+
+# -- zero-overhead guard ---------------------------------------------------
+
+
+def test_no_span_allocation_when_idle(restore_publisher):
+    obs.set_publisher(None)
+    assert obs.span(obs.TYPE_S3, "x") is obs.NOOP_SPAN
+    pub = TracePubSub()
+    obs.set_publisher(pub)
+    # publisher attached but zero subscribers: still the shared no-op
+    assert obs.span(obs.TYPE_TPU, "y", field=1) is obs.NOOP_SPAN
+    assert not obs.active()
+    sub = pub.subscribe()
+    try:
+        assert obs.active()
+        assert isinstance(obs.span(obs.TYPE_TPU, "y"), obs.Span)
+    finally:
+        pub.unsubscribe(sub)
+    assert obs.span(obs.TYPE_TPU, "z") is obs.NOOP_SPAN
+
+
+def test_noop_span_is_inert(restore_publisher):
+    obs.set_publisher(None)
+    with obs.span(obs.TYPE_STORAGE, "op", drive="d") as sp:
+        sp.set(bytes=4)  # must not raise, must not allocate
+
+
+# -- filter semantics ------------------------------------------------------
+
+
+def test_parse_duration():
+    assert parse_duration("100ms") == pytest.approx(0.1)
+    assert parse_duration("2s") == pytest.approx(2.0)
+    assert parse_duration("0.5") == pytest.approx(0.5)
+    assert parse_duration("250us") == pytest.approx(250e-6)
+    with pytest.raises(ValueError):
+        parse_duration("fast")
+
+
+def test_trace_filter_semantics():
+    f = TraceFilter.from_query(
+        {"type": "tpu,storage", "threshold": "1ms", "err-only": "on"}
+    )
+    ok = {"type": "tpu", "durationNs": 10**7, "error": "boom"}
+    assert f.match(ok)
+    assert not f.match({**ok, "type": "s3"})          # type filtered
+    assert not f.match({**ok, "durationNs": 10_000})  # under threshold
+    assert not f.match({**ok, "error": ""})           # err-only
+    # statusCode >= 400 counts as an error for request-level records
+    assert f.match({"type": "storage", "durationNs": 10**7, "statusCode": 503})
+
+
+def test_trace_filter_rejects_unknown_type():
+    with pytest.raises(ValueError):
+        TraceFilter.from_query({"type": "s3,bogus"})
+
+
+def test_trace_filter_roundtrip_query():
+    f = TraceFilter.from_query({"type": "s3", "threshold": "5ms", "err-only": "on"})
+    f2 = TraceFilter.from_query(f.to_query())
+    assert f2.types == f.types
+    assert f2.threshold_ns == f.threshold_ns
+    assert f2.err_only == f.err_only
+
+
+def test_publish_applies_subscriber_filter(restore_publisher):
+    pub = TracePubSub()
+    obs.set_publisher(pub)
+    sub = pub.subscribe(filter=TraceFilter(types={"tpu"}))
+    pub.publish({"type": "s3", "durationNs": 1})
+    pub.publish({"type": "tpu", "durationNs": 1})
+    assert sub.q.qsize() == 1
+    assert sub.q.get_nowait()["type"] == "tpu"
+
+
+# -- drop accounting -------------------------------------------------------
+
+
+def test_slow_subscriber_drops_counted(restore_publisher, monkeypatch):
+    monkeypatch.setenv("MINIO_TPU_TRACE_BUFFER", "2")
+    pub = TracePubSub()
+    obs.set_publisher(pub)
+    sub = pub.subscribe(label="slow")
+    for _ in range(5):
+        pub.publish({"type": "s3", "durationNs": 1})
+    assert sub.dropped == 3
+    assert pub.dropped_total == 3
+    stats = pub.subscriber_stats()
+    assert stats == [{"label": "slow", "dropped": 3, "queued": 2}]
+
+
+# -- span-context propagation ----------------------------------------------
+
+
+def test_context_propagates_across_async_hop_and_pool(restore_publisher):
+    pub = TracePubSub()
+    obs.set_publisher(pub)
+    sub = pub.subscribe()
+    pool = ContextPool(max_workers=2)
+
+    async def handler():
+        with obs.request_context("REQ42"):
+            await asyncio.sleep(0)  # async hop keeps the contextvar
+            assert obs.current_request_id() == "REQ42"
+            loop = asyncio.get_running_loop()
+
+            def disk_op():
+                with obs.span(obs.TYPE_STORAGE, "readfile", drive="d0"):
+                    return obs.current_request_id()
+
+            return await loop.run_in_executor(pool, disk_op)
+
+    assert asyncio.run(handler()) == "REQ42"
+    rec = sub.q.get_nowait()
+    assert rec["reqId"] == "REQ42" and rec["type"] == "storage"
+
+
+def test_span_nesting_parent_ids(restore_publisher):
+    pub = TracePubSub()
+    obs.set_publisher(pub)
+    sub = pub.subscribe()
+    with obs.request_context("TREE1"):
+        with obs.span(obs.TYPE_INTERNAL, "outer"):
+            with obs.span(obs.TYPE_STORAGE, "inner"):
+                pass
+    inner, outer = sub.q.get_nowait(), sub.q.get_nowait()
+    assert inner["name"] == "inner" and outer["name"] == "outer"
+    assert inner["parentId"] == outer["spanId"]
+    assert outer["parentId"] == 0
+    assert inner["reqId"] == outer["reqId"] == "TREE1"
+
+
+def test_context_propagates_over_storage_rest_call(restore_publisher, tmp_path):
+    """The grid storage.call payload carries the request id; the serving
+    node's rpc span joins the caller's tree."""
+    import msgpack
+
+    from minio_tpu.cluster.storage_rest import StorageRESTServer
+    from minio_tpu.storage.xlstorage import XLStorage
+
+    class FakeGrid:
+        def __init__(self):
+            self.singles = {}
+
+        def register_single(self, name, fn):
+            self.singles[name] = fn
+
+        def register_stream(self, name, fn):
+            pass
+
+    drive = XLStorage(str(tmp_path / "d0"), endpoint="d0")
+    srv = StorageRESTServer({0: drive}, token="t")
+    grid = FakeGrid()
+    srv.register_grid(grid)
+
+    pub = TracePubSub()
+    obs.set_publisher(pub)
+    sub = pub.subscribe()
+    # 4-element payload (new callers) — and the 3-element legacy form
+    grid.singles["storage.call"](
+        msgpack.packb([0, "diskinfo", b"", "WIRE77"])
+    )
+    grid.singles["storage.call"](msgpack.packb([0, "diskinfo", b""]))
+    first = sub.q.get_nowait()
+    second = sub.q.get_nowait()
+    assert first["name"] == "rpc.diskinfo" and first["reqId"] == "WIRE77"
+    assert second["reqId"] == ""  # legacy payload: no context, still traced
+
+
+# -- end-to-end span tree --------------------------------------------------
+
+
+def _drain(sub, req_id, want_types, deadline_s=10.0):
+    """Collect records for req_id until every wanted type arrived."""
+    got = []
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        try:
+            rec = sub.q.get(timeout=0.5)
+        except Exception:  # noqa: BLE001 — queue.Empty
+            continue
+        if rec.get("reqId") == req_id or req_id in rec.get("reqIds", []):
+            got.append(rec)
+            if want_types <= {r["type"] for r in got}:
+                return got
+    return got
+
+
+def test_get_yields_span_tree_with_one_request_id(server, cli):
+    sub = server.srv.trace.subscribe()
+    try:
+        r = cli.get_object("obsbkt", "striped")
+        assert r.status == 200
+        req_id = r.headers["x-amz-request-id"]
+        assert req_id
+        got = _drain(sub, req_id, {"s3", "tpu", "storage"})
+    finally:
+        server.srv.trace.unsubscribe(sub)
+    types = {rec["type"] for rec in got}
+    assert {"s3", "tpu", "storage"} <= types, (types, got)
+    # every record of the tree shares the response's x-amz-request-id
+    assert all(
+        rec.get("reqId") == req_id or req_id in rec.get("reqIds", [])
+        for rec in got
+    )
+    s3 = [rec for rec in got if rec["type"] == "s3"][0]
+    # tx metered at write time: the streamed GET reports real bytes sent
+    assert s3["tx"] == SIZE
+    tpu = [rec for rec in got if rec["type"] == "tpu"][0]
+    assert tpu["name"] in ("stripe.read-verify", "dispatch.batch")
+
+
+def test_put_yields_internal_and_storage_spans(server, cli):
+    sub = server.srv.trace.subscribe()
+    try:
+        r = cli.put_object("obsbkt", "striped2", b"y" * SIZE)
+        assert r.status == 200
+        req_id = r.headers["x-amz-request-id"]
+        got = _drain(sub, req_id, {"s3", "internal", "storage"})
+    finally:
+        server.srv.trace.unsubscribe(sub)
+    by_type = {}
+    for rec in got:
+        by_type.setdefault(rec["type"], []).append(rec)
+    assert "internal" in by_type and "storage" in by_type and "s3" in by_type
+    assert any(
+        rec["name"] == "erasure.put_object" for rec in by_type["internal"]
+    )
+
+
+def test_request_id_on_error_xml_and_header(server, cli):
+    r = cli.get_object("obsbkt", "does-not-exist")
+    assert r.status == 404
+    req_id = r.headers.get("x-amz-request-id", "")
+    assert req_id
+    body = r.body.decode()
+    assert f"<RequestId>{req_id}</RequestId>" in body
+
+
+def test_trace_stream_filters_end_to_end(server, cli):
+    """type=s3&err-only=on over the admin HTTP stream: only the failing
+    request-level record comes through."""
+    import http.client
+
+    from minio_tpu.server.signature import sign_request
+
+    path = "/minio/admin/v3/trace?type=s3&err-only=on"
+    url = f"http://127.0.0.1:{server.port}{path}"
+    headers = sign_request("GET", url, {}, b"", "minioadmin", "minioadmin")
+    conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=15)
+    conn.request("GET", path, headers=headers)
+    resp = conn.getresponse()
+    assert resp.status == 200
+
+    def traffic():
+        time.sleep(0.2)
+        cli.get_object("obsbkt", "striped")   # 200: filtered out
+        cli.get_object("obsbkt", "missing-child")  # 404: passes
+
+    t = threading.Thread(target=traffic)
+    t.start()
+    line = resp.readline()
+    t.join()
+    rec = json.loads(line)
+    assert rec["type"] == "s3"
+    assert rec["statusCode"] == 404
+    conn.close()
+
+
+def test_trace_stream_threshold_rejects_garbage(server, cli):
+    r = cli.request("GET", "/minio/admin/v3/trace", query={"threshold": "zzz"})
+    assert r.status == 400
+    r = cli.request("GET", "/minio/admin/v3/trace", query={"type": "nope"})
+    assert r.status == 400
+
+
+# -- metrics ---------------------------------------------------------------
+
+
+def test_metrics_v3_api_tpu_group(server, cli):
+    r = cli.request("GET", "/minio/metrics/v3/api/tpu")
+    assert r.status == 200
+    text = r.body.decode()
+    for series in (
+        "minio_tpu_queue_wait_seconds_distribution",
+        "minio_tpu_device_time_seconds_distribution",
+        "minio_tpu_batch_occupancy_avg_pct",
+        "minio_tpu_host_seconds_total",
+        "minio_tpu_device_seconds_total",
+        "minio_tpu_dispatch_fg_deferred_behind_bg_total",
+    ):
+        assert series in text, series
+    # histogram rows must include the +Inf terminator
+    assert 'minio_tpu_queue_wait_seconds_distribution{le="+Inf"}' in text
+
+
+def test_metrics_v3_trace_group_counts_drops(server, cli):
+    sub = server.srv.trace.subscribe(label="probe")
+    try:
+        r = cli.request("GET", "/minio/metrics/v3/api/trace")
+        assert r.status == 200
+        text = r.body.decode()
+        assert "minio_trace_subscribers 1" in text
+        assert "minio_trace_dropped_records_total" in text
+        assert 'minio_trace_subscriber_dropped_records{subscriber="probe"}' in text
+    finally:
+        server.srv.trace.unsubscribe(sub)
+
+
+def test_metrics_v3_drive_latency_group(server, cli):
+    cli.get_object("obsbkt", "striped")  # ensure per-op samples exist
+    r = cli.request("GET", "/minio/metrics/v3/system/drive/latency")
+    assert r.status == 200
+    text = r.body.decode()
+    assert "minio_system_drive_api_calls_total" in text
+    assert 'api="read_version"' in text
+    assert "minio_system_drive_api_seconds_total" in text
+
+
+# -- dispatcher kernel metrics ---------------------------------------------
+
+
+def test_dispatcher_histograms_and_batch_record(restore_publisher):
+    from minio_tpu.ops import rs_jax
+    from minio_tpu.parallel.dispatcher import TpuDispatcher
+
+    codec = rs_jax.get_tpu_codec(4, 2)
+    disp = TpuDispatcher(codec, 1024, window_s=0.01)
+    pub = TracePubSub()
+    obs.set_publisher(pub)
+    sub = pub.subscribe()
+    with obs.request_context("BATCH9"):
+        disp.encode(
+            np.random.default_rng(0).integers(0, 256, (2, 4, 1024), np.uint8)
+        )
+    st = disp.stats
+    assert st["dispatches"] >= 1
+    assert sum(st["queue_wait_hist"]) >= 1
+    assert sum(st["device_time_hist"]) == st["dispatches"]
+    assert st["device_s"] > 0.0
+    assert 0.0 < st["occupancy_pct_sum"] <= 100.0 * st["dispatches"]
+    # the per-batch tpu record names the requests it served (published by
+    # the worker thread right after fan-out: poll briefly)
+    batch = None
+    deadline = time.monotonic() + 5.0
+    while batch is None and time.monotonic() < deadline:
+        try:
+            rec = sub.q.get(timeout=0.25)
+        except Exception:  # noqa: BLE001 — queue.Empty
+            continue
+        if rec.get("name") == "dispatch.batch":
+            batch = rec
+    assert batch is not None and "BATCH9" in batch["reqIds"]
+    assert batch["deviceNs"] > 0
+    assert batch["occupancyPct"] > 0
+
+
+def test_aggregate_stats_merges_histograms():
+    from minio_tpu.parallel import dispatcher as dmod
+
+    agg = dmod.aggregate_stats()
+    if not agg:  # no dispatcher built yet in this process
+        pytest.skip("no live dispatchers")
+    assert isinstance(agg.get("queue_wait_hist", []), list)
