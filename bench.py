@@ -244,6 +244,81 @@ def _bench_qos_p99(np) -> dict:
     }
 
 
+def _bench_degraded(np) -> dict:
+    """Degraded-mode GET throughput: one drive injected at +400 ms
+    (fault/registry.py), measured with the hedged-read path on and off.
+    The hedge_on number staying near healthy throughput while hedge_off
+    inherits the straggler's stall is the wire-visible proof of the
+    hedge policy; regressions show up across BENCH_*.json rounds."""
+    import os
+    import shutil
+    import tempfile
+
+    from minio_tpu.erasure.set import ErasureSet
+    from minio_tpu.fault import registry as freg
+    from minio_tpu.fault.storage import FaultInjectedDisk
+    from minio_tpu.storage.health import HealthCheckedDisk
+    from minio_tpu.storage.xlstorage import XLStorage
+    from minio_tpu.utils.hashing import hash_order
+
+    base = tempfile.mkdtemp(prefix="bench-degraded-")
+    saved = {
+        k: os.environ.get(k)
+        for k in ("MINIO_TPU_NATIVE_PLANE", "MINIO_TPU_HEDGE")
+    }
+    # the native pread plane bypasses the injection wrapper: force the
+    # Python read path so the straggler actually stalls reads
+    os.environ["MINIO_TPU_NATIVE_PLANE"] = "0"
+    try:
+        disks = [
+            HealthCheckedDisk(FaultInjectedDisk(XLStorage(f"{base}/d{i}")))
+            for i in range(8)
+        ]
+        es = ErasureSet(disks)
+        es.make_bucket("bbkt")
+        body = np.random.default_rng(1).integers(
+            0, 256, size=16 << 20, dtype=np.uint8
+        ).tobytes()
+        es.put_object("bbkt", "obj", body)
+
+        def measure() -> float:
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                _, it = es.get_object("bbkt", "obj")
+                n = sum(len(c) for c in it)
+                assert n == len(body)
+                best = min(best, time.perf_counter() - t0)
+            return (len(body) / 2**30) / best
+
+        # straggle the drive holding data shard 0 (parity isn't read
+        # eagerly, so a parity straggler would measure nothing)
+        dist = hash_order("bbkt/obj", 8)
+        freg.inject({
+            "boundary": "storage", "mode": "latency", "latency_ms": 400,
+            "target": disks[dist.index(1)].endpoint, "op": "read_file",
+            "seed": 1,
+        })
+        os.environ["MINIO_TPU_HEDGE"] = "1"
+        on = measure()
+        wins = freg.COUNTERS.get("hedge_wins", 0)
+        os.environ["MINIO_TPU_HEDGE"] = "0"
+        off = measure()
+        return {
+            "degraded_get_gibps_hedge_on": round(on, 3),
+            "degraded_get_gibps_hedge_off": round(off, 3),
+            "degraded_hedge_wins": wins,
+        }
+    finally:
+        freg.clear()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        shutil.rmtree(base, ignore_errors=True)
+
+
 def main() -> None:
     import jax
     import jax.numpy as jnp
@@ -293,6 +368,10 @@ def main() -> None:
         qos = _bench_qos_p99(np)
     except Exception:  # noqa: BLE001 — QoS metric must not sink the line
         qos = {}
+    try:
+        degraded = _bench_degraded(np)
+    except Exception:  # noqa: BLE001 — robustness metric must not sink it
+        degraded = {}
     print(
         json.dumps(
             {
@@ -310,6 +389,7 @@ def main() -> None:
                 "decode_metric": "rs_decode_verify_ec8_2lost_gibps",
                 "decode_value": round(decode_gibps, 2),
                 **qos,
+                **degraded,
             }
         )
     )

@@ -27,6 +27,11 @@ bug classes this reproduction actually hits:
                       context-manager API (``with obs.span(...)``);
                       an orphaned start would leak the trace context
                       token on any non-finally exit path.
+- ``retry-discipline`` ad-hoc retry loops (``time.sleep`` pacing a loop
+                      around a network/storage call whose failures it
+                      swallows) outside ``fault/retry.py`` — all
+                      retries ride the shared policy (backoff, jitter,
+                      idempotency classes tuned in one place).
 
 Run it as ``python -m minio_tpu.analysis [paths] [--strict]`` (see
 __main__.py) or ``make check``; tier-1 enforces a clean tree via

@@ -133,6 +133,30 @@ _ALL: list[Knob] = [
        "Enable the object-lambda transform endpoint with this id."),
     _k("MINIO_LAMBDA_WEBHOOK_ENDPOINT_", "", "events",
        "HTTP endpoint object-lambda GETs are transformed through."),
+    # -- fault / robustness ------------------------------------------------
+    _k("MINIO_TPU_RETRY_ATTEMPTS", "3", "fault",
+       "Attempts for idempotent internode RPCs through the unified "
+       "retry policy (fault/retry.py); non-idempotent ops never retry."),
+    _k("MINIO_TPU_RETRY_BASE_MS", "25", "fault",
+       "Base delay of the jittered exponential retry backoff."),
+    _k("MINIO_TPU_RETRY_CAP_MS", "1000", "fault",
+       "Ceiling on a single retry backoff sleep."),
+    _k("MINIO_TPU_HEDGE", "1", "fault",
+       "Hedged shard reads on the GET window path: when a drive blows "
+       "the latency budget, parity reads race the straggler and the GET "
+       "decodes around it; 0 disables."),
+    _k("MINIO_TPU_HEDGE_MIN_MS", "50", "fault",
+       "Floor of the hedged-read straggler budget (a cold or fast "
+       "cluster must not hedge on noise)."),
+    _k("MINIO_TPU_HEDGE_MULT", "4", "fault",
+       "Hedged-read budget as a multiple of the median per-drive EWMA "
+       "latency (HealthCheckedDisk accounting)."),
+    _k("MINIO_TPU_BACKEND_DEMOTE_FAULTS", "3", "fault",
+       "Consecutive TPU device faults before the dispatcher demotes the "
+       "encode backend to the pure-numpy rung."),
+    _k("MINIO_TPU_BACKEND_PROBE_AFTER", "16", "fault",
+       "Dispatches between synthetic probe batches while degraded; a "
+       "successful probe re-promotes the device backend."),
     # -- iam / identity ---------------------------------------------------
     _k("MINIO_ETCD_ENDPOINTS", "", "iam",
        "Comma-separated etcd endpoints; when set, IAM documents live in "
@@ -252,6 +276,16 @@ _ALL: list[Knob] = [
        "Per-subscriber trace stream queue depth; a consumer slower than "
        "the record rate drops (counted) records beyond it."),
     # -- storage ----------------------------------------------------------
+    _k("MINIO_TPU_DRIVE_FAIL_THRESHOLD", "4", "storage",
+       "Consecutive drive faults before the per-drive circuit breaker "
+       "(HealthCheckedDisk) takes the drive offline."),
+    _k("MINIO_TPU_DRIVE_COOLDOWN_S", "15", "storage",
+       "Seconds an offline drive's circuit stays open before one probe "
+       "call is admitted (half-open)."),
+    _k("MINIO_TPU_DRIVE_LATENCY_TRIP_S", "10", "storage",
+       "Per-drive EWMA call latency that trips the circuit breaker: a "
+       "chronically slow drive goes offline like an erroring one; 0 "
+       "disables."),
     _k("MINIO_TPU_FSYNC", "0", "storage",
        "fsync shard files on write (1) instead of trusting the page "
        "cache (0)."),

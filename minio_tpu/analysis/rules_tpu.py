@@ -35,8 +35,9 @@ _HOT_PATH_GLOBS = (
 # before anything is device-resident. Everything else needs a pragma
 # with a reason.
 HOSTSYNC_BOUNDARY: dict[str, set[str]] = {
-    # batch fan-out: futures hand numpy shards back to request threads
-    "parallel/dispatcher.py": {"_loop", "_fused_cm"},
+    # batch fan-out: futures hand numpy shards back to request threads;
+    # the degradation probe's materialization IS the probe verdict
+    "parallel/dispatcher.py": {"_loop", "_fused_cm", "_probe_device"},
     # decode boundary: rebuilt shards + digests materialize for the
     # bitrot/write plane
     "ops/bitrot_jax.py": {"_try_fused_decode"},

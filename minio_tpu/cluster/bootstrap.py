@@ -114,11 +114,18 @@ def verify_peers(
     peers after retries are reported too — bootstrap proceeds (the node
     may be down legitimately) but the operator sees it."""
     import http.client
-    import time
 
     def check_one(peer: str) -> str:
         host, _, port = peer.rpartition(":")
         last = "unreachable"
+        # peer-probe retry pacing via the shared backoff helper
+        # (fault/retry.py); fixed-interval (mult=1): peers legitimately
+        # take a while to come up, exponential growth would just delay
+        # the mismatch report
+        from ..fault.retry import Backoff
+
+        boff = Backoff(base_s=retry_delay, cap_s=retry_delay, mult=1.0,
+                       jitter=0.0)
         for attempt in range(retries):
             try:
                 from ..crypto import tlsconf
@@ -140,9 +147,7 @@ def verify_peers(
             except (OSError, ValueError) as e:
                 last = f"unreachable: {e}"
             if attempt < retries - 1:
-                # miniovet: ignore[blocking] -- peer-probe retry backoff;
-                # runs on a bootstrap ThreadPoolExecutor worker, not the loop
-                time.sleep(retry_delay)
+                boff.sleep()
         return last
 
     # peers check in parallel: one down node must not stall bootstrap by

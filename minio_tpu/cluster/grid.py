@@ -48,6 +48,9 @@ from typing import Awaitable, Callable
 
 import msgpack
 
+from ..fault import registry as fault_registry
+from ..fault import retry as retry_mod
+
 GRID_ROUTE = "/minio/grid/v1"
 _WS_GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
 
@@ -90,6 +93,11 @@ class GridConnectError(GridError):
     """Could not establish the connection: the request was never sent, so
     the caller may safely fall back to another transport and resend even
     for non-idempotent operations."""
+
+
+class GridTimeout(GridError):
+    """No response within the deadline. The request MAY have been applied
+    remotely — only idempotent callers retry it."""
 
 
 class RemoteError(Exception):
@@ -675,15 +683,46 @@ class GridClient:
 
     # -- public API --------------------------------------------------------
 
+    def _apply_net_fault(self, rule, handler: str) -> None:
+        """Injected network fault (fault/ registry) on this peer link."""
+        if rule.mode == "delay":
+            fault_registry.sleep_latency(rule)
+            return
+        if rule.mode == "disconnect":
+            with self._lock:
+                ws = self._ws
+            if ws is not None:
+                self._drop(ws)
+            raise GridError(
+                f"grid {self.host}:{self.port}: injected disconnect"
+            )
+        if rule.mode == "partition":
+            # never-sent semantics: callers may fall back / resend freely
+            raise GridConnectError(
+                f"grid {self.host}:{self.port}: injected partition"
+            )
+        raise GridError(
+            f"grid call {handler}: injected drop"
+        )
+
     def call(self, handler: str, payload: bytes, timeout: float = 30.0,
              retry: bool = False) -> bytes:
         """Single-payload request/response. Raises RemoteError (typed) or
-        GridError (transport). retry=True re-sends once after reconnect —
-        callers must only set it for idempotent ops."""
-        stats_add("calls")
-        attempts = 2 if retry else 1
-        last: Exception = GridError("unreachable")
-        for _ in range(attempts):
+        GridError (transport). retry=True retries transport failures AND
+        timeouts through the shared backoff policy (fault/retry.py) —
+        callers must only set it for idempotent ops (a timed-out request
+        may still have been applied remotely). The retry budget is
+        deadline-bounded at 1.5x the caller's timeout: a blackholed peer
+        costs at most half a timeout more than the old single-attempt
+        behaviour, instead of attempts x timeout."""
+        deadline = time.monotonic() + timeout * 1.5 if retry else None
+
+        def attempt() -> bytes:
+            rule = fault_registry.check(
+                "network", f"{self.host}:{self.port}", handler
+            )
+            if rule is not None:
+                self._apply_net_fault(rule, handler)
             mux = self._next_mux()
             q: queue.Queue = queue.Queue()
             # registration under _lock: _drop swaps the dict under the same
@@ -692,24 +731,34 @@ class GridClient:
             # never silently orphaned between the two
             with self._lock:
                 self._calls[mux] = q
+            wait_s = timeout
+            if deadline is not None:
+                wait_s = max(min(timeout, deadline - time.monotonic()), 0.01)
             try:
                 self._send(_frame(T_REQ, mux, msgpack.packb([handler, payload])))
-                resp = q.get(timeout=timeout)
-            except GridError as e:
-                self._calls.pop(mux, None)
-                last = e
-                continue
+                resp = q.get(timeout=wait_s)
             except queue.Empty:
                 self._calls.pop(mux, None)
-                raise GridError(f"grid call {handler}: timeout") from None
+                raise GridTimeout(f"grid call {handler}: timeout") from None
+            except GridError:
+                self._calls.pop(mux, None)
+                raise
             if isinstance(resp, Exception):
-                last = resp
-                continue
+                raise resp
             ok, a, b = msgpack.unpackb(resp, raw=False)
             if ok:
                 return a if isinstance(a, bytes) else bytes(a)
             raise RemoteError(a, b)
-        raise last
+
+        stats_add("calls")
+        # the policy deadline bounds attempt waits AND backoff sleeps
+        policy = retry_mod.shared_policy(
+            idempotent=retry,
+            deadline_s=timeout * 1.5 if retry else None,
+        )
+        return policy.run(
+            attempt, retryable=lambda e: isinstance(e, GridError)
+        )
 
     def stream(self, handler: str, payload: bytes,
                window: int = DEFAULT_WINDOW) -> ClientStream:

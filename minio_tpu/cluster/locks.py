@@ -12,7 +12,6 @@ from __future__ import annotations
 
 import http.client
 import os
-import random
 import threading
 import time
 import uuid as uuidlib
@@ -277,7 +276,13 @@ class DRWMutex:
         op_unlock = "unlock" if write else "runlock"
         deadline = time.monotonic() + timeout
         quorum = self._quorum(write)
-        backoff = 0.002
+        # dsync retry jitter via the shared backoff helper
+        # (fault/retry.py): the spread breaks the lockstep livelock of
+        # two symmetric contenders (the reference randomizes dsync
+        # retry timing the same way)
+        from ..fault.retry import Backoff
+
+        boff = Backoff(base_s=0.002, cap_s=0.25, jitter=0.5)
         while True:
             # broadcast concurrently: one slow/blackholed peer must not add
             # its full timeout to every round (the reference fans out too)
@@ -300,12 +305,7 @@ class DRWMutex:
                 getattr(lk, op_unlock)(self.resource, self.uid)
             if time.monotonic() > deadline:
                 return False
-            # jitter breaks the lockstep livelock of two symmetric
-            # contenders (the reference randomizes dsync retry timing)
-            # miniovet: ignore[blocking] -- dsync retry jitter; lock
-            # acquisition runs on storage executor threads, never the loop
-            time.sleep(backoff * (0.5 + random.random()))
-            backoff = min(backoff * 2, 0.25)
+            boff.sleep()
 
     def lock(self, timeout: float = 10.0) -> bool:
         return self._acquire(True, timeout)

@@ -22,6 +22,8 @@ import msgpack
 from aiohttp import web
 
 from .. import obs
+from ..fault import registry as fault_registry
+from ..fault import retry as retry_mod
 from ..storage import errors
 from ..storage.datatypes import DiskInfo, FileInfo, VolInfo
 from ..storage.interface import StorageAPI
@@ -295,20 +297,29 @@ class StorageRESTClient(StorageAPI):
             self._local.conn = c
         return c
 
-    # ops safe to resend after a dropped connection; replays of renames,
-    # appends, and version deletes change outcomes (double-append, rename
-    # of a now-missing source counted as a write error) and must not retry
-    _RETRYABLE = frozenset(
-        {"diskinfo", "makevol", "listvols", "statvol", "deletevol",
-         "writemetadata", "updatemetadata", "readversion", "readversions",
-         "createfile", "readfile", "delete", "listdir", "walkdir",
-         "statinfofile", "verifyfile"}
-    )
+    # per-op idempotency class (fault/retry.py is the single source):
+    # only these ops may be resent after a dropped connection or timeout
+    _RETRYABLE = retry_mod.IDEMPOTENT_STORAGE_OPS
 
     # bulk shard payloads: per the grid design (reference grid README) these
     # stay on their own HTTP bodies so one large transfer can't stall every
     # muxed RPC behind it
     _BULK_OPS = frozenset({"createfile", "appendfile", "readfile"})
+
+    def _check_net_fault(self, op: str) -> None:
+        """Injected network faults (fault/ registry): delay stalls the
+        call; everything else raises the same OS-class error a real
+        transport failure would, so the unified retry policy absorbs
+        transient rules and the circuit breaker (HealthCheckedDisk wraps
+        this client) counts persistent ones."""
+        rule = fault_registry.check("network", f"{self.host}:{self.port}", op)
+        if rule is not None:
+            if rule.mode == "delay":
+                fault_registry.sleep_latency(rule)
+            else:
+                raise OSError(
+                    f"{self.endpoint}: injected network fault ({rule.mode})"
+                )
 
     def _rpc(self, op: str, args: dict | None = None) -> bytes:
         body = msgpack.packb(args or {})
@@ -348,8 +359,11 @@ class StorageRESTClient(StorageAPI):
                             f"{self.endpoint} grid rpc {op} failed mid-flight"
                         ) from None
         path = f"{STORAGE_PREFIX}/{self.drive_index}/{op}"
-        attempts = (0, 1) if op in self._RETRYABLE else (1,)
-        for attempt in attempts:
+
+        def attempt() -> tuple:
+            # inside the retry loop: a transient injected fault (count- or
+            # prob-limited) is absorbed exactly like a real blip would be
+            self._check_net_fault(op)
             conn = self._conn()
             try:
                 hdrs = {"x-minio-token": self.token,
@@ -357,20 +371,32 @@ class StorageRESTClient(StorageAPI):
                 if req_id:
                     hdrs["x-minio-reqid"] = req_id
                 conn.request("POST", path, body=body, headers=hdrs)
-                resp = conn.getresponse()
-                data = resp.read()
-                # internode accounting covers the HTTP plane too (bulk
-                # shard bodies + grid fallback), not just the mux
-                from .grid import stats_add
-
-                stats_add("calls")
-                stats_add("tx_bytes", len(body))
-                stats_add("rx_bytes", len(data))
-                break
+                r = conn.getresponse()
+                d = r.read()
             except (http.client.HTTPException, OSError):
                 self._local.conn = None
-                if attempt:
-                    raise errors.DiskNotFound(f"{self.endpoint} unreachable") from None
+                raise
+            # internode accounting covers the HTTP plane too (bulk
+            # shard bodies + grid fallback), not just the mux
+            from .grid import stats_add
+
+            stats_add("calls")
+            stats_add("tx_bytes", len(body))
+            stats_add("rx_bytes", len(d))
+            return r, d
+
+        # unified retry (fault/retry.py): transport failures resend only
+        # for the idempotent op class, with jittered backoff
+        policy = retry_mod.shared_policy(idempotent=op in self._RETRYABLE)
+        try:
+            resp, data = policy.run(
+                attempt,
+                retryable=lambda e: isinstance(
+                    e, (http.client.HTTPException, OSError)
+                ),
+            )
+        except (http.client.HTTPException, OSError):
+            raise errors.DiskNotFound(f"{self.endpoint} unreachable") from None
         if resp.status == 460:
             err_type = _ERR_TYPES.get(
                 resp.headers.get("x-storage-err", ""), errors.StorageError

@@ -14,12 +14,14 @@ import hashlib
 import os
 import threading
 import uuid
-from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor
+from concurrent.futures import wait as _fut_wait
 from typing import Callable, Iterator
 
 import numpy as np
 
 from .. import obs
+from ..fault import registry as fault_registry
 from ..ops.bitrot import DEFAULT_BITROT_ALGO, fast_hash256
 from ..storage import errors
 from ..storage.datatypes import (
@@ -182,6 +184,33 @@ class ErasureSet:
         if key not in self._coders:
             self._coders[key] = ErasureCoder(d, p)
         return self._coders[key]
+
+    def _hedge_budget_s(self) -> float | None:
+        """Straggler budget for hedged shard reads, or None when hedging
+        is off. EWMA-derived: a multiple of the MEDIAN per-drive smoothed
+        latency (HealthCheckedDisk accounting), floored so a cold/fast
+        cluster doesn't hedge on noise. The median keeps one straggling
+        drive from inflating its own budget."""
+        if os.environ.get("MINIO_TPU_HEDGE", "1") == "0":
+            return None
+        # malformed tuning falls back to defaults: a chaos-knob typo must
+        # not take down the GET path
+        try:
+            floor = float(os.environ.get("MINIO_TPU_HEDGE_MIN_MS", "50")) / 1e3
+        except ValueError:
+            floor = 0.05
+        try:
+            mult = float(os.environ.get("MINIO_TPU_HEDGE_MULT", "4"))
+        except ValueError:
+            mult = 4.0
+        ews = sorted(
+            e for e in (
+                getattr(d, "ewma_latency", lambda: 0.0)() for d in self.disks
+            ) if e > 0.0
+        )
+        if not ews:
+            return floor
+        return max(floor, mult * ews[len(ews) // 2])
 
     def _parallel(self, fn: Callable[[StorageAPI], object]) -> list:
         """Run fn on every drive concurrently; returns [(result|None, err|None)]."""
@@ -877,6 +906,7 @@ class ErasureSet:
 
         pool = _read_pool()
         window = max(1, int(os.environ.get("MINIO_TPU_READ_WINDOW", "8")))
+        hedge_budget = self._hedge_budget_s()
 
         def start_window(win):
             """Submit data-first reads for every block of the window."""
@@ -890,36 +920,119 @@ class ErasureSet:
             return futs
 
         def gather_window(win, futs):
-            """Resolve reads, spilling to parity until every block has d."""
+            """Resolve reads until every block has d shards, spilling to
+            parity on FAILURE — and, when a straggling drive blows the
+            hedge budget, on LATENCY: extra parity reads race the
+            straggler and decode around it, whichever reaches d first
+            wins (the hedged-read policy; the reference instead pays the
+            straggler's full latency before spilling)."""
             got: list[dict[int, bytes]] = [{} for _ in win]
-            while True:
-                for (bi, idx), f in futs.items():
-                    try:
-                        got[bi][idx] = f.result()
-                    except (errors.FileCorrupt, errors.FileNotFound, OSError):
-                        bad.add(idx)
-                        report_degraded()
-                futs = {}
-                deficient = [bi for bi in range(len(win)) if len(got[bi]) < d]
-                if not deficient:
-                    return got
-                # next spill candidates: indices not yet tried anywhere
-                tried = set().union(*(g.keys() for g in got)) | bad
-                cands = [i for i in range(self.n) if i in sources and i not in tried]
-                if not cands:
-                    bi0 = deficient[0]
-                    pnum, _per, f_off, _lo, _hi = win[bi0]
-                    raise QuorumError(
-                        f"cannot read part {pnum} shard offset {f_off}: "
-                        f"only {len(got[bi0])} of {d} shards"
+            pending: dict[tuple[int, int], object] = dict(futs)
+            rev = {f: k for k, f in pending.items()}
+            hedged_idx: set[int] = set()
+            hedge_fired = False
+            import time as _time
+
+            deadline = (
+                _time.monotonic() + hedge_budget
+                if hedge_budget is not None else None
+            )
+
+            def submit_more(bi: int, racing: bool) -> int:
+                """Spill reads for block bi so results (+ inflight unless
+                `racing`) can reach d; hedge submissions race stragglers
+                instead of counting them."""
+                inflight = [k[1] for k in pending if k[0] == bi]
+                have = len(got[bi]) + (0 if racing else len(inflight))
+                tried = set(got[bi]) | bad | set(inflight)
+                cands = [
+                    i for i in range(self.n) if i in sources and i not in tried
+                ]
+                n_sub = 0
+                pnum, per, f_off, _lo, _hi = win[bi]
+                for idx in cands[: max(d - have, 0)]:
+                    f = pool.submit(read_shard_block, pnum, idx, per, f_off)
+                    pending[(bi, idx)] = f
+                    rev[f] = (bi, idx)
+                    if racing:
+                        hedged_idx.add(idx)
+                    n_sub += 1
+                return n_sub
+
+            try:
+                while any(len(g) < d for g in got):
+                    # keep every deficient block able to reach d (failure
+                    # spill)
+                    for bi in range(len(win)):
+                        if len(got[bi]) >= d:
+                            continue
+                        inflight = sum(1 for k in pending if k[0] == bi)
+                        if len(got[bi]) + inflight < d:
+                            if submit_more(bi, False) == 0 and inflight == 0:
+                                pnum, _per, f_off, _lo, _hi = win[bi]
+                                raise QuorumError(
+                                    f"cannot read part {pnum} shard offset "
+                                    f"{f_off}: only {len(got[bi])} of {d} "
+                                    "shards"
+                                )
+                    if not pending:
+                        continue  # spills just submitted; re-check
+                    timeout = None
+                    if deadline is not None and not hedge_fired:
+                        timeout = max(deadline - _time.monotonic(), 0.0)
+                    done, _ = _fut_wait(
+                        set(pending.values()), timeout=timeout,
+                        return_when=FIRST_COMPLETED,
                     )
-                for bi in deficient:
-                    pnum, per, f_off, _lo, _hi = win[bi]
-                    # each block spills only as many extra shards as IT needs
-                    for idx in cands[: d - len(got[bi])]:
-                        futs[(bi, idx)] = pool.submit(
-                            read_shard_block, pnum, idx, per, f_off
+                    if not done:
+                        # stragglers blew the budget: hedge — race a
+                        # parity-decode of the remaining shards against them
+                        hedge_fired = True
+                        fired = sum(
+                            submit_more(bi, True)
+                            for bi in range(len(win)) if len(got[bi]) < d
                         )
+                        if fired:
+                            fault_registry.stats_add("hedge_reads")
+                            fault_registry.emit(
+                                "hedge.fire", bucket=bucket, object=obj,
+                                budgetMs=round((hedge_budget or 0.0) * 1e3, 1),
+                                reads=fired,
+                            )
+                        else:
+                            deadline = None  # nothing left to hedge with
+                        continue
+                    for f in done:
+                        bi, idx = rev.pop(f)
+                        del pending[(bi, idx)]
+                        try:
+                            got[bi][idx] = f.result()
+                        except (errors.FileCorrupt, errors.FileNotFound,
+                                errors.DiskNotFound, errors.DiskFull,
+                                OSError):
+                            # DiskNotFound covers a circuit that opened
+                            # BETWEEN the metadata read and this shard read
+                            # (latency trip, remote retries exhausted): the
+                            # drive is a failed shard to spill around, not
+                            # a reason to fail a GET that still has quorum
+                            bad.add(idx)
+                            report_degraded()
+            finally:
+                # success, QuorumError, or anything else: never leave
+                # reads (least of all 500ms-straggler hedge bait) hogging
+                # the shared pool after this window is decided
+                for f in pending.values():
+                    f.cancel()
+            # window satisfied: settle the hedge bet (win = a hedged
+            # shard ended up in some block's decode set)
+            if hedged_idx:
+                used: set[int] = set()
+                for g in got:
+                    used.update(sorted(g.keys())[:d])
+                fault_registry.stats_add(
+                    "hedge_wins" if used & hedged_idx else "hedge_losses"
+                )
+            return got
 
         def decode_window(win, got) -> list[bytes]:
             """Per-block data bytes; same-pattern degraded blocks batch."""

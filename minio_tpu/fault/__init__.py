@@ -1,0 +1,46 @@
+"""fault — runtime fault injection and the hardening it proves out.
+
+The robustness plane (docs/ROBUSTNESS.md):
+
+- ``registry``  admin-togglable fault rules with deterministic seeded
+  schedules at the storage / network / TPU boundaries, plus the
+  robustness counters behind metrics-v3 ``/api/fault``;
+- ``retry``     THE retry policy — jittered exponential backoff,
+  per-op idempotency classes, deadline-aware (the ``retry-discipline``
+  miniovet rule points every ad-hoc retry loop here);
+- ``storage``   the ``FaultInjectedDisk`` chaos wrapper (under the
+  circuit breaker) and the deterministic ``FaultyDisk`` test fixture.
+
+``storage`` loads lazily: ``storage/health.py`` imports this package for
+the registry, and an eager import here would close that cycle.
+"""
+
+from .registry import (  # noqa: F401
+    BOUNDARIES,
+    COUNTERS,
+    MODES,
+    FaultRule,
+    check,
+    clear,
+    emit,
+    inject,
+    sleep_latency,
+    stats_add,
+    status,
+)
+from .retry import (  # noqa: F401
+    IDEMPOTENT_STORAGE_OPS,
+    Backoff,
+    RetryPolicy,
+    shared_policy,
+)
+
+_LAZY = ("FaultInjectedDisk", "FaultyDisk")
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        from . import storage as _storage
+
+        return getattr(_storage, name)
+    raise AttributeError(name)

@@ -1,0 +1,215 @@
+"""Runtime fault-injection registry — the chaos-engineering control plane.
+
+Admin-togglable fault rules with deterministic seeded schedules, injected
+at three boundaries:
+
+- ``storage``  per-drive, per-op faults applied by ``fault.storage.
+  FaultInjectedDisk`` (error / latency / bitrot / torn-write / enospc),
+  wrapped UNDER ``HealthCheckedDisk`` so the circuit breaker sees them;
+- ``network``  internode transport faults applied by ``cluster/grid.py``
+  and ``cluster/storage_rest.py`` (delay / drop / disconnect /
+  partition);
+- ``tpu``      device faults applied by ``parallel/dispatcher.py``
+  (kernel-fail / slow-batch / device-lost) that drive the
+  TPU→XLA→numpy backend degradation ladder.
+
+The registry is the single source of truth: rules are added via the
+admin API (``fault/inject``), matched per call site through ``check()``,
+and removed via ``fault/clear``. The no-rules fast path is one module
+global read — production traffic pays nothing while chaos is off.
+
+Each rule carries its own seeded RNG, so a schedule (rule set + seeds)
+replays deterministically given the same call sequence — the property
+the chaos harness (tests/test_chaos.py) is built on. Every hit emits an
+``obs`` record of type ``fault`` and bumps the metrics-v3 counters
+served under ``/api/fault``.
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+import threading
+import time
+
+BOUNDARIES = ("storage", "network", "tpu")
+MODES = {
+    "storage": frozenset({"error", "latency", "bitrot", "torn-write", "enospc"}),
+    "network": frozenset({"delay", "drop", "disconnect", "partition"}),
+    "tpu": frozenset({"kernel-fail", "slow-batch", "device-lost"}),
+}
+
+# fast-path flag: check() returns immediately while no rules exist; only
+# mutated under _mu, read without it (a stale read costs one lock
+# acquisition or one missed injection window, never correctness)
+_ACTIVE = False
+_mu = threading.Lock()
+_rules: dict[int, "FaultRule"] = {}
+_ids = itertools.count(1)
+
+# robustness-plane counters (metrics v3 /api/fault): injection hits per
+# boundary plus the hedged-read outcome counters fed by erasure/set.py
+COUNTERS = {
+    "storage": 0, "network": 0, "tpu": 0,
+    "hedge_reads": 0, "hedge_wins": 0, "hedge_losses": 0,
+    "latency_trips": 0,
+}
+
+
+def stats_add(key: str, n: int = 1) -> None:
+    with _mu:
+        COUNTERS[key] = COUNTERS.get(key, 0) + n
+
+
+class FaultRule:
+    """One injection rule. ``target`` is a substring match against the
+    call site's identity (drive endpoint, ``host:port`` peer, TPU shape);
+    ``op`` matches the operation name exactly; both accept ``"*"``/empty
+    for any. ``prob`` gates each hit through the rule's seeded RNG;
+    ``count`` > 0 limits total hits (the rule stays listed, spent)."""
+
+    __slots__ = (
+        "rule_id", "boundary", "target", "op", "mode", "prob",
+        "latency_s", "count", "seed", "hits", "rng",
+    )
+
+    def __init__(self, boundary: str, mode: str, target: str = "*",
+                 op: str = "*", prob: float = 1.0, latency_ms: float = 0.0,
+                 count: int = -1, seed: int = 0):
+        if boundary not in BOUNDARIES:
+            raise ValueError(f"unknown fault boundary {boundary!r}")
+        if mode not in MODES[boundary]:
+            raise ValueError(f"unknown {boundary} fault mode {mode!r}")
+        if not 0.0 < prob <= 1.0:
+            raise ValueError("prob must be in (0, 1]")
+        self.rule_id = 0
+        self.boundary = boundary
+        self.target = target
+        self.op = op
+        self.mode = mode
+        self.prob = float(prob)
+        self.latency_s = float(latency_ms) / 1e3
+        self.count = int(count)
+        self.seed = int(seed)
+        self.hits = 0
+        self.rng = random.Random(self.seed)
+
+    def to_dict(self) -> dict:
+        return {
+            "id": self.rule_id, "boundary": self.boundary,
+            "target": self.target, "op": self.op, "mode": self.mode,
+            "prob": self.prob, "latencyMs": round(self.latency_s * 1e3, 3),
+            "remaining": self.count, "hits": self.hits, "seed": self.seed,
+        }
+
+
+def inject(spec: dict) -> int:
+    """Register a rule from its wire form (admin ``fault/inject`` body);
+    returns the rule id. Raises ValueError on a malformed spec."""
+    if not isinstance(spec, dict):
+        raise ValueError("fault spec must be a JSON object")
+    try:
+        rule = FaultRule(
+            boundary=spec["boundary"],
+            mode=spec["mode"],
+            target=str(spec.get("target", "*")) or "*",
+            op=str(spec.get("op", "*")) or "*",
+            prob=float(spec.get("prob", 1.0)),
+            latency_ms=float(spec.get("latency_ms", spec.get("latencyMs", 0.0))),
+            count=int(spec.get("count", -1)),
+            seed=int(spec.get("seed", 0)),
+        )
+    except (KeyError, TypeError) as e:
+        raise ValueError(f"bad fault spec: {e}") from None
+    global _ACTIVE
+    with _mu:
+        rule.rule_id = next(_ids)
+        _rules[rule.rule_id] = rule
+        _ACTIVE = True
+    return rule.rule_id
+
+
+def clear(rule_id: int | None = None) -> int:
+    """Remove one rule (or all with None); returns how many were removed."""
+    global _ACTIVE
+    with _mu:
+        if rule_id is None:
+            n = len(_rules)
+            _rules.clear()
+        else:
+            n = 1 if _rules.pop(rule_id, None) is not None else 0
+        _ACTIVE = bool(_rules)
+    return n
+
+
+def status() -> dict:
+    with _mu:
+        return {
+            "active": bool(_rules),
+            "rules": [r.to_dict() for r in _rules.values()],
+            "counters": dict(COUNTERS),
+        }
+
+
+def check(boundary: str, target: str, op: str = "",
+          modes: tuple[str, ...] | None = None) -> FaultRule | None:
+    """The per-call-site gate: the first matching armed rule, with its
+    hit accounted, or None. Near-free while no rules are registered.
+    ``modes`` restricts matching to the fault modes the call site can
+    actually apply (e.g. the fused-kernel rung applies ``kernel-fail``,
+    the device boundary ``device-lost``/``slow-batch``)."""
+    if not _ACTIVE:
+        return None
+    hit: FaultRule | None = None
+    with _mu:
+        for r in _rules.values():
+            if r.boundary != boundary or r.count == 0:
+                continue
+            if modes is not None and r.mode not in modes:
+                continue
+            if r.target not in ("", "*") and r.target not in target:
+                continue
+            if r.op not in ("", "*") and r.op != op:
+                continue
+            if r.prob < 1.0 and r.rng.random() >= r.prob:
+                continue
+            if r.count > 0:
+                r.count -= 1
+            r.hits += 1
+            COUNTERS[boundary] = COUNTERS.get(boundary, 0) + 1
+            hit = r
+            break
+    if hit is not None:
+        emit(f"{boundary}.{hit.mode}", target=target, op=op,
+             rule=hit.rule_id)
+    return hit
+
+
+def emit(name: str, **fields) -> None:
+    """Publish a ``type=fault`` obs record (injection hits, hedge fires,
+    backend demotions/promotions, breaker latency trips). Costs one
+    module-attribute read when nobody is tracing."""
+    from .. import obs
+
+    if not obs.active():
+        return
+    rec = {
+        "time": time.time(),
+        "type": obs.TYPE_FAULT,
+        "name": name,
+        "reqId": obs.current_request_id(),
+        "node": obs.trace.NODE,
+        "error": "",
+    }
+    rec.update(fields)
+    obs.publish(rec)
+
+
+def sleep_latency(rule: FaultRule) -> None:
+    """Apply a latency/delay/slow-batch rule's injected stall. Callers
+    sit on worker/dispatcher threads (the injection points are all
+    blocking transports), never the event loop."""
+    if rule.latency_s > 0:
+        # miniovet: ignore[blocking] -- injected fault latency on the
+        # faulted call's own worker thread; that stall is the fault
+        time.sleep(rule.latency_s)

@@ -1,0 +1,138 @@
+"""Unified retry policy: jittered exponential backoff, idempotency
+classes, deadline awareness.
+
+Every retry in the tree goes through this module (the ``retry-discipline``
+miniovet rule flags ad-hoc ``time.sleep``-in-a-loop retries elsewhere):
+
+- ``RetryPolicy.run(fn)`` — attempt-loop form for request/response
+  transports (grid RPC, storage REST);
+- ``Backoff`` — sleeper form for callers whose loop shape can't be a
+  closure (dsync lock acquisition, bootstrap peer probing).
+
+Idempotency classes live here too: ``IDEMPOTENT_STORAGE_OPS`` is the
+single source for which storage RPCs may be resent after a transport
+failure OR a timeout (replays of renames, appends, and version deletes
+change outcomes and never retry). The shared knobs
+(``MINIO_TPU_RETRY_*``) size the attempt budget and backoff curve
+cluster-wide.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import time
+from typing import Callable
+
+# storage RPCs safe to resend after a dropped connection or a timeout;
+# replays of renames, appends, and version deletes change outcomes
+# (double-append, rename of a now-missing source counted as a write
+# error) and must not retry
+IDEMPOTENT_STORAGE_OPS = frozenset(
+    {"diskinfo", "makevol", "listvols", "statvol", "deletevol",
+     "writemetadata", "updatemetadata", "readversion", "readversions",
+     "createfile", "readfile", "delete", "listdir", "walkdir",
+     "statinfofile", "verifyfile"}
+)
+
+
+def _sleep(seconds: float) -> None:
+    if seconds > 0:
+        # miniovet: ignore[blocking] -- the ONE sanctioned retry/backoff
+        # sleep in the tree; retrying callers are blocking transports on
+        # worker threads, never the event loop
+        time.sleep(seconds)
+
+
+class Backoff:
+    """Jittered exponential backoff sleeper for loop-form call sites.
+
+    ``jitter`` scales a symmetric factor: delay * [1-jitter, 1+jitter)
+    (jitter=0.5 reproduces the classic 0.5x..1.5x spread that breaks
+    retry lockstep between symmetric contenders)."""
+
+    def __init__(self, base_s: float = 0.025, cap_s: float = 1.0,
+                 mult: float = 2.0, jitter: float = 0.5,
+                 rng: random.Random | None = None):
+        self.base_s = base_s
+        self.cap_s = cap_s
+        self.mult = mult
+        self.jitter = jitter
+        self._rng = rng if rng is not None else random
+        self._n = 0
+
+    def next_delay(self) -> float:
+        d = min(self.base_s * (self.mult ** self._n), self.cap_s)
+        self._n += 1
+        if self.jitter:
+            d *= 1.0 - self.jitter + 2.0 * self.jitter * self._rng.random()
+        return d
+
+    def sleep(self) -> None:
+        _sleep(self.next_delay())
+
+    def reset(self) -> None:
+        self._n = 0
+
+
+class RetryPolicy:
+    """Attempt-loop retry: run ``fn`` up to ``attempts`` times, sleeping
+    a jittered exponential backoff between failures the ``retryable``
+    predicate accepts. ``deadline_s`` bounds the WHOLE call including
+    backoff sleeps: once spent, the last error raises instead of
+    retrying, and a backoff never sleeps past the deadline."""
+
+    def __init__(self, attempts: int = 3, base_s: float = 0.025,
+                 cap_s: float = 1.0, jitter: float = 0.5,
+                 deadline_s: float | None = None):
+        self.attempts = max(1, int(attempts))
+        self.base_s = base_s
+        self.cap_s = cap_s
+        self.jitter = jitter
+        self.deadline_s = deadline_s
+
+    def run(self, fn: Callable[[], object], *,
+            retryable: Callable[[Exception], bool] = lambda e: True):
+        boff = Backoff(self.base_s, self.cap_s, jitter=self.jitter)
+        deadline = (
+            time.monotonic() + self.deadline_s
+            if self.deadline_s is not None else None
+        )
+        for attempt in range(self.attempts):
+            try:
+                return fn()
+            except Exception as e:  # noqa: BLE001 — predicate decides
+                if attempt >= self.attempts - 1 or not retryable(e):
+                    raise
+                if deadline is not None and time.monotonic() >= deadline:
+                    raise
+                delay = boff.next_delay()
+                if deadline is not None:
+                    delay = min(delay, max(deadline - time.monotonic(), 0.0))
+                _sleep(delay)
+        raise AssertionError("unreachable")  # pragma: no cover
+
+
+def shared_policy(idempotent: bool = True,
+                  deadline_s: float | None = None) -> RetryPolicy:
+    """The knob-configured cluster-wide policy. Non-idempotent callers
+    get a single attempt — the idempotency class decides, not the call
+    site."""
+    if not idempotent:
+        return RetryPolicy(attempts=1, deadline_s=deadline_s)
+    # malformed tuning falls back to defaults: a retry-knob typo must not
+    # break every idempotent internode RPC
+    try:
+        attempts = int(os.environ.get("MINIO_TPU_RETRY_ATTEMPTS", "3"))
+    except ValueError:
+        attempts = 3
+    try:
+        base = float(os.environ.get("MINIO_TPU_RETRY_BASE_MS", "25")) / 1e3
+    except ValueError:
+        base = 0.025
+    try:
+        cap = float(os.environ.get("MINIO_TPU_RETRY_CAP_MS", "1000")) / 1e3
+    except ValueError:
+        cap = 1.0
+    return RetryPolicy(attempts=attempts, base_s=base, cap_s=cap,
+                       deadline_s=deadline_s)

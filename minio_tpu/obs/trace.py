@@ -27,8 +27,10 @@ TYPE_STORAGE = "storage"
 TYPE_TPU = "tpu"
 TYPE_HEAL = "heal"
 TYPE_SCANNER = "scanner"
+TYPE_FAULT = "fault"
 TRACE_TYPES = frozenset(
-    {TYPE_S3, TYPE_INTERNAL, TYPE_STORAGE, TYPE_TPU, TYPE_HEAL, TYPE_SCANNER}
+    {TYPE_S3, TYPE_INTERNAL, TYPE_STORAGE, TYPE_TPU, TYPE_HEAL,
+     TYPE_SCANNER, TYPE_FAULT}
 )
 
 # (request_id, parent_span_id); spans nest by swapping the second slot

@@ -36,7 +36,18 @@ from concurrent.futures import Future
 import numpy as np
 
 from .. import obs
+from ..fault import registry as fault_registry
 from ..qos.context import PRI_BACKGROUND, PRI_FOREGROUND, current_priority
+
+# backend degradation ladder (fault/ tpu boundary): fused Pallas
+# mega-kernel -> row-major XLA -> pure-numpy CPU. Repeated device faults
+# demote; background probe batches re-promote once the device answers
+# again. The numpy rung is byte-identical to the device rungs (the
+# golden tests pin all three), so degraded mode changes latency, never
+# payloads.
+LEVEL_FUSED = 2
+LEVEL_XLA = 1
+LEVEL_NUMPY = 0
 
 # fixed histogram edges (seconds) for the metrics-v3 /api/tpu group: the
 # queue-wait edges bracket the 2 ms batch window, the device edges the
@@ -98,6 +109,26 @@ class TpuDispatcher:
         self._fused_cooldown = 0   # dispatches to skip before re-probing
         self._fused_backoff = 8    # next cooldown length, doubles to a cap
         self._encode_and_hash = encode_and_hash
+        # degradation ladder state: consecutive device (XLA-or-worse)
+        # failures past the threshold demote to the numpy rung; a probe
+        # batch every `probe_after` dispatches re-promotes. Malformed env
+        # values fall back — a chaos tuning typo must not kill encodes.
+        try:
+            self._demote_threshold = int(
+                os.environ.get("MINIO_TPU_BACKEND_DEMOTE_FAULTS", "3")
+            )
+        except ValueError:
+            self._demote_threshold = 3
+        try:
+            self._probe_after = int(
+                os.environ.get("MINIO_TPU_BACKEND_PROBE_AFTER", "16")
+            )
+        except ValueError:
+            self._probe_after = 16
+        self._device_fault_streak = 0
+        self._probe_countdown = self._probe_after
+        self._shape = f"{codec.data_shards}+{codec.parity_shards}"
+        self._np_codec = None  # lazy: numpy rung only pays when reached
         self._cv = threading.Condition()
         # lanes hold (blocks, fut, priority, t_enqueue); unconsumed items
         # stay at the head, so no separate carry slot is needed
@@ -111,6 +142,14 @@ class TpuDispatcher:
             "fg_blocks": 0, "bg_blocks": 0, "bg_forced": 0,
             "bg_batch_max": 0, "fg_deferred_behind_bg": 0,
             "fused": 0, "fused_failures": 0,
+            # degradation ladder (metrics-v3 /api/fault): current rung,
+            # device-fault streak witnesses, demote/promote transitions.
+            # The gauge is a FAULT signal: 2 = healthy (fused serving, or
+            # fused benignly inapplicable — disabled, unsupported shape),
+            # 1 = fused faulted out (XLA serving), 0 = device gone (numpy)
+            "backend_level": LEVEL_FUSED,
+            "device_faults": 0, "demotions": 0, "promotions": 0, "probes": 0,
+            "numpy_blocks": 0,
             # kernel-level timing (metrics-v3 /api/tpu): host orchestration
             # vs device execute split + per-item queue wait
             "occupancy_pct_sum": 0.0, "host_s": 0.0, "device_s": 0.0,
@@ -263,6 +302,13 @@ class TpuDispatcher:
         if not fp.supports(d, p, b, n):
             return None
         try:
+            rule = fault_registry.check(
+                "tpu", self._shape, "kernel", modes=("kernel-fail",)
+            )
+            if rule is not None:
+                # injected Pallas-kernel failure: caught below, so the
+                # ladder's first demotion rung (fused -> XLA) engages
+                raise RuntimeError("injected TPU kernel fault")
             parity_cm, digests = fp.fused_encode_hash_cm(
                 fp.pack_chunk_major(all_blocks), d, p
             )
@@ -280,6 +326,78 @@ class TpuDispatcher:
             self.stats["fused_failures"] += 1
             return None
 
+    # -- degradation ladder ------------------------------------------------
+
+    def _tpu_fault_hook(self) -> None:
+        """Device-boundary fault injection (fault/ registry): slow-batch
+        stalls the dispatch, device-lost raises so the whole device rung
+        (XLA included) fails and the ladder demotes."""
+        rule = fault_registry.check(
+            "tpu", self._shape, "dispatch", modes=("device-lost", "slow-batch")
+        )
+        if rule is None:
+            return
+        if rule.mode == "slow-batch":
+            fault_registry.sleep_latency(rule)
+            return
+        raise RuntimeError("injected TPU device loss")
+
+    def _device_fault(self, err: Exception) -> None:
+        self._device_fault_streak += 1
+        self.stats["device_faults"] += 1
+        if (
+            self.stats["backend_level"] != LEVEL_NUMPY
+            and self._device_fault_streak >= self._demote_threshold
+        ):
+            self.stats["backend_level"] = LEVEL_NUMPY
+            self.stats["demotions"] += 1
+            self._probe_countdown = self._probe_after
+            fault_registry.emit(
+                "backend.demote", shape=self._shape, to="numpy",
+                fault=f"{type(err).__name__}: {err}",
+            )
+
+    def _probe_device(self) -> bool:
+        """Synthetic probe batch through the device (XLA) rung; the
+        materialization IS the probe verdict. User traffic keeps riding
+        numpy until a probe succeeds — a flapping device never fails a
+        live request."""
+        self.stats["probes"] += 1
+        try:
+            self._tpu_fault_hook()
+            blocks = np.zeros((1, self.codec.data_shards, self.n), dtype=np.uint8)
+            parity, digests = self._encode_and_hash(self.codec, blocks)
+            np.asarray(parity)
+            np.asarray(digests)
+            return True
+        except Exception:  # noqa: BLE001 — device still gone
+            return False
+
+    def _encode_numpy(self, blocks: np.ndarray):
+        """Pure-CPU rung: numpy GF parity + numpy HighwayHash digests,
+        byte-identical to the device rungs (golden tests pin all three).
+        [k, d, n] -> (parity [k, p, n], digests [k, d+p, 32])."""
+        if self._np_codec is None:
+            from ..ops.rs import get_codec
+
+            self._np_codec = get_codec(
+                self.codec.data_shards, self.codec.parity_shards
+            )
+        from ..ops import gf
+        from ..ops.highwayhash import hash256_batch_numpy
+
+        ref = self._np_codec
+        k, d, n = blocks.shape
+        p = self.codec.parity_shards
+        parity = np.empty((k, p, n), dtype=np.uint8)
+        digests = np.empty((k, d + p, 32), dtype=np.uint8)
+        for i in range(k):
+            parity[i] = gf.gf_matvec_blocks(ref.parity_matrix, blocks[i])
+            digests[i] = hash256_batch_numpy(
+                np.concatenate([blocks[i], parity[i]], axis=0)
+            )
+        return parity, digests
+
     def _loop(self) -> None:
         while True:
             batch = self._collect()
@@ -293,6 +411,14 @@ class TpuDispatcher:
                 _hist_add(self.stats["queue_wait_hist"], QUEUE_WAIT_BUCKETS, wait)
             try:
                 all_blocks = np.concatenate([it[0] for it in batch], axis=0)
+                # malformed input is a CALLER error: it must propagate to
+                # the waiters, never count as a device fault or get
+                # "served degraded" by the numpy rung
+                if all_blocks.shape[1] != self.codec.data_shards:
+                    raise ValueError(
+                        f"blocks have d={all_blocks.shape[1]}, codec "
+                        f"expects {self.codec.data_shards}"
+                    )
                 k = all_blocks.shape[0]
                 bucket = self._bucket(k)
                 if bucket < 16 and self._fused_enabled and self._fused_cooldown == 0:
@@ -310,22 +436,68 @@ class TpuDispatcher:
                         (bucket - k, *all_blocks.shape[1:]), dtype=np.uint8
                     )
                     all_blocks = np.concatenate([all_blocks, pad], axis=0)
-                t_dev = _monotonic()
-                fused = self._fused_cm(all_blocks)
-                was_fused = fused is not None
-                if fused is None:
-                    # don't pay mega-kernel padding (16) on the XLA path:
-                    # trim back to the natural power-of-two bucket
-                    nb = self._bucket(k)
-                    if nb < all_blocks.shape[0]:
-                        all_blocks = all_blocks[:nb]
-                    fused = self._encode_and_hash(self.codec, all_blocks)
-                parity, digests = fused
-                # np.asarray is the device sync point: execute + D2H land
-                # inside the device window, fan-out below is host time
-                parity = np.asarray(parity)[:k]
-                digests = np.asarray(digests)[:k]
-                device_s = _monotonic() - t_dev
+                level = self.stats["backend_level"]
+                if level == LEVEL_NUMPY:
+                    # degraded: traffic serves on CPU; every probe_after
+                    # dispatches a synthetic batch probes the device and
+                    # re-promotes on success
+                    self._probe_countdown -= 1
+                    if self._probe_countdown <= 0:
+                        if self._probe_device():
+                            level = LEVEL_XLA
+                            self.stats["backend_level"] = level
+                            self.stats["promotions"] += 1
+                            self._device_fault_streak = 0
+                            fault_registry.emit(
+                                "backend.promote", shape=self._shape
+                            )
+                        else:
+                            self._probe_countdown = self._probe_after
+                was_fused = False
+                parity = digests = None
+                # device_s covers ONLY time spent against the device
+                # (successful or faulted attempts) — the numpy rung and
+                # the probe are host work and land in host_s, so the
+                # host-vs-device split stays honest in degraded mode
+                device_s = 0.0
+                if level != LEVEL_NUMPY:
+                    t_dev = _monotonic()
+                    try:
+                        self._tpu_fault_hook()
+                        fused = self._fused_cm(all_blocks)
+                        was_fused = fused is not None
+                        if fused is None:
+                            # don't pay mega-kernel padding (16) on the XLA
+                            # path: trim back to the power-of-two bucket
+                            nb = self._bucket(k)
+                            if nb < all_blocks.shape[0]:
+                                all_blocks = all_blocks[:nb]
+                            fused = self._encode_and_hash(self.codec, all_blocks)
+                        parity, digests = fused
+                        # np.asarray is the device sync point: execute + D2H
+                        # land inside the device window, fan-out is host time
+                        parity = np.asarray(parity)[:k]
+                        digests = np.asarray(digests)[:k]
+                        self._device_fault_streak = 0
+                        # gauge semantics: XLA is a DEGRADATION signal only
+                        # when the fused rung is faulted out (cooldown); a
+                        # benign fused skip (unsupported shape, big bucket,
+                        # MINIO_TPU_FUSED_CM=0) reads healthy
+                        if self._fused_cooldown > 0:
+                            self.stats["backend_level"] = LEVEL_XLA
+                        else:
+                            self.stats["backend_level"] = LEVEL_FUSED
+                    except Exception as e:  # noqa: BLE001 — serve degraded
+                        # the device rung failed mid-batch: waiters get
+                        # numpy results instead of errors, the ladder
+                        # counts the fault and demotes past the threshold
+                        self._device_fault(e)
+                        was_fused = False
+                        parity = None
+                    device_s = _monotonic() - t_dev
+                if parity is None:
+                    parity, digests = self._encode_numpy(all_blocks[:k])
+                    self.stats["numpy_blocks"] += k
                 shards = np.concatenate(
                     [all_blocks[:k], parity], axis=1
                 )  # [B, t, n]
@@ -404,7 +576,10 @@ def aggregate_stats() -> dict:
     out: dict = {}
     for d in list(_dispatchers.values()):
         for k, v in d.stats.items():
-            if k in ("max_batch", "bg_batch_max"):
+            if k == "backend_level":
+                # most-degraded rung across shapes: the alarming signal
+                out[k] = min(out.get(k, LEVEL_FUSED), v)
+            elif k in ("max_batch", "bg_batch_max"):
                 out[k] = max(out.get(k, 0), v)
             elif isinstance(v, list):
                 cur = out.setdefault(k, [0] * len(v))

@@ -499,6 +499,55 @@ async def handle_admin(server, request: web.Request, access_key: str, subpath: s
         await server._run(iam.remove_user, sa.access_key)
         return web.Response(status=204)
 
+    # -- fault injection (chaos plane, fault/registry.py) ------------------
+    if op == "fault/inject" and m == "POST":
+        authz("admin:ServerUpdate")
+        from .. import fault
+
+        try:
+            spec = json.loads(body) if body else {}
+            rid = fault.inject(spec)
+        except ValueError as e:
+            return _json({"error": str(e)}, 400)
+        out = {"id": rid, "rule": spec}
+        if q.get("local") != "true":
+            out["peers"] = await server._run(
+                _fault_fanout, server, "inject", body, {}
+            )
+        return _json(out)
+    if op == "fault/clear" and m == "POST":
+        authz("admin:ServerUpdate")
+        from .. import fault
+
+        rid = None
+        if q.get("id"):
+            try:
+                rid = int(q["id"])
+            except ValueError:
+                raise s3err.InvalidArgument from None
+        removed = fault.clear(rid)
+        out = {"removed": removed}
+        # rule ids are per-process counters, so an id-scoped clear is
+        # meaningful only on the node that minted the id — fanning an id
+        # out would clear a DIFFERENT (or no) rule on each peer while
+        # reporting success. Only full clears go cluster-wide.
+        if q.get("local") != "true" and rid is None:
+            out["peers"] = await server._run(
+                _fault_fanout, server, "clear", b"", {}
+            )
+        return _json(out)
+    if op == "fault/status" and m == "GET":
+        authz("admin:OBDInfo")
+        from .. import fault
+        from ..parallel import dispatcher as dmod
+
+        st = fault.status()
+        ds = dmod.aggregate_stats()
+        st["backendLevel"] = ds.get("backend_level", 2)
+        st["demotions"] = ds.get("demotions", 0)
+        st["promotions"] = ds.get("promotions", 0)
+        return _json(st)
+
     # -- observability ----------------------------------------------------
     if op == "trace" and m == "GET":
         authz("admin:ServerTrace")
@@ -814,6 +863,42 @@ def _peer_trace_pump(server, peer: str, flt, sub, stop) -> None:
                 conn.close()
             except Exception:  # noqa: BLE001 — teardown best-effort
                 pass
+
+
+def _fault_fanout(server, action: str, body: bytes, query: dict) -> dict:
+    """Drive a fault inject/clear cluster-wide: replay it on every peer's
+    admin endpoint with ``local=true`` (the same stop-the-recursion
+    convention the profile fan-out uses). Peers are contacted in
+    parallel — chaos tooling must work on a chaotic cluster, where some
+    peers are down and a serial 10 s connect timeout each would make
+    injection itself the outage. A dead peer is a row in the result,
+    not a failure."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    peers = getattr(server, "peers", None) or []
+    if not peers:
+        return {}
+
+    def one(peer: str) -> tuple[str, str]:
+        host, _, port = peer.rpartition(":")
+        try:
+            from ..client import S3Client
+
+            cli = S3Client(
+                f"{host}:{port}",
+                access_key=server.iam.root_user,
+                secret_key=server.iam.root_password,
+            )
+            r = cli.request(
+                "POST", f"/minio/admin/v3/fault/{action}",
+                query={**query, "local": "true"}, body=body, timeout=10,
+            )
+            return peer, "ok" if r.status == 200 else f"HTTP {r.status}"
+        except Exception as e:  # noqa: BLE001 — a dead peer is a row
+            return peer, f"error: {e}"
+
+    with ThreadPoolExecutor(max_workers=min(len(peers), 16)) as pool:
+        return dict(pool.map(one, peers))
 
 
 async def _stream_trace(server, request: web.Request) -> web.StreamResponse:

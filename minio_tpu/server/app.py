@@ -980,6 +980,7 @@ def make_object_layer(
     for pool_idx, paths in enumerate(pool_specs):
         disks = []
         any_local = False
+        from ..fault.storage import FaultInjectedDisk
         from ..storage.health import HealthCheckedDisk
 
         for p in paths:
@@ -996,8 +997,11 @@ def make_object_layer(
                     ep.host, ep.port, global_idx, internode_token_value, endpoint=p
                 )
             # circuit breaker: a dead drive fails fast instead of adding
-            # its timeout to every quorum operation
-            disks.append(HealthCheckedDisk(d))
+            # its timeout to every quorum operation. The fault-injection
+            # wrapper sits UNDER it so admin-injected chaos (fault/) hits
+            # the same breaker/latency accounting real faults do; it costs
+            # one flag read per op while no rules are armed.
+            disks.append(HealthCheckedDisk(FaultInjectedDisk(d)))
             global_idx += 1
         if not any_local and local_drive_registry is not None:
             raise ValueError(f"pool {pool_idx}: no local drives for this node")

@@ -832,6 +832,57 @@ def _g_api_trace(server) -> list[str]:
     return out
 
 
+def _g_api_fault(server) -> list[str]:
+    """Robustness plane: armed fault-injection rules and their hits, the
+    hedged-read win/loss counters (erasure/set.py GET window path), the
+    latency-breaker trip count, and the TPU backend degradation ladder
+    (2=fused, 1=XLA, 0=numpy) with its demote/promote transitions."""
+    from .. import fault
+    from ..parallel import dispatcher as dmod
+    from ..storage.health import HealthCheckedDisk
+
+    out: list[str] = []
+    st = fault.status()
+    c = st["counters"]
+    _fmt(out, "minio_fault_rules_active", "gauge", [({}, len(st["rules"]))],
+         "Armed fault-injection rules on this node")
+    _fmt(out, "minio_fault_injected_total", "counter",
+         [({"boundary": b}, c.get(b, 0)) for b in ("storage", "network", "tpu")],
+         "Injected fault hits per boundary")
+    _fmt(out, "minio_fault_hedge_reads_total", "counter",
+         [({}, c.get("hedge_reads", 0))],
+         "GET windows that fired hedged parity reads past the budget")
+    _fmt(out, "minio_fault_hedge_wins_total", "counter",
+         [({}, c.get("hedge_wins", 0))],
+         "Hedged windows where the parity decode beat the straggler")
+    _fmt(out, "minio_fault_hedge_losses_total", "counter",
+         [({}, c.get("hedge_losses", 0))])
+    trips = 0
+    for d in getattr(server.store, "disks", []):
+        if isinstance(d, HealthCheckedDisk):
+            trips += d.latency_trips
+    _fmt(out, "minio_fault_drive_latency_trips_total", "counter",
+         [({}, trips)],
+         "Circuit-breaker opens caused by chronic drive latency")
+    ds = dmod.aggregate_stats()
+    _fmt(out, "minio_tpu_backend_level", "gauge",
+         [({}, ds.get("backend_level", dmod.LEVEL_FUSED))],
+         "Encode backend rung: 2=healthy, 1=fused faulted out (XLA), "
+         "0=device gone (numpy)")
+    _fmt(out, "minio_tpu_backend_demotions_total", "counter",
+         [({}, ds.get("demotions", 0))])
+    _fmt(out, "minio_tpu_backend_promotions_total", "counter",
+         [({}, ds.get("promotions", 0))])
+    _fmt(out, "minio_tpu_backend_device_faults_total", "counter",
+         [({}, ds.get("device_faults", 0))])
+    _fmt(out, "minio_tpu_backend_probe_batches_total", "counter",
+         [({}, ds.get("probes", 0))])
+    _fmt(out, "minio_tpu_backend_numpy_blocks_total", "counter",
+         [({}, ds.get("numpy_blocks", 0))],
+         "Stripe blocks served by the degraded numpy rung")
+    return out
+
+
 def _g_system_drive_latency(server) -> list[str]:
     """Per-drive, per-op latency (HealthCheckedDisk accounting): lets a
     slow p99 GET be attributed to one laggy disk instead of the whole
@@ -859,6 +910,7 @@ V3_GROUPS = {
     "/api/qos": _g_api_qos,
     "/api/tpu": _g_api_tpu,
     "/api/trace": _g_api_trace,
+    "/api/fault": _g_api_fault,
     "/system/drive/latency": _g_system_drive_latency,
     "/system/network/internode": _g_system_network,
     "/system/drive": _g_system_drive,

@@ -8,18 +8,31 @@ so one dead remote drive can't keep adding its full timeout to every
 quorum operation.
 
 Logical errors (missing files/volumes, corrupt shards) are NOT drive
-faults — only transport/OS-level failures trip the breaker.
+faults — only transport/OS-level failures trip the breaker. A drive that
+answers but has become chronically slow trips it too: the per-op EWMA
+latency exceeding ``MINIO_TPU_DRIVE_LATENCY_TRIP_S`` opens the circuit
+exactly like consecutive errors would (a slow-but-alive drive otherwise
+taxes every quorum operation forever). The same EWMA feeds the hedged
+shard-read budget in erasure/set.py.
 """
 
 from __future__ import annotations
 
 import collections
+import os
 import threading
 import time
 
 from .. import obs
+from ..fault import registry as fault_registry
 from . import errors
 from .interface import StorageAPI
+
+# EWMA smoothing for per-drive call latency: ~the last dozen calls
+# dominate, one outlier doesn't
+_EWMA_ALPHA = 0.2
+# latency trips need a warm estimator: don't judge the first few calls
+_EWMA_MIN_SAMPLES = 8
 
 # errors that indicate the DRIVE is fine and the request was just wrong
 _LOGICAL = (
@@ -45,17 +58,48 @@ _WRAPPED = (
 class HealthCheckedDisk(StorageAPI):
     """Circuit-breaking, latency-tracking proxy around any StorageAPI."""
 
-    def __init__(self, inner: StorageAPI, fail_threshold: int = 4,
-                 cooldown: float = 15.0):
+    def __init__(self, inner: StorageAPI, fail_threshold: int | None = None,
+                 cooldown: float | None = None,
+                 latency_trip_s: float | None = None):
         self._inner = inner
+        # breaker tuning rides MINIO_TPU_* knobs (analysis/knobs.py);
+        # explicit constructor args (tests, embedders) still win
+        # malformed tuning falls back to defaults: a breaker-knob typo
+        # must not refuse to boot the object layer
+        if fail_threshold is None:
+            try:
+                fail_threshold = int(
+                    os.environ.get("MINIO_TPU_DRIVE_FAIL_THRESHOLD", "4")
+                )
+            except ValueError:
+                fail_threshold = 4
         self._threshold = fail_threshold
+        if cooldown is None:
+            try:
+                cooldown = float(
+                    os.environ.get("MINIO_TPU_DRIVE_COOLDOWN_S", "15")
+                )
+            except ValueError:
+                cooldown = 15.0
         self._cooldown = cooldown
+        # EWMA latency above this opens the circuit (0 disables)
+        if latency_trip_s is None:
+            try:
+                latency_trip_s = float(
+                    os.environ.get("MINIO_TPU_DRIVE_LATENCY_TRIP_S", "10")
+                )
+            except ValueError:
+                latency_trip_s = 10.0
+        self._latency_trip_s = latency_trip_s
         self._mu = threading.Lock()
         self._consecutive_faults = 0
         self._open_until = 0.0  # circuit-open deadline
         self._probe_inflight = False
         self._latencies: collections.deque = collections.deque(maxlen=64)
         self.total_faults = 0
+        self.latency_trips = 0
+        self._ewma = 0.0
+        self._ewma_n = 0
         # per-op latency accounting (metrics-v3 /system/drive/latency):
         # op name -> [calls, total seconds]
         self._op_stats: dict[str, list] = {}
@@ -81,12 +125,21 @@ class HealthCheckedDisk(StorageAPI):
     def health(self) -> dict:
         with self._mu:
             lat = list(self._latencies)
+            ewma = self._ewma
         return {
             "endpoint": self.endpoint,
             "online": self.online,
             "totalFaults": self.total_faults,
+            "latencyTrips": self.latency_trips,
             "avgLatencyMs": round(sum(lat) / len(lat) * 1e3, 3) if lat else 0.0,
+            "ewmaLatencyMs": round(ewma * 1e3, 3),
         }
+
+    def ewma_latency(self) -> float:
+        """Smoothed per-call latency in seconds (0.0 until warm) — the
+        input to the hedged-read budget in erasure/set.py."""
+        with self._mu:
+            return self._ewma if self._ewma_n >= _EWMA_MIN_SAMPLES else 0.0
 
     def _enter(self) -> bool:
         """False -> circuit open, fail fast. After the cooldown exactly ONE
@@ -104,18 +157,52 @@ class HealthCheckedDisk(StorageAPI):
             return True
 
     def _ok(self, dt: float, op: str | None = None) -> None:
+        tripped = False
         with self._mu:
             self._consecutive_faults = 0
-            self._open_until = 0.0  # probe success closes the circuit
+            # ONLY a half-open probe success closes an open circuit: a
+            # call that was already in flight when the circuit opened
+            # (e.g. the latency trip below, fired by a sibling read of
+            # the same window) must not re-close it on completion — that
+            # would neuter the breaker under exactly the concurrent load
+            # it exists for
+            if self._probe_inflight:
+                self._open_until = 0.0
             self._probe_inflight = False
             self._latencies.append(dt)
+            self._ewma_locked(dt)
             if op is not None:
                 self._account_locked(op, dt)
+            # latency breaker: a drive that ANSWERS but has become
+            # chronically slow goes offline like an erroring one; the
+            # EWMA resets so the post-cooldown probe is judged fresh.
+            # Skipped while the circuit is already open: late in-flight
+            # completions must not stack trips / extend the cooldown
+            if (
+                self._latency_trip_s > 0
+                and self._open_until == 0.0
+                and self._ewma_n >= _EWMA_MIN_SAMPLES
+                and self._ewma > self._latency_trip_s
+            ):
+                tripped_ewma = self._ewma
+                self._open_until = time.monotonic() + self._cooldown
+                self._ewma = 0.0
+                self._ewma_n = 0
+                self.latency_trips += 1
+                tripped = True
+        if tripped:
+            fault_registry.stats_add("latency_trips")
+            fault_registry.emit(
+                "breaker.latency-trip", drive=self.endpoint,
+                ewmaMs=round(tripped_ewma * 1e3, 3),
+            )
 
     def _fault(self, op: str | None = None, dt: float = 0.0) -> None:
         with self._mu:
             self._consecutive_faults += 1
             self.total_faults += 1
+            if dt > 0.0:
+                self._ewma_locked(dt)
             if self._probe_inflight:
                 # failed probe: re-open immediately, no threshold grace
                 self._probe_inflight = False
@@ -126,6 +213,13 @@ class HealthCheckedDisk(StorageAPI):
                 self._consecutive_faults = 0
             if op is not None:
                 self._account_locked(op, dt)
+
+    def _ewma_locked(self, dt: float) -> None:
+        if self._ewma_n == 0:
+            self._ewma = dt
+        else:
+            self._ewma = _EWMA_ALPHA * dt + (1.0 - _EWMA_ALPHA) * self._ewma
+        self._ewma_n += 1
 
     def _account_locked(self, name: str, dt: float) -> None:
         st = self._op_stats.get(name)

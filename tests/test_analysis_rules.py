@@ -557,3 +557,89 @@ def test_span_rule_exempts_obs_package():
             return Span(t, n, fields)
     """
     assert run(src, relpath="obs/trace.py", rules=["span"]) == []
+
+
+# -- retry-discipline ------------------------------------------------------
+
+BAD_RETRY = """
+    import time
+
+    def fetch(conn):
+        while True:
+            try:
+                conn.request("GET", "/x")
+                return conn.getresponse()
+            except OSError:
+                pass
+            time.sleep(1.0)
+"""
+
+GOOD_HEARTBEAT = """
+    import time
+
+    def keepalive(ws):
+        while True:
+            time.sleep(10)
+            try:
+                ws.send_binary(b"ping")
+            except OSError:
+                teardown(ws)
+                return
+"""
+
+GOOD_PACING = """
+    import time
+
+    def scan(store):
+        for raw in store.walk_objects("b"):
+            try:
+                inspect(raw)
+            except Exception:
+                queue_heal(raw)
+            time.sleep(0.01)
+"""
+
+
+def test_retry_discipline_flags_adhoc_loop():
+    fs = run(BAD_RETRY, rules=["retry-discipline"])
+    assert len(fs) == 1 and "fault/retry.py" in fs[0].message
+
+
+def test_retry_discipline_exempts_teardown_heartbeat():
+    # handler exits the loop (return): teardown, not a retry
+    assert run(GOOD_HEARTBEAT, rules=["retry-discipline"]) == []
+
+
+def test_retry_discipline_exempts_pacing_loop():
+    # no network/storage-shaped call in the loop body: pacing, not retry
+    assert run(GOOD_PACING, rules=["retry-discipline"]) == []
+
+
+def test_retry_discipline_exempts_retry_module():
+    src = """
+        import time
+
+        def _sleep_loop(fn):
+            while True:
+                try:
+                    return fn.call()
+                except OSError:
+                    pass
+                time.sleep(0.1)
+    """
+    assert run(src, relpath="fault/retry.py", rules=["retry-discipline"]) == []
+
+
+def test_retry_discipline_sleep_inside_handler_flagged():
+    src = """
+        import time
+
+        def fetch(cli):
+            for _ in range(5):
+                try:
+                    return cli.call("op", b"")
+                except OSError:
+                    time.sleep(0.5)
+    """
+    fs = run(src, rules=["retry-discipline"])
+    assert len(fs) == 1

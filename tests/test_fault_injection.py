@@ -13,6 +13,9 @@ import pytest
 
 from minio_tpu.erasure.quorum import QuorumError
 from minio_tpu.erasure.set import ErasureSet
+# the fixture lives in the fault package now (shared with the chaos
+# harness, tests/test_chaos.py)
+from minio_tpu.fault.storage import FaultyDisk
 from minio_tpu.storage import errors
 from minio_tpu.storage.xlstorage import XLStorage
 
@@ -25,32 +28,6 @@ def _python_read_path(monkeypatch):
     # bypassing the wrapper's read_file faults — force the Python read
     # path so the injected faults actually land
     monkeypatch.setenv("MINIO_TPU_NATIVE_PLANE", "0")
-
-
-class FaultyDisk:
-    """Wraps a real drive; fails the ops named in `fail_ops`. With
-    `fail_after` > 0 the first N calls of each op succeed first (models a
-    drive dying mid-stream, like the reference's badDisk hook)."""
-
-    def __init__(self, inner, fail_ops=(), fail_after=0, exc=None):
-        self._inner = inner
-        self.fail_ops = set(fail_ops)
-        self.fail_after = fail_after
-        self.exc = exc or OSError("injected fault")
-        self.calls: dict[str, int] = {}
-
-    def __getattr__(self, name):
-        attr = getattr(self._inner, name)
-        if not callable(attr) or name.startswith("_"):
-            return attr
-
-        def wrapper(*a, **kw):
-            self.calls[name] = self.calls.get(name, 0) + 1
-            if name in self.fail_ops and self.calls[name] > self.fail_after:
-                raise self.exc
-            return attr(*a, **kw)
-
-        return wrapper
 
 
 def _rig(tmp_path, n=8):
