@@ -276,3 +276,4 @@ from . import rules_locks   # noqa: E402,F401
 from . import rules_knobs   # noqa: E402,F401
 from . import rules_obs     # noqa: E402,F401
 from . import rules_retry   # noqa: E402,F401
+from . import rules_cache   # noqa: E402,F401

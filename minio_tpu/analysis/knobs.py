@@ -38,6 +38,26 @@ _ALL: list[Knob] = [
     _k("MINIO_TPU_LOCK_REFRESH_S", "10", "cluster",
        "Interval between distributed-lock refreshes; a holder that "
        "misses refreshes loses the lock at TTL expiry."),
+    # -- caching layer (cache/) ------------------------------------------
+    _k("MINIO_TPU_CACHE", "1", "cache",
+       "Master switch for the quorum-coherent caching layer (FileInfo, "
+       "hot-object data, and listing tiers); 0 disables every tier."),
+    _k("MINIO_TPU_CACHE_ADMIT_TOUCHES", "2", "cache",
+       "Reads of an object within the admission window before its bytes "
+       "earn data-cache residency (1 = admit on first read; inline-data "
+       "objects always admit immediately)."),
+    _k("MINIO_TPU_CACHE_FILEINFO_ENTRIES", "4096", "cache",
+       "Per-erasure-set LRU capacity of the FileInfo metadata cache."),
+    _k("MINIO_TPU_CACHE_MEM_MB", "256", "cache",
+       "Process-wide byte budget (MiB) shared by the hot-object data "
+       "cache and cached inline payloads; oldest entries evict past it."),
+    _k("MINIO_TPU_CACHE_OBJECT_MAX", "2097152", "cache",
+       "Largest object (bytes) the hot-object data cache will hold."),
+    _k("MINIO_TPU_CACHE_REVALIDATE_S", "1", "cache",
+       "Distributed deployments re-check cached entries older than this "
+       "(single-drive modTime probe) before serving them; bounds the "
+       "staleness window of a lost cross-node invalidation. 0 trusts "
+       "invalidations alone; single-node deployments never revalidate."),
     # -- erasure / object layer ------------------------------------------
     _k("MINIO_TPU_BACKEND", "jax", "erasure",
        "Erasure codec backend: `jax` (TPU/XLA bit-plane kernels) or "

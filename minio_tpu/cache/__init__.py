@@ -1,0 +1,30 @@
+"""minio_tpu.cache — the quorum-coherent caching layer.
+
+Three tiers over the GET/HEAD hot path (see docs/CACHING.md):
+
+- **FileInfo cache** (``core.SetCache``, one per erasure set): hot
+  GET/HEAD/get_object_info skip the N-drive ``read_version`` fan-out;
+  concurrent misses singleflight into one quorum read.
+- **Hot-object data cache** (``core.DataCache``, process-wide byte
+  budget): repeat GETs of small/hot objects are served from memory with
+  etag/bitrot identity preserved.
+- **Listing metacache** (``erasure/listing.py``): repeated
+  ``list_objects`` scans reuse recent prefix walks.
+
+Coherence is write-through via ONE choke point
+(``SetCache.invalidate_object`` — enforced by the miniovet
+``cache-discipline`` rule) plus cross-node grid broadcasts with
+generation-gap epoch bumps (``coherence``): a lost invalidation can only
+cause a revalidate, never a stale serve.
+"""
+
+from .core import (  # noqa: F401
+    SetCache,
+    aggregate_stats,
+    clear_store,
+    data_cache,
+    enabled,
+    object_max,
+    store_caches,
+)
+from . import coherence  # noqa: F401

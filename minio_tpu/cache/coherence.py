@@ -1,0 +1,184 @@
+"""Cross-node cache coherence: invalidation broadcasts over the grid.
+
+Every mutation that flows through the cache choke point
+(``SetCache.invalidate_object``) is replayed to every peer as a small
+grid RPC (``cache.invalidate`` on the muxed storage-plane websocket,
+cluster/grid.py). The broadcast is **synchronous with the mutation**:
+``put_object``/``delete_object`` return only after peers were told (or
+the short per-peer deadline passed), so a client that saw its PUT
+succeed never reads the old version from another node's cache.
+
+Loss handling rides a per-sender **generation counter**: every broadcast
+carries ``gen = n``; a receiver that observes a gap (``gen != last+1``)
+knows at least one invalidation never arrived and bumps the **epoch** on
+every local SetCache. Epoch-bumped entries are not dropped — they must
+revalidate (one single-drive modTime check, ``core.SetCache``) before
+their next serve. Between the loss and the gap detection, distributed
+deployments additionally re-check entries older than
+``MINIO_TPU_CACHE_REVALIDATE_S`` (default 1 s), so the worst case for a
+lost broadcast is a short revalidate window, never an unbounded stale
+serve. Single-node deployments skip all of this: the choke point is
+authoritative and broadcasts are no-ops.
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+import weakref
+
+import msgpack
+
+HANDLER = "cache.invalidate"
+BROADCAST_TIMEOUT_S = 2.0
+# how long a missing generation may stay missing before it is declared
+# lost: concurrent broadcasts are sent on racing threads, so short
+# reorder windows are NORMAL delivery, not loss
+GAP_GRACE_S = 5.0
+
+NODE_ID = uuid.uuid4().hex[:12]
+
+_mu = threading.Lock()
+_store_ref: "weakref.ref | None" = None
+_peers: list[str] = []
+_token = ""
+_gen = 0
+_last_seen: dict[str, int] = {}
+_holes: dict[str, dict[int, float]] = {}  # node -> {missing gen: deadline}
+_stats = {"sent": 0, "send_errors": 0, "received": 0, "gen_gaps": 0}
+
+
+def attach(store) -> None:
+    """Bind the node's serving object layer (called from set_store):
+    received invalidations apply to THIS store's set caches."""
+    global _store_ref
+    with _mu:
+        _store_ref = weakref.ref(store)
+
+
+def configure(peers: list[str], token: str) -> None:
+    """Arm broadcasting towards cluster peers (called from server main)."""
+    global _peers, _token
+    with _mu:
+        _peers = list(peers)
+        _token = token
+
+
+def is_distributed() -> bool:
+    return bool(_peers)
+
+
+def stats() -> dict:
+    with _mu:
+        return dict(_stats, peers=len(_peers), lastGen=_gen)
+
+
+def register_grid(grid) -> None:
+    """Register the receive side on the node's GridServer. inline=True:
+    the handler only touches in-memory dicts — it must never queue behind
+    disk-bound executor work."""
+    grid.register_single(HANDLER, _handle, inline=True)
+
+
+def broadcast_invalidate(pool_idx: int, set_idx: int, bucket: str,
+                         obj: str, kind: str = "obj") -> None:
+    """Tell every peer to drop (bucket, obj) — or every key under the
+    prefix, or the whole bucket (``kind``: obj|prefix|bucket) — from the
+    addressed set's caches. Parallel across peers, bounded per-peer
+    deadline; a dead peer costs one short timeout, is counted, and heals
+    via the generation-gap epoch bump on its next received broadcast."""
+    global _gen
+    with _mu:
+        peers, token = list(_peers), _token
+        if not peers:
+            return
+        _gen += 1
+        payload = msgpack.packb(
+            [NODE_ID, _gen, pool_idx, set_idx, bucket, obj, kind]
+        )
+
+    from ..cluster.grid import shared_client
+
+    def one(peer: str) -> None:
+        host, _, port = peer.rpartition(":")
+        try:
+            shared_client(host, int(port), token, "storage").call(
+                HANDLER, payload, timeout=BROADCAST_TIMEOUT_S, retry=True
+            )
+            with _mu:
+                _stats["sent"] += 1
+        except Exception:  # noqa: BLE001 — gap detection covers the loss
+            with _mu:
+                _stats["send_errors"] += 1
+
+    if len(peers) == 1:
+        one(peers[0])
+        return
+    threads = [
+        threading.Thread(target=one, args=(p,), daemon=True) for p in peers
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(BROADCAST_TIMEOUT_S * 1.5)
+
+
+def _handle(payload: bytes) -> bytes:
+    """Receive side: drop the key locally (no re-broadcast) and track the
+    sender's generation sequence; a gap bumps every set cache's epoch."""
+    node, gen, pool_idx, set_idx, bucket, obj, kind = msgpack.unpackb(
+        payload, raw=False
+    )
+    import time as _time
+
+    now = _time.monotonic()
+    with _mu:
+        _stats["received"] += 1
+        last = _last_seen.get(node, 0)
+        holes = _holes.setdefault(node, {})
+        # a skipped generation becomes a HOLE with a grace deadline, not
+        # an instant loss: concurrent broadcasts are assigned gens under
+        # the sender's lock but sent on racing threads, so both
+        # reorder-behind (gen <= last) and reorder-ahead (a later gen
+        # arriving first) are normal delivery. Only a hole that outlives
+        # the grace is a genuinely lost invalidation — that bumps the
+        # epoch so pre-gap entries revalidate before serving.
+        if gen > last:
+            if last > 0:
+                for h in range(last + 1, gen):
+                    holes[h] = now + GAP_GRACE_S
+            _last_seen[node] = gen
+        else:
+            holes.pop(gen, None)  # reordered delivery filled its hole
+        expired = [h for h, dl in holes.items() if now >= dl]
+        for h in expired:
+            del holes[h]
+        if len(holes) > 1024:  # runaway loss: treat the overflow as one
+            holes.clear()
+            expired.append(-1)
+        gap = bool(expired)
+        if gap:
+            _stats["gen_gaps"] += 1
+        store = _store_ref() if _store_ref is not None else None
+    if store is None:
+        return b""
+    from .core import store_caches
+
+    if gap:
+        for c in store_caches(store):
+            c.bump_epoch()
+    for p in getattr(store, "pools", [store]):
+        if getattr(p, "pool_index", 0) != pool_idx:
+            continue
+        for s in getattr(p, "sets", [p]):
+            if getattr(s, "set_index", 0) != set_idx:
+                continue
+            cache = getattr(s, "cache", None)
+            if cache is not None:
+                if kind == "prefix":
+                    cache.invalidate_prefix(bucket, obj, broadcast=False)
+                elif kind == "bucket":
+                    cache.invalidate_bucket(bucket, broadcast=False)
+                else:
+                    cache.invalidate_object(bucket, obj, broadcast=False)
+    return b""

@@ -61,11 +61,17 @@ def _merged_keys(es, bucket: str, prefix: str) -> Iterator[str]:
             return
 
 
-# ---- persisted metacache ---------------------------------------------------
-# Without it every continuation page re-walks every drive from scratch
-# (O(pages x full-walk)); the reference caches listing streams as objects
-# under .minio.sys and resumes them by continuation token
-# (/root/reference/cmd/metacache-set.go:319, metacache-server-pool.go:60).
+# ---- listing metacache -----------------------------------------------------
+# Two jobs: continuation pages resume a cached key stream instead of
+# re-walking every drive per page (the reference caches listing streams
+# as objects under .minio.sys and resumes them by continuation token,
+# /root/reference/cmd/metacache-set.go:319, metacache-server-pool.go:60),
+# and REPEATED first-page scans of the same (bucket, prefix) — training
+# manifests, dashboards — reuse the previous walk outright. Coherence:
+# every object mutation invalidates its bucket's entries through the
+# cache choke point (cache/core.SetCache.invalidate_object), so a
+# same-node put -> list round-trip always sees the new key; cross-node
+# the TTL plus the coherence broadcast bound staleness.
 
 _MC_LOCK = threading.Lock()
 # (store-id, bucket, prefix) -> (created, keys | None, store-weakref);
@@ -74,6 +80,18 @@ _MC_LOCK = threading.Lock()
 # a store is garbage-collected.
 _MC_MEM: dict[tuple[int, str, str], tuple[float, list[str] | None, object]] = {}
 _MC_MAX_ENTRIES = 256
+_MC_STATS = {"hits": 0, "misses": 0, "invalidations": 0, "stores": 0}
+# per-bucket invalidation sequence: a first-page walk captured across a
+# concurrent mutation must not be memoized (the walk may predate the new
+# key but would be stamped fresh) — snapshot at walk start, compare at
+# store time
+_MC_SEQ = 0
+_MC_BSEQ: dict[str, int] = {}
+
+
+def _mc_bucket_seq(bucket: str) -> int:
+    with _MC_LOCK:
+        return _MC_BSEQ.get(bucket, 0)
 
 
 def _mc_ttl() -> float:
@@ -85,10 +103,76 @@ def _mc_max_keys() -> int:
 
 
 def invalidate_bucket(bucket: str) -> None:
-    """Drop in-memory cache entries for a (deleted/recreated) bucket."""
+    """Drop in-memory cache entries for a bucket (choke-point API: called
+    on every object mutation in it, and on bucket delete/recreate)."""
+    global _MC_SEQ
     with _MC_LOCK:
-        for ck in [k for k in _MC_MEM if k[1] == bucket]:
+        _MC_SEQ += 1
+        _MC_BSEQ[bucket] = _MC_SEQ
+        if len(_MC_BSEQ) > 4096:
+            _MC_BSEQ.clear()  # seqs are global-monotonic: a forgotten
+            # bucket re-registers at a HIGHER seq on its next mutation,
+            # and _mc_bucket_seq falling back to 0 only rejects stores
+        victims = [k for k in _MC_MEM if k[1] == bucket]
+        for ck in victims:
             del _MC_MEM[ck]
+        _MC_STATS["invalidations"] += len(victims)
+
+
+def clear_metacache() -> int:
+    """Admin cache/clear: drop every in-memory listing entry."""
+    with _MC_LOCK:
+        n = len(_MC_MEM)
+        _MC_MEM.clear()
+    return n
+
+
+def metacache_stats() -> dict:
+    with _MC_LOCK:
+        return dict(_MC_STATS, entries=len(_MC_MEM))
+
+
+def _mc_mem_lookup(es, bucket: str, prefix: str) -> list[str] | None:
+    """Fresh in-memory key list for (bucket, prefix), else None. Unlike
+    ``_metacache_keys`` this never reads the persisted copy or builds —
+    it is the zero-I/O fast path for repeated first-page scans."""
+    from ..cache import core as cache_core
+
+    ttl = _mc_ttl()
+    if ttl <= 0 or bucket.startswith(SYSTEM_BUCKET) or not cache_core.enabled():
+        return None
+    now = time.time()
+    ck = (id(es), bucket, prefix)
+    with _MC_LOCK:
+        hit = _MC_MEM.get(ck)
+        if hit and hit[1] is not None and now - hit[0] < ttl and hit[2]() is es:
+            _MC_STATS["hits"] += 1
+            return hit[1]
+    return None
+
+
+def _mc_mem_store(es, bucket: str, prefix: str, keys: list[str],
+                  seq0: int) -> None:
+    """Memoize a fully-consumed walk so the NEXT scan of this prefix is
+    zero-I/O (in-memory only; the persisted tier stays owned by the
+    pagination builder in ``_metacache_keys``). ``seq0`` is the bucket's
+    invalidation sequence at WALK START: a mutation that landed mid-walk
+    rejects the store — the walk may predate the new key, and memoizing
+    it with a fresh timestamp would hide the key for a whole TTL."""
+    from ..cache import core as cache_core
+
+    ttl = _mc_ttl()
+    if ttl <= 0 or bucket.startswith(SYSTEM_BUCKET) or not cache_core.enabled():
+        return
+    if len(keys) > _mc_max_keys():
+        return
+    now = time.time()
+    with _MC_LOCK:
+        if _MC_BSEQ.get(bucket, 0) != seq0:
+            return  # invalidated while walking: not trustworthy
+        _mc_evict(now, ttl)
+        _MC_MEM[(id(es), bucket, prefix)] = (now, list(keys), weakref.ref(es))
+        _MC_STATS["stores"] += 1
 
 
 def _mc_evict(now: float, ttl: float) -> None:
@@ -113,7 +197,11 @@ def _metacache_keys(es, bucket: str, prefix: str) -> list[str] | None:
         _mc_evict(now, ttl)
         hit = _MC_MEM.get(ck)
     if hit and now - hit[0] < ttl and hit[2]() is es:
+        with _MC_LOCK:
+            _MC_STATS["hits"] += 1
         return hit[1]
+    with _MC_LOCK:
+        _MC_STATS["misses"] += 1
     obj_key = (
         f"buckets/{bucket}/.metacache/"
         f"{hashlib.sha1(prefix.encode()).hexdigest()}.json"
@@ -179,14 +267,30 @@ def list_objects(
         return len(out.objects) + len(out.prefixes) >= max_keys
 
     key_source: Iterator[str] | list[str] | None = None
+    capture: list[str] | None = None
     if marker:
         # continuation page: reuse (or build once) the cached key stream
         # instead of re-walking every drive per page
         key_source = _metacache_keys(es, bucket, prefix)
+    else:
+        # repeated first-page scan: a fresh prior walk serves in-memory
+        key_source = _mc_mem_lookup(es, bucket, prefix)
+    cap_seq0 = 0
     if key_source is None:
         key_source = _merged_keys(es, bucket, prefix)
+        if not marker:
+            # capture the walk; if this page consumes it COMPLETELY (no
+            # truncation) the keys are the full prefix listing — cache
+            # them for free so the next scan is zero-I/O
+            capture = []
+            cap_seq0 = _mc_bucket_seq(bucket)
 
+    cap_max = _mc_max_keys()
     for raw_key in key_source:
+        if capture is not None:
+            capture.append(raw_key)
+            if len(capture) > cap_max:
+                capture = None
         key = decode_dir_object(raw_key)
         if delimiter:
             rest = key[len(prefix) :]
@@ -242,4 +346,6 @@ def list_objects(
         oi.name = key
         out.objects.append(oi)
         last_emitted = key
+    if capture is not None:
+        _mc_mem_store(es, bucket, prefix, capture, cap_seq0)
     return out

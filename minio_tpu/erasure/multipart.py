@@ -201,6 +201,9 @@ class MultipartManager:
                 disk.delete(MP_VOLUME, prefix, recursive=True)
             except Exception:  # noqa: BLE001
                 pass
+        # recursive delete bypassed delete_object: drop every cached
+        # upload/part record under the prefix through the choke point
+        self.es.cache.invalidate_prefix(MP_VOLUME, prefix)
 
     # -- completion ------------------------------------------------------------
 
@@ -376,6 +379,10 @@ class MultipartManager:
             reduce_quorum_errs(errs, write_q)
         finally:
             mtx.unlock()
+        # the commit replaced the live version: write-through invalidation
+        # outside the lock (the cross-node broadcast must not inflate
+        # lock hold), before the complete response returns
+        self.es.cache.invalidate_object(bucket, obj)
         self._cleanup(bucket, obj, upload_id)
         oi = self.es._to_object_info(bucket, obj, fi)
         oi.parts = len(parts)

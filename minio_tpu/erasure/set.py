@@ -176,6 +176,11 @@ class ErasureSet:
         # read-path degradation hook (MRF heal-on-read, reference cmd/mrf.go)
         self.on_degraded = None
         self._bucket_cache: dict[str, float] = {}
+        # quorum-coherent caching layer (cache/): FileInfo + hot-object
+        # tiers; every mutation below invalidates through its choke point
+        from ..cache import SetCache
+
+        self.cache = SetCache(self)
 
     # -- helpers -----------------------------------------------------------
 
@@ -237,6 +242,7 @@ class ErasureSet:
 
     def delete_bucket(self, bucket: str, force: bool = False) -> None:
         self._bucket_cache.pop(bucket, None)
+        self.cache.invalidate_bucket(bucket)
         res = self._parallel(lambda d: d.delete_vol(bucket, force=force))
         errs = [e for _, e in res]
         for e in errs:
@@ -294,6 +300,23 @@ class ErasureSet:
         fi = find_file_info_in_quorum(metas, read_q)
         return fi, metas, read_q, write_q
 
+    def _cached_fileinfo(
+        self, bucket: str, obj: str, version_id: str
+    ) -> tuple[FileInfo, list[FileInfo | None]]:
+        """Read-path quorum metadata via the FileInfo cache: hot keys skip
+        the N-drive fan-out; concurrent misses singleflight one quorum
+        read (read_data=True so GET and HEAD share one entry). Mutation
+        paths keep calling ``_quorum_fileinfo`` directly — they read
+        under the write lock and must see authoritative state."""
+
+        def load():
+            fi, metas, _, _ = self._quorum_fileinfo(
+                bucket, obj, version_id, read_data=True
+            )
+            return fi, metas
+
+        return self.cache.fileinfo(bucket, obj, version_id, load)
+
     # -- put ---------------------------------------------------------------
 
     def put_object(
@@ -345,12 +368,21 @@ class ErasureSet:
                     or len(data) > (8 << 20)
                 if long_running:
                     mtx.start_refresher(write=True)
-                return self._put_object_locked(
+                oi = self._put_object_locked(
                     bucket, obj, data, user_defined, version_id, versioned,
                     parity, distribution, allow_inline, lock=mtx,
                 )
             finally:
                 mtx.unlock()
+            # write-through invalidation AFTER the lock releases but
+            # BEFORE the PUT returns: the cross-node broadcast (seconds
+            # on a blackholed peer) must never inflate lock hold time,
+            # and a reader overlapping this window may legitimately
+            # serve the pre-overwrite version — the PUT hasn't returned.
+            # Loaders racing this are rejected by the cache's
+            # invalidation-sequence guard.
+            self.cache.invalidate_object(bucket, obj)
+            return oi
 
     def _put_object_locked(
         self,
@@ -646,7 +678,7 @@ class ErasureSet:
     # -- get ---------------------------------------------------------------
 
     def get_object_info(self, bucket: str, obj: str, version_id: str = "") -> ObjectInfo:
-        fi, *_ = self._quorum_fileinfo(bucket, obj, version_id)
+        fi, _ = self._cached_fileinfo(bucket, obj, version_id)
         if fi.deleted:
             if not version_id:
                 raise ObjectNotFound(f"{bucket}/{obj}")
@@ -657,7 +689,22 @@ class ErasureSet:
         self, bucket: str, obj: str, version_id: str = ""
     ) -> tuple[ObjectInfo, "ObjectHandle"]:
         """One quorum metadata read under a namespace read lock; the handle
-        serves any number of ranged reads without re-reading metadata."""
+        serves any number of ranged reads without re-reading metadata.
+        Hot objects short-circuit both: a data-cache hit serves an
+        immutable verified snapshot from memory — no lock, no metadata
+        fan-out, no shard I/O (invalidation through the cache choke point
+        happens under the writer's lock BEFORE it releases, so any entry
+        found here was the live version when the lookup happened)."""
+        hit = self.cache.data_get(bucket, obj, version_id)
+        if hit is not None:
+            fi, data = hit
+            from ..cache.core import span_lookup
+
+            span_lookup("object", bucket, obj, True)
+            return (
+                self._to_object_info(bucket, obj, fi),
+                CachedObjectHandle(fi, data),
+            )
         with obs.span(
             obs.TYPE_INTERNAL, "erasure.open_object", bucket=bucket, object=obj
         ):
@@ -665,9 +712,7 @@ class ErasureSet:
             if not _lock_dyn(mtx, write=False):
                 raise QuorumError(f"namespace read lock timeout on {bucket}/{obj}")
             try:
-                fi, metas, _, _ = self._quorum_fileinfo(
-                    bucket, obj, version_id, read_data=True
-                )
+                fi, metas = self._cached_fileinfo(bucket, obj, version_id)
                 if fi.deleted:
                     raise ObjectNotFound(f"{bucket}/{obj}")
                 oi = self._to_object_info(bucket, obj, fi)
@@ -675,7 +720,10 @@ class ErasureSet:
                 # reference holds GetObject's lock until the reader closes)
                 # and is refreshed during long streams; the TTL backstops
                 # abandoned handles
-                return oi, ObjectHandle(self, bucket, obj, fi, metas, mutex=mtx)
+                return oi, ObjectHandle(
+                    self, bucket, obj, fi, metas, mutex=mtx,
+                    requested_vid=version_id,
+                )
             except BaseException:
                 # everything up to handle construction releases on failure;
                 # a raise after lock ownership transferred would
@@ -1009,12 +1057,14 @@ class ErasureSet:
                             got[bi][idx] = f.result()
                         except (errors.FileCorrupt, errors.FileNotFound,
                                 errors.DiskNotFound, errors.DiskFull,
-                                OSError):
+                                errors.VolumeNotFound, OSError):
                             # DiskNotFound covers a circuit that opened
                             # BETWEEN the metadata read and this shard read
-                            # (latency trip, remote retries exhausted): the
-                            # drive is a failed shard to spill around, not
-                            # a reason to fail a GET that still has quorum
+                            # (latency trip, remote retries exhausted);
+                            # VolumeNotFound a bucket that vanished under
+                            # a cached-metadata read: the drive is a
+                            # failed shard to spill around, not a reason
+                            # to fail a GET that still has quorum
                             bad.add(idx)
                             report_degraded()
             finally:
@@ -1102,9 +1152,12 @@ class ErasureSet:
             if not _lock_dyn(mtx, write=True):
                 raise QuorumError(f"namespace write lock timeout on {bucket}/{obj}")
             try:
-                return self._delete_object_locked(bucket, obj, version_id, versioned)
+                oi = self._delete_object_locked(bucket, obj, version_id, versioned)
             finally:
                 mtx.unlock()
+            # invalidate + broadcast outside the lock, before returning
+            self.cache.invalidate_object(bucket, obj)
+            return oi
 
     def _delete_object_locked(
         self, bucket: str, obj: str, version_id: str, versioned: bool
@@ -1169,6 +1222,7 @@ class ErasureSet:
             reduce_quorum_errs(errs, write_q)
         finally:
             mtx.unlock()
+        self.cache.invalidate_object(bucket, obj)
 
     def transition_object(
         self, bucket: str, obj: str, tier: str, remote_key: str,
@@ -1225,6 +1279,7 @@ class ErasureSet:
                         pass
         finally:
             mtx.unlock()
+        self.cache.invalidate_object(bucket, obj)
 
     def restore_object(
         self, bucket: str, obj: str, data: bytes, days: int, version_id: str = ""
@@ -1274,6 +1329,7 @@ class ErasureSet:
             reduce_quorum_errs(errs, write_q)
         finally:
             mtx.unlock()
+        self.cache.invalidate_object(bucket, obj)
 
     def set_object_tags(
         self, bucket: str, obj: str, tags: dict[str, str], version_id: str = ""
@@ -1297,7 +1353,7 @@ class ErasureSet:
     ) -> dict[str, str]:
         import urllib.parse as _up
 
-        fi, *_ = self._quorum_fileinfo(bucket, obj, version_id)
+        fi, _ = self._cached_fileinfo(bucket, obj, version_id)
         raw = fi.metadata.get(self.TAGS_META_KEY, "")
         # empty tag VALUES are legal ("env=") and must round-trip
         return dict(_up.parse_qsl(raw, keep_blank_values=True))
@@ -1332,9 +1388,14 @@ class ErasureSet:
             try:
                 res = self._heal_object_locked(bucket, obj, version_id, lock=mtx)
                 hsp.set(healed=len(res.get("healed", [])))
-                return res
             finally:
                 mtx.unlock()
+            if res.get("healed"):
+                # healed shards change per-drive metadata/frames: cached
+                # metas and bytes re-resolve (fault-injected bitrot/
+                # torn-write repairs flow through here too)
+                self.cache.invalidate_object(bucket, obj)
+            return res
 
     def _heal_object_locked(
         self, bucket: str, obj: str, version_id: str, lock=None
@@ -1572,7 +1633,8 @@ class ObjectHandle:
     _REFRESH_EVERY = 30.0  # seconds; well under the 120s lock TTL
 
     def __init__(
-        self, es: ErasureSet, bucket: str, obj: str, fi: FileInfo, metas, mutex=None
+        self, es: ErasureSet, bucket: str, obj: str, fi: FileInfo, metas,
+        mutex=None, requested_vid: str = "",
     ):
         self.es = es
         self.bucket = bucket
@@ -1580,6 +1642,7 @@ class ObjectHandle:
         self.fi = fi
         self.metas = metas
         self._mutex = mutex
+        self._vid = requested_vid
 
     def close(self) -> None:
         mtx, self._mutex = self._mutex, None
@@ -1603,8 +1666,21 @@ class ObjectHandle:
             self.close()
             raise ValueError("invalid range")
 
+        # full-object reads of eligible hot objects fill the data cache:
+        # bytes below already passed per-block bitrot verification, and
+        # they enter stamped with THIS read's quorum FileInfo, so the
+        # cached copy shares the served copy's etag/bitrot identity.
+        # The token rejects the fill if the object was invalidated while
+        # streaming (a TTL-expired lock racing an overwrite).
+        fill_token = None
+        if offset == 0 and length == self.fi.size:
+            fill_token = self.es.cache.data_admit(
+                self.bucket, self.obj, self._vid, self.fi
+            )
+
         def gen():
             last_refresh = _time.monotonic()
+            collected: list[bytes] | None = [] if fill_token is not None else None
             try:
                 for chunk in self.es._read_range(
                     self.bucket, self.obj, self.fi, self.metas, offset, length
@@ -1613,9 +1689,47 @@ class ObjectHandle:
                     if self._mutex is not None and now - last_refresh > self._REFRESH_EVERY:
                         self._mutex.refresh()
                         last_refresh = now
+                    if collected is not None:
+                        collected.append(bytes(chunk))
                     yield chunk
+                if collected is not None:
+                    self.es.cache.data_put(
+                        self.bucket, self.obj, self._vid, self.fi,
+                        b"".join(collected), fill_token,
+                    )
             finally:
                 if close_when_done:
                     self.close()
+
+        return gen()
+
+
+class CachedObjectHandle:
+    """ObjectHandle-compatible view over a data-cache entry: ranged reads
+    slice an immutable in-memory snapshot; there is no namespace lock to
+    hold or release (the snapshot cannot be torn by concurrent writers —
+    invalidation removed it from the cache before any overwrite
+    completed, and this handle pinned the bytes). Serves the hot-GET
+    path: no metadata fan-out, no shard I/O, no lock RPCs."""
+
+    def __init__(self, fi: FileInfo, data: bytes):
+        self.fi = fi
+        self._data = memoryview(data)
+
+    def close(self) -> None:
+        pass
+
+    def read(
+        self, offset: int = 0, length: int = -1, close_when_done: bool = True
+    ) -> Iterator[bytes]:
+        if length < 0:
+            length = self.fi.size - offset
+        if offset < 0 or offset + length > self.fi.size:
+            raise ValueError("invalid range")
+
+        def gen():
+            mv = self._data[offset:offset + length]
+            for o in range(0, len(mv), 1 << 20):
+                yield mv[o:o + (1 << 20)]
 
         return gen()

@@ -250,7 +250,17 @@ def _finish_from_state(s: "_St", blocks: jax.Array, done: int, n: int) -> jax.Ar
 
     carry, _ = jax.lax.scan(_fin, s.tup(), None, length=10)
     s = _St.of(carry)
-    # modular reduction per 128-bit half -> 4 x uint64 out, little-endian
+    words = jnp.stack(_reduce_words(s), axis=-1)  # [B, 8] uint32, LE order
+    return jax.lax.bitcast_convert_type(words, jnp.uint8).reshape(b, 32)
+
+
+def _reduce_words(s: "_St") -> list:
+    """Modular reduction of a finalized HighwayHash-256 state into the 8
+    little-endian uint32 digest words (m0l, m0h, m1l, m1h per 128-bit
+    half). Pure elementwise ops on whatever shape the state lanes carry
+    — shared by the XLA finisher above and the Pallas mega-kernel's
+    in-kernel epilogue (ops/fused_pallas.py), so the two paths cannot
+    drift."""
     outs = []
     for half in (0, 2):
         a0h, a0l = _add64(s.v0h[half], s.v0l[half], s.m0h[half], s.m0l[half])
@@ -267,8 +277,7 @@ def _finish_from_state(s: "_St", blocks: jax.Array, done: int, n: int) -> jax.Ar
         t2h, t2l = (a2h << 2) | (a2l >> 30), a2l << 2
         m0h, m0l = a0h ^ t1h ^ t2h, a0l ^ t1l ^ t2l
         outs += [m0l, m0h, m1l, m1h]
-    words = jnp.stack(outs, axis=-1)  # [B, 8] uint32, LE word order
-    return jax.lax.bitcast_convert_type(words, jnp.uint8).reshape(b, 32)
+    return outs
 
 
 def _select_hash_fn():

@@ -512,7 +512,7 @@ async def handle_admin(server, request: web.Request, access_key: str, subpath: s
         out = {"id": rid, "rule": spec}
         if q.get("local") != "true":
             out["peers"] = await server._run(
-                _fault_fanout, server, "inject", body, {}
+                _admin_fanout, server, "fault/inject", body, {}
             )
         return _json(out)
     if op == "fault/clear" and m == "POST":
@@ -533,7 +533,7 @@ async def handle_admin(server, request: web.Request, access_key: str, subpath: s
         # reporting success. Only full clears go cluster-wide.
         if q.get("local") != "true" and rid is None:
             out["peers"] = await server._run(
-                _fault_fanout, server, "clear", b"", {}
+                _admin_fanout, server, "fault/clear", b"", {}
             )
         return _json(out)
     if op == "fault/status" and m == "GET":
@@ -547,6 +547,26 @@ async def handle_admin(server, request: web.Request, access_key: str, subpath: s
         st["demotions"] = ds.get("demotions", 0)
         st["promotions"] = ds.get("promotions", 0)
         return _json(st)
+
+    # -- caching layer (cache/: FileInfo + data + listing tiers) -----------
+    if op == "cache/status" and m == "GET":
+        authz("admin:OBDInfo")
+        from .. import cache
+        from ..cache import coherence as cache_coherence
+
+        st = await server._run(cache.aggregate_stats, server.store)
+        st["coherence"] = cache_coherence.stats()
+        return _json(st)
+    if op == "cache/clear" and m == "POST":
+        authz("admin:ServerUpdate")
+        from .. import cache
+
+        out = {"cleared": await server._run(cache.clear_store, server.store)}
+        if q.get("local") != "true":
+            out["peers"] = await server._run(
+                _admin_fanout, server, "cache/clear", b"", {}
+            )
+        return _json(out)
 
     # -- observability ----------------------------------------------------
     if op == "trace" and m == "GET":
@@ -865,14 +885,14 @@ def _peer_trace_pump(server, peer: str, flt, sub, stop) -> None:
                 pass
 
 
-def _fault_fanout(server, action: str, body: bytes, query: dict) -> dict:
-    """Drive a fault inject/clear cluster-wide: replay it on every peer's
-    admin endpoint with ``local=true`` (the same stop-the-recursion
-    convention the profile fan-out uses). Peers are contacted in
-    parallel — chaos tooling must work on a chaotic cluster, where some
-    peers are down and a serial 10 s connect timeout each would make
-    injection itself the outage. A dead peer is a row in the result,
-    not a failure."""
+def _admin_fanout(server, path: str, body: bytes, query: dict) -> dict:
+    """Replay an admin POST on every peer's endpoint with ``local=true``
+    (the same stop-the-recursion convention the profile fan-out uses);
+    drives fault inject/clear and cache clear cluster-wide. Peers are
+    contacted in parallel — chaos tooling must work on a chaotic
+    cluster, where some peers are down and a serial 10 s connect timeout
+    each would make injection itself the outage. A dead peer is a row in
+    the result, not a failure."""
     from concurrent.futures import ThreadPoolExecutor
 
     peers = getattr(server, "peers", None) or []
@@ -890,7 +910,7 @@ def _fault_fanout(server, action: str, body: bytes, query: dict) -> dict:
                 secret_key=server.iam.root_password,
             )
             r = cli.request(
-                "POST", f"/minio/admin/v3/fault/{action}",
+                "POST", f"/minio/admin/v3/{path}",
                 query={**query, "local": "true"}, body=body, timeout=10,
             )
             return peer, "ok" if r.status == 200 else f"HTTP {r.status}"

@@ -189,6 +189,11 @@ class S3Server(
             PoolManager(store) if hasattr(store, "pools") else None
         )
         self.store = store
+        # cache coherence: received grid invalidations apply to THIS
+        # store's per-set caches (cache/coherence.py)
+        from ..cache import coherence as cache_coherence
+
+        cache_coherence.attach(store)
         self.site.load()  # resume a persisted site group across restarts
         # background durability plane: scanner + MRF heal workers
         from ..erasure.background import BackgroundOps
@@ -1103,6 +1108,11 @@ def main(argv: list[str] | None = None) -> None:
     grid = GridServer(token)
     storage_srv.register_grid(grid)
     lock_srv.register_grid(grid)
+    # cache-invalidation broadcasts ride the same muxed storage plane
+    from ..cache import coherence as cache_coherence
+
+    cache_coherence.register_grid(grid)
+    cache_coherence.configure(peers, token)
     grid.register(srv.app)
     from ..cluster import bootstrap as bootmod
 

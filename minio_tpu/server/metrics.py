@@ -883,6 +883,58 @@ def _g_api_fault(server) -> list[str]:
     return out
 
 
+def _g_api_cache(server) -> list[str]:
+    """Caching layer (cache/): per-tier hit/miss/eviction counters, the
+    global byte budget's fill, singleflight collapse counts, and the
+    write-through invalidation/revalidation activity — the series that
+    prove (or disprove) the hot-GET path is actually being served from
+    memory."""
+    from .. import cache
+    from ..cache import coherence as cache_coherence
+
+    out: list[str] = []
+    if server.store is None:
+        return out
+    st = cache.aggregate_stats(server.store)
+    tiers = ("fileinfo", "data", "listing")
+
+    def rows(key: str):
+        return [({"tier": t}, st[t].get(key, 0)) for t in tiers]
+
+    _fmt(out, "minio_cache_enabled", "gauge", [({}, int(st["enabled"]))])
+    _fmt(out, "minio_cache_hits_total", "counter", rows("hits"),
+         "Cache hits per tier")
+    _fmt(out, "minio_cache_misses_total", "counter", rows("misses"))
+    _fmt(out, "minio_cache_evictions_total", "counter",
+         [({"tier": t}, st[t].get("evictions", 0)) for t in ("fileinfo", "data")])
+    _fmt(out, "minio_cache_invalidations_total", "counter", rows("invalidations"))
+    _fmt(out, "minio_cache_revalidations_total", "counter",
+         [({"tier": t}, st[t].get("revalidations", 0)) for t in ("fileinfo", "data")])
+    _fmt(out, "minio_cache_entries", "gauge", rows("entries"))
+    _fmt(out, "minio_cache_bytes", "gauge",
+         [({"tier": "data"}, st["data"].get("bytes", 0)),
+          ({"tier": "total"}, st["bytesTotal"])],
+         "Cached bytes vs the MINIO_TPU_CACHE_MEM_MB budget")
+    _fmt(out, "minio_cache_singleflight_shared_total", "counter",
+         [({}, st["fileinfo"].get("singleflight_shared", 0))],
+         "Concurrent metadata misses that shared one quorum read")
+    _fmt(out, "minio_cache_data_fills_total", "counter",
+         [({}, st["data"].get("fills", 0))])
+    _fmt(out, "minio_cache_epoch", "gauge", [({}, st["epoch"])],
+         "Coherence epoch (bumped on detected lost invalidations)")
+    co = cache_coherence.stats()
+    _fmt(out, "minio_cache_coherence_broadcasts_total", "counter",
+         [({"result": "sent"}, co["sent"]),
+          ({"result": "error"}, co["send_errors"])])
+    _fmt(out, "minio_cache_coherence_received_total", "counter",
+         [({}, co["received"])])
+    _fmt(out, "minio_cache_coherence_gen_gaps_total", "counter",
+         [({}, co["gen_gaps"])],
+         "Generation-sequence gaps observed (lost invalidations healed "
+         "via epoch revalidation)")
+    return out
+
+
 def _g_system_drive_latency(server) -> list[str]:
     """Per-drive, per-op latency (HealthCheckedDisk accounting): lets a
     slow p99 GET be attributed to one laggy disk instead of the whole
@@ -911,6 +963,7 @@ V3_GROUPS = {
     "/api/tpu": _g_api_tpu,
     "/api/trace": _g_api_trace,
     "/api/fault": _g_api_fault,
+    "/api/cache": _g_api_cache,
     "/system/drive/latency": _g_system_drive_latency,
     "/system/network/internode": _g_system_network,
     "/system/drive": _g_system_drive,

@@ -643,3 +643,82 @@ def test_retry_discipline_sleep_inside_handler_flagged():
     """
     fs = run(src, rules=["retry-discipline"])
     assert len(fs) == 1
+
+
+# -- cache-discipline ------------------------------------------------------
+
+BAD_CACHE_DICT_WRITE = """
+    def warm(es, k, v):
+        es.cache._fi[k] = v
+"""
+
+BAD_CACHE_INTERNAL_POP = """
+    def evict(es, k):
+        es.cache._fi.pop(k)
+"""
+
+BAD_CACHE_NON_API_CALL = """
+    def poke(es, k):
+        es.cache.forget(k)
+"""
+
+BAD_METACACHE_WRITE = """
+    def seed(ck, keys):
+        _MC_MEM[ck] = (0, keys, None)
+"""
+
+GOOD_CACHE_CHOKEPOINT = """
+    def mutate(es, bucket, obj):
+        es.cache.invalidate_object(bucket, obj)
+        es.cache.invalidate_prefix(bucket, obj + "/")
+        es.cache.invalidate_bucket(bucket)
+        es.cache.bump_epoch()
+        es.cache.clear()
+"""
+
+GOOD_CACHE_READ_SIDE = """
+    def read(es, bucket, obj, vid, loader, fi, data):
+        fi2, metas = es.cache.fileinfo(bucket, obj, vid, loader)
+        hit = es.cache.data_get(bucket, obj, vid)
+        if es.cache.data_admit(bucket, obj, vid, fi):
+            es.cache.data_put(bucket, obj, vid, fi, data)
+        return es.cache.snapshot()
+"""
+
+
+def test_cache_discipline_flags_internal_dict_write():
+    fs = run(BAD_CACHE_DICT_WRITE, relpath="erasure/set.py",
+             rules=["cache-discipline"])
+    assert fs and all(f.rule == "cache-discipline" for f in fs)
+
+
+def test_cache_discipline_flags_internal_pop():
+    fs = run(BAD_CACHE_INTERNAL_POP, relpath="erasure/set.py",
+             rules=["cache-discipline"])
+    assert fs and "cache internal" in fs[0].message
+
+
+def test_cache_discipline_flags_non_api_method():
+    fs = run(BAD_CACHE_NON_API_CALL, relpath="server/object_handlers.py",
+             rules=["cache-discipline"])
+    assert fs and "non-choke-point" in fs[0].message
+
+
+def test_cache_discipline_flags_metacache_write():
+    fs = run(BAD_METACACHE_WRITE, relpath="server/admin.py",
+             rules=["cache-discipline"])
+    assert fs and "_MC_MEM" in fs[0].message
+
+
+def test_cache_discipline_allows_chokepoint_and_reads():
+    assert run(GOOD_CACHE_CHOKEPOINT, relpath="erasure/set.py",
+               rules=["cache-discipline"]) == []
+    assert run(GOOD_CACHE_READ_SIDE, relpath="erasure/set.py",
+               rules=["cache-discipline"]) == []
+
+
+def test_cache_discipline_exempts_cache_package_and_listing():
+    assert run(BAD_CACHE_DICT_WRITE, relpath="cache/core.py",
+               rules=["cache-discipline"]) == []
+    assert run(BAD_METACACHE_WRITE, relpath="erasure/listing.py",
+               rules=["cache-discipline"]) == []

@@ -1,0 +1,428 @@
+"""Quorum-coherent caching layer (minio_tpu/cache/): FileInfo tier,
+hot-object data tier, singleflight, admission, epoch revalidation,
+write-through invalidation, and the server-facing surfaces (metrics v3
+/api/cache, admin cache/status + cache/clear, QoS accounting)."""
+
+import os
+
+os.environ.setdefault("MINIO_TPU_BACKEND", "numpy")
+
+import hashlib
+import threading
+import time
+
+import pytest
+
+from minio_tpu.cache import core as cache_core
+from minio_tpu.erasure.set import ErasureSet
+from minio_tpu.storage.xlstorage import XLStorage
+
+
+@pytest.fixture(autouse=True)
+def _cache_env(monkeypatch):
+    monkeypatch.setenv("MINIO_TPU_CACHE", "1")
+    monkeypatch.setenv("MINIO_TPU_CACHE_ADMIT_TOUCHES", "2")
+    yield
+
+
+def _rig(tmp_path, n=4):
+    disks = [XLStorage(str(tmp_path / f"d{i}")) for i in range(n)]
+    es = ErasureSet(disks)
+    es.make_bucket("cb")
+    return es, disks
+
+
+# -- FileInfo tier ----------------------------------------------------------
+
+
+def test_fileinfo_hit_skips_drive_fanout(tmp_path, monkeypatch):
+    es, _ = _rig(tmp_path)
+    es.put_object("cb", "k", b"x" * 1000)
+    es.get_object_info("cb", "k")  # miss: quorum fan-out, fills
+    calls = {"n": 0}
+    orig = XLStorage.read_version
+
+    def counting(self, *a, **kw):
+        calls["n"] += 1
+        return orig(self, *a, **kw)
+
+    monkeypatch.setattr(XLStorage, "read_version", counting)
+    oi = es.get_object_info("cb", "k")
+    assert oi.etag == hashlib.md5(b"x" * 1000).hexdigest()
+    assert calls["n"] == 0  # zero drive metadata reads on the hot path
+    assert es.cache.snapshot()["fileinfo"]["hits"] >= 1
+
+
+def test_singleflight_collapses_concurrent_misses(tmp_path, monkeypatch):
+    es, _ = _rig(tmp_path)
+    es.put_object("cb", "sf", b"y" * 500)
+    es.cache.clear()
+    fanouts = {"n": 0}
+    orig = ErasureSet._read_all_fileinfo
+
+    def slow_fanout(self, *a, **kw):
+        fanouts["n"] += 1
+        time.sleep(0.05)  # widen the race window
+        return orig(self, *a, **kw)
+
+    monkeypatch.setattr(ErasureSet, "_read_all_fileinfo", slow_fanout)
+    results = []
+    threads = [
+        threading.Thread(
+            target=lambda: results.append(es.get_object_info("cb", "sf").etag)
+        )
+        for _ in range(8)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(set(results)) == 1 and len(results) == 8
+    assert fanouts["n"] == 1  # one quorum read served all 8
+    assert es.cache.snapshot()["fileinfo"]["singleflight_shared"] >= 1
+
+
+def test_disabled_cache_bypasses(tmp_path, monkeypatch):
+    es, _ = _rig(tmp_path)
+    es.put_object("cb", "off", b"z")
+    monkeypatch.setenv("MINIO_TPU_CACHE", "0")
+    es.get_object_info("cb", "off")
+    es.get_object_info("cb", "off")
+    snap = es.cache.snapshot()["fileinfo"]
+    assert snap["hits"] == 0 and snap["misses"] == 0
+
+
+# -- data tier --------------------------------------------------------------
+
+
+def test_data_cache_admits_on_second_read_and_serves_memory(
+    tmp_path, monkeypatch
+):
+    es, _ = _rig(tmp_path)
+    body = os.urandom(300_000)
+    es.put_object("cb", "hot", body)
+
+    def drain():
+        oi, it = es.get_object("cb", "hot")
+        return oi, b"".join(bytes(c) for c in it)
+
+    drain()  # touch 1: no fill
+    assert cache_core.data_cache().get(es, "cb", "hot", "") is None
+    drain()  # touch 2: admitted + filled
+    reads = {"n": 0}
+    orig = XLStorage.read_file
+
+    def counting(self, *a, **kw):
+        reads["n"] += 1
+        return orig(self, *a, **kw)
+
+    monkeypatch.setattr(XLStorage, "read_file", counting)
+    oi, got = drain()
+    assert got == body and oi.etag == hashlib.md5(body).hexdigest()
+    assert reads["n"] == 0  # zero shard I/O: served from memory
+
+
+def test_data_cache_respects_object_max_and_budget(tmp_path, monkeypatch):
+    monkeypatch.setenv("MINIO_TPU_CACHE_ADMIT_TOUCHES", "1")
+    monkeypatch.setenv("MINIO_TPU_CACHE_OBJECT_MAX", "1000")
+    es, _ = _rig(tmp_path)
+    es.put_object("cb", "big", os.urandom(5000))
+    _, it = es.get_object("cb", "big")
+    b"".join(it)
+    assert cache_core.data_cache().get(es, "cb", "big", "") is None
+
+
+def test_overwrite_delete_tags_invalidate(tmp_path):
+    es, _ = _rig(tmp_path)
+    v1, v2 = os.urandom(2000), os.urandom(3000)
+    es.put_object("cb", "mut", v1)
+    for _ in range(2):
+        _, it = es.get_object("cb", "mut")
+        b"".join(it)
+    assert cache_core.data_cache().get(es, "cb", "mut", "") is not None
+    es.put_object("cb", "mut", v2)  # overwrite -> choke point
+    oi, it = es.get_object("cb", "mut")
+    assert b"".join(bytes(c) for c in it) == v2
+    assert oi.etag == hashlib.md5(v2).hexdigest()
+    # metadata mutation invalidates too
+    es.set_object_tags("cb", "mut", {"a": "1"})
+    assert es.get_object_tags("cb", "mut") == {"a": "1"}
+    es.delete_object("cb", "mut")
+    from minio_tpu.erasure.quorum import ObjectNotFound
+
+    with pytest.raises(ObjectNotFound):
+        es.get_object_info("cb", "mut")
+
+
+def test_heal_flows_through_invalidation(tmp_path):
+    es, _ = _rig(tmp_path)
+    body = os.urandom(200_000)
+    es.put_object("cb", "healme", body)
+    es.get_object_info("cb", "healme")  # cached metas
+    # lose one drive's copy out-of-band, heal it back
+    import shutil
+
+    shutil.rmtree(tmp_path / "d0" / "cb" / "healme")
+    res = es.heal_object("cb", "healme")
+    assert res["healed"]
+    inv = es.cache.snapshot()["fileinfo"]["invalidations"]
+    assert inv >= 1  # heal went through the choke point
+    _, it = es.get_object("cb", "healme")
+    assert b"".join(bytes(c) for c in it) == body
+
+
+# -- epoch / revalidation ---------------------------------------------------
+
+
+def test_epoch_bump_revalidates_instead_of_stale_serve(tmp_path, monkeypatch):
+    es, _ = _rig(tmp_path)
+    es.put_object("cb", "ep", b"e" * 1500)
+    es.get_object_info("cb", "ep")
+    es.cache.bump_epoch()  # as a detected lost-invalidation would
+    fanouts = {"n": 0}
+    orig = ErasureSet._read_all_fileinfo
+
+    def counting(self, *a, **kw):
+        fanouts["n"] += 1
+        return orig(self, *a, **kw)
+
+    monkeypatch.setattr(ErasureSet, "_read_all_fileinfo", counting)
+    oi = es.get_object_info("cb", "ep")  # revalidates: 1-drive check only
+    assert oi.etag == hashlib.md5(b"e" * 1500).hexdigest()
+    assert fanouts["n"] == 0
+    assert es.cache.snapshot()["fileinfo"]["revalidations"] == 1
+
+
+def test_epoch_bump_detects_changed_identity(tmp_path):
+    """Revalidation must DROP an entry whose on-disk identity moved on
+    (the lost-invalidation-was-real case): next read is a fresh quorum
+    read, never the cached version."""
+    es, _ = _rig(tmp_path)
+    es.put_object("cb", "moved", b"m" * 800)
+    es.get_object_info("cb", "moved")
+    # mutate WITHOUT the choke point seeing it: simulate the lost
+    # broadcast by re-priming the cache with the old entry
+    snap_before = dict(es.cache._fi)  # test-only peek
+    es.put_object("cb", "moved", b"M" * 900)
+    es.cache._fi.update(snap_before)  # test-only: force staleness back
+    es.cache.bump_epoch()
+    oi = es.get_object_info("cb", "moved")
+    assert oi.etag == hashlib.md5(b"M" * 900).hexdigest()  # not stale
+
+
+def test_coherence_gen_gap_bumps_epoch(tmp_path, monkeypatch):
+    """Receiver side of the broadcast protocol: a generation hole that
+    outlives the reorder grace (lost invalidation) bumps the epoch on
+    every set cache; reordered delivery of concurrent broadcasts fills
+    its hole and never bumps."""
+    import msgpack
+
+    from minio_tpu.cache import coherence
+
+    es, _ = _rig(tmp_path)
+    coherence.attach(es)
+    monkeypatch.setitem(coherence._last_seen, "nodeA", 0)
+    coherence._holes.pop("nodeA", None)
+
+    def msg(gen, obj="o"):
+        return msgpack.packb(["nodeA", gen, 0, 0, "cb", obj, "obj"])
+
+    # reorder tolerance: 5 arrives before 3 and 4 (racing send threads);
+    # within the grace window nothing bumps, and late arrivals fill holes
+    e0 = es.cache.snapshot()["epoch"]
+    gaps0 = coherence.stats()["gen_gaps"]
+    coherence._handle(msg(1))
+    coherence._handle(msg(2))
+    coherence._handle(msg(5))
+    assert es.cache.snapshot()["epoch"] == e0
+    coherence._handle(msg(4))
+    coherence._handle(msg(3))
+    coherence._handle(msg(6))
+    assert es.cache.snapshot()["epoch"] == e0
+    assert coherence.stats()["gen_gaps"] == gaps0
+
+    # genuine loss: the hole outlives the grace -> epoch bump
+    monkeypatch.setattr(coherence, "GAP_GRACE_S", 0.0)
+    coherence._handle(msg(9))   # 7 and 8 lost
+    assert es.cache.snapshot()["epoch"] == e0 + 1
+    assert coherence.stats()["gen_gaps"] > gaps0
+
+
+# -- server surfaces --------------------------------------------------------
+
+
+from test_s3_api import ServerThread  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    base = tmp_path_factory.mktemp("cachesrv")
+    st = ServerThread([str(base / f"d{i}") for i in range(4)])
+    yield st
+    st.stop()
+
+
+@pytest.fixture(scope="module")
+def cli(server):
+    from minio_tpu.client import S3Client
+
+    return S3Client(f"127.0.0.1:{server.port}")
+
+
+def test_cache_metrics_and_admin_endpoints(server, cli):
+    import json
+
+    cli.make_bucket("cmb")
+    body = os.urandom(100_000)
+    assert cli.put_object("cmb", "obj", body).status == 200
+    for _ in range(3):
+        g = cli.get_object("cmb", "obj")
+        assert g.status == 200 and g.body == body
+
+    st = json.loads(
+        cli.request("GET", "/minio/admin/v3/cache/status").body
+    )
+    assert st["enabled"]
+    assert st["fileinfo"]["hits"] >= 1
+    assert st["data"]["fills"] >= 1
+    assert "coherence" in st
+
+    text = cli.request("GET", "/minio/metrics/v3/api/cache").body.decode()
+    assert 'minio_cache_hits_total{tier="fileinfo"}' in text
+    assert 'minio_cache_bytes{tier="data"}' in text
+    assert "minio_cache_singleflight_shared_total" in text
+    assert "minio_cache_epoch" in text
+
+    r = cli.request("POST", "/minio/admin/v3/cache/clear")
+    assert r.status == 200
+    assert json.loads(r.body)["cleared"] >= 1
+    st = json.loads(cli.request("GET", "/minio/admin/v3/cache/status").body)
+    assert st["fileinfo"]["entries"] == 0
+
+    # cleared but still correct
+    g = cli.get_object("cmb", "obj")
+    assert g.status == 200 and g.body == body
+
+
+def test_cache_hits_still_pass_qos_accounting(server, cli):
+    """QoS interaction regression: a GET served from the data cache must
+    still pass admission control and land in the last-minute latency
+    ring — a hit that bypassed `_entry` accounting would silently skew
+    /api/qos (and let cached traffic evade SlowDown caps)."""
+    cli.make_bucket("qcb")
+    body = os.urandom(50_000)
+    assert cli.put_object("qcb", "q", body).status == 200
+    for _ in range(3):  # ensure at least one request is a pure cache hit
+        assert cli.get_object("qcb", "q").body == body
+
+    srv = server.srv
+    # _entry's accounting runs after the response hit the wire; let the
+    # warm-up requests' finally blocks land before sampling
+    time.sleep(0.3)
+    adm_before = srv.qos.admission.snapshot()["s3"]["admitted"]
+    lm_before = srv.qos.last_minute.totals().get("GetObject", {}).get("count", 0)
+    data_hits_before = cache_core.data_cache().stats.hits
+
+    assert cli.get_object("qcb", "q").body == body  # cache-hit GET
+    time.sleep(0.3)
+
+    assert cache_core.data_cache().stats.hits > data_hits_before
+    assert srv.qos.admission.snapshot()["s3"]["admitted"] == adm_before + 1
+    lm_after = srv.qos.last_minute.totals().get("GetObject", {}).get("count", 0)
+    assert lm_after == lm_before + 1
+
+
+def test_store_skipped_when_invalidated_during_load(tmp_path):
+    """Review regression: a lock-free miss (HEAD/tags hold no namespace
+    lock) whose loader races a concurrent overwrite+invalidation must
+    serve its result but never CACHE it — caching would pin
+    pre-overwrite metadata that nothing will invalidate again."""
+    es, _ = _rig(tmp_path)
+    es.put_object("cb", "race", b"r" * 1000)
+    es.cache.clear()
+
+    def loader():
+        fi, metas, _, _ = es._quorum_fileinfo("cb", "race", "", read_data=True)
+        # the overwrite's invalidation lands while the loader is mid-read
+        es.cache.invalidate_object("cb", "race")
+        return fi, metas
+
+    fi, _ = es.cache.fileinfo("cb", "race", "", loader)
+    assert fi.size == 1000  # caller still gets the loader's answer
+    assert es.cache.snapshot()["fileinfoEntries"] == 0  # but nothing cached
+
+
+def test_bucket_delete_broadcasts_to_peers(tmp_path, monkeypatch):
+    """Review regression: bucket deletion must ride the coherence
+    broadcast like object invalidations, or peers keep serving cached
+    objects of a deleted bucket."""
+    from minio_tpu.cache import coherence
+
+    es, _ = _rig(tmp_path)
+    calls = []
+    monkeypatch.setattr(
+        coherence, "broadcast_invalidate",
+        lambda *a, **kw: calls.append((a, kw)),
+    )
+    es.put_object("cb", "o", b"x")
+    es.delete_bucket("cb", force=True)
+    assert any(kw.get("kind") == "bucket" for _, kw in calls), calls
+
+
+def test_revalidation_needs_quorum_intersection(tmp_path, monkeypatch):
+    """Review regression: revalidation probes parity+1 drives and ALL
+    must match — one lagging drive (down during the overwrite) can never
+    re-certify a stale entry by itself."""
+    es, _ = _rig(tmp_path)  # 4 drives, parity 2 -> probes 3
+    es.put_object("cb", "lag", b"l" * 1200)
+    es.get_object_info("cb", "lag")  # cached
+    ent = next(iter(es.cache._fi.values()))  # test-only peek
+    stale_stamp = ent.stamp
+
+    # overwrite; then force the stale entry back (simulated lost
+    # invalidation) and make drive 0 "lag" by answering with the OLD
+    # version while every other drive reports the new one
+    es.put_object("cb", "lag", b"L" * 1300)
+    import copy as _copy
+
+    old_fi = _copy.deepcopy(ent.fi)
+    orig = XLStorage.read_version
+    first_disk = es.disks[0]
+
+    def lagging(self, volume, path, version_id="", read_data=False):
+        m = orig(self, volume, path, version_id, read_data=read_data)
+        inner = getattr(first_disk, "disk", first_disk)
+        base = getattr(inner, "disk", inner)
+        if self is base and path == "lag":
+            m.mod_time, m.data_dir = stale_stamp  # drive 0 lags
+        return m
+
+    monkeypatch.setattr(XLStorage, "read_version", lagging)
+    key = ("cb", "lag", "")
+    from minio_tpu.cache.core import _FiEntry
+
+    es.cache._fi[key] = _FiEntry(old_fi, [old_fi] * 4, es.cache._epoch, 0)
+    es.cache._by_obj[("cb", "lag")] = {key}
+    es.cache.bump_epoch()
+    oi = es.get_object_info("cb", "lag")
+    import hashlib as _hl
+
+    assert oi.etag == _hl.md5(b"L" * 1300).hexdigest()  # not re-certified
+
+
+def test_data_fill_rejected_if_invalidated_mid_stream(tmp_path, monkeypatch):
+    """Review regression (data tier): a fill whose object was
+    invalidated while the reader streamed (TTL-expired lock racing an
+    overwrite) must be discarded — the same serve-but-never-store rule
+    the FileInfo tier applies."""
+    monkeypatch.setenv("MINIO_TPU_CACHE_ADMIT_TOUCHES", "1")
+    es, _ = _rig(tmp_path)
+    body = os.urandom(200_000)
+    es.put_object("cb", "stream", body)
+    oi, h = es.open_object("cb", "stream")
+    it = h.read()
+    got = [next(it)]  # streaming started: fill token already captured
+    es.cache.invalidate_object("cb", "stream")  # overwrite landed
+    got.extend(it)
+    assert b"".join(bytes(c) for c in got) == body  # served fine
+    assert cache_core.data_cache().get(es, "cb", "stream", "") is None

@@ -492,6 +492,8 @@ def _spawn(port: int, specs: list[str]) -> subprocess.Popen:
         "MINIO_PROMETHEUS_AUTH_TYPE": "public",
         # fast breaker recovery so post-chaos convergence fits a test
         "MINIO_TPU_DRIVE_COOLDOWN_S": "1",
+        # deterministic data-cache warm-up for the cross-invalidation test
+        "MINIO_TPU_CACHE_ADMIT_TOUCHES": "1",
     })
     env.pop("JAX_PLATFORMS", None)
     return subprocess.Popen(
@@ -609,3 +611,131 @@ def test_cluster_chaos_partition_schedule(cluster2):
     assert g.status == 200 and g.body == b"back"
     g = cli1.get_object("ckt", "survivor")
     assert g.status == 200 and g.body == body
+
+
+# ---------------------------------------------------------------------------
+# cache-coherence schedules (cache/ tentpole: no stale serves, ever)
+# ---------------------------------------------------------------------------
+
+
+def test_cache_coherence_schedule(tmp_path, monkeypatch):
+    """Injected bitrot + heal + overwrite under concurrent cached GETs:
+    every response's body must hash to its own etag (no torn/mixed
+    serves), every served version must be one that was legitimately live
+    during the read, and once a mutation RETURNS every subsequent read
+    observes it — cached or not."""
+    import hashlib
+    import threading
+
+    monkeypatch.setenv("MINIO_TPU_CACHE", "1")
+    monkeypatch.setenv("MINIO_TPU_CACHE_ADMIT_TOUCHES", "1")
+    es, disks = _rig(tmp_path)
+    v1 = os.urandom(120_000)
+    es.put_object("cbkt", "coh", v1)
+    for _ in range(2):  # warm FileInfo + data tiers
+        _, it = es.get_object("cbkt", "coh")
+        b"".join(it)
+    from minio_tpu.cache import core as cache_core
+
+    assert cache_core.data_cache().get(es, "cbkt", "coh", "") is not None
+
+    expected = {hashlib.md5(v1).hexdigest(): v1}
+    problems: list[str] = []
+    stop = threading.Event()
+    mu = threading.Lock()
+
+    def reader():
+        while not stop.is_set():
+            try:
+                oi, it = es.get_object("cbkt", "coh")
+                body = b"".join(bytes(c) for c in it)
+            except Exception as e:  # noqa: BLE001
+                with mu:
+                    problems.append(f"read failed: {e!r}")
+                return
+            h = hashlib.md5(body).hexdigest()
+            with mu:
+                if h != oi.etag:
+                    problems.append(f"etag/bytes mismatch: {oi.etag} vs {h}")
+                    return
+                if expected.get(h) != body:
+                    problems.append(f"unknown version served: {h}")
+                    return
+
+    threads = [threading.Thread(target=reader) for _ in range(6)]
+    for t in threads:
+        t.start()
+    try:
+        # 1) bitrot one drive's shard reads: cached serves are immune,
+        #    uncached reads must decode around the corruption
+        fault.inject({
+            "boundary": "storage", "mode": "bitrot",
+            "target": disks[0].endpoint, "op": "read_file", "seed": 9,
+        })
+        time.sleep(0.15)
+        # 2) lose another drive's copy outright, then heal: the rebuild
+        #    must invalidate through the choke point
+        import shutil
+
+        shutil.rmtree(tmp_path / "d1" / "cbkt" / "coh")
+        res = es.heal_object("cbkt", "coh")
+        assert res["healed"], res
+        time.sleep(0.1)
+        # 3) overwrite: v2 becomes live; in-flight readers may still
+        #    finish serving v1 (they began before the write completed)
+        v2 = os.urandom(90_000)
+        with mu:
+            expected[hashlib.md5(v2).hexdigest()] = v2
+        es.put_object("cbkt", "coh", v2)
+        time.sleep(0.15)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    assert not problems, problems
+
+    # determinism: the overwrite returned above, so only v2 may be
+    # served now — first a fresh read, then the re-warmed cached path
+    for _ in range(3):
+        oi, it = es.get_object("cbkt", "coh")
+        body = b"".join(bytes(c) for c in it)
+        assert body == v2, "stale bytes served after overwrite returned"
+        assert oi.etag == hashlib.md5(v2).hexdigest(), "stale etag served"
+    inv = es.cache.snapshot()["fileinfo"]["invalidations"]
+    assert inv >= 2  # heal + overwrite both flowed through the choke point
+
+
+def test_cluster_cache_cross_invalidation(cluster2):
+    """2-node coherence: node 2 serves an object from its cache; node 1
+    overwrites it. The write returns only after the grid invalidation
+    broadcast, so node 2 must serve the new bytes IMMEDIATELY after the
+    PUT response — even with injected delay on the invalidation RPC."""
+    import hashlib
+
+    cli1, cli2 = cluster2["cli1"], cluster2["cli2"]
+    body1 = os.urandom(100_000)
+    assert cli1.put_object("ckt", "xinv", body1).status == 200
+    for _ in range(3):  # warm node 2's tiers (admit touches = 1 in _spawn)
+        g = cli2.get_object("ckt", "xinv")
+        assert g.status == 200 and g.body == body1
+    st = json.loads(cli2.request("GET", "/minio/admin/v3/cache/status").body)
+    assert st["fileinfo"]["hits"] >= 1, st
+
+    # slow the invalidation RPC: a PUT must wait it out, not serve stale
+    r = cli1.request(
+        "POST", "/minio/admin/v3/fault/inject", query={"local": "true"},
+        body=json.dumps({
+            "boundary": "network", "mode": "delay", "latency_ms": 50,
+            "op": "cache.invalidate", "seed": 41,
+        }).encode(),
+    )
+    assert r.status == 200, r.body
+    body2 = os.urandom(80_000)
+    assert cli1.put_object("ckt", "xinv", body2).status == 200
+    g = cli2.get_object("ckt", "xinv")
+    assert g.status == 200
+    assert g.body == body2, "node 2 served stale bytes after cross-node PUT"
+    assert g.headers["etag"].strip('"') == hashlib.md5(body2).hexdigest()
+    assert cli1.request("POST", "/minio/admin/v3/fault/clear").status == 200
+    st = json.loads(cli2.request("GET", "/minio/admin/v3/cache/status").body)
+    assert st["coherence"]["received"] >= 1, st

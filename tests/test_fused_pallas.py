@@ -132,3 +132,42 @@ def test_reconstruct_and_hash_uses_fused_path():
             assert (rebuilt[b, mi] == full[b][idx]).all()
         want = hash256_batch_numpy(np.stack([full[b][i] for i in missing]))
         assert (digs[b] == want).all()
+
+
+def test_finalization_epilogue_matches_numpy_golden():
+    """The mega-kernel's in-kernel epilogue (fori_loop permute rounds +
+    `_reduce_words` + word assembly — the math that replaced the XLA
+    finalization after pallas_call) must be byte-identical to the XLA
+    finisher AND the independent numpy HighwayHash. Runs on CPU: the
+    epilogue is pure elementwise jnp, the same ops the kernel traces."""
+    import jax
+    import jax.numpy as jnp
+
+    from minio_tpu.ops import bitrot_jax as bj
+    from minio_tpu.ops.highwayhash import MINIO_KEY, hash256_batch_numpy
+
+    rng = np.random.default_rng(7)
+    blocks = rng.integers(0, 256, size=(16, 4 * 32), dtype=np.uint8)
+    want = np.asarray(hash256_batch_numpy(list(blocks)))
+    # the pre-existing XLA path (scan finalization) — the old epilogue
+    got_old = np.asarray(bj.hash256_blocks(jnp.asarray(blocks)))
+    assert (got_old == want).all()
+    # the new in-kernel epilogue math, exactly as _build's last grid
+    # step runs it: 10 fori_loop permute rounds, then _reduce_words
+    s = bj._init_state(16, MINIO_KEY)
+    hi, lo = bj._load_packets(jnp.asarray(blocks))
+
+    def step(carry, x):
+        return bj._update(bj._St.of(carry), x[0], x[1]).tup(), ()
+
+    carry, _ = jax.lax.scan(step, s.tup(), (hi, lo))
+    state = jax.lax.fori_loop(
+        0, 10,
+        lambda _i, st: bj._permute_and_update(bj._St.of(st)).tup(),
+        carry,
+    )
+    words = jnp.stack(bj._reduce_words(bj._St.of(state)), axis=-1)
+    got_new = np.asarray(
+        jax.lax.bitcast_convert_type(words, jnp.uint8).reshape(16, 32)
+    )
+    assert (got_new == want).all()

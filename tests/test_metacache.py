@@ -68,11 +68,39 @@ def test_cache_expires_and_sees_new_objects(es, monkeypatch):
     assert "docs/k999" in keys
 
 
-def test_unpaginated_listing_never_builds_cache(es):
+def test_repeated_first_page_scan_reuses_walk(es, monkeypatch):
+    """A fully-consumed (un-truncated) first-page walk memoizes its keys
+    for free; the NEXT scan of the same prefix walks zero drives. A write
+    into the bucket invalidates through the cache choke point, so
+    put -> list always sees the new key on this node."""
     listing._MC_MEM.clear()
     res = listing.list_objects(es, "mcb", prefix="docs/", max_keys=1000)
     assert len(res.objects) == 25
-    assert not listing._MC_MEM
+    assert listing._MC_MEM  # captured for the next scan
+
+    walks = {"n": 0}
+    orig = XLStorage.walk_dir
+
+    def counting(self, bucket, base):
+        walks["n"] += 1
+        return orig(self, bucket, base)
+
+    monkeypatch.setattr(XLStorage, "walk_dir", counting)
+    res = listing.list_objects(es, "mcb", prefix="docs/", max_keys=1000)
+    assert len(res.objects) == 25
+    assert walks["n"] == 0  # served from the memoized walk
+
+    # coherence: a PUT drops the bucket's listing entries immediately
+    es.put_object("mcb", "docs/knew", b"x")
+    res = listing.list_objects(es, "mcb", prefix="docs/", max_keys=1000)
+    assert "docs/knew" in [o.name for o in res.objects]
+
+
+def test_truncated_first_page_does_not_memoize(es):
+    listing._MC_MEM.clear()
+    res = listing.list_objects(es, "mcb", prefix="docs/", max_keys=4)
+    assert res.is_truncated
+    assert not listing._MC_MEM  # partial walk: nothing trustworthy to keep
 
 
 def test_too_big_verdict_memoized(es, monkeypatch):
