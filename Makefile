@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: check test docs
+.PHONY: check test docs bench-smoke
 
 check:
 	$(PY) -m minio_tpu.analysis minio_tpu/ --strict
@@ -14,3 +14,9 @@ test:
 
 docs:
 	$(PY) -m minio_tpu.analysis --gen-config-docs docs/CONFIG.md
+
+# harness-stays-runnable gate: the closed-loop load harness end to end
+# (worker pool, mixed zipf traffic, heal flood, QoS guard metrics) in
+# seconds — full runs write BENCH json, this just proves it still works
+bench-smoke:
+	MINIO_TPU_BACKEND=numpy $(PY) benchmarks/bench_load.py --quick

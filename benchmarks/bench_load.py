@@ -1,0 +1,536 @@
+"""Closed-loop production load harness (round 7: many-core data plane).
+
+Drives a REAL server process (optionally an SO_REUSEPORT worker pool,
+``MINIO_TPU_WORKERS``) with production-shaped traffic and emits the
+numbers PERF.md and BENCH_r07.json track:
+
+- **Mixed closed-loop phase**: N virtual clients, each a coroutine that
+  issues its next request only after the previous one completes (closed
+  loop — offered load adapts to service rate instead of queueing without
+  bound). Op mix GET/PUT/HEAD/LIST over a zipf-hot keyspace, with the
+  background scanner/ILM running and induced heal work pending, so QoS
+  admission, the cache tiers, hedged reads, and the heal plane are
+  exercised TOGETHER. Reports per-class p50/p99 latency, IOPS, and
+  aggregate throughput.
+- **Large-PUT segment**: few concurrent 64 MiB streaming PUTs at EC 8+8
+  over 16 drives — the VERDICT r5 top-gap metric (target >= 350 MiB/s
+  multi-core; the single-core wall was ~200-240 MiB/s).
+- **QoS guard phase**: foreground GET p99 with a background heal flood
+  off vs on, at high connection counts (>= 5k full mode), plus the
+  ``fg_deferred_behind_bg`` invariant read from the pool-aggregated
+  metrics — the "bg must ride leftover capacity only" proof under real
+  HTTP load rather than the dispatcher microbench in bench.py.
+
+Worker count and nproc are recorded in the JSON so cross-host numbers
+are never compared blindly.
+
+Usage:
+    python benchmarks/bench_load.py                    # full run
+    python benchmarks/bench_load.py --quick            # seconds (CI gate)
+    python benchmarks/bench_load.py --workers 1,2      # compare pool sizes
+    python benchmarks/bench_load.py --out BENCH_r07.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import bisect
+import json
+import os
+import random
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("MINIO_TPU_BACKEND", "numpy")
+
+from minio_tpu.client import S3Client  # noqa: E402
+from minio_tpu.server.signature import sign_request  # noqa: E402
+
+MIB = 1 << 20
+BUCKET = "loadbkt"
+UNSIGNED = "UNSIGNED-PAYLOAD"
+
+
+# ---------------------------------------------------------------- server
+
+
+class Server:
+    """One server process (pool supervisor when workers > 1) over fresh
+    local drives, EC 8+8 when 16 drives."""
+
+    def __init__(self, base: str, port: int, drives: int, workers: int,
+                 scan_interval: float):
+        self.port = port
+        self.drives = [os.path.join(base, f"d{i}") for i in range(drives)]
+        env = dict(
+            os.environ,
+            MINIO_TPU_WORKERS=str(workers),
+            MINIO_TPU_SCAN_INTERVAL=str(scan_interval),
+            MINIO_COMPRESSION_ENABLE="off",
+        )
+        # the readiness probes below assume the default control-port
+        # layout (port+1000+i); scrub inherited pool identity/overrides
+        # so an operator env can't silently shift the workers elsewhere
+        for k in ("MINIO_TPU_WORKER_INDEX", "MINIO_TPU_WORKER_COUNT",
+                  "MINIO_TPU_WORKER_PORT_BASE"):
+            env.pop(k, None)
+        if drives >= 16:
+            # the default storage class at 16 drives is EC:4; the target
+            # config is EC 8+8
+            env["MINIO_STORAGE_CLASS_STANDARD"] = "EC:8"
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "minio_tpu.server",
+             "--address", f"127.0.0.1:{port}", *self.drives],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT,
+        )
+        # readiness must cover EVERY worker: the shared SO_REUSEPORT port
+        # answers as soon as ONE worker is up, and a request landing on a
+        # still-booting sibling would 503
+        probes = (
+            [S3Client(f"127.0.0.1:{port + 1000 + i}") for i in range(workers)]
+            if workers > 1
+            else [S3Client(f"127.0.0.1:{port}")]
+        )
+        deadline = time.time() + 120
+        pending = list(probes)
+        while pending and time.time() < deadline:
+            still = []
+            for cli in pending:
+                try:
+                    if cli.request("GET", "/", timeout=5).status != 200:
+                        still.append(cli)
+                except Exception:  # noqa: BLE001 — still booting
+                    still.append(cli)
+            pending = still
+            if pending:
+                time.sleep(0.3)
+        if pending:
+            self.stop()
+            raise RuntimeError("server did not become ready")
+
+    def stop(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.send_signal(signal.SIGTERM)
+            try:
+                self.proc.wait(20)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait()
+
+
+# ------------------------------------------------------------- async client
+
+
+class AsyncS3:
+    """Minimal SigV4 asyncio client: one aiohttp session shared by every
+    virtual client (connection pool unbounded — concurrency is set by the
+    closed-loop client count, not by the connector)."""
+
+    def __init__(self, session, host: str, port: int):
+        self.session = session
+        self.base = f"http://{host}:{port}"
+        self.host = host
+        self.port = port
+
+    def _signed(self, method: str, path: str, query: str) -> dict:
+        url = f"{self.base}{path}" + (f"?{query}" if query else "")
+        return sign_request(
+            method, url, {"x-amz-content-sha256": UNSIGNED}, UNSIGNED,
+            "minioadmin", "minioadmin", "us-east-1",
+        )
+
+    async def request(self, method: str, path: str, query: str = "",
+                      body: bytes = b"", read: bool = True):
+        headers = self._signed(method, path, query)
+        url = f"{self.base}{path}" + (f"?{query}" if query else "")
+        async with self.session.request(
+            method, url, data=body if body else None, headers=headers
+        ) as resp:
+            data = await resp.read() if read else b""
+            return resp.status, data
+
+
+ZIPF_ALPHA = 1.1
+
+
+def zipf_cdf(n: int, alpha: float = ZIPF_ALPHA) -> list[float]:
+    w = [1.0 / (i + 1) ** alpha for i in range(n)]
+    total = sum(w)
+    acc, out = 0.0, []
+    for x in w:
+        acc += x / total
+        out.append(acc)
+    return out
+
+
+class Stats:
+    """Per-class latency/bytes accounting for one phase. 503 SlowDown is
+    the admission plane doing its job (bounded latency instead of
+    unbounded queueing) — counted separately from errors, excluded from
+    the latency percentiles, and answered by the virtual client with the
+    Retry-After backoff a real SDK would apply."""
+
+    def __init__(self):
+        self.lat: dict[str, list[float]] = {}
+        self.bytes = 0
+        self.errors = 0
+        self.slowdowns = 0
+        self.ops = 0
+
+    def add(self, cls: str, dt: float, nbytes: int, status: int) -> None:
+        if status == 503:
+            self.slowdowns += 1
+            return
+        self.lat.setdefault(cls, []).append(dt)
+        self.ops += 1
+        self.bytes += nbytes
+        if status != 200:
+            self.errors += 1
+
+    def summary(self, wall: float) -> dict:
+        def pct(xs: list[float], q: float) -> float:
+            xs = sorted(xs)
+            return xs[min(len(xs) - 1, int(len(xs) * q))]
+
+        per_class = {
+            cls: {
+                "count": len(xs),
+                "p50_ms": round(pct(xs, 0.50) * 1e3, 3),
+                "p99_ms": round(pct(xs, 0.99) * 1e3, 3),
+            }
+            for cls, xs in sorted(self.lat.items())
+        }
+        return {
+            "wall_s": round(wall, 2),
+            "iops": round(self.ops / max(wall, 1e-9), 1),
+            "throughput_mibs": round(self.bytes / MIB / max(wall, 1e-9), 1),
+            "errors": self.errors,
+            "slowdowns_503": self.slowdowns,
+            "per_class": per_class,
+        }
+
+
+async def run_mixed(cli: AsyncS3, clients: int, duration: float,
+                    keyspace: int, obj_kb: int, put_frac: float) -> Stats:
+    """Closed-loop mixed GET/PUT/HEAD/LIST phase over a zipf-hot keyspace."""
+    stats = Stats()
+    cdf = zipf_cdf(keyspace)
+    stop_at = time.monotonic() + duration
+    body = os.urandom(obj_kb * 1024)
+
+    async def one_client(cid: int) -> None:
+        rng = random.Random(cid)
+        while time.monotonic() < stop_at:
+            r = rng.random()
+            key = f"o{bisect.bisect_left(cdf, rng.random()):06d}"
+            t0 = time.perf_counter()
+            try:
+                if r < put_frac:  # overwrite a hot key: invalidation churn
+                    st, _ = await cli.request(
+                        "PUT", f"/{BUCKET}/{key}", body=body, read=False
+                    )
+                    stats.add("PUT", time.perf_counter() - t0, len(body), st)
+                elif r < put_frac + 0.60:
+                    st, data = await cli.request("GET", f"/{BUCKET}/{key}")
+                    stats.add("GET", time.perf_counter() - t0, len(data), st)
+                elif r < put_frac + 0.75:
+                    st, _ = await cli.request("HEAD", f"/{BUCKET}/{key}")
+                    stats.add("HEAD", time.perf_counter() - t0, 0, st)
+                else:
+                    st, data = await cli.request(
+                        "GET", f"/{BUCKET}",
+                        query="list-type=2&max-keys=50&prefix=o0",
+                    )
+                    stats.add("LIST", time.perf_counter() - t0, len(data), st)
+                if st == 503:  # SlowDown: back off like a real SDK
+                    await asyncio.sleep(1.0)
+            except Exception:  # noqa: BLE001 — count, keep looping
+                stats.add("ERR", time.perf_counter() - t0, 0, 599)
+
+    t0 = time.monotonic()
+    await asyncio.gather(*(one_client(i) for i in range(clients)))
+    stats.wall = time.monotonic() - t0
+    return stats
+
+
+async def run_get_loop(cli: AsyncS3, clients: int, duration: float,
+                       keyspace: int) -> Stats:
+    """Hot-GET closed loop (QoS guard phase): latency under connection
+    pressure, no writes."""
+    stats = Stats()
+    cdf = zipf_cdf(keyspace)
+    stop_at = time.monotonic() + duration
+
+    async def one_client(cid: int) -> None:
+        rng = random.Random(cid * 7919)
+        while time.monotonic() < stop_at:
+            key = f"o{bisect.bisect_left(cdf, rng.random()):06d}"
+            t0 = time.perf_counter()
+            try:
+                st, data = await cli.request("GET", f"/{BUCKET}/{key}")
+                stats.add("GET", time.perf_counter() - t0, len(data), st)
+                if st == 503:  # SlowDown: back off like a real SDK
+                    await asyncio.sleep(1.0)
+            except Exception:  # noqa: BLE001
+                stats.add("ERR", time.perf_counter() - t0, 0, 599)
+
+    t0 = time.monotonic()
+    await asyncio.gather(*(one_client(i) for i in range(clients)))
+    stats.wall = time.monotonic() - t0
+    return stats
+
+
+async def run_put_throughput(cli: AsyncS3, streams: int, obj_mib: int,
+                             repeats: int) -> float:
+    """Aggregate streaming-PUT MiB/s: `streams` concurrent large PUTs,
+    `repeats` rounds each."""
+    body = os.urandom(obj_mib * MIB)
+
+    async def one(i: int) -> None:
+        for r in range(repeats):
+            st, _ = await cli.request(
+                "PUT", f"/{BUCKET}/big-{i}-{r}", body=body, read=False
+            )
+            assert st == 200, f"big PUT failed: HTTP {st}"
+
+    t0 = time.perf_counter()
+    await asyncio.gather(*(one(i) for i in range(streams)))
+    wall = time.perf_counter() - t0
+    return streams * repeats * obj_mib / wall
+
+
+# ----------------------------------------------------------- qos plumbing
+
+
+def scrape_counter(port: int, series: str, path: str = "/api/qos") -> int:
+    """Sum a counter across workers from the pool-aggregated metrics v3
+    exposition (worker labels sum away). A failed scrape or a missing
+    series raises — the guard invariant must never 'pass' because the
+    measurement silently returned nothing."""
+    cli = S3Client(f"127.0.0.1:{port}")
+    r = cli.request("GET", f"/minio/metrics/v3{path}")
+    assert r.status == 200, f"metrics scrape failed: HTTP {r.status}"
+    total = 0
+    seen = False
+    for line in r.body.decode().splitlines():
+        if line.startswith(series) and not line.startswith("#"):
+            try:
+                total += int(float(line.rsplit(" ", 1)[1]))
+                seen = True
+            except ValueError:
+                pass
+    assert seen, f"series {series} absent from {path} exposition"
+    return total
+
+
+class HealFlood:
+    """Background heal/ILM flood: a thread looping admin heal sweeps
+    (walks + per-object heal over the whole keyspace) while the scanner
+    keeps its own cycle going — the bg pressure the QoS guard phase
+    measures fg p99 against."""
+
+    def __init__(self, port: int):
+        self.cli = S3Client(f"127.0.0.1:{port}")
+        self.stop = threading.Event()
+        self.sweeps = 0
+        self.thread = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self) -> None:
+        while not self.stop.is_set():
+            try:
+                self.cli.request(
+                    "POST", f"/minio/admin/v3/heal/{BUCKET}", timeout=120
+                )
+                self.sweeps += 1
+            except Exception:  # noqa: BLE001 — flood keeps flooding
+                time.sleep(0.2)
+
+    def __enter__(self) -> "HealFlood":
+        self.thread.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop.set()
+        self.thread.join(timeout=150)
+
+
+# ----------------------------------------------------------------- phases
+
+
+async def run_round(port: int, cfg: argparse.Namespace) -> dict:
+    import aiohttp
+
+    conn = aiohttp.TCPConnector(limit=0)
+    timeout = aiohttp.ClientTimeout(total=300)
+    async with aiohttp.ClientSession(
+        connector=conn, timeout=timeout, auto_decompress=False
+    ) as session:
+        cli = AsyncS3(session, "127.0.0.1", port)
+
+        # preload the keyspace (also the heal flood's object population)
+        body = os.urandom(cfg.object_kb * 1024)
+        sem = asyncio.Semaphore(32)
+
+        async def put_one(i: int) -> None:
+            async with sem:
+                st, _ = await cli.request(
+                    "PUT", f"/{BUCKET}/o{i:06d}", body=body, read=False
+                )
+                assert st == 200, f"preload PUT {i}: HTTP {st}"
+
+        t0 = time.monotonic()
+        await asyncio.gather(*(put_one(i) for i in range(cfg.keyspace)))
+        preload_s = time.monotonic() - t0
+
+        # mixed closed loop with scanner/ILM live
+        mixed = await run_mixed(
+            cli, cfg.clients, cfg.duration, cfg.keyspace, cfg.object_kb,
+            put_frac=0.20,
+        )
+
+        # large-PUT aggregate throughput (the EC 8+8 target metric)
+        put_mibs = await run_put_throughput(
+            cli, cfg.put_streams, cfg.put_object_mib, cfg.put_repeats
+        )
+
+        # QoS guard: fg GET p99 with bg heal flood off vs on, at high
+        # connection count; fg_deferred_behind_bg read AFTER, aggregated
+        # over workers
+        qos_off = await run_get_loop(
+            cli, cfg.connections, cfg.qos_duration, cfg.keyspace
+        )
+        with HealFlood(port) as flood:
+            qos_on = await run_get_loop(
+                cli, cfg.connections, cfg.qos_duration, cfg.keyspace
+            )
+            sweeps = flood.sweeps
+        deferred = scrape_counter(
+            port, "minio_tpu_dispatch_fg_deferred_behind_bg_total"
+        )
+
+    off, on = qos_off.summary(qos_off.wall), qos_on.summary(qos_on.wall)
+    return {
+        "preload_s": round(preload_s, 1),
+        "mixed": mixed.summary(mixed.wall),
+        "put_streams": cfg.put_streams,
+        "put_object_mib": cfg.put_object_mib,
+        "put_throughput_mibs": round(put_mibs, 1),
+        "qos": {
+            "connections": cfg.connections,
+            "fg_get_p50_ms_bg_off": off["per_class"].get("GET", {}).get("p50_ms"),
+            "fg_get_p99_ms_bg_off": off["per_class"].get("GET", {}).get("p99_ms"),
+            "fg_get_p50_ms_bg_on": on["per_class"].get("GET", {}).get("p50_ms"),
+            "fg_get_p99_ms_bg_on": on["per_class"].get("GET", {}).get("p99_ms"),
+            "fg_iops_bg_off": off["iops"],
+            "fg_iops_bg_on": on["iops"],
+            "errors_bg_off": off["errors"],
+            "errors_bg_on": on["errors"],
+            "slowdowns_bg_off": off["slowdowns_503"],
+            "slowdowns_bg_on": on["slowdowns_503"],
+            "heal_sweeps_during_flood": sweeps,
+            "fg_deferred_behind_bg": deferred,
+        },
+    }
+
+
+def bench_one_worker_count(workers: int, cfg: argparse.Namespace) -> dict:
+    base = tempfile.mkdtemp(prefix=f"bench-load-w{workers}-")
+    srv = Server(base, cfg.port, cfg.drives, workers,
+                 scan_interval=cfg.scan_interval)
+    try:
+        cli = S3Client(f"127.0.0.1:{cfg.port}")
+        assert cli.make_bucket(BUCKET).status == 200
+        out = asyncio.run(run_round(cfg.port, cfg))
+        out["workers"] = workers
+        return out
+    finally:
+        srv.stop()
+        shutil.rmtree(base, ignore_errors=True)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--workers", default="",
+                    help="comma-separated pool sizes to compare "
+                         "(default: 1,<nproc>; quick: 2)")
+    ap.add_argument("--drives", type=int, default=16)
+    ap.add_argument("--clients", type=int, default=512,
+                    help="closed-loop clients in the mixed phase")
+    ap.add_argument("--connections", type=int, default=5000,
+                    help="closed-loop clients in the QoS guard phase")
+    ap.add_argument("--duration", type=float, default=15.0)
+    ap.add_argument("--qos-duration", type=float, default=12.0)
+    ap.add_argument("--keyspace", type=int, default=512)
+    ap.add_argument("--object-kb", type=int, default=256,
+                    help="mixed-phase object size")
+    ap.add_argument("--put-streams", type=int, default=4)
+    ap.add_argument("--put-object-mib", type=int, default=64)
+    ap.add_argument("--put-repeats", type=int, default=3)
+    ap.add_argument("--scan-interval", type=float, default=30.0)
+    ap.add_argument("--port", type=int, default=19801)
+    ap.add_argument("--out", default="",
+                    help="write the JSON here too (stdout always)")
+    ap.add_argument("--quick", action="store_true",
+                    help="seconds-long smoke (CI harness-stays-runnable "
+                         "gate): tiny keyspace, short phases, one pool size")
+    args = ap.parse_args()
+
+    if args.quick:
+        args.drives = min(args.drives, 8)
+        args.clients = 48
+        args.connections = 128
+        args.duration = 3.0
+        args.qos_duration = 2.5
+        args.keyspace = 48
+        args.object_kb = 64
+        args.put_streams = 2
+        args.put_object_mib = 4
+        args.put_repeats = 2
+        args.scan_interval = 5.0
+    worker_counts = [
+        int(w) for w in (
+            args.workers.split(",") if args.workers
+            else (["2"] if args.quick
+                  else ["1", str(os.cpu_count() or 1)])
+        )
+        if w.strip()
+    ]
+    # dedupe preserving order (nproc may be 1)
+    worker_counts = list(dict.fromkeys(worker_counts))
+
+    runs = []
+    for w in worker_counts:
+        print(f"=== round: {w} worker(s) ===", file=sys.stderr, flush=True)
+        runs.append(bench_one_worker_count(w, args))
+
+    result = {
+        "metric": "load_harness_closed_loop",
+        "nproc": os.cpu_count(),
+        "drives": args.drives,
+        "ec": "8+8" if args.drives >= 16 else "default",
+        "quick": bool(args.quick),
+        "runs": runs,
+    }
+    by_w = {r["workers"]: r["put_throughput_mibs"] for r in runs}
+    if 1 in by_w and len(by_w) > 1:
+        best_w = max(w for w in by_w if w != 1)
+        result["put_scaling_vs_1_worker"] = round(
+            by_w[best_w] / max(by_w[1], 1e-9), 2
+        )
+    line = json.dumps(result)
+    print(line)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as fh:
+            fh.write(line + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -173,6 +173,10 @@ def analyze_file(
 
 
 def iter_python_files(paths: Iterable[str]) -> Iterator[str]:
+    # native sources ride the same walk: the knob-native rule scans them
+    # (rules_native.py); everything else only sees .py files
+    from .rules_native import NATIVE_EXTS
+
     for p in paths:
         if os.path.isfile(p):
             yield p
@@ -183,16 +187,22 @@ def iter_python_files(paths: Iterable[str]) -> Iterator[str]:
                     if d not in ("__pycache__", ".git", "fixtures")
                 )
                 for name in sorted(files):
-                    if name.endswith(".py"):
+                    if name.endswith(".py") or name.endswith(NATIVE_EXTS):
                         yield os.path.join(root, name)
 
 
 def analyze_paths(
     paths: Iterable[str], rules: Iterable[str] | None = None
 ) -> list[Finding]:
+    from . import rules_native
+
     findings: list[Finding] = []
     for path in iter_python_files(paths):
-        findings.extend(analyze_file(path, rules=rules))
+        if path.endswith(rules_native.NATIVE_EXTS):
+            if rules is None or rules_native.RULE_ID in set(rules):
+                findings.extend(rules_native.scan_native_file(path))
+        else:
+            findings.extend(analyze_file(path, rules=rules))
     return findings
 
 
@@ -277,3 +287,4 @@ from . import rules_knobs   # noqa: E402,F401
 from . import rules_obs     # noqa: E402,F401
 from . import rules_retry   # noqa: E402,F401
 from . import rules_cache   # noqa: E402,F401
+from . import rules_native  # noqa: E402,F401

@@ -79,6 +79,11 @@ _ALL: list[Knob] = [
     _k("MINIO_TPU_NATIVE_PLANE", "auto", "erasure",
        "Native (C) data-plane helpers: `auto` probes, `on` requires, "
        "`off` disables."),
+    _k("MINIO_TPU_NATIVE_THREADS", "1", "erasure",
+       "Native PUT per-stripe-block worker threads (parity+hash+write "
+       "parallelize per block; md5 stays pipelined on the feeding "
+       "thread). 0 = auto from hardware concurrency; malformed or "
+       "negative values fall back to 1 (serial); clamped to 16."),
     _k("MINIO_TPU_READ_SPAN_MB", "16", "erasure",
        "Bytes of contiguous shard data one GET read span covers before "
        "the next span is scheduled."),
@@ -295,6 +300,21 @@ _ALL: list[Knob] = [
     _k("MINIO_TPU_TRACE_BUFFER", "1000", "server",
        "Per-subscriber trace stream queue depth; a consumer slower than "
        "the record rate drops (counted) records beyond it."),
+    _k("MINIO_TPU_WORKERS", "1", "server",
+       "SO_REUSEPORT worker pool size: N forks N serving processes "
+       "sharing the listen port over the same drives (coherent via "
+       "ns-lock quorum + cache invalidation broadcasts); 0 = auto from "
+       "nproc. Single-node deployments only for now."),
+    _k("MINIO_TPU_WORKER_COUNT", "1", "server",
+       "Set by the worker-pool supervisor on each child: total workers "
+       "in the pool (divides the node-wide QoS admission budgets)."),
+    _k("MINIO_TPU_WORKER_INDEX", None, "server",
+       "Set by the worker-pool supervisor on each child: this worker's "
+       "index; its presence marks a process as a pool worker."),
+    _k("MINIO_TPU_WORKER_PORT_BASE", "", "server",
+       "First loopback control port of the worker pool (worker i "
+       "listens on base+i for sibling/admin RPC); empty = S3 port + "
+       "1000."),
     # -- storage ----------------------------------------------------------
     _k("MINIO_TPU_DRIVE_FAIL_THRESHOLD", "4", "storage",
        "Consecutive drive faults before the per-drive circuit breaker "

@@ -31,6 +31,11 @@ import msgpack
 
 HANDLER = "cache.invalidate"
 BROADCAST_TIMEOUT_S = 2.0
+# loopback SO_REUSEPORT worker peers answer in microseconds or are dead
+# (crashed, supervisor restarting them); a worker outage must not cost
+# every mutation the full cross-node deadline — the gen-gap epoch bump
+# revalidates whatever the restarted worker missed anyway
+WORKER_BROADCAST_TIMEOUT_S = 0.5
 # how long a missing generation may stay missing before it is declared
 # lost: concurrent broadcasts are sent on racing threads, so short
 # reorder windows are NORMAL delivery, not loss
@@ -41,6 +46,7 @@ NODE_ID = uuid.uuid4().hex[:12]
 _mu = threading.Lock()
 _store_ref: "weakref.ref | None" = None
 _peers: list[str] = []
+_worker_peers: set[str] = set()  # subset of _peers: loopback pool siblings
 _token = ""
 _gen = 0
 _last_seen: dict[str, int] = {}
@@ -56,11 +62,15 @@ def attach(store) -> None:
         _store_ref = weakref.ref(store)
 
 
-def configure(peers: list[str], token: str) -> None:
-    """Arm broadcasting towards cluster peers (called from server main)."""
-    global _peers, _token
+def configure(peers: list[str], token: str,
+              worker_peers: list[str] | None = None) -> None:
+    """Arm broadcasting towards cluster peers (called from server main).
+    ``worker_peers`` names the subset that are loopback SO_REUSEPORT
+    pool siblings — same invalidation protocol, tighter deadline."""
+    global _peers, _token, _worker_peers
     with _mu:
         _peers = list(peers)
+        _worker_peers = set(worker_peers or ())
         _token = token
 
 
@@ -70,7 +80,8 @@ def is_distributed() -> bool:
 
 def stats() -> dict:
     with _mu:
-        return dict(_stats, peers=len(_peers), lastGen=_gen)
+        return dict(_stats, peers=len(_peers),
+                    workerPeers=len(_worker_peers), lastGen=_gen)
 
 
 def register_grid(grid) -> None:
@@ -99,11 +110,17 @@ def broadcast_invalidate(pool_idx: int, set_idx: int, bucket: str,
 
     from ..cluster.grid import shared_client
 
+    worker_peers = _worker_peers
+
     def one(peer: str) -> None:
         host, _, port = peer.rpartition(":")
+        deadline = (
+            WORKER_BROADCAST_TIMEOUT_S if peer in worker_peers
+            else BROADCAST_TIMEOUT_S
+        )
         try:
             shared_client(host, int(port), token, "storage").call(
-                HANDLER, payload, timeout=BROADCAST_TIMEOUT_S, retry=True
+                HANDLER, payload, timeout=deadline, retry=True
             )
             with _mu:
                 _stats["sent"] += 1

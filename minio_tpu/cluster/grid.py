@@ -164,6 +164,7 @@ class GridServer:
         self._stream: dict[str, StreamHandler] = {}
         self._inline: set[str] = set()
         self.connections = 0  # live websocket count (tests assert muxing)
+        self._live_ws: set = set()  # open server-side sockets (shutdown)
 
     def register_single(self, name: str, fn: SingleHandler,
                         inline: bool = False) -> None:
@@ -183,6 +184,22 @@ class GridServer:
         from aiohttp import web
 
         app.router.add_route("GET", GRID_ROUTE, self.handle)
+        # grid websockets are LONG-LIVED by design; without this hook a
+        # graceful app cleanup waits the full shutdown timeout for every
+        # peer that hasn't closed its end yet (two pool workers stopping
+        # together would stall each other's drains)
+        app.on_shutdown.append(self._close_live)
+
+    async def _close_live(self, _app) -> None:
+        import asyncio
+
+        for ws in list(self._live_ws):
+            try:
+                await ws.close()
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
 
     async def handle(self, request):
         import asyncio
@@ -198,6 +215,7 @@ class GridServer:
         ws = web.WebSocketResponse(max_msg_size=16 << 20, heartbeat=30.0)
         await ws.prepare(request)
         self.connections += 1
+        self._live_ws.add(ws)
         send_lock = asyncio.Lock()
 
         async def send_frame(data: bytes) -> None:
@@ -265,8 +283,14 @@ class GridServer:
                             st._send_credits.release()
         finally:
             self.connections -= 1
+            self._live_ws.discard(ws)
             for t in tasks:
                 t.cancel()
+        # returning the WebSocketResponse is aiohttp's contract; falling
+        # off the end logs "Missing return statement on request handler"
+        # on every graceful peer close (worker pools close these on
+        # every shutdown)
+        return ws
 
     async def _run_single(self, send_frame, mux: int, payload: bytes) -> None:
         import asyncio
@@ -804,6 +828,22 @@ def shared_client(host: str, port: int, token: str, plane: str = "storage") -> G
             c = GridClient(host, port, token, plane)
             _registry[key] = c
         return c
+
+
+def close_shared_clients() -> None:
+    """Shutdown hook: close every outgoing grid connection. Without
+    this, the PEER's aiohttp server keeps a parked websocket handler
+    per connection and its graceful cleanup waits out the full shutdown
+    timeout — two pool workers stopping together would deadlock each
+    other's drains for up to a minute."""
+    with _registry_lock:
+        clients = list(_registry.values())
+        _registry.clear()
+    for c in clients:
+        try:
+            c.close()
+        except Exception:  # noqa: BLE001 — teardown best-effort
+            pass
 
 
 class GridGate:

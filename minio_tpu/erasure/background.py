@@ -107,21 +107,32 @@ class BackgroundOps:
 
     # -- lifecycle ---------------------------------------------------------
 
-    def start(self) -> None:
-        t = threading.Thread(target=self._scan_loop, daemon=True, name="scanner")
-        t.start()
-        self._threads.append(t)
+    def start(self, scanner: bool = True) -> None:
+        """Start the background plane. ``scanner=False`` starts only the
+        MRF heal workers — SO_REUSEPORT pool workers past index 0 must
+        drain their own heal-on-read queues, but duplicating the
+        namespace scanner / ILM applier / fresh-disk monitor N× over the
+        SAME shared drives would race transitions and multiply bg I/O
+        by the pool size (cluster peers scan their OWN drives; workers
+        share them)."""
+        if scanner:
+            t = threading.Thread(
+                target=self._scan_loop, daemon=True, name="scanner"
+            )
+            t.start()
+            self._threads.append(t)
         for i in range(self._heal_workers):
             t = threading.Thread(
                 target=self._heal_loop, daemon=True, name=f"heal-{i}"
             )
             t.start()
             self._threads.append(t)
-        t = threading.Thread(
-            target=self._disk_monitor_loop, daemon=True, name="fresh-disk"
-        )
-        t.start()
-        self._threads.append(t)
+        if scanner:
+            t = threading.Thread(
+                target=self._disk_monitor_loop, daemon=True, name="fresh-disk"
+            )
+            t.start()
+            self._threads.append(t)
 
     def stop(self) -> None:
         self._stop.set()

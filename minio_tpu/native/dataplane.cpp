@@ -15,9 +15,11 @@
 //
 // Core scaling: every stripe block is independent (parity+hash+write), so
 // the pass parallelizes by handing blocks round-robin to a small thread
-// pool; md5 is inherently serial and stays on the feeding thread. The bench
-// host is single-core, so the pool defaults to inline execution
-// (MINIO_TPU_NATIVE_THREADS to override on real hardware).
+// pool; md5 is inherently serial and stays PIPELINED on the feeding thread
+// (it digests chunk k while workers encode/hash/write chunk k-1, so a
+// single large PUT overlaps etag and parity work across cores).
+// MINIO_TPU_NATIVE_THREADS: 1 (default) = inline, 0 = auto from hardware
+// concurrency, malformed/negative falls back to 1.
 
 #include <atomic>
 #include <condition_variable>
@@ -65,8 +67,13 @@ static int evp_ready = -1;  // -1 unprobed, 0 no, 1 yes
 static bool evp_probe() {
     if (evp_ready >= 0) return evp_ready == 1;
     evp_ready = 0;
+    // probe every common soname: hosts shipping only libcrypto.so.1.1
+    // (no dev symlink) would otherwise fall back to the ~1.4x-slower
+    // portable MD5, which caps the whole PUT plane (md5 is the serial
+    // stage on the feeding thread)
     void* h = dlopen("libcrypto.so.3", RTLD_LAZY | RTLD_GLOBAL);
     if (!h) h = dlopen("libcrypto.so", RTLD_LAZY | RTLD_GLOBAL);
+    if (!h) h = dlopen("libcrypto.so.1.1", RTLD_LAZY | RTLD_GLOBAL);
     if (!h) return false;
     evp_new = (fn_ctx_new)dlsym(h, "EVP_MD_CTX_new");
     evp_free = (fn_ctx_free)dlsym(h, "EVP_MD_CTX_free");
@@ -237,6 +244,26 @@ extern "C" void dp_md5(const uint8_t* data, long n, uint8_t* out16) {
 // ----------------------------------------------------------------- PUT
 
 static const int DIGEST = 32;
+static const int MAX_THREADS = 16;
+
+// MINIO_TPU_NATIVE_THREADS, parsed strictly: a malformed or negative
+// value falls back to 1 (serial — atoi would silently turn "abc" into
+// auto), "0" auto-sizes to the hardware concurrency, and the pool is
+// clamped to MAX_THREADS (slots are 2x threads of block_size scratch).
+static int dp_parse_threads(const char* s) {
+    if (!s || !*s) return 1;
+    char* end = nullptr;
+    long v = strtol(s, &end, 10);
+    while (end && (*end == ' ' || *end == '\t')) end++;
+    if (!end || *end != '\0') return 1;  // trailing junk: not a number
+    if (v < 0) return 1;
+    if (v == 0) {
+        unsigned hc = std::thread::hardware_concurrency();
+        v = hc ? (long)hc : 1;
+    }
+    if (v > MAX_THREADS) v = MAX_THREADS;
+    return (int)v;
+}
 
 // Worker slot for the optional multi-core pipeline: one stripe block's
 // padded input plus per-slot parity/digest scratch.
@@ -421,10 +448,7 @@ extern "C" void* dp_put_open(int d, int p, long block_size,
     c->t = d + p;
     c->block_size = block_size;
     c->per = (block_size + d - 1) / d;
-    const char* nt = getenv("MINIO_TPU_NATIVE_THREADS");
-    c->nthreads = nt ? atoi(nt) : 1;
-    if (c->nthreads < 1) c->nthreads = 1;
-    if (c->nthreads > 16) c->nthreads = 16;
+    c->nthreads = dp_parse_threads(getenv("MINIO_TPU_NATIVE_THREADS"));
     c->stopping = false;
     c->parity_mat = (uint8_t*)malloc((size_t)p * d);
     c->fds = (int*)malloc(sizeof(int) * c->t);

@@ -23,6 +23,10 @@ Env knobs (all optional):
 - ``MINIO_TPU_API_REQUESTS_DEADLINE``  wait deadline seconds (default 10)
 - ``MINIO_TPU_API_ADMIN_REQUESTS_MAX`` admin inflight cap (default 64)
 - ``MINIO_TPU_API_BG_REQUESTS_MAX``    background inflight cap (default 64)
+
+All caps are node-wide budgets: under an SO_REUSEPORT worker pool
+(``MINIO_TPU_WORKERS``, server/worker.py) each worker's controller gets
+``budget // worker_count`` so pool size never multiplies capacity.
 """
 
 from __future__ import annotations
@@ -84,6 +88,20 @@ class AdmissionController:
             deadline = 10.0
         admin_max = _int("MINIO_TPU_API_ADMIN_REQUESTS_MAX", 64)
         bg_max = _int("MINIO_TPU_API_BG_REQUESTS_MAX", 64)
+        # every cap above is a NODE-wide budget. In an SO_REUSEPORT
+        # worker pool (server/worker.py) each worker runs its own
+        # controller, so the budget divides by the pool size — forking N
+        # workers must not silently multiply admission capacity N×.
+        # Unlimited (-1) stays unlimited; a divided cap never drops
+        # below 1 (a worker that can admit nothing serves nothing).
+        workers = max(_int("MINIO_TPU_WORKER_COUNT", 1), 1)
+        if workers > 1:
+            def _divide(mx: int) -> int:
+                return max(mx // workers, 1) if mx > 0 else mx
+
+            s3_max = _divide(s3_max)
+            admin_max = _divide(admin_max)
+            bg_max = _divide(bg_max)
 
         def policy(mx: int) -> ClassPolicy:
             # wait queue bounded at 4x the cap: overflow beyond it answers

@@ -13,6 +13,7 @@ encrypted, as madmin.DecryptData expects.
 from __future__ import annotations
 
 import json
+import os
 import time
 
 from aiohttp import web
@@ -788,6 +789,11 @@ def server_info_payload(server) -> dict:
         "uptime": int(time.time() - server.started_at),
         "version": "minio-tpu/0.1.0",
         "backendType": "Erasure",
+        # SO_REUSEPORT pool identity: which worker answered, how many
+        # serve this node (tests + debugging address workers by this)
+        "workerIndex": getattr(server, "worker_index", 0),
+        "workerCount": getattr(server, "worker_count", 1),
+        "pid": os.getpid(),
     }
     for p in pools:
         sets = getattr(p, "sets", [p])

@@ -78,6 +78,16 @@ class S3Server(
         self.started_at = _time.time()
         self.metrics = Metrics()
         self.trace = TracePubSub()
+        # worker-pool identity (server/worker.py): single-process serving
+        # is worker 0 of 1 with no siblings; main() overwrites these when
+        # the process is part of an SO_REUSEPORT pool. worker_peers are
+        # loopback control endpoints of the SIBLING workers — they ride
+        # `peers` for admin/trace fan-out but stay separately addressable
+        # for metrics aggregation (a scrape must merge workers, not
+        # cluster nodes, which scrape themselves).
+        self.worker_index = 0
+        self.worker_count = 1
+        self.worker_peers: list[str] = []
         # deep-tracing spans (obs/) publish through this server's pubsub;
         # module-level registration because spans open in layers with no
         # server reference (dispatcher, storage wrappers) — one process
@@ -207,7 +217,10 @@ class S3Server(
             for s in getattr(p, "sets", [p]):
                 s.on_degraded = self.background.mrf.add
         if interval > 0:
-            self.background.start()
+            # pool workers past index 0 run heal (their own MRF queue)
+            # but not the scanner/ILM/fresh-disk plane: those walk the
+            # SHARED drives and would duplicate bg work N× per node
+            self.background.start(scanner=self.worker_index == 0)
 
     # -- plumbing ------------------------------------------------------------
 
@@ -638,10 +651,21 @@ class S3Server(
                         ):
                             return self._err_response(request, s3err.AccessDenied)
                 if key.startswith("metrics/v3"):
-                    from .metrics import render_v3
+                    from .metrics import render_v3, render_v3_pool
 
                     sub = key[len("metrics/v3"):]
-                    text = await self._run(render_v3, self, sub)
+                    # worker pool: a scrape landing on this worker merges
+                    # every sibling's series (worker-labelled) unless the
+                    # caller opted out with local=on (the fan-out itself
+                    # uses local=on, so recursion stops after one hop)
+                    local_only = request.rel_url.query.get(
+                        "local", ""
+                    ).lower() in ("on", "true", "1")
+                    render = (
+                        render_v3 if local_only or not self.worker_peers
+                        else render_v3_pool
+                    )
+                    text = await self._run(render, self, sub)
                     if text is None:
                         return web.Response(status=404, body=b"unknown metrics path")
                 else:
@@ -944,6 +968,7 @@ def make_object_layer(
     internode_token_value: str = "",
     local_drive_registry: dict[int, XLStorage] | None = None,
     ns_lock=None,
+    allow_mint: bool | None = None,
 ):
     """Build the full L3 topology from drive specs (ellipses expanded):
     endpoints -> local XLStorage / remote StorageRESTClient -> format.json
@@ -976,9 +1001,13 @@ def make_object_layer(
         pool_specs.insert(0, bare)
 
     # bootstrap-leader rule: only the node owning the very first endpoint
-    # may mint a fresh cluster layout
-    leader = parse_endpoint(pool_specs[0][0], my_port).is_local
-    allow_mint = leader if local_drive_registry is not None else True
+    # may mint a fresh cluster layout; in an SO_REUSEPORT worker pool the
+    # caller narrows this further (only worker 0 mints — two workers
+    # racing init_or_load_formats over the same empty drives would both
+    # try to write format.json)
+    if allow_mint is None:
+        leader = parse_endpoint(pool_specs[0][0], my_port).is_local
+        allow_mint = leader if local_drive_registry is not None else True
 
     pools = []
     global_idx = 0
@@ -1055,6 +1084,37 @@ def main(argv: list[str] | None = None) -> None:
     host, _, port = args.address.rpartition(":")
     my_port = int(port)
 
+    # -- SO_REUSEPORT worker pool (server/worker.py) ----------------------
+    # The supervisor path never builds a server: it herds N re-executed
+    # children, each of which lands here again WITH a worker identity.
+    from . import worker as workermod
+
+    wid = workermod.worker_identity()
+    if wid is None:
+        n_workers = workermod.resolve_worker_count()
+        if n_workers > 1:
+            import sys
+
+            probe_eps = parse_endpoints(
+                [p for spec in args.drives for p in ellipses.expand(spec)],
+                my_port,
+            )
+            raise SystemExit(
+                workermod.supervise(
+                    list(argv) if argv is not None else sys.argv[1:],
+                    n_workers, my_port,
+                    distributed=bool(remote_nodes(probe_eps)),
+                )
+            )
+        worker_index, worker_count, worker_port_base = 0, 1, 0
+    else:
+        worker_index, worker_count, worker_port_base = wid
+    worker_siblings = (
+        workermod.sibling_peers(worker_index, worker_count, worker_port_base)
+        if worker_count > 1
+        else []
+    )
+
     # TLS: certs-dir with a keypair turns on https + wss everywhere, with
     # in-place hot reload (reference cmd/common-main.go:942 getTLSConfig)
     from ..crypto import tlsconf
@@ -1090,13 +1150,24 @@ def main(argv: list[str] | None = None) -> None:
 
     registry: dict[int, XLStorage] = {}
     local_locker = LocalLocker()
+    # sibling workers are lock peers: a write lock needs a quorum of ALL
+    # workers' tables (n/2+1), so two workers mutating the same object
+    # serialize exactly like two cluster nodes would (dsync semantics,
+    # jittered-retry tie-break and all)
     lockers = [local_locker] + [
-        _RemoteLocker(n.split(":")[0], int(n.split(":")[1]), token) for n in peers
+        _RemoteLocker(n.split(":")[0], int(n.split(":")[1]), token)
+        for n in (*worker_siblings, *peers)
     ]
     ns_lock = NamespaceLock(lockers)
 
     srv = S3Server(None)
-    srv.peers = peers  # cluster peers, for admin profile/pprof fan-out
+    # cluster peers + sibling workers, for admin/trace/profile fan-out
+    # (a worker is just another peer for those planes)
+    srv.peers = worker_siblings + peers
+    srv.worker_index = worker_index
+    srv.worker_count = worker_count
+    srv.worker_peers = worker_siblings
+    srv.worker_port_base = worker_port_base
     from ..cluster.grid import GridServer
 
     storage_srv = StorageRESTServer(registry, token)
@@ -1112,7 +1183,14 @@ def main(argv: list[str] | None = None) -> None:
     from ..cache import coherence as cache_coherence
 
     cache_coherence.register_grid(grid)
-    cache_coherence.configure(peers, token)
+    # sibling workers receive the same synchronous invalidation
+    # broadcasts cluster peers do: a PUT on worker A drops the object
+    # from B's and C's caches before the client sees its 200 (loopback
+    # siblings get a tighter deadline — a crashed worker must not cost
+    # every mutation the cross-node timeout while it restarts)
+    cache_coherence.configure(
+        worker_siblings + peers, token, worker_peers=worker_siblings
+    )
     grid.register(srv.app)
     from ..cluster import bootstrap as bootmod
 
@@ -1125,8 +1203,11 @@ def main(argv: list[str] | None = None) -> None:
         loop = asyncio.get_running_loop()
 
         def build():
+            # in a worker pool only worker 0 may mint a fresh format.json
+            # (the others retry below until the layout exists on disk)
             return make_object_layer(
-                args.drives, args.set_size, my_port, token, registry, ns_lock
+                args.drives, args.set_size, my_port, token, registry, ns_lock,
+                allow_mint=None if worker_count == 1 else worker_index == 0,
             )
 
         if peers:
@@ -1168,6 +1249,16 @@ def main(argv: list[str] | None = None) -> None:
 
         async def boot_then_gateways():
             await bootstrap()
+            # gateway ports don't SO_REUSEPORT: in a pool only worker 0
+            # binds them (a second binder would EADDRINUSE-crash, and
+            # the supervisor's crash budget would take the whole pool
+            # down over a gateway flag)
+            if worker_index > 0 and (args.ftp or args.sftp):
+                print(
+                    f"worker {worker_index}: FTP/SFTP gateways served by "
+                    "worker 0 only", flush=True,
+                )
+                return
             if args.ftp:
                 from .ftp import FTPGateway
 
@@ -1204,8 +1295,28 @@ def main(argv: list[str] | None = None) -> None:
         site = web.TCPSite(
             runner, host or "0.0.0.0", my_port,
             ssl_context=cert_mgr.ctx if cert_mgr else None,
+            # worker pool: every worker binds the SAME port; the kernel
+            # load-balances accepted connections across them
+            reuse_port=True if worker_count > 1 else None,
         )
         await site.start()
+        if worker_count > 1:
+            # per-worker loopback control listener: SO_REUSEPORT makes
+            # the shared port land on an ARBITRARY worker, so siblings
+            # (coherence broadcasts, lock RPCs, admin/metrics fan-out)
+            # address each worker here. Same app, same auth.
+            ctrl = web.TCPSite(
+                runner, "127.0.0.1",
+                workermod.control_port(worker_port_base, worker_index),
+                ssl_context=cert_mgr.ctx if cert_mgr else None,
+            )
+            await ctrl.start()
+            print(
+                f"worker {worker_index}/{worker_count} serving "
+                f"{args.address} (shared), control port "
+                f"{workermod.control_port(worker_port_base, worker_index)}",
+                flush=True,
+            )
         cert_watcher = None
         if cert_mgr is not None:
             print(f"serving https on {args.address}", flush=True)
@@ -1234,8 +1345,20 @@ def main(argv: list[str] | None = None) -> None:
         await stop.wait()
         if cert_watcher is not None:
             cert_watcher.cancel()
-        await runner.cleanup()  # close listeners, drain in-flight requests
+        # teardown order matters: stop the background planes FIRST (the
+        # scanner/heal threads broadcast invalidations, which would
+        # re-dial the grid right after we close it), THEN close our
+        # OUTGOING grid connections — the sibling/peer server holds a
+        # parked websocket handler per connection and its graceful drain
+        # waits for ours to close (two pool workers stopping together
+        # would otherwise stall each other's cleanup for the full
+        # shutdown timeout; the supervisor's SIGKILL grace is the
+        # backstop for a mid-sweep straggler that re-dials anyway)
         srv.close()  # stop IAM refresh/watch + scanner threads
+        from ..cluster import grid as gridmod
+
+        gridmod.close_shared_clients()
+        await runner.cleanup()  # close listeners, drain in-flight requests
 
     try:
         _asyncio.run(_serve())

@@ -989,6 +989,80 @@ V3_BUCKET_GROUPS = {
 }
 
 
+def _worker_relabel(text: str, worker: int, keep_comments: bool) -> list[str]:
+    """Stamp every series line with a ``worker="i"`` label. Peer lines
+    drop their # HELP/TYPE comments (the serving worker's copy already
+    carries them — duplicated TYPE lines are invalid exposition)."""
+    out: list[str] = []
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            if keep_comments:
+                out.append(line)
+            continue
+        i = line.rfind("} ")
+        if i >= 0:
+            out.append(f'{line[:i]},worker="{worker}"{line[i:]}')
+        else:
+            name, _, rest = line.partition(" ")
+            out.append(f'{name}{{worker="{worker}"}} {rest}')
+    return out
+
+
+def render_v3_pool(server, path: str) -> str | None:
+    """Pool-aware exposition: the serving worker's groups plus every
+    sibling worker's, each series stamped ``worker="i"`` — a scrape of
+    the shared SO_REUSEPORT port lands on ONE worker, and without the
+    fan-out it would report that worker's QoS/cache/TPU view as if it
+    were the node's. Counters aggregate with sum by (series) without the
+    worker label; siblings render with ``local=on`` so the fan-out never
+    recurses. A dead sibling is a 0 in ``minio_worker_up``, not a scrape
+    failure."""
+    own = render_v3(server, path)
+    if own is None or not server.worker_peers:
+        return own
+    from concurrent.futures import ThreadPoolExecutor
+
+    sub = "/" + path.strip("/") if path.strip("/") else ""
+    base = getattr(server, "worker_port_base", 0)
+
+    def one(peer: str) -> tuple[int, str | None]:
+        host, _, p = peer.rpartition(":")
+        idx = int(p) - base if base else -1
+        try:
+            from ..client import S3Client
+
+            r = S3Client(
+                peer, access_key=server.root_user,
+                secret_key=server.root_pass,
+            ).request(
+                "GET", f"/minio/metrics/v3{sub}", query={"local": "on"},
+                timeout=10,
+            )
+            if r.status != 200:
+                return idx, None
+            return idx, r.body.decode()
+        except Exception:  # noqa: BLE001 — a dead worker is a 0 gauge
+            return idx, None
+
+    with ThreadPoolExecutor(max_workers=min(len(server.worker_peers), 16)) as pool:
+        results = list(pool.map(one, server.worker_peers))
+    lines = _worker_relabel(own, server.worker_index, keep_comments=True)
+    up = [(server.worker_index, 1)]
+    for idx, text in results:
+        up.append((idx, 1 if text is not None else 0))
+        if text is not None:
+            lines.extend(_worker_relabel(text, idx, keep_comments=False))
+    _fmt(lines, "minio_workers_total", "gauge",
+         [({}, len(server.worker_peers) + 1)],
+         "SO_REUSEPORT pool size on this node")
+    _fmt(lines, "minio_worker_up", "gauge",
+         [({"worker": str(i)}, v) for i, v in sorted(up)],
+         "1 when the worker answered the pool metrics fan-out")
+    return "\n".join(lines) + "\n"
+
+
 def render_v3(server, path: str) -> str | None:
     """Render the v3 group(s) under `path` ('' = all non-bucket groups).
     Returns None for an unknown path (-> 404)."""

@@ -42,6 +42,11 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "tpu: needs the real TPU backend (run via the TPU lane)"
     )
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 lane (`-m 'not slow'`); run "
+        "explicitly or via make bench-smoke",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

@@ -722,3 +722,48 @@ def test_cache_discipline_exempts_cache_package_and_listing():
                rules=["cache-discipline"]) == []
     assert run(BAD_METACACHE_WRITE, relpath="erasure/listing.py",
                rules=["cache-discipline"]) == []
+
+
+# -- knob-native: getenv() in C++ sources checked against the registry ----
+
+from minio_tpu.analysis.rules_native import scan_native_source  # noqa: E402
+
+
+def test_knob_native_flags_undeclared_getenv():
+    src = 'int n = atoi(getenv("MINIO_TPU_TOTALLY_UNDECLARED"));\n'
+    fs = scan_native_source(src, "native/fake.cpp")
+    assert len(fs) == 1
+    assert fs[0].rule == "knob-native"
+    assert "MINIO_TPU_TOTALLY_UNDECLARED" in fs[0].message
+    assert fs[0].line == 1
+
+
+def test_knob_native_allows_declared_and_prefix_knobs():
+    src = (
+        'const char* a = getenv("MINIO_TPU_NATIVE_THREADS");\n'
+        'const char* b = getenv("MINIO_NOTIFY_WEBHOOK_ENABLE_X");\n'
+    )
+    assert scan_native_source(src, "native/fake.cpp") == []
+
+
+def test_knob_native_pragma_suppresses():
+    src = (
+        'getenv("MINIO_TPU_NOPE");  '
+        "// miniovet: ignore[knob-native] -- test fixture\n"
+    )
+    assert scan_native_source(src, "native/fake.cpp") == []
+
+
+def test_knob_native_ignores_non_minio_env():
+    assert scan_native_source('getenv("HOME");\n', "native/fake.cpp") == []
+
+
+def test_knob_native_runs_via_analyze_paths(tmp_path):
+    from minio_tpu.analysis import analyze_paths
+
+    cpp = tmp_path / "x.cpp"
+    cpp.write_text('getenv("MINIO_TPU_NOT_A_KNOB");\n')
+    fs = analyze_paths([str(tmp_path)])
+    assert [f.rule for f in fs] == ["knob-native"]
+    # rule selection excludes it like any other rule
+    assert analyze_paths([str(tmp_path)], rules=["knob"]) == []

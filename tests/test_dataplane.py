@@ -229,3 +229,88 @@ def test_native_put_quorum_failure_cleans_up(tmp):
         es.put_object("b", "obj", reader())
     with pytest.raises((ObjectNotFound, QuorumError)):
         es.get_object_info("b", "obj")
+
+
+# -- MINIO_TPU_NATIVE_THREADS: the per-stripe-block worker pool -----------
+#
+# The pool parallelizes parity+hash+write per block while md5 stays
+# pipelined on the feeding thread; output must be byte-identical to the
+# serial pass for EVERY setting, and malformed values must degrade to
+# serial rather than crash or silently auto-size.
+
+
+def _dp_run(tmp, threads: str | None, tag: str):
+    d, p = 8, 8
+    coder = ErasureCoder(d, p)
+    data = np.random.default_rng(99).integers(
+        0, 256, size=5 * coder.block_size + 12345, dtype=np.uint8
+    ).tobytes()
+    saved = os.environ.get("MINIO_TPU_NATIVE_THREADS")
+    if threads is None:
+        os.environ.pop("MINIO_TPU_NATIVE_THREADS", None)
+    else:
+        os.environ["MINIO_TPU_NATIVE_THREADS"] = threads
+    try:
+        paths = [os.path.join(tmp, f"{tag}-s{i}") for i in range(d + p)]
+        ctx = native.DataplanePut(
+            d, p, coder.block_size, coder._np.parity_matrix, MINIO_KEY, paths
+        )
+        for off in range(0, len(data), 700_001):
+            ctx.feed(data[off : off + 700_001])
+        etag, dead = ctx.finish()
+        assert dead == 0
+        assert etag == hashlib.md5(data).hexdigest()
+        shards = []
+        for path in paths:
+            with open(path, "rb") as f:
+                shards.append(f.read())
+        return etag, shards
+    finally:
+        if saved is None:
+            os.environ.pop("MINIO_TPU_NATIVE_THREADS", None)
+        else:
+            os.environ["MINIO_TPU_NATIVE_THREADS"] = saved
+
+
+@pytest.mark.parametrize(
+    "threads",
+    ["2", "4", "16", "0",          # real pools incl. 0 = auto
+     "abc", "-3", "", " 2 ", "2x"],  # hardened parsing: fall back/clamp
+)
+def test_native_threads_byte_identical(tmp, threads):
+    ref = _dp_run(tmp, None, "ref")
+    got = _dp_run(tmp, threads, f"t{abs(hash(threads))}")
+    assert got == ref, f"threads={threads!r} diverged from serial output"
+
+
+def test_native_threads_out_of_order_blocks(tmp):
+    """Many small stripe blocks through a wide pool: deterministic
+    offsets mean blocks may complete out of order — the framed files
+    must still be exactly the serial ones."""
+    d, p = 4, 2
+    coder = ErasureCoder(d, p)
+    data = np.random.default_rng(3).integers(
+        0, 256, size=23 * coder.block_size + 77, dtype=np.uint8
+    ).tobytes()
+
+    def run(threads: str) -> list[bytes]:
+        saved = os.environ.get("MINIO_TPU_NATIVE_THREADS")
+        os.environ["MINIO_TPU_NATIVE_THREADS"] = threads
+        try:
+            sub = tempfile.mkdtemp(dir=tmp)
+            paths = [os.path.join(sub, f"s{i}") for i in range(d + p)]
+            ctx = native.DataplanePut(
+                d, p, coder.block_size, coder._np.parity_matrix, MINIO_KEY,
+                paths,
+            )
+            ctx.feed(data)
+            etag, dead = ctx.finish()
+            assert dead == 0 and etag == hashlib.md5(data).hexdigest()
+            return [open(pa, "rb").read() for pa in paths]
+        finally:
+            if saved is None:
+                os.environ.pop("MINIO_TPU_NATIVE_THREADS", None)
+            else:
+                os.environ["MINIO_TPU_NATIVE_THREADS"] = saved
+
+    assert run("8") == run("1")

@@ -179,6 +179,38 @@ def test_from_env_policies(monkeypatch):
     assert s3["deadlineSeconds"] == 2.5
 
 
+def test_from_env_divides_node_budget_across_workers(monkeypatch):
+    """Caps are NODE-wide budgets: a worker pool of N must not multiply
+    admission capacity — each worker gets budget // N."""
+    monkeypatch.setenv("MINIO_TPU_API_REQUESTS_MAX", "100")
+    monkeypatch.setenv("MINIO_TPU_API_ADMIN_REQUESTS_MAX", "8")
+    monkeypatch.setenv("MINIO_TPU_WORKER_COUNT", "4")
+    adm = AdmissionController.from_env()
+    snap = adm.snapshot()
+    assert snap[CLASS_S3]["maxInflight"] == 25
+    assert snap["admin"]["maxInflight"] == 2
+    assert snap["background"]["maxInflight"] == 16  # default 64 / 4
+
+
+def test_from_env_worker_division_edge_cases(monkeypatch):
+    import os as _os
+
+    # auto-sized budget divides too
+    monkeypatch.setenv("MINIO_TPU_API_REQUESTS_MAX", "0")
+    monkeypatch.setenv("MINIO_TPU_WORKER_COUNT", "2")
+    node = max(256, 32 * (_os.cpu_count() or 1))
+    adm = AdmissionController.from_env()
+    assert adm.snapshot()[CLASS_S3]["maxInflight"] == node // 2
+    # unlimited stays unlimited; tiny caps floor at 1; malformed count = 1
+    monkeypatch.setenv("MINIO_TPU_API_REQUESTS_MAX", "-1")
+    assert AdmissionController.from_env().snapshot()[CLASS_S3]["maxInflight"] == -1
+    monkeypatch.setenv("MINIO_TPU_API_REQUESTS_MAX", "3")
+    monkeypatch.setenv("MINIO_TPU_WORKER_COUNT", "16")
+    assert AdmissionController.from_env().snapshot()[CLASS_S3]["maxInflight"] == 1
+    monkeypatch.setenv("MINIO_TPU_WORKER_COUNT", "junk")
+    assert AdmissionController.from_env().snapshot()[CLASS_S3]["maxInflight"] == 3
+
+
 # -- SlowDown over the wire ---------------------------------------------------
 
 
