@@ -3,10 +3,17 @@
 
 PY ?= python
 
-.PHONY: check test docs bench-smoke
+.PHONY: check check-clean test docs bench-smoke
 
+# whole-program static analysis (per-file rules + interprocedural
+# passes) with the content-hash incremental cache: warm runs re-parse
+# only changed files (timings on stderr). `make check-clean` busts it.
 check:
-	$(PY) -m minio_tpu.analysis minio_tpu/ --strict
+	$(PY) -m minio_tpu.analysis minio_tpu/ --strict --cache --jobs 2
+
+check-clean:
+	$(PY) -m minio_tpu.analysis --clean-cache
+	$(PY) -m minio_tpu.analysis minio_tpu/ --strict --cache --jobs 2
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
@@ -14,6 +21,7 @@ test:
 
 docs:
 	$(PY) -m minio_tpu.analysis --gen-config-docs docs/CONFIG.md
+	$(PY) -m minio_tpu.analysis minio_tpu/ --cache --gen-lock-order docs/LOCK_ORDER.md
 
 # harness-stays-runnable gate: the closed-loop load harness end to end
 # (worker pool, mixed zipf traffic, heal flood, QoS guard metrics) in

@@ -1,13 +1,23 @@
 """miniovet CLI.
 
     python -m minio_tpu.analysis [paths...] [--strict] [--select rule[,rule]]
+                                 [--format text|json|sarif] [--jobs N]
+                                 [--cache [PATH] | --no-cache] [--clean-cache]
     python -m minio_tpu.analysis --gen-config-docs [PATH]
+    python -m minio_tpu.analysis --gen-lock-order [PATH]
     python -m minio_tpu.analysis --list-rules
 
 Findings print as ``file:line: rule: message`` (clickable); exit status
 is non-zero when anything is found. ``--strict`` additionally fails on
 unused ``# miniovet: ignore[...]`` pragmas. With no paths, the installed
-``minio_tpu`` package is analyzed.
+``minio_tpu`` package is analyzed — per-file rules plus the
+interprocedural passes (blocking-reachable, lock-order, coherence-path,
+cancellation-reachable) over the whole program.
+
+``--cache`` keeps per-file summaries in a content-hash-keyed JSON file
+(default ``.miniovet-cache.json`` next to the package) so warm runs
+re-parse only changed files; any change to the analysis package itself
+busts every entry. ``--clean-cache`` deletes it first.
 """
 
 from __future__ import annotations
@@ -16,8 +26,9 @@ import argparse
 import os
 import sys
 
-from . import ALL_RULES, analyze_paths
+from . import ALL_RULES
 from .knobs import generate_config_md
+from .project import INTERPROC_PASSES, analyze_project, default_cache_path
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -29,7 +40,32 @@ def main(argv: list[str] | None = None) -> int:
     )
     ap.add_argument(
         "--select", default=None,
-        help="comma-separated rule ids to run (default: all)",
+        help="comma-separated rule/pass ids to run (default: all)",
+    )
+    ap.add_argument(
+        "--format", default="text", choices=("text", "json", "sarif"),
+        help="finding output format (default: text)",
+    )
+    ap.add_argument(
+        "--jobs", type=int, default=1, metavar="N",
+        help="parallel per-file analysis processes (default: 1)",
+    )
+    ap.add_argument(
+        "--cache", action="store_true",
+        help="use the incremental summary cache",
+    )
+    ap.add_argument(
+        "--cache-file", default=None, metavar="PATH",
+        help="cache location (implies --cache; default: "
+             ".miniovet-cache.json next to the package)",
+    )
+    ap.add_argument(
+        "--no-cache", action="store_true",
+        help="ignore and do not write the incremental cache",
+    )
+    ap.add_argument(
+        "--clean-cache", action="store_true",
+        help="delete the incremental cache before analyzing",
     )
     ap.add_argument(
         "--list-rules", action="store_true", help="list rule ids and exit"
@@ -40,42 +76,115 @@ def main(argv: list[str] | None = None) -> int:
         help="write docs/CONFIG.md from the knob registry and exit "
              "('-' prints to stdout)",
     )
+    ap.add_argument(
+        "--gen-lock-order", nargs="?", const="docs/LOCK_ORDER.md",
+        default=None, metavar="PATH",
+        help="write the canonical lock-ordering table proved cycle-free "
+             "by the lock-order pass and exit ('-' prints to stdout)",
+    )
     args = ap.parse_args(argv)
 
     if args.list_rules:
-        for rule_id in sorted(ALL_RULES):
+        for rule_id in sorted(set(ALL_RULES) | set(INTERPROC_PASSES)):
             print(rule_id)
         return 0
 
     if args.gen_config_docs is not None:
-        content = generate_config_md() + "\n"
-        if args.gen_config_docs == "-":
-            sys.stdout.write(content)
-        else:
-            os.makedirs(
-                os.path.dirname(args.gen_config_docs) or ".", exist_ok=True
-            )
-            with open(args.gen_config_docs, "w", encoding="utf-8") as fh:
-                fh.write(content)
-            print(f"wrote {args.gen_config_docs}")
-        return 0
+        return _write_doc(
+            args.gen_config_docs, generate_config_md() + "\n"
+        )
 
     paths = args.paths or [os.path.dirname(os.path.dirname(__file__))]
     rules = None
     if args.select:
         rules = [r.strip() for r in args.select.split(",") if r.strip()]
-        unknown = set(rules) - set(ALL_RULES)
+        unknown = set(rules) - set(ALL_RULES) - set(INTERPROC_PASSES)
         if unknown:
             ap.error(f"unknown rules: {', '.join(sorted(unknown))}")
-    findings = analyze_paths(paths, rules=rules)
+        if args.gen_lock_order is not None and "lock-order" not in rules:
+            # the doc IS the lock-order pass's output — a selection that
+            # skips the pass would silently write an empty table the
+            # runtime witness then loads as "no ordering to check"
+            rules.append("lock-order")
+
+    cache_path = None
+    if (args.cache or args.cache_file) and not args.no_cache:
+        cache_path = args.cache_file or default_cache_path()
+    if args.clean_cache:
+        # an explicit --cache-file scopes the clean to that file (even
+        # under --no-cache); only a default-cache run may delete the
+        # shared default cache
+        cp = args.cache_file or cache_path or default_cache_path()
+        if os.path.exists(cp):
+            os.unlink(cp)
+            print(f"removed {cp}", file=sys.stderr)
+        # bare `--clean-cache` (no paths, no cache to rebuild, no doc to
+        # generate) is a standalone "delete the cache" command; explicit
+        # paths always analyze — deleting the cache must never skip them
+        if not args.paths and cache_path is None and args.gen_lock_order is None:
+            return 0
+
+    result = analyze_project(
+        paths, rules=rules, jobs=max(args.jobs, 1), cache_path=cache_path
+    )
+
+    if args.gen_lock_order is not None:
+        from .interproc import generate_lock_order_md
+
+        gate = result.findings
+        if not args.strict:  # same pragma filtering as the normal path
+            gate = [f for f in gate if f.rule != "pragma"]
+        if gate:
+            for f in sorted(gate):
+                print(f, file=sys.stderr)
+            print(
+                "miniovet: refusing to generate the lock-order doc from a "
+                "tree with findings", file=sys.stderr,
+            )
+            return 1
+        return _write_doc(
+            args.gen_lock_order,
+            generate_lock_order_md(result.lock_order, result.lock_edges),
+        )
+
+    findings = result.findings
     if not args.strict and rules is None:
         findings = [f for f in findings if f.rule != "pragma"]
-    for f in findings:
-        print(f)
+
+    if args.format == "json":
+        from .output import findings_json
+
+        sys.stdout.write(findings_json(findings, result.stats))
+    elif args.format == "sarif":
+        from .output import findings_sarif
+
+        sys.stdout.write(findings_sarif(findings))
+    else:
+        for f in findings:
+            print(f)
+
     n = len(findings)
+    s = result.stats
     rule_word = "finding" if n == 1 else "findings"
-    print(f"miniovet: {n} {rule_word}", file=sys.stderr)
+    print(
+        f"miniovet: {n} {rule_word} "
+        f"({s['files']} files, {s['cached']} cached, "
+        f"{s['total_s']:.2f}s = {s['perfile_s']:.2f}s per-file "
+        f"+ {s['interproc_s']:.2f}s interproc)",
+        file=sys.stderr,
+    )
     return 1 if findings else 0
+
+
+def _write_doc(dest: str, content: str) -> int:
+    if dest == "-":
+        sys.stdout.write(content)
+        return 0
+    os.makedirs(os.path.dirname(dest) or ".", exist_ok=True)
+    with open(dest, "w", encoding="utf-8") as fh:
+        fh.write(content)
+    print(f"wrote {dest}")
+    return 0
 
 
 if __name__ == "__main__":

@@ -117,6 +117,57 @@ def _package_relpath(path: str) -> str:
     return norm.rsplit("/", 1)[-1]
 
 
+def analyze_tree(
+    tree: ast.AST,
+    ctx: FileContext,
+    rules: Iterable[str] | None = None,
+) -> tuple[list[Finding], set[int]]:
+    """Run the requested per-file rules over a pre-parsed tree. Returns
+    (findings after pragma suppression, pragma lines that suppressed
+    something) — the caller decides what to do about unused pragmas
+    (interprocedural passes may still consume them)."""
+    from .project import INTERPROC_PASSES  # deferred: project imports core
+
+    findings: list[Finding] = []
+    used_pragma_lines: set[int] = set()
+    wanted = set(rules) if rules is not None else set(ALL_RULES)
+    unknown = wanted - set(ALL_RULES) - set(INTERPROC_PASSES) - {"pragma"}
+    if unknown:
+        # a typo'd rule id must not come back as a clean result
+        raise KeyError(f"unknown rule id(s): {', '.join(sorted(unknown))}")
+    for rule_id in sorted(wanted):
+        if rule_id == "pragma" or rule_id not in ALL_RULES:
+            continue  # pseudo-rule / interprocedural pass id
+        fn = ALL_RULES[rule_id]
+        for f in fn(tree, ctx):
+            pline = ctx.suppressed(f.line, f.rule)
+            if pline is not None:
+                used_pragma_lines.add(pline)
+            else:
+                findings.append(f)
+    return findings, used_pragma_lines
+
+
+def unused_pragma_findings(
+    path: str,
+    pragmas: dict[int, set[str]],
+    used_pragma_lines: set[int],
+) -> list[Finding]:
+    """`pragma` pseudo-rule: unused suppressions rot into lies about the
+    code, so every pragma must have suppressed at least one finding."""
+    out = []
+    for line, tags in sorted(pragmas.items()):
+        if line not in used_pragma_lines:
+            out.append(
+                Finding(
+                    path, line, "pragma",
+                    "unused `miniovet: ignore[%s]` pragma (nothing "
+                    "suppressed on this line)" % ",".join(sorted(tags)),
+                )
+            )
+    return out
+
+
 def analyze_source(
     source: str,
     path: str = "<string>",
@@ -135,33 +186,13 @@ def analyze_source(
         return [
             Finding(path, e.lineno or 1, "parse", f"syntax error: {e.msg}")
         ]
-    findings: list[Finding] = []
-    used_pragma_lines: set[int] = set()
-    wanted = set(rules) if rules is not None else set(ALL_RULES)
-    for rule_id in sorted(wanted):
-        if rule_id == "pragma":  # pseudo-rule, handled below
-            continue
-        fn = ALL_RULES[rule_id]
-        for f in fn(tree, ctx):
-            pline = ctx.suppressed(f.line, f.rule)
-            if pline is not None:
-                used_pragma_lines.add(pline)
-            else:
-                findings.append(f)
-    # unused suppressions rot into lies about the code; the `pragma`
-    # pseudo-rule keeps them honest. Only meaningful on full runs — a
-    # --select subset can't tell an unused pragma from one whose rule
-    # didn't run
+    findings, used_pragma_lines = analyze_tree(tree, ctx, rules)
+    # only meaningful on full runs — a --select subset can't tell an
+    # unused pragma from one whose rule didn't run
     if rules is None:
-        for line, tags in sorted(ctx.pragmas.items()):
-            if line not in used_pragma_lines:
-                findings.append(
-                    Finding(
-                        path, line, "pragma",
-                        "unused `miniovet: ignore[%s]` pragma (nothing "
-                        "suppressed on this line)" % ",".join(sorted(tags)),
-                    )
-                )
+        findings.extend(
+            unused_pragma_findings(path, ctx.pragmas, used_pragma_lines)
+        )
     return sorted(findings)
 
 
@@ -192,18 +223,19 @@ def iter_python_files(paths: Iterable[str]) -> Iterator[str]:
 
 
 def analyze_paths(
-    paths: Iterable[str], rules: Iterable[str] | None = None
+    paths: Iterable[str],
+    rules: Iterable[str] | None = None,
+    jobs: int = 1,
+    cache_path: str | None = None,
 ) -> list[Finding]:
-    from . import rules_native
+    """Whole-program analysis: per-file rules plus the interprocedural
+    passes (call-graph reachability, lock ordering, coherence paths) over
+    everything reachable from `paths` as one program. See project.py."""
+    from .project import analyze_project
 
-    findings: list[Finding] = []
-    for path in iter_python_files(paths):
-        if path.endswith(rules_native.NATIVE_EXTS):
-            if rules is None or rules_native.RULE_ID in set(rules):
-                findings.extend(rules_native.scan_native_file(path))
-        else:
-            findings.extend(analyze_file(path, rules=rules))
-    return findings
+    return analyze_project(
+        paths, rules=rules, jobs=jobs, cache_path=cache_path
+    ).findings
 
 
 # -- shared AST helpers used by several rule modules -----------------------

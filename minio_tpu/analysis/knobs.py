@@ -229,6 +229,18 @@ _ALL: list[Knob] = [
     _k("MINIO_KMS_SSE_KEY", "", "kms",
        "Default MinKMS key name for SSE-KMS when the request names "
        "none."),
+    # -- analysis / sanitizer ---------------------------------------------
+    _k("MINIO_TPU_SANITIZE", "0", "analysis",
+       "Runtime sanitizer mode (analysis/sanitizer.py): wraps in-package "
+       "lock creation with a lock-order witness checked against the "
+       "static docs/LOCK_ORDER.md ordering, arms the event-loop stall "
+       "watchdog, and enables per-test-module env-mutation isolation. "
+       "The tier-1 conftest turns it on by default; violations surface "
+       "as obs `type=sanitizer` records, never as raised exceptions."),
+    _k("MINIO_TPU_SANITIZE_STALL_S", "0.5", "analysis",
+       "Event-loop stall watchdog threshold in seconds: the loop "
+       "missing its monotonic tick for longer than this records one "
+       "`loop.stall` sanitizer event with the loop thread's stack."),
     # -- qos --------------------------------------------------------------
     _k("MINIO_TPU_API_ADMIN_REQUESTS_MAX", None, "qos",
        "Admin-API inflight cap (helper default 64)."),

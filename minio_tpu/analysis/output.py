@@ -1,0 +1,79 @@
+"""Machine-readable finding output: stable JSON and SARIF 2.1.0.
+
+Both serializations are deterministic for a given tree (findings sorted,
+no timestamps, no absolute paths beyond what the caller passed) so CI
+can diff consecutive runs and upload artifacts without churn. SARIF is
+the minimal subset GitHub code scanning and VS Code's SARIF viewer
+consume: one run, one driver, rule ids + per-result physical locations.
+"""
+
+from __future__ import annotations
+
+import json
+
+from .core import Finding
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
+
+
+def findings_json(findings: list[Finding], stats: dict | None = None) -> str:
+    doc: dict = {
+        "tool": "miniovet",
+        "findings": [
+            {
+                "file": f.file,
+                "line": f.line,
+                "rule": f.rule,
+                "message": f.message,
+            }
+            for f in sorted(findings)
+        ],
+    }
+    if stats:
+        # timings are NOT stable run to run; keep them out of the diffable
+        # part by rounding to the counters CI actually asserts on
+        doc["stats"] = {
+            k: v for k, v in sorted(stats.items())
+            if not k.endswith("_s")
+        }
+    return json.dumps(doc, indent=2, sort_keys=False) + "\n"
+
+
+def findings_sarif(findings: list[Finding]) -> str:
+    rules = sorted({f.rule for f in findings})
+    results = [
+        {
+            "ruleId": f.rule,
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {"uri": f.file},
+                        "region": {"startLine": max(f.line, 1)},
+                    }
+                }
+            ],
+        }
+        for f in sorted(findings)
+    ]
+    doc = {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "miniovet",
+                        "rules": [{"id": r} for r in rules],
+                    }
+                },
+                "results": results,
+            }
+        ],
+    }
+    return json.dumps(doc, indent=2) + "\n"

@@ -1,0 +1,1125 @@
+"""Whole-program analysis driver: symbol table, call graph, summaries.
+
+The per-file rules (rules_*.py) see one tree at a time; everything that
+needs to understand the *program* — "is this blocking call reachable
+from an async def through three sync helpers", "do these two subsystems
+acquire locks in opposite orders" — runs here. The pipeline:
+
+1. every ``.py`` file is parsed once and reduced to a serializable
+   **FileSummary**: functions with their call sites (classified as plain
+   calls vs executor/thread submissions, which sever the event-loop
+   context), blocking primitives, lock definitions and lock-held
+   regions, per-return-path call sets, and broad try/except blocks;
+2. summaries are indexed into a **ProjectIndex**: module-qualified
+   symbol table (functions, classes with bases, import aliases) and a
+   resolver mapping call expressions (``self._helper``, ``mod.fn``,
+   ``Backoff(...).sleep`` via local type inference, unique-name
+   fallback) to definitions;
+3. the interprocedural passes (interproc.py) walk the resulting call
+   graph.
+
+Summaries — not trees — are what the **incremental cache** stores: a
+JSON file keyed by content hash, plus a digest of the analysis package
+itself so rule changes bust everything. A warm run re-parses only
+changed files; the interprocedural passes always re-run (they are
+whole-program by nature) but on cached summaries they cost milliseconds.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import os
+import time
+from dataclasses import dataclass, field
+
+from .core import (
+    FileContext,
+    Finding,
+    analyze_tree,
+    iter_python_files,
+    unused_pragma_findings,
+    _package_relpath,
+)
+
+# bump to invalidate every cache entry on engine-format changes
+ENGINE_VERSION = "miniovet-ip-1"
+
+# interprocedural pass ids (per-file rule ids live in core.ALL_RULES)
+INTERPROC_PASSES = (
+    "blocking-reachable",
+    "lock-order",
+    "coherence-path",
+    "cancellation-reachable",
+)
+
+# blocking primitives for reachability (names matched on the dotted call
+# expression). Sync file I/O is deliberately NOT here: the per-file
+# `blocking` rule flags direct use in async defs, and flagging every
+# helper that opens a file would drown the signal — the executor
+# boundary is where file I/O is supposed to live.
+_BLOCKING_PRIMS = {
+    "time.sleep": "time.sleep",
+    "socket.create_connection": "sync connect",
+    "socket.getaddrinfo": "sync DNS",
+    "socket.gethostbyname": "sync DNS",
+    "subprocess.run": "subprocess",
+    "subprocess.call": "subprocess",
+    "subprocess.check_call": "subprocess",
+    "subprocess.check_output": "subprocess",
+    "urllib.request.urlopen": "sync HTTP",
+    "urllib.request.urlretrieve": "sync HTTP",
+}
+_BLOCKING_ROOTS = {"requests"}  # requests.get/post/... sync HTTP client
+
+# attribute calls that park the calling thread on a future/queue — the
+# cancellation-relevant sync waits (concurrent.futures Future.result
+# raises CancelledError; a broad except around a helper that calls it
+# swallows cancellation exactly like one around an await)
+_WAIT_ATTRS = {"result"}
+
+_LOCKISH_ATTRS = ("lock", "mutex", "_mu", "_cv", "cond")
+
+
+def _is_lockish(name: str) -> bool:
+    low = name.lower()
+    return any(t in low for t in _LOCKISH_ATTRS) and "unlock" not in low
+
+
+def _dotted(node: ast.AST) -> str | None:
+    """'a.b.c' for pure Name/Attribute chains; chains rooted in a call or
+    subscript (``self.set_for(x).put_object``) come back as '?.put_object'
+    so the method name survives for heuristic resolution."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    if parts and isinstance(node, (ast.Call, ast.Subscript, ast.Await)):
+        return "?." + parts[0]  # keep only the method actually invoked
+    return None
+
+
+def _module_name(relpath: str) -> str:
+    """'erasure/set.py' -> 'erasure.set'; 'cache/__init__.py' -> 'cache';
+    '__init__.py' -> '' (the package root)."""
+    mod = relpath[:-3] if relpath.endswith(".py") else relpath
+    mod = mod.replace("/", ".")
+    if mod.endswith("__init__"):
+        mod = mod[: -len("__init__")].rstrip(".")
+    return mod
+
+
+# -- per-file summary extraction -------------------------------------------
+
+
+def _unwrap_callable_arg(node: ast.AST) -> ast.AST:
+    """run_in_executor(None, bind_context(fn)) / partial(fn, x) -> fn."""
+    if isinstance(node, ast.Call):
+        fname = _dotted(node.func) or ""
+        if fname.split(".")[-1] in ("bind_context", "partial") and node.args:
+            return _unwrap_callable_arg(node.args[0])
+    return node
+
+
+def _callable_ref(node: ast.AST) -> str | None:
+    node = _unwrap_callable_arg(node)
+    return _dotted(node)
+
+
+class _FunctionExtractor:
+    """Walks one function body (nested defs excluded — they get their own
+    summaries) collecting calls, blocking primitives, lock regions."""
+
+    def __init__(self, fn: ast.AST, qualname: str, cls: str | None,
+                 want_exits: bool):
+        self.fn = fn
+        self.sum: dict = {
+            "name": qualname,
+            "line": fn.lineno,
+            "async": isinstance(fn, ast.AsyncFunctionDef),
+            "class": cls,
+            "calls": [],       # {expr, line, kind}
+            "prims": [],       # {what, line}
+            "waits": [],       # {expr, line} -- .result()-style sync waits
+            "holds": [],       # {lock, line, calls, acquires}
+            "acquires": [],    # {lock, line} -- every acquire in this fn
+            "locals": {},      # var -> class-ref expr (light type inference)
+            "broad_trys": [],  # {line, calls} (async fns only)
+            "exits": [],       # {line, kind, before, tail}
+        }
+        self.want_exits = want_exits
+        self._active_holds: list[dict] = []
+
+    def run(self) -> dict:
+        self._walk_block(self.fn.body)
+        if self.want_exits:
+            self.sum["exits"] = _exit_paths(self.fn)
+        if isinstance(self.fn, ast.AsyncFunctionDef):
+            self._collect_broad_trys()
+        # serialize sets
+        for h in self.sum["holds"]:
+            h["calls"] = sorted(set(h["calls"]))
+            h["acquires"] = sorted(set(h["acquires"]))
+        return self.sum
+
+    # -- expression-level collection ------------------------------------
+
+    def _scan_expr(self, node: ast.AST) -> None:
+        """Record calls/prims/waits in an expression tree, not descending
+        into nested function/class definitions."""
+        awaited: set[int] = set()
+        for n in ast.walk(node):
+            if isinstance(n, ast.Await) and isinstance(n.value, ast.Call):
+                awaited.add(id(n.value))
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call):
+                self._record_call(n, awaited=id(n) in awaited)
+
+    def _record_call(self, call: ast.Call, awaited: bool = False) -> None:
+        expr = _dotted(call.func)
+        if expr is None:
+            return
+        line = call.lineno
+        attr = expr.split(".")[-1]
+        # executor/thread boundaries: the submitted callable runs off the
+        # event loop — record the edge with its kind so reachability can
+        # stop (executor/thread) or continue (task: runs ON the loop)
+        boundary: tuple[str, int] | None = None  # (kind, arg index)
+        if attr == "submit":
+            boundary = ("executor", 0)
+        elif attr == "to_thread":
+            boundary = ("executor", 0)
+        elif attr == "run_in_executor":
+            boundary = ("executor", 1)
+        elif attr == "Thread" and expr in ("threading.Thread", "Thread"):
+            boundary = ("thread", -1)  # target= keyword
+        elif attr in ("call_soon", "call_soon_threadsafe"):
+            boundary = ("task", 0)
+        elif attr == "call_later":
+            boundary = ("task", 1)
+        if boundary is not None:
+            kind, idx = boundary
+            target: ast.AST | None = None
+            if idx == -1:
+                for kw in call.keywords:
+                    if kw.arg == "target":
+                        target = kw.value
+            elif len(call.args) > idx:
+                target = call.args[idx]
+            if target is not None:
+                ref = _callable_ref(target)
+                if ref:
+                    self.sum["calls"].append(
+                        {"expr": ref, "line": line, "kind": kind}
+                    )
+            return
+        # blocking primitives
+        root = expr.split(".", 1)[0]
+        if expr in _BLOCKING_PRIMS:
+            self.sum["prims"].append({"what": expr, "line": line})
+        elif root in _BLOCKING_ROOTS and "." in expr:
+            self.sum["prims"].append({"what": expr, "line": line})
+        elif not awaited and attr in _WAIT_ATTRS and "." in expr:
+            self.sum["waits"].append({"expr": expr, "line": line})
+        # an awaited call can only target an awaitable — linking it to a
+        # sync def (via the unique-name fallback, say) would be wrong by
+        # construction, so the edge carries its own kind
+        self.sum["calls"].append(
+            {"expr": expr, "line": line,
+             "kind": "await" if awaited else "call"}
+        )
+        for h in self._active_holds:
+            h["calls"].append(expr)
+
+    # -- statement-level walk (tracks lock-held regions) -----------------
+
+    def _acquire(self, lock_expr: str, line: int) -> None:
+        self.sum["acquires"].append({"lock": lock_expr, "line": line})
+        for h in self._active_holds:
+            h["acquires"].append(lock_expr)
+
+    def _open_hold(self, lock_expr: str, line: int) -> dict:
+        self._acquire(lock_expr, line)
+        h = {"lock": lock_expr, "line": line, "calls": [], "acquires": []}
+        self.sum["holds"].append(h)
+        self._active_holds.append(h)
+        return h
+
+    def _close_hold(self, h: dict) -> None:
+        self._active_holds.remove(h)
+
+    def _walk_block(self, stmts: list[ast.stmt]) -> None:
+        pending_nslock: int | None = None
+        for st in stmts:
+            # ns-lock idiom: an acquire statement (`if not _lock_dyn(mtx):
+            # raise` / `ok = mtx.lock(...)`) whose held region is the
+            # immediately-following try block (the discipline shape
+            # rules_locks.py enforces)
+            if pending_nslock is not None and isinstance(st, ast.Try):
+                h = self._open_hold("<nslock>", pending_nslock)
+                pending_nslock = None
+                self._walk_stmt(st)
+                self._close_hold(h)
+                continue
+            pending_nslock = None
+            acq = self._nslock_acquire_in(st)
+            if acq is not None:
+                self._acquire("<nslock>", acq)
+                pending_nslock = acq
+            self._walk_stmt(st)
+
+    def _walk_stmt(self, st: ast.stmt) -> None:
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            return  # nested defs summarized separately
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            held: list[dict] = []
+            for item in st.items:
+                ce = item.context_expr
+                lock = None
+                if isinstance(ce, (ast.Attribute, ast.Name)):
+                    name = _dotted(ce)
+                    if name and _is_lockish(name.split(".")[-1]):
+                        lock = name
+                if lock is not None:
+                    held.append(self._open_hold(lock, st.lineno))
+                else:
+                    self._scan_expr(ce)
+            self._walk_block(st.body)
+            for h in held:
+                self._close_hold(h)
+            return
+        if isinstance(st, ast.Assign) and isinstance(st.value, ast.Call):
+            # light local type inference: v = ClassRef(...)
+            ref = _dotted(st.value.func)
+            if ref and len(st.targets) == 1 and isinstance(st.targets[0], ast.Name):
+                seg = ref.split(".")[-1]
+                if seg[:1].isupper() or seg == "new":
+                    self.sum["locals"][st.targets[0].id] = ref
+        # collect calls in this statement's own expressions
+        for fieldname, value in ast.iter_fields(st):
+            if fieldname in ("body", "orelse", "finalbody", "handlers"):
+                continue
+            if isinstance(value, ast.AST):
+                self._scan_expr(value)
+            elif isinstance(value, list):
+                for v in value:
+                    if isinstance(v, ast.AST):
+                        self._scan_expr(v)
+        for fieldname in ("body", "orelse", "finalbody"):
+            block = getattr(st, fieldname, None)
+            if block:
+                self._walk_block(block)
+        for hdl in getattr(st, "handlers", []) or []:
+            self._walk_block(hdl.body)
+
+    @staticmethod
+    def _nslock_acquire_in(st: ast.stmt) -> int | None:
+        roots: list[ast.AST] = []
+        if isinstance(st, (ast.Expr, ast.Assign)):
+            roots.append(st.value)
+        elif isinstance(st, ast.If):
+            roots.append(st.test)
+        for root in roots:
+            for n in ast.walk(root):
+                if not isinstance(n, ast.Call):
+                    continue
+                name = _dotted(n.func) or ""
+                if name == "_lock_dyn":
+                    return n.lineno
+                if name.endswith(".lock") or name.endswith(".rlock"):
+                    base = name.rsplit(".", 1)[0]
+                    if base.split(".")[-1] in ("mtx", "lk", "lock", "mutex"):
+                        return n.lineno
+        return None
+
+    # -- broad try/except collection (cancellation-reachable) -------------
+
+    def _collect_broad_trys(self) -> None:
+        from .rules_async import _is_broad, _reraises
+        from .core import contains_await
+
+        # own-body traversal: nested defs (callbacks, helpers) get their
+        # own summaries — a broad except inside one must not be
+        # attributed to this function
+        trys: list[ast.Try] = []
+        stack: list[ast.AST] = [self.fn]
+        while stack:
+            n = stack.pop()
+            if n is not self.fn and isinstance(
+                n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            if isinstance(n, ast.Try):
+                trys.append(n)
+            stack.extend(ast.iter_child_nodes(n))
+        for node in trys:
+            if contains_await(node.body):
+                continue  # the per-file `cancellation` rule owns this case
+            # an earlier `except CancelledError: raise` clause drains the
+            # cancellation before any broad handler can swallow it
+            handled = False
+            for h in node.handlers:
+                names = []
+                if h.type is not None:
+                    for t in (
+                        h.type.elts if isinstance(h.type, ast.Tuple)
+                        else [h.type]
+                    ):
+                        d = _dotted(t)
+                        if d:
+                            names.append(d.split(".")[-1])
+                if "CancelledError" in names and _reraises(h):
+                    handled = True
+                    break
+                if _is_broad(h):
+                    break  # a broad clause above the reraise wins
+            if handled:
+                continue
+            for h in node.handlers:
+                broad = _is_broad(h)
+                if broad and not _reraises(h):
+                    calls = []
+                    waits = []
+                    for n in ast.walk(ast.Module(body=list(node.body),
+                                                 type_ignores=[])):
+                        if isinstance(n, ast.Call):
+                            e = _dotted(n.func)
+                            if e:
+                                if e.split(".")[-1] in _WAIT_ATTRS and "." in e:
+                                    waits.append(e)
+                                calls.append(e)
+                    self.sum["broad_trys"].append({
+                        "line": h.lineno,
+                        "calls": sorted(set(calls)),
+                        "waits": sorted(set(waits)),
+                    })
+                    break
+
+
+def _exit_paths(fn: ast.AST) -> list[dict]:
+    """Non-exception exits of a function with the set of call exprs that
+    DEFINITELY executed before each (branch-joins intersect; loop bodies
+    don't count — they may run zero times). Exception exits are exempt
+    from the coherence contract; returns are not."""
+    exits: list[dict] = []
+
+    def calls_in(node: ast.AST) -> set[str]:
+        out = set()
+        for n in ast.walk(node):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                continue
+            if isinstance(n, ast.Call):
+                e = _dotted(n.func)
+                if e:
+                    out.add(e)
+        return out
+
+    def walk(stmts: list[ast.stmt], seen: set[str]) -> tuple[set[str], bool]:
+        s = set(seen)
+        for st in stmts:
+            if isinstance(st, ast.Return):
+                tail = None
+                if isinstance(st.value, ast.Call):
+                    tail = _dotted(st.value.func)
+                if st.value is not None:
+                    s |= calls_in(st.value)
+                exits.append({"line": st.lineno, "kind": "return",
+                              "before": sorted(s), "tail": tail})
+                return s, False
+            if isinstance(st, ast.Raise):
+                return s, False
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue
+            if isinstance(st, ast.If):
+                s |= calls_in(st.test)
+                s1, f1 = walk(st.body, s)
+                s2, f2 = walk(st.orelse, s)
+                if f1 and f2:
+                    s = s1 & s2
+                elif f1:
+                    s = s1
+                elif f2:
+                    s = s2
+                else:
+                    return s, False
+                continue
+            if isinstance(st, (ast.For, ast.AsyncFor, ast.While)):
+                walk(st.body, s)      # exits inside count; calls don't
+                walk(st.orelse, s)
+                if (
+                    isinstance(st, ast.While)
+                    and isinstance(st.test, ast.Constant)
+                    and st.test.value
+                    and not _loop_breaks(st)
+                ):
+                    # `while True:` with no break never falls through —
+                    # its returns are the only exits (retry-loop shape)
+                    return s, False
+                continue
+            if isinstance(st, ast.Try):
+                mark = len(exits)
+                s_body, f_body = walk(st.body, s)
+                joins: list[set[str]] = []
+                any_falls = False
+                if f_body and st.orelse:
+                    s_body, f_body = walk(st.orelse, s_body)
+                if f_body:
+                    joins.append(s_body)
+                    any_falls = True
+                for h in st.handlers:
+                    s_h, f_h = walk(h.body, s)
+                    if f_h:
+                        joins.append(s_h)
+                        any_falls = True
+                post = set.intersection(*joins) if joins else s
+                if st.finalbody:
+                    # a return inside the try/handlers runs the finally
+                    # on the way out: its definite calls belong to those
+                    # exits too (`try: return write() finally:
+                    # cache.invalidate()` is the canonical safe shape).
+                    # Probe walk computes them; its own exits are probe
+                    # artifacts and dropped.
+                    probe = len(exits)
+                    fin_calls, _ = walk(st.finalbody, set())
+                    del exits[probe:]
+                    for ex in exits[mark:]:
+                        ex["before"] = sorted(set(ex["before"]) | fin_calls)
+                    post, f_fin = walk(st.finalbody, post)
+                    if not f_fin:
+                        return post, False
+                if not any_falls:
+                    return post, False
+                s = post
+                continue
+            if isinstance(st, (ast.With, ast.AsyncWith)):
+                for it in st.items:
+                    s |= calls_in(it.context_expr)
+                s, falls = walk(st.body, s)
+                if not falls:
+                    return s, False
+                continue
+            s |= calls_in(st)
+            if isinstance(st, (ast.Break, ast.Continue)):
+                return s, False
+        return s, True
+
+    s, falls = walk(fn.body, set())
+    if falls:
+        end = max(getattr(fn, "end_lineno", fn.lineno) or fn.lineno, fn.lineno)
+        exits.append({"line": end, "kind": "fallthrough",
+                      "before": sorted(s), "tail": None})
+    return exits
+
+
+def _loop_breaks(loop: ast.AST) -> bool:
+    """Does `loop` contain a break at its own level (not in a nested
+    loop, which the break would target instead)?"""
+    stack: list[ast.AST] = list(loop.body)
+    while stack:
+        n = stack.pop()
+        if isinstance(n, ast.Break):
+            return True
+        if isinstance(n, (ast.For, ast.AsyncFor, ast.While,
+                          ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda, ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+    return False
+
+
+_LOCK_CTORS = ("threading.Lock", "threading.RLock", "threading.Condition",
+               "Lock", "RLock", "Condition")
+
+
+def extract_summary(tree: ast.AST, relpath: str) -> dict:
+    """Reduce one parsed module to its serializable project summary."""
+    module = _module_name(relpath)
+    want_exits = relpath.startswith("erasure/")
+    summary: dict = {
+        "module": module,
+        "relpath": relpath,
+        "imports": {},    # alias -> package-relative or external dotted
+        "classes": {},    # name -> {"bases": [...], "methods": [names]}
+        "functions": {},  # qualname -> funcsum
+        "locks": {},      # attr-or-name -> canonical lock id
+    }
+
+    def resolve_import_target(modpath: str, level: int) -> str:
+        if level == 0:
+            if modpath == "minio_tpu":
+                return ""
+            if modpath.startswith("minio_tpu."):
+                return modpath[len("minio_tpu."):]
+            return "ext:" + modpath
+        # relative: level=1 is this module's package, 2 is its parent...
+        base = module.split(".")
+        if relpath.endswith("__init__.py"):
+            base = base + ["_"]  # packages: `from . import x` = same pkg
+        if level > len(base):
+            return "ext:" + modpath
+        prefix = base[: len(base) - level]
+        return ".".join(prefix + ([modpath] if modpath else [])).strip(".")
+
+    for node in tree.body:
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                summary["imports"][a.asname or a.name.split(".")[0]] = (
+                    resolve_import_target(a.name, 0)
+                )
+        elif isinstance(node, ast.ImportFrom):
+            base = resolve_import_target(node.module or "", node.level)
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                tgt = f"{base}.{a.name}" if base else a.name
+                summary["imports"][a.asname or a.name] = tgt
+
+    def lock_ctor_id(value: ast.AST) -> str | None:
+        if not isinstance(value, ast.Call):
+            return None
+        name = _dotted(value.func) or ""
+        if name in _LOCK_CTORS:
+            return "@auto"  # canonical id derived from assignment target
+        if name.split(".")[-1] == "make_lock" and value.args and isinstance(
+            value.args[0], ast.Constant
+        ) and isinstance(value.args[0].value, str):
+            return value.args[0].value  # witness name IS the canonical id
+        return None
+
+    def extract_function(fn, qualprefix: str, cls: str | None):
+        qual = f"{qualprefix}{fn.name}"
+        summary["functions"][qual] = _FunctionExtractor(
+            fn, qual, cls, want_exits
+        ).run()
+        # nested defs (one level of recursion handles all depths)
+        for sub in _direct_nested_defs(fn):
+            extract_function(sub, f"{qual}.<locals>.", cls)
+
+    def _direct_nested_defs(fn):
+        out = []
+        stack = list(fn.body)
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.append(n)
+                continue  # don't descend: recursion handles deeper levels
+            if isinstance(n, (ast.ClassDef, ast.Lambda)):
+                continue
+            stack.extend(ast.iter_child_nodes(n))
+        return out
+
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            extract_function(node, "", None)
+        elif isinstance(node, ast.ClassDef):
+            cls = node.name
+            bases = [b for b in (_dotted(x) for x in node.bases) if b]
+            methods = []
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    methods.append(sub.name)
+                    extract_function(sub, f"{cls}.", cls)
+                    # self.X = threading.Lock() in any method
+                    for stmt in ast.walk(sub):
+                        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+                            t = stmt.targets[0]
+                            if (
+                                isinstance(t, ast.Attribute)
+                                and isinstance(t.value, ast.Name)
+                                and t.value.id == "self"
+                            ):
+                                lid = lock_ctor_id(stmt.value)
+                                if lid:
+                                    canon = (
+                                        f"{module}.{cls}.{t.attr}"
+                                        if lid == "@auto" else lid
+                                    )
+                                    summary["locks"][f"{cls}.{t.attr}"] = canon
+            summary["classes"][cls] = {"bases": bases, "methods": methods}
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+            t = node.targets[0]
+            if isinstance(t, ast.Name):
+                lid = lock_ctor_id(node.value)
+                if lid:
+                    canon = f"{module}.{t.id}" if lid == "@auto" else lid
+                    summary["locks"][t.id] = canon
+    return summary
+
+
+# -- project index + call resolution ---------------------------------------
+
+
+class ProjectIndex:
+    """Symbol table + call-expression resolver over all file summaries."""
+
+    def __init__(self, summaries: dict[str, dict], paths: dict[str, str]):
+        # keyed by relpath; paths maps relpath -> reported path
+        self.summaries = summaries
+        self.paths = paths
+        self.modules: dict[str, dict] = {}        # module -> summary
+        self.functions: dict[str, dict] = {}      # "mod::qual" -> funcsum
+        self.func_file: dict[str, str] = {}       # "mod::qual" -> relpath
+        self.classes: dict[str, dict] = {}        # "mod::Cls" -> classinfo
+        self.method_defs: dict[str, list[str]] = {}  # name -> [keys]
+        self.lock_ids: dict[str, str] = {}        # "mod|Cls.attr" -> canon
+        for relpath, s in sorted(summaries.items()):
+            mod = s["module"]
+            self.modules[mod] = s
+            for qual, fs in s["functions"].items():
+                key = f"{mod}::{qual}"
+                self.functions[key] = fs
+                self.func_file[key] = relpath
+                base = qual.split(".<locals>.")[-1].split(".")[-1]
+                self.method_defs.setdefault(base, []).append(key)
+            for cls, ci in s["classes"].items():
+                self.classes[f"{mod}::{cls}"] = ci
+            for ref, canon in s["locks"].items():
+                self.lock_ids[f"{mod}|{ref}"] = canon
+
+    # ---- symbol resolution ----
+
+    def _module_symbol(self, mod: str, name: str) -> str | None:
+        """Resolve `name` inside module `mod` to a functions/classes key."""
+        s = self.modules.get(mod)
+        if s is None:
+            return None
+        if name in s["functions"]:
+            return f"{mod}::{name}"
+        if name in s["classes"]:
+            return f"class:{mod}::{name}"
+        tgt = s["imports"].get(name)
+        if tgt is None:
+            return None
+        if tgt.startswith("ext:"):
+            # absolute import that isn't minio_tpu.*: still resolvable
+            # when the named module was analyzed in this run (synthetic
+            # module pairs in tests, scripts next to the package)
+            tail = tgt[4:]
+            if tail in self.modules:
+                return f"module:{tail}"
+            if "." in tail:
+                owner, sym = tail.rsplit(".", 1)
+                if owner in self.modules:
+                    return self._module_symbol(owner, sym)
+            return None
+        # imported module, or imported symbol from an in-package module
+        if tgt in self.modules:
+            return f"module:{tgt}"
+        if "." in tgt:
+            owner, sym = tgt.rsplit(".", 1)
+            if owner in self.modules:
+                return self._module_symbol(owner, sym)
+        return None
+
+    def _class_method(self, clskey: str, name: str,
+                      depth: int = 0) -> str | None:
+        if depth > 8 or clskey not in self.classes:
+            return None
+        mod = clskey.split("::")[0]
+        cls = clskey.split("::")[1]
+        ci = self.classes[clskey]
+        if name in ci["methods"]:
+            return f"{mod}::{cls}.{name}"
+        for b in ci["bases"]:
+            bsym = self._module_symbol(mod, b.split(".")[-1]) \
+                if "." not in b else self._resolve_dotted_symbol(mod, b)
+            if bsym and bsym.startswith("class:"):
+                hit = self._class_method(bsym[6:], name, depth + 1)
+                if hit:
+                    return hit
+        return None
+
+    def _resolve_dotted_symbol(self, mod: str, dotted: str) -> str | None:
+        parts = dotted.split(".")
+        sym = self._module_symbol(mod, parts[0])
+        for p in parts[1:]:
+            if sym is None:
+                return None
+            if sym.startswith("module:"):
+                sym = self._module_symbol(sym[7:], p)
+            elif sym.startswith("class:"):
+                m = self._class_method(sym[6:], p)
+                return m
+            else:
+                return None
+        return sym
+
+    def resolve_call(self, relpath: str, caller_qual: str,
+                     expr: str) -> list[str]:
+        """Call expression -> candidate function keys ("mod::qual")."""
+        s = self.summaries.get(relpath)
+        if s is None:
+            return []
+        mod = s["module"]
+        fs = s["functions"].get(caller_qual)
+        parts = expr.split(".")
+        # self.method / cls.method
+        if parts[0] in ("self", "cls") and fs and fs.get("class"):
+            if len(parts) == 2:
+                hit = self._class_method(f"{mod}::{fs['class']}", parts[1])
+                return [hit] if hit else self._unique_fallback(parts[-1])
+            return self._unique_fallback(parts[-1])
+        # local variable with inferred class type: v = Cls(...); v.m()
+        if fs and len(parts) == 2 and parts[0] in fs.get("locals", {}):
+            ctor = fs["locals"][parts[0]]
+            sym = self._resolve_dotted_symbol(mod, ctor)
+            if sym and sym.startswith("class:"):
+                hit = self._class_method(sym[6:], parts[1])
+                if hit:
+                    return [hit]
+            return self._unique_fallback(parts[-1])
+        # nested function in enclosing scope chain
+        if len(parts) == 1:
+            scope = caller_qual
+            while scope:
+                cand = f"{scope}.<locals>.{expr}"
+                if f"{mod}::{cand}" in self.functions:
+                    return [f"{mod}::{cand}"]
+                scope = scope.rsplit(".<locals>.", 1)[0] \
+                    if ".<locals>." in scope else ""
+            sym = self._module_symbol(mod, expr)
+            if sym is None:
+                return []
+            if sym.startswith("class:"):
+                init = self._class_method(sym[6:], "__init__")
+                return [init] if init else []
+            if sym.startswith("module:"):
+                return []
+            return [sym]
+        # dotted: walk alias/module/class chain
+        sym = self._resolve_dotted_symbol(mod, expr)
+        if sym and not sym.startswith(("module:", "class:")):
+            return [sym]
+        if sym and sym.startswith("class:"):
+            init = self._class_method(sym[6:], "__init__")
+            return [init] if init else []
+        # a root that is a known EXTERNAL import (asyncio, numpy, aiohttp)
+        # must not heuristic-match in-package names: `asyncio.sleep` is
+        # not OUR `sleep`
+        root_tgt = s["imports"].get(parts[0])
+        if root_tgt is not None and root_tgt.startswith("ext:"):
+            return []
+        return self._unique_fallback(parts[-1])
+
+    def _unique_fallback(self, name: str) -> list[str]:
+        """`obj.frob()` with receiver type unknown: if exactly one class
+        METHOD in the whole program is named `frob`, link to it — unique
+        names carry their identity; common names resolve nowhere rather
+        than everywhere. Module-level functions are excluded: a call
+        through a receiver cannot be one."""
+        if name.startswith("__"):
+            return []
+        cands = [
+            k for k in self.method_defs.get(name, [])
+            if "." in k.split("::", 1)[1] and ".<locals>." not in k
+        ]
+        return cands if len(cands) == 1 else []
+
+    def canon_lock(self, relpath: str, caller_qual: str, raw: str) -> str:
+        """Map a raw lock expression at a use site to its canonical id."""
+        s = self.summaries.get(relpath, {})
+        mod = s.get("module", "")
+        fs = s.get("functions", {}).get(caller_qual, {})
+        if raw == "<nslock>":
+            return "nslock"
+        parts = raw.split(".")
+        if parts[0] in ("self", "cls") and fs.get("class"):
+            key = f"{mod}|{fs['class']}.{parts[-1]}"
+            if key in self.lock_ids:
+                return self.lock_ids[key]
+            # inherited lock attr: any class defining it
+            hits = sorted(
+                v for k, v in self.lock_ids.items()
+                if k.split("|")[1].split(".")[-1] == parts[-1]
+            )
+            if len(set(hits)) == 1:
+                return hits[0]
+            return f"{mod}.{fs['class']}.{parts[-1]}"
+        if len(parts) == 1:
+            key = f"{mod}|{raw}"
+            if key in self.lock_ids:
+                return self.lock_ids[key]
+            tgt = s.get("imports", {}).get(raw)
+            if tgt and not tgt.startswith("ext:") and "." in tgt:
+                owner, sym = tgt.rsplit(".", 1)
+                okey = f"{owner}|{sym}"
+                if okey in self.lock_ids:
+                    return self.lock_ids[okey]
+            return f"{mod}.{raw}"
+        if len(parts) == 2:
+            # module-attr lock through an import: `sibling.a_lock`
+            tgt = s.get("imports", {}).get(parts[0])
+            if tgt:
+                owner = tgt[4:] if tgt.startswith("ext:") else tgt
+                if owner in self.modules:
+                    okey = f"{owner}|{parts[1]}"
+                    if okey in self.lock_ids:
+                        return self.lock_ids[okey]
+        return f"{mod}.{raw}"
+
+
+# -- the driver -------------------------------------------------------------
+
+
+@dataclass
+class ProjectResult:
+    findings: list[Finding]
+    lock_order: list[str] = field(default_factory=list)
+    lock_edges: dict[str, list[str]] = field(default_factory=dict)
+    stats: dict = field(default_factory=dict)
+
+
+def _sha1(data: bytes) -> str:
+    return hashlib.sha1(data).hexdigest()
+
+
+def _engine_digest() -> str:
+    """Hash of the analysis package sources: any rule/engine change
+    invalidates the whole cache."""
+    here = os.path.dirname(__file__)
+    h = hashlib.sha1(ENGINE_VERSION.encode())
+    for name in sorted(os.listdir(here)):
+        if name.endswith(".py"):
+            with open(os.path.join(here, name), "rb") as fh:
+                h.update(_sha1(fh.read()).encode())
+    return h.hexdigest()
+
+
+def _analyze_one(args: tuple[str, str, str]) -> dict:
+    """Worker: full per-file analysis + summary extraction. Returns a
+    JSON-serializable record (also the cache entry format). The stored
+    sha is computed from the bytes actually analyzed — NOT the parent's
+    scheduling sha — so a file edited mid-run cannot poison the cache
+    with old-hash/new-findings entries."""
+    path, relpath, _sched_sha = args
+    with open(path, "rb") as fh:
+        raw = fh.read()
+    source = raw.decode("utf-8")
+    ctx = FileContext(path=path, relpath=relpath, source=source)
+    rec: dict = {
+        "sha": _sha1(raw),
+        "path": path,
+        "findings": [],
+        "used_pragmas": [],
+        "pragmas": {str(k): sorted(v) for k, v in ctx.pragmas.items()},
+        "targets": {str(k): v for k, v in ctx._targets.items()},
+        "summary": None,
+    }
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        rec["findings"] = [
+            [relpath, e.lineno or 1, "parse", f"syntax error: {e.msg}"]
+        ]
+        return rec
+    findings, used = analyze_tree(tree, ctx, None)
+    rec["findings"] = [[relpath, f.line, f.rule, f.message] for f in findings]
+    rec["used_pragmas"] = sorted(used)
+    rec["summary"] = extract_summary(tree, relpath)
+    return rec
+
+
+class _PragmaView:
+    """Pragma lookups over cached records (no re-tokenize)."""
+
+    def __init__(self, rec: dict):
+        self.pragmas = {int(k): set(v) for k, v in rec["pragmas"].items()}
+        self.targets = {int(k): v for k, v in rec["targets"].items()}
+
+    def suppressed(self, line: int, rule_id: str) -> int | None:
+        for pline in self.targets.get(line, ()):
+            tags = self.pragmas.get(pline, set())
+            if rule_id in tags or "*" in tags:
+                return pline
+        return None
+
+
+def default_cache_path() -> str:
+    pkg = os.path.dirname(os.path.dirname(__file__))
+    return os.path.join(os.path.dirname(pkg), ".miniovet-cache.json")
+
+
+def analyze_project(
+    paths,
+    rules=None,
+    jobs: int = 1,
+    cache_path: str | None = None,
+) -> ProjectResult:
+    """Run everything: per-file rules, native scans, interprocedural
+    passes, pragma accounting. `cache_path` enables the incremental
+    cache (miss -> parse + analyze + store; hit -> reuse findings and
+    summary)."""
+    from . import rules_native
+    from . import interproc
+
+    t0 = time.perf_counter()
+    wanted = set(rules) if rules is not None else None
+    if wanted is not None:
+        from .core import ALL_RULES
+
+        unknown = wanted - set(ALL_RULES) - set(INTERPROC_PASSES) \
+            - {"pragma", rules_native.RULE_ID}
+        if unknown:
+            # same invariant analyze_tree enforces: a typo'd rule id
+            # must not come back as a clean result
+            raise KeyError(
+                f"unknown rule id(s): {', '.join(sorted(unknown))}"
+            )
+    py_files: list[tuple[str, str]] = []   # (path, relpath)
+    findings: list[Finding] = []
+    for path in iter_python_files(paths):
+        if path.endswith(rules_native.NATIVE_EXTS):
+            if wanted is None or rules_native.RULE_ID in wanted:
+                findings.extend(rules_native.scan_native_file(path))
+        else:
+            py_files.append((path, _package_relpath(path)))
+
+    cache: dict = {}
+    cache_dirty = False
+    engine = _engine_digest() if cache_path else ""
+    if cache_path and os.path.exists(cache_path):
+        try:
+            with open(cache_path, "r", encoding="utf-8") as fh:
+                on_disk = json.load(fh)
+            if on_disk.get("engine") == engine:
+                cache = on_disk.get("files", {})
+        except (OSError, ValueError):
+            cache = {}
+
+    todo: list[tuple[str, str, str]] = []
+    records: dict[str, dict] = {}   # relpath -> record
+    relpath_to_path: dict[str, str] = {}
+    for i, (path, relpath) in enumerate(py_files):
+        if relpath_to_path.get(relpath, path) != path:
+            # two out-of-package files sharing a basename (a/util.py,
+            # b/util.py): basename keys would silently drop one file's
+            # findings — fall back to the full path as the key
+            relpath = path.lstrip("./").replace(os.sep, "/")
+            py_files[i] = (path, relpath)
+        relpath_to_path[relpath] = path
+        if not cache_path:
+            # no cache: the scheduling sha is never compared, don't pay
+            # a second full read of every file just to compute it
+            todo.append((path, relpath, ""))
+            continue
+        with open(path, "rb") as fh:
+            sha = _sha1(fh.read())
+        hit = cache.get(relpath)
+        if hit is not None and hit.get("sha") == sha:
+            records[relpath] = hit
+        else:
+            todo.append((path, relpath, sha))
+
+    parsed = len(todo)
+    if todo:
+        if jobs > 1 and len(todo) > 4:
+            from concurrent.futures import ProcessPoolExecutor
+
+            with ProcessPoolExecutor(max_workers=jobs) as pool:
+                for args, rec in zip(todo, pool.map(_analyze_one, todo)):
+                    records[args[1]] = rec
+        else:
+            for args in todo:
+                records[args[1]] = _analyze_one(args)
+        cache_dirty = True
+
+    # per-file findings (rule-filtered when --select is active)
+    used_by_file: dict[str, set[int]] = {}
+    for relpath, rec in records.items():
+        used_by_file[relpath] = set(rec.get("used_pragmas", ()))
+        for f in rec["findings"]:
+            if wanted is None or f[2] in wanted or f[2] == "parse":
+                findings.append(
+                    Finding(relpath_to_path[relpath], f[1], f[2], f[3])
+                )
+
+    # interprocedural passes over the summaries
+    t1 = time.perf_counter()
+    summaries = {
+        rp: rec["summary"] for rp, rec in records.items()
+        if rec.get("summary") is not None
+    }
+    index = ProjectIndex(summaries, relpath_to_path)
+    pragma_views = {rp: _PragmaView(rec) for rp, rec in records.items()}
+
+    def _suppressed(relpath: str, line: int, tag: str) -> bool:
+        view = pragma_views.get(relpath)
+        if view is None:
+            return False
+        pline = view.suppressed(line, tag)
+        if pline is not None:
+            used_by_file.setdefault(relpath, set()).add(pline)
+            return True
+        return False
+
+    ip = interproc.run_passes(
+        index,
+        passes=[p for p in INTERPROC_PASSES
+                if wanted is None or p in wanted],
+        suppressed=_suppressed,
+    )
+    for f in ip.findings:
+        view = pragma_views.get(f.file)
+        pline = view.suppressed(f.line, f.rule) if view else None
+        if pline is not None:
+            used_by_file.setdefault(f.file, set()).add(pline)
+        else:
+            findings.append(
+                Finding(
+                    relpath_to_path.get(f.file, f.file),
+                    f.line, f.rule, f.message,
+                )
+            )
+
+    # unused pragmas: only decidable on full runs
+    if wanted is None:
+        for relpath, rec in records.items():
+            pragmas = {int(k): set(v) for k, v in rec["pragmas"].items()}
+            findings.extend(
+                unused_pragma_findings(
+                    relpath_to_path[relpath], pragmas,
+                    used_by_file.get(relpath, set()),
+                )
+            )
+
+    if cache_path and cache_dirty:
+        # merge into the on-disk view: a subset run (one directory, one
+        # file) must not clobber entries for files it didn't visit —
+        # but entries whose source is gone (deleted/renamed) are pruned
+        # so the cache doesn't grow monotonically
+        cache.update(records)
+        pkg = os.path.dirname(os.path.dirname(__file__))
+        cache = {
+            k: v for k, v in cache.items()
+            if k in records
+            or os.path.exists(v.get("path", os.path.join(pkg, k)))
+        }
+        out = {"engine": engine, "files": cache}
+        tmp = cache_path + ".tmp"
+        try:
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump(out, fh, separators=(",", ":"))
+            os.replace(tmp, cache_path)
+        except OSError:
+            pass
+
+    t2 = time.perf_counter()
+    return ProjectResult(
+        findings=sorted(findings),
+        lock_order=ip.lock_order,
+        lock_edges=ip.lock_edges,
+        stats={
+            "files": len(py_files),
+            "parsed": parsed,
+            "cached": len(py_files) - parsed,
+            "perfile_s": t1 - t0,
+            "interproc_s": t2 - t1,
+            "total_s": t2 - t0,
+        },
+    )

@@ -1,0 +1,485 @@
+"""Runtime sanitizer — the dynamic half of the miniovet gate.
+
+``MINIO_TPU_SANITIZE=1`` (the tier-1 conftest turns it on by default)
+installs three witnesses that check at runtime what the static passes
+prove at analysis time:
+
+- **lock-order witness** — ``threading.Lock/RLock/Condition`` objects
+  created inside the package are wrapped so every acquisition is checked
+  against the canonical ordering the static ``lock-order`` pass emitted
+  into ``docs/LOCK_ORDER.md``. Acquiring B while holding A is a
+  violation iff the static graph shows a path B ⇝ A — that runtime edge
+  closes a cycle the static pass proved absent, i.e. a latent deadlock
+  the analysis missed (through a callback, a C extension, reflection).
+- **event-loop stall watchdog** — a monotonic tick rides the loop; a
+  daemon thread that sees the tick age past
+  ``MINIO_TPU_SANITIZE_STALL_S`` captures the loop thread's stack. The
+  static ``blocking-reachable`` pass proves no *known* blocking
+  primitive is reachable; the watchdog catches the ones it cannot name
+  (native calls, pathological algorithms).
+- **env-mutation tracking** — snapshot/diff/restore helpers for
+  ``MINIO_*`` / ``MINIO_TPU_*`` process env; the tier-1 conftest uses
+  them to scope each test module's env mutations to that module and
+  fail modules that leak (the bug class PR 6 hit with
+  ``MINIO_COMPRESSION_ENABLE``).
+
+Every violation is appended to an in-process ring (``events()``) and
+published as an ``obs`` record with ``type="sanitizer"`` so ``mc admin
+trace``-style subscribers see sanitizer hits inline with the request
+flow. Witnesses only ever *report* — they never raise into application
+code; enforcement lives in the test harness.
+
+Import-light like the rest of the analysis package: stdlib + obs (also
+stdlib-only).
+"""
+
+from __future__ import annotations
+
+import linecache
+import os
+import re
+import sys
+import threading
+import time
+import traceback
+from collections import deque
+
+_TRUTHY = ("1", "on", "true", "yes")
+
+# module ring of sanitizer events; tests and admin surfaces read it
+_EVENTS: deque = deque(maxlen=256)
+_events_mu = threading.Lock()
+
+_installed = False
+_real_lock = threading.Lock
+_real_rlock = threading.RLock
+
+# canonical lock id -> rank, and direct edge map, from the static pass
+_ranks: dict[str, int] = {}
+_reach: dict[str, frozenset] = {}
+
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_ASSIGN_RE = re.compile(r"(?:self|cls)?\.?([A-Za-z_][A-Za-z0-9_]*)\s*=")
+
+
+def enabled() -> bool:
+    return os.environ.get("MINIO_TPU_SANITIZE", "0").lower() in _TRUTHY
+
+
+def stall_threshold_s() -> float:
+    raw = os.environ.get("MINIO_TPU_SANITIZE_STALL_S", "0.5")
+    try:
+        v = float(raw)
+    except ValueError:
+        return 0.5
+    return v if v > 0 else 0.5
+
+
+def events(name: str | None = None) -> list[dict]:
+    with _events_mu:
+        recs = list(_EVENTS)
+    return [r for r in recs if name is None or r["name"] == name]
+
+
+def clear_events() -> None:
+    with _events_mu:
+        _EVENTS.clear()
+
+
+def _report(name: str, **fields) -> None:
+    rec = {"time": time.time(), "type": "sanitizer", "name": name}
+    rec.update(fields)
+    with _events_mu:
+        _EVENTS.append(rec)
+    try:
+        from minio_tpu import obs
+
+        obs.publish(dict(rec))
+    except Exception:
+        pass  # reporting must never take the process down
+
+
+# -- lock-order witness -----------------------------------------------------
+
+
+def load_static_order(path: str | None = None) -> bool:
+    """Parse docs/LOCK_ORDER.md (the table the static pass generated)
+    into the rank/reachability maps the witness checks against. Returns
+    False (witness stays dormant) when the doc is absent."""
+    if path is None:
+        path = os.path.join(
+            os.path.dirname(_PKG_DIR), "docs", "LOCK_ORDER.md"
+        )
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            text = fh.read()
+    except OSError:
+        return False
+    order: list[str] = []
+    edges: dict[str, list[str]] = {}
+    for line in text.splitlines():
+        m = re.match(r"\|\s*\d+\s*\|\s*`([^`]+)`\s*\|(.*)\|", line)
+        if not m:
+            continue
+        lk = m.group(1)
+        order.append(lk)
+        edges[lk] = re.findall(r"`([^`]+)`", m.group(2))
+    configure_order(order, edges)
+    return bool(order)
+
+
+def configure_order(order: list[str], edges: dict[str, list[str]]) -> None:
+    """Install a canonical ordering directly (tests use this to drive
+    the witness with a synthetic graph)."""
+    global _ranks, _reach
+    _ranks = {lk: i for i, lk in enumerate(order)}
+    # transitive closure: _reach[a] = every lock reachable from a
+    reach: dict[str, set] = {}
+
+    def dfs(a: str) -> set:
+        if a in reach:
+            return reach[a]
+        reach[a] = set()  # cycle guard (static graph is acyclic anyway)
+        out: set = set()
+        for b in edges.get(a, ()):
+            out.add(b)
+            out |= dfs(b)
+        reach[a] = out
+        return out
+
+    for a in list(edges):
+        dfs(a)
+    _reach = {a: frozenset(s) for a, s in reach.items()}
+
+
+class _HeldState(threading.local):
+    def __init__(self) -> None:
+        # acquisition cells, acquisition order: each is [canonical_id]
+        # while the acquisition is live, emptied when released. Cells —
+        # not bare ids — because threading.Lock may legally be released
+        # by a DIFFERENT thread (completion-signal pattern): the releaser
+        # kills the cell, the acquiring thread's stack purges it lazily.
+        self.stack: list[list] = []
+        self.reporting = False       # re-entrancy guard
+
+_held = _HeldState()
+
+
+def _check_acquire(lock_id: str) -> None:
+    st = _held
+    if st.reporting or not _ranks:
+        return
+    if st.stack and not all(st.stack):
+        st.stack[:] = [c for c in st.stack if c]  # purge dead cells
+    if lock_id in _ranks:
+        for cell in st.stack:
+            if not cell:
+                continue  # killed by a cross-thread release mid-scan
+            held_id = cell[0]
+            if held_id == lock_id:
+                continue  # same class: per-instance, rank-equal
+            # runtime edge held -> lock_id closes a cycle iff the static
+            # graph already demands lock_id ⇝ held
+            if held_id in _reach.get(lock_id, ()):
+                st.reporting = True
+                try:
+                    _report(
+                        "lock.order",
+                        lock=lock_id,
+                        held=held_id,
+                        thread=threading.current_thread().name,
+                        stack="".join(traceback.format_stack(limit=12)),
+                    )
+                finally:
+                    st.reporting = False
+
+
+class SanitizedLock:
+    """Witness wrapper around a real ``threading`` lock. Quacks like the
+    wrapped lock (acquire/release/locked/context manager) and keeps a
+    per-thread acquisition stack for the order check."""
+
+    __slots__ = ("_inner", "lock_id", "_cells")
+
+    def __init__(self, inner, lock_id: str):
+        self._inner = inner
+        self.lock_id = lock_id
+        self._cells: list[list] = []  # live acquisitions, any thread
+
+    def acquire(self, *a, **kw):
+        _check_acquire(self.lock_id)
+        got = self._inner.acquire(*a, **kw)
+        if got:
+            cell = [self.lock_id]
+            _held.stack.append(cell)
+            self._cells.append(cell)
+        return got
+
+    def release(self):
+        # kill the most recent live acquisition of THIS instance — even
+        # when the releaser is not the acquirer (legal for Lock); the
+        # acquiring thread's stack drops the dead cell lazily
+        if self._cells:
+            try:
+                self._cells.pop().clear()
+            except IndexError:
+                pass  # racing releasers; inner.release() will raise
+        st = _held.stack
+        if st and not all(st):
+            st[:] = [c for c in st if c]
+        return self._inner.release()
+
+    def locked(self):
+        return self._inner.locked()
+
+    def _at_fork_reinit(self):
+        # threading._after_fork reinitializes every lock in the child;
+        # Event/Condition delegate here — missing it breaks forked
+        # children (multiprocessing, our own --jobs worker pool)
+        self._cells.clear()
+        return self._inner._at_fork_reinit()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return f"<SanitizedLock {self.lock_id} {self._inner!r}>"
+
+
+class SanitizedRLock(SanitizedLock):
+    """RLock wrapper exposing the reentrant-lock protocol
+    ``threading.Condition`` probes for (``_release_save`` etc.) —
+    without it Condition falls back to the non-reentrant path and
+    ``wait()`` misjudges ownership."""
+
+    __slots__ = ()
+
+    def _release_save(self):
+        # full release of a possibly-reentrant hold: kill every live
+        # cell (an RLock is single-owner, so they are all this thread's)
+        # and remember the count so _acquire_restore rebuilds it exactly
+        count = len(self._cells)
+        for c in self._cells:
+            c.clear()
+        self._cells.clear()
+        st = _held.stack
+        st[:] = [c for c in st if c]
+        return (self._inner._release_save(), count)
+
+    def _acquire_restore(self, state):
+        inner_state, count = state
+        _check_acquire(self.lock_id)
+        r = self._inner._acquire_restore(inner_state)
+        for _ in range(max(count, 1)):
+            cell = [self.lock_id]
+            _held.stack.append(cell)
+            self._cells.append(cell)
+        return r
+
+    def _is_owned(self):
+        return self._inner._is_owned()
+
+
+def _creation_id() -> str | None:
+    """Canonical id for a lock being constructed NOW, derived from the
+    creating frame: package module + enclosing class + the assignment
+    target on the source line — the same shape the static pass canonises
+    (``cache.core.SetCache._mu``). None for locks created outside the
+    package (leave those untouched)."""
+    f = sys._getframe(2)
+    while f is not None:
+        fname = f.f_code.co_filename
+        if fname.startswith(_PKG_DIR) and not fname.endswith("sanitizer.py"):
+            break
+        # threading.py frames (Condition() allocating its RLock) keep
+        # walking out to the package-level caller
+        if "threading" not in fname and "sanitizer" not in fname:
+            return None
+        f = f.f_back
+    if f is None:
+        return None
+    rel = os.path.relpath(f.f_code.co_filename, _PKG_DIR)
+    mod = rel[:-3].replace(os.sep, ".")
+    if mod.endswith("__init__"):
+        mod = mod[: -len(".__init__")] if "." in mod else ""
+    line = linecache.getline(f.f_code.co_filename, f.f_lineno)
+    m = _ASSIGN_RE.match(line.strip())
+    attr = m.group(1) if m else f"line{f.f_lineno}"
+    slf = f.f_locals.get("self")
+    if slf is not None and f.f_code.co_name != "<module>":
+        # the static pass canonises by DEFINING class, so find the mro
+        # class whose method owns this code object — `SetCache.__init__`
+        # running for a TieredSetCache(SetCache) instance must still tag
+        # `cache.core.SetCache._mu` or the witness silently skips it
+        cls = type(slf).__name__
+        for k in type(slf).__mro__:
+            fn = vars(k).get(f.f_code.co_name)
+            if getattr(fn, "__code__", None) is f.f_code:
+                cls = k.__name__
+                break
+        return f"{mod}.{cls}.{attr}"
+    return f"{mod}.{attr}"
+
+
+def _wrapping_factory(real, cls):
+    def make(*a, **kw):
+        inner = real(*a, **kw)
+        try:
+            lock_id = _creation_id()
+        except Exception:
+            lock_id = None
+        if lock_id is None:
+            return inner
+        return cls(inner, lock_id)
+
+    # threading.Condition(lock=None) does `lock = RLock()` — keep the
+    # original reachable for anything that needs the raw factory
+    make.__wrapped__ = real
+    return make
+
+
+def install() -> bool:
+    """Idempotently install the lock witness (wrap lock creation inside
+    the package) and load the static ordering. Locks created before
+    install are not witnessed — call early (conftest import, server
+    main). Returns whether the witness is actively checking."""
+    global _installed
+    if not _installed:
+        threading.Lock = _wrapping_factory(_real_lock, SanitizedLock)
+        threading.RLock = _wrapping_factory(_real_rlock, SanitizedRLock)
+        _installed = True
+    if not _ranks:
+        load_static_order()
+    return bool(_ranks)
+
+
+def uninstall() -> None:
+    global _installed
+    threading.Lock = _real_lock
+    threading.RLock = _real_rlock
+    _installed = False
+
+
+# -- event-loop stall watchdog ---------------------------------------------
+
+
+class LoopWatchdog:
+    """Monotonic tick scheduled on the loop + a daemon thread that
+    notices the tick going stale. A stall past the threshold reports ONE
+    ``loop.stall`` event with the loop thread's current stack (the
+    offender is usually still on the frame that blocked), then re-arms
+    when the loop breathes again."""
+
+    def __init__(self, loop, threshold_s: float | None = None):
+        self.loop = loop
+        self.threshold = threshold_s or stall_threshold_s()
+        self.tick_interval = max(self.threshold / 4.0, 0.05)
+        self._last_tick = time.monotonic()
+        self._loop_thread_id: int | None = None
+        self._stalled = False
+        self._stop = threading.Event()
+        self.stalls = 0
+        self._thread = threading.Thread(
+            target=self._watch, name="minio-tpu-sanitize-watchdog",
+            daemon=True,
+        )
+
+    def start(self) -> "LoopWatchdog":
+        self._schedule_tick()
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            _watchdogs.remove(self)
+        except ValueError:
+            pass
+
+    def _schedule_tick(self) -> None:
+        def tick():
+            self._last_tick = time.monotonic()
+            self._loop_thread_id = threading.get_ident()
+            self._stalled = False
+            if not self._stop.is_set() and not self.loop.is_closed():
+                self.loop.call_later(self.tick_interval, tick)
+
+        try:
+            self.loop.call_soon_threadsafe(tick)
+        except RuntimeError:
+            pass  # loop already closed
+
+    def _watch(self) -> None:
+        while not self._stop.wait(self.tick_interval):
+            if self.loop.is_closed():
+                return
+            age = time.monotonic() - self._last_tick
+            if age < self.threshold or self._stalled:
+                continue
+            self._stalled = True  # one report per stall episode
+            self.stalls += 1
+            stack = ""
+            tid = self._loop_thread_id
+            if tid is not None:
+                frame = sys._current_frames().get(tid)
+                if frame is not None:
+                    stack = "".join(traceback.format_stack(frame, limit=16))
+            _report("loop.stall", stall_s=round(age, 3),
+                    threshold_s=self.threshold, stack=stack)
+
+
+_watchdogs: list[LoopWatchdog] = []
+
+
+def watch_loop(loop, threshold_s: float | None = None) -> LoopWatchdog:
+    wd = LoopWatchdog(loop, threshold_s).start()
+    _watchdogs.append(wd)
+    return wd
+
+
+# -- env-mutation tracking --------------------------------------------------
+
+_ENV_MISSING = "<unset>"
+
+
+def _is_tracked(name: str) -> bool:
+    return name.startswith("MINIO_")  # covers MINIO_TPU_* too
+
+
+def env_snapshot() -> dict[str, str]:
+    return {k: v for k, v in os.environ.items() if _is_tracked(k)}
+
+
+def env_diff(snapshot: dict[str, str]) -> dict[str, tuple[str, str]]:
+    """{name: (old, new)} for every tracked var that changed since the
+    snapshot; absent-on-either-side shows as the ``<unset>`` sentinel."""
+    now = env_snapshot()
+    out: dict[str, tuple[str, str]] = {}
+    for k in sorted(set(snapshot) | set(now)):
+        old = snapshot.get(k, _ENV_MISSING)
+        new = now.get(k, _ENV_MISSING)
+        if old != new:
+            out[k] = (old, new)
+    return out
+
+
+def env_restore(snapshot: dict[str, str]) -> None:
+    for k in list(os.environ):
+        if _is_tracked(k) and k not in snapshot:
+            del os.environ[k]
+    for k, v in snapshot.items():
+        if os.environ.get(k) != v:
+            os.environ[k] = v
+
+
+def report_env_leak(scope: str, diff: dict[str, tuple[str, str]]) -> None:
+    _report(
+        "env.leak", scope=scope,
+        changes={k: list(v) for k, v in diff.items()},
+    )

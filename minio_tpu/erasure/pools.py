@@ -73,6 +73,9 @@ class ServerPools:
 
     def delete_bucket(self, bucket: str, force: bool = False) -> None:
         for p in self.pools:
+            # miniovet: ignore[coherence-path] -- delegates per pool inside
+            # the loop (self.pools is never empty); every ErasureSet
+            # underneath invalidates its own cache in its locked region
             p.delete_bucket(bucket, force=force)
 
     def bucket_exists(self, bucket: str) -> bool:

@@ -73,6 +73,9 @@ class ErasureSets:
 
     def delete_bucket(self, bucket: str, force: bool = False) -> None:
         for s in self.sets:
+            # miniovet: ignore[coherence-path] -- delegates per set inside
+            # the loop (self.sets is never empty); ErasureSet.delete_bucket
+            # invalidates its own cache in its locked region
             s.delete_bucket(bucket, force=force)
 
     def bucket_exists(self, bucket: str) -> bool:

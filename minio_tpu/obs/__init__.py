@@ -26,6 +26,7 @@ from .trace import (  # noqa: F401
     TYPE_HEAL,
     TYPE_INTERNAL,
     TYPE_S3,
+    TYPE_SANITIZER,
     TYPE_SCANNER,
     TYPE_STORAGE,
     TYPE_TPU,

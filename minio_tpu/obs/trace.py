@@ -28,9 +28,10 @@ TYPE_TPU = "tpu"
 TYPE_HEAL = "heal"
 TYPE_SCANNER = "scanner"
 TYPE_FAULT = "fault"
+TYPE_SANITIZER = "sanitizer"
 TRACE_TYPES = frozenset(
     {TYPE_S3, TYPE_INTERNAL, TYPE_STORAGE, TYPE_TPU, TYPE_HEAL,
-     TYPE_SCANNER, TYPE_FAULT}
+     TYPE_SCANNER, TYPE_FAULT, TYPE_SANITIZER}
 )
 
 # (request_id, parent_span_id); spans nest by swapping the second slot

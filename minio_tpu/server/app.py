@@ -1059,6 +1059,13 @@ def make_server(
 def main(argv: list[str] | None = None) -> None:
     import argparse
 
+    from ..analysis import sanitizer
+
+    if sanitizer.enabled():
+        # before any object-layer construction so instance locks created
+        # from here on are witnessed against docs/LOCK_ORDER.md
+        sanitizer.install()
+
     from ..cluster.endpoint import parse_endpoints, remote_nodes
     from ..cluster.locks import LocalLocker, LockRESTServer, NamespaceLock, _RemoteLocker
     from ..cluster.storage_rest import StorageRESTServer, internode_token
@@ -1277,7 +1284,21 @@ def main(argv: list[str] | None = None) -> None:
 
         app["bootstrap"] = asyncio.create_task(boot_then_gateways())
 
+        if sanitizer.enabled():
+            # stall watchdog on the serving loop: blocking work that the
+            # static blocking-reachable pass could not name shows up as
+            # obs `type=sanitizer` loop.stall records with the stack
+            app["sanitize_watchdog"] = sanitizer.watch_loop(
+                asyncio.get_running_loop()
+            )
+
+    async def on_stop(app):
+        wd = app.get("sanitize_watchdog")
+        if wd is not None:
+            wd.stop()
+
     srv.app.on_startup.append(on_start)
+    srv.app.on_cleanup.append(on_stop)
     # explicit runner instead of run_app: read_bufsize lifts aiohttp's
     # 64 KiB StreamReader watermark, which otherwise pause/resumes the
     # transport 16x per MiB on large streaming PUTs (hot-path cost on the

@@ -11,17 +11,49 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 import minio_tpu
-from minio_tpu.analysis import analyze_paths
 from minio_tpu.analysis.knobs import generate_config_md
+from minio_tpu.analysis.project import analyze_project
 
 PKG_DIR = os.path.dirname(minio_tpu.__file__)
 REPO_ROOT = os.path.dirname(PKG_DIR)
 
 
-def test_package_is_clean():
-    findings = analyze_paths([PKG_DIR])
+@pytest.fixture(scope="module")
+def project_result():
+    # one whole-program run shared by the gate assertions below (the
+    # interprocedural passes need the same pass anyway)
+    return analyze_project([PKG_DIR])
+
+
+def test_package_is_clean(project_result):
+    findings = project_result.findings
     assert findings == [], "\n" + "\n".join(str(f) for f in findings)
+
+
+def test_lock_order_doc_in_sync(project_result):
+    from minio_tpu.analysis.interproc import generate_lock_order_md
+
+    path = os.path.join(REPO_ROOT, "docs", "LOCK_ORDER.md")
+    with open(path, "r", encoding="utf-8") as fh:
+        on_disk = fh.read()
+    expected = generate_lock_order_md(
+        project_result.lock_order, project_result.lock_edges
+    )
+    assert on_disk == expected, (
+        "docs/LOCK_ORDER.md is stale; regenerate with "
+        "`python -m minio_tpu.analysis --gen-lock-order` (make docs)"
+    )
+
+
+def test_lock_order_covers_cross_subsystem_edges(project_result):
+    # the orderings the runtime witness relies on: the ns-lock is taken
+    # before the cache tiers' mutexes on the mutation paths
+    order = project_result.lock_order
+    assert "nslock" in order
+    assert order.index("nslock") < order.index("cache.core.SetCache._mu")
 
 
 def test_cli_exit_codes_and_format(tmp_path):

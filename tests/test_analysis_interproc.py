@@ -1,0 +1,489 @@
+"""Interprocedural analysis engine: call graph, whole-program passes,
+incremental cache, machine-readable output (minio_tpu/analysis/project.py
++ interproc.py + output.py)."""
+
+import ast
+import json
+import os
+
+from minio_tpu.analysis.interproc import generate_lock_order_md
+from minio_tpu.analysis.output import findings_json, findings_sarif
+from minio_tpu.analysis.project import (
+    ProjectIndex,
+    analyze_project,
+    extract_summary,
+)
+
+
+def _index(**modules: str) -> ProjectIndex:
+    """Build a ProjectIndex from {relpath_stem: source} pairs."""
+    summaries = {}
+    paths = {}
+    for stem, src in modules.items():
+        relpath = stem.replace(".", "/") + ".py"
+        summaries[relpath] = extract_summary(ast.parse(src), relpath)
+        paths[relpath] = relpath
+    return ProjectIndex(summaries, paths)
+
+
+def _write_tree(base, files: dict[str, str]) -> str:
+    for rel, src in files.items():
+        p = base / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+    return str(base)
+
+
+def _rules(findings) -> set:
+    return {f.rule for f in findings}
+
+
+# -- call-graph construction ------------------------------------------------
+
+
+def test_resolves_self_methods_and_inheritance():
+    ix = _index(svc="""
+class Base:
+    def ping(self):
+        pass
+
+class Svc(Base):
+    def run(self):
+        self.ping()
+        self.local()
+
+    def local(self):
+        pass
+""")
+    assert ix.resolve_call("svc.py", "Svc.run", "self.ping") == ["svc::Base.ping"]
+    assert ix.resolve_call("svc.py", "Svc.run", "self.local") == ["svc::Svc.local"]
+
+
+def test_resolves_module_aliases_and_imported_symbols():
+    ix = _index(
+        helpers="""
+def pace():
+    pass
+""",
+        svc="""
+import helpers
+from helpers import pace as hurry
+
+def a():
+    helpers.pace()
+
+def b():
+    hurry()
+""",
+    )
+    assert ix.resolve_call("svc.py", "a", "helpers.pace") == ["helpers::pace"]
+    assert ix.resolve_call("svc.py", "b", "hurry") == ["helpers::pace"]
+
+
+def test_external_roots_never_heuristic_match():
+    ix = _index(svc="""
+import asyncio
+
+class Timer:
+    def sleep(self):
+        pass
+
+async def f():
+    await asyncio.sleep(1)
+""")
+    # asyncio is a known external import: `asyncio.sleep` must not link
+    # to the in-project unique method named `sleep`
+    assert ix.resolve_call("svc.py", "f", "asyncio.sleep") == []
+
+
+def test_local_type_inference_links_constructor_calls():
+    ix = _index(svc="""
+class Codec:
+    def encode(self):
+        pass
+
+def run():
+    c = Codec()
+    c.encode()
+""")
+    assert ix.resolve_call("svc.py", "run", "c.encode") == ["svc::Codec.encode"]
+
+
+def test_executor_submissions_recorded_as_boundary_edges():
+    src = """
+import asyncio
+
+def helper():
+    pass
+
+async def f(pool, loop):
+    await asyncio.to_thread(helper)
+    pool.submit(helper)
+    loop.run_in_executor(None, helper)
+"""
+    s = extract_summary(ast.parse(src), "svc.py")
+    kinds = {(c["expr"], c["kind"]) for c in s["functions"]["f"]["calls"]}
+    assert ("helper", "executor") in kinds
+    # three submissions, all severed from the event-loop context
+    assert sum(1 for e, k in kinds if k == "executor") >= 1
+    assert all(k != "call" for e, k in kinds if e == "helper")
+
+
+# -- blocking-reachable -----------------------------------------------------
+
+
+def test_blocking_reachable_through_sync_helper_chain(tmp_path):
+    root = _write_tree(tmp_path, {
+        "helpers.py": """
+import time
+
+class Pacer:
+    def wait_slot(self):
+        time.sleep(0.5)
+
+def pace():
+    Pacer().wait_slot()
+""",
+        "svc.py": """
+from helpers import pace
+
+async def handler():
+    pace()
+""",
+    })
+    res = analyze_project([root])
+    hits = [f for f in res.findings if f.rule == "blocking-reachable"]
+    assert len(hits) == 1
+    # the full chain is printed so the fix target is obvious
+    assert "pace" in hits[0].message and "time.sleep" in hits[0].message
+    assert hits[0].file.endswith("svc.py")
+
+
+def test_executor_boundary_severs_blocking_chain(tmp_path):
+    root = _write_tree(tmp_path, {
+        "svc.py": """
+import asyncio
+import time
+
+def helper():
+    time.sleep(0.5)  # miniovet: ignore[blocking] -- runs on executor only
+
+async def handler():
+    await asyncio.to_thread(helper)
+""",
+    })
+    res = analyze_project([root])
+    assert "blocking-reachable" not in _rules(res.findings)
+
+
+def test_awaited_calls_never_link_to_sync_methods(tmp_path):
+    # regression: `await w.drain()` (external StreamWriter) must not be
+    # linked to an unrelated in-project sync method named `drain`
+    root = _write_tree(tmp_path, {
+        "q.py": """
+import time
+
+class Queue:
+    def drain(self):
+        time.sleep(0.1)  # miniovet: ignore[blocking] -- sync shutdown helper
+""",
+        "svc.py": """
+async def send(w):
+    w.write(b"x")
+    await w.drain()
+""",
+    })
+    res = analyze_project([root])
+    assert "blocking-reachable" not in _rules(res.findings)
+
+
+def test_blocking_reachable_pragma_declassifies_source(tmp_path):
+    root = _write_tree(tmp_path, {
+        "svc.py": """
+import time
+
+def pace():
+    # miniovet: ignore[blocking, blocking-reachable] -- test pacing stub
+    time.sleep(0.5)
+
+async def handler():
+    pace()
+""",
+    })
+    res = analyze_project([root])
+    assert "blocking-reachable" not in _rules(res.findings)
+
+
+# -- lock-order -------------------------------------------------------------
+
+_LOCK_CYCLE_A = """
+import threading
+import m_b
+
+a_lock = threading.Lock()
+
+def with_a_then_b():
+    with a_lock:
+        m_b.grab_b()
+"""
+
+_LOCK_CYCLE_B = """
+import threading
+import m_a
+
+b_lock = threading.Lock()
+
+def grab_b():
+    with b_lock:
+        pass
+
+def with_b_then_a():
+    with b_lock:
+        with m_a.a_lock:
+            pass
+"""
+
+
+def test_lock_order_cycle_across_module_pair(tmp_path):
+    root = _write_tree(tmp_path, {
+        "m_a.py": _LOCK_CYCLE_A,
+        "m_b.py": _LOCK_CYCLE_B,
+    })
+    res = analyze_project([root])
+    hits = [f for f in res.findings if f.rule == "lock-order"]
+    assert len(hits) >= 1
+    assert "m_a.a_lock" in hits[0].message
+    assert "m_b.b_lock" in hits[0].message
+
+
+def test_lock_order_clean_nesting_yields_order_no_findings(tmp_path):
+    root = _write_tree(tmp_path, {
+        "m.py": """
+import threading
+
+outer_lock = threading.Lock()
+inner_lock = threading.Lock()
+
+def nested():
+    with outer_lock:
+        with inner_lock:
+            pass
+""",
+    })
+    res = analyze_project([root])
+    assert "lock-order" not in _rules(res.findings)
+    assert res.lock_order.index("m.outer_lock") < res.lock_order.index(
+        "m.inner_lock"
+    )
+    assert res.lock_edges["m.outer_lock"] == ["m.inner_lock"]
+    md = generate_lock_order_md(res.lock_order, res.lock_edges)
+    assert "| `m.outer_lock` | `m.inner_lock` |" in md
+
+
+# -- coherence-path ---------------------------------------------------------
+
+_COHERENCE_BAD = """
+class FakeSet:
+    def put_object(self, bucket, obj, data):
+        if data is None:
+            return None  # early exit skips invalidation
+        self._write(bucket, obj, data)
+        self.cache.invalidate_object(bucket, obj)
+        return obj
+
+    def _write(self, bucket, obj, data):
+        pass
+"""
+
+_COHERENCE_GOOD = """
+class FakeSet:
+    def put_object(self, bucket, obj, data):
+        if data is None:
+            raise ValueError("no data")  # exception exits are exempt
+        self._write(bucket, obj, data)
+        self.cache.invalidate_object(bucket, obj)
+        return obj
+
+    def _write(self, bucket, obj, data):
+        pass
+"""
+
+
+def test_coherence_path_flags_exit_missing_invalidation(tmp_path):
+    root = _write_tree(
+        tmp_path, {"minio_tpu/erasure/fakeset.py": _COHERENCE_BAD}
+    )
+    res = analyze_project([root])
+    hits = [f for f in res.findings if f.rule == "coherence-path"]
+    assert len(hits) == 1
+    assert "put_object" in hits[0].message
+    assert hits[0].line == 5  # the early return
+
+
+def test_coherence_path_accepts_invalidating_mutator(tmp_path):
+    root = _write_tree(
+        tmp_path, {"minio_tpu/erasure/fakeset.py": _COHERENCE_GOOD}
+    )
+    res = analyze_project([root])
+    assert "coherence-path" not in _rules(res.findings)
+
+
+def test_coherence_path_sees_invalidation_through_helper(tmp_path):
+    src = """
+class FakeSet:
+    def delete_object(self, bucket, obj):
+        self._commit(bucket, obj)
+        return True
+
+    def _commit(self, bucket, obj):
+        self.cache.invalidate_object(bucket, obj)
+"""
+    root = _write_tree(tmp_path, {"minio_tpu/erasure/fakeset.py": src})
+    res = analyze_project([root])
+    assert "coherence-path" not in _rules(res.findings)
+
+
+# -- cancellation-reachable -------------------------------------------------
+
+
+def test_cancellation_reachable_through_sync_wait_helper(tmp_path):
+    root = _write_tree(tmp_path, {
+        "svc.py": """
+class Svc:
+    def sync_wait(self, fut):
+        return fut.result()
+
+    async def shielded(self, fut):
+        try:
+            self.sync_wait(fut)
+        except Exception:
+            return None
+""",
+    })
+    res = analyze_project([root])
+    hits = [f for f in res.findings if f.rule == "cancellation-reachable"]
+    assert len(hits) == 1
+    assert "fut.result()" in hits[0].message
+
+
+def test_cancellation_reachable_quiet_when_handler_reraises(tmp_path):
+    root = _write_tree(tmp_path, {
+        "svc.py": """
+import asyncio
+
+class Svc:
+    def sync_wait(self, fut):
+        return fut.result()
+
+    async def shielded(self, fut):
+        try:
+            self.sync_wait(fut)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            return None
+""",
+    })
+    res = analyze_project([root])
+    assert "cancellation-reachable" not in _rules(res.findings)
+
+
+# -- incremental cache ------------------------------------------------------
+
+
+def test_incremental_cache_warm_run_skips_parsing(tmp_path):
+    root = _write_tree(tmp_path, {
+        "a.py": "def f():\n    pass\n",
+        "b.py": "def g():\n    pass\n",
+    })
+    cache = str(tmp_path / "cache.json")
+    cold = analyze_project([root], cache_path=cache)
+    assert cold.stats["parsed"] == 2
+    warm = analyze_project([root], cache_path=cache)
+    assert warm.stats["parsed"] == 0
+    assert warm.stats["cached"] == 2
+    assert warm.findings == cold.findings
+
+
+def test_incremental_cache_reparses_only_changed_file(tmp_path):
+    root = _write_tree(tmp_path, {
+        "a.py": "def f():\n    pass\n",
+        "b.py": "def g():\n    pass\n",
+    })
+    cache = str(tmp_path / "cache.json")
+    analyze_project([root], cache_path=cache)
+    (tmp_path / "a.py").write_text(
+        "import time\n\nasync def f():\n    time.sleep(1)\n"
+    )
+    res = analyze_project([root], cache_path=cache)
+    assert res.stats["parsed"] == 1
+    assert res.stats["cached"] == 1
+    assert "blocking" in _rules(res.findings)
+
+
+def test_subset_run_does_not_clobber_cache(tmp_path):
+    root = _write_tree(tmp_path, {
+        "pkg/a.py": "def f():\n    pass\n",
+        "pkg/b.py": "def g():\n    pass\n",
+    })
+    cache = str(tmp_path / "cache.json")
+    analyze_project([root], cache_path=cache)
+    (tmp_path / "pkg" / "a.py").write_text("def f2():\n    pass\n")
+    analyze_project([str(tmp_path / "pkg" / "a.py")], cache_path=cache)
+    with open(cache) as fh:
+        entries = json.load(fh)["files"]
+    assert len(entries) == 2  # b.py's summary survived the subset run
+
+
+# -- output formats ---------------------------------------------------------
+
+
+def test_json_output_is_stable_and_complete(tmp_path):
+    root = _write_tree(tmp_path, {
+        "svc.py": "import time\n\nasync def f():\n    time.sleep(1)\n",
+    })
+    res = analyze_project([root])
+    doc = json.loads(findings_json(res.findings, res.stats))
+    assert doc["tool"] == "miniovet"
+    assert doc["findings"][0]["rule"] == "blocking"
+    assert doc["findings"][0]["line"] == 4
+    assert "perfile_s" not in doc.get("stats", {})  # timings aren't diffable
+
+
+def test_sarif_output_shape(tmp_path):
+    root = _write_tree(tmp_path, {
+        "svc.py": "import time\n\nasync def f():\n    time.sleep(1)\n",
+    })
+    res = analyze_project([root])
+    doc = json.loads(findings_sarif(res.findings))
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "miniovet"
+    assert {r["id"] for r in run["tool"]["driver"]["rules"]} == {"blocking"}
+    result = run["results"][0]
+    assert result["ruleId"] == "blocking"
+    loc = result["locations"][0]["physicalLocation"]
+    assert loc["region"]["startLine"] == 4
+    assert loc["artifactLocation"]["uri"].endswith("svc.py")
+
+
+def test_interproc_findings_respect_pragmas(tmp_path):
+    root = _write_tree(tmp_path, {
+        "minio_tpu/erasure/fakeset.py": """
+class FakeSet:
+    def put_object(self, bucket, obj, data):
+        if data is None:
+            # miniovet: ignore[coherence-path] -- nothing written, nothing stale
+            return None
+        self._write(bucket, obj, data)
+        self.cache.invalidate_object(bucket, obj)
+        return obj
+
+    def _write(self, bucket, obj, data):
+        pass
+""",
+    })
+    res = analyze_project([root])
+    assert "coherence-path" not in _rules(res.findings)
+    # and the pragma counts as used (no `pragma` finding either)
+    assert "pragma" not in _rules(res.findings)

@@ -8,7 +8,6 @@ import os
 
 os.environ.setdefault("MINIO_TPU_BACKEND", "numpy")
 os.environ.setdefault("MINIO_TPU_SCAN_INTERVAL", "0")
-os.environ["MINIO_COMPRESSION_ENABLE"] = "on"
 
 import glob
 
@@ -18,8 +17,23 @@ from minio_tpu.client import S3Client
 from tests.test_s3_api import ServerThread
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _compression_on():
+    # module-scoped, restored on teardown: an import-time
+    # `os.environ["MINIO_COMPRESSION_ENABLE"] = "on"` here leaked into
+    # every later-alphabet server test (masking etag bugs, PR 6 notes) —
+    # exactly the class the env sanitizer now fails modules for
+    prev = os.environ.get("MINIO_COMPRESSION_ENABLE")
+    os.environ["MINIO_COMPRESSION_ENABLE"] = "on"
+    yield
+    if prev is None:
+        del os.environ["MINIO_COMPRESSION_ENABLE"]
+    else:
+        os.environ["MINIO_COMPRESSION_ENABLE"] = prev
+
+
 @pytest.fixture(scope="module")
-def server(tmp_path_factory):
+def server(tmp_path_factory, _compression_on):
     base = tmp_path_factory.mktemp("sse-drives")
     st = ServerThread([str(base / f"d{i}") for i in range(4)])
     st.base = str(base)
