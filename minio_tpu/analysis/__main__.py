@@ -82,6 +82,13 @@ def main(argv: list[str] | None = None) -> int:
         help="write the canonical lock-ordering table proved cycle-free "
              "by the lock-order pass and exit ('-' prints to stdout)",
     )
+    ap.add_argument(
+        "--gen-concurrency", nargs="?", const="docs/CONCURRENCY.md",
+        default=None, metavar="PATH",
+        help="write the guarded-by table inferred by the races pass "
+             "(the runtime access witness loads it) and exit "
+             "('-' prints to stdout)",
+    )
     args = ap.parse_args(argv)
 
     if args.list_rules:
@@ -106,6 +113,9 @@ def main(argv: list[str] | None = None) -> int:
             # skips the pass would silently write an empty table the
             # runtime witness then loads as "no ordering to check"
             rules.append("lock-order")
+        if args.gen_concurrency is not None and "races" not in rules:
+            # same contract for the guarded-by table
+            rules.append("races")
 
     cache_path = None
     if (args.cache or args.cache_file) and not args.no_cache:
@@ -121,16 +131,16 @@ def main(argv: list[str] | None = None) -> int:
         # bare `--clean-cache` (no paths, no cache to rebuild, no doc to
         # generate) is a standalone "delete the cache" command; explicit
         # paths always analyze — deleting the cache must never skip them
-        if not args.paths and cache_path is None and args.gen_lock_order is None:
+        if not args.paths and cache_path is None \
+                and args.gen_lock_order is None \
+                and args.gen_concurrency is None:
             return 0
 
     result = analyze_project(
         paths, rules=rules, jobs=max(args.jobs, 1), cache_path=cache_path
     )
 
-    if args.gen_lock_order is not None:
-        from .interproc import generate_lock_order_md
-
+    if args.gen_lock_order is not None or args.gen_concurrency is not None:
         gate = result.findings
         if not args.strict:  # same pragma filtering as the normal path
             gate = [f for f in gate if f.rule != "pragma"]
@@ -138,14 +148,26 @@ def main(argv: list[str] | None = None) -> int:
             for f in sorted(gate):
                 print(f, file=sys.stderr)
             print(
-                "miniovet: refusing to generate the lock-order doc from a "
-                "tree with findings", file=sys.stderr,
+                "miniovet: refusing to generate docs from a tree with "
+                "findings", file=sys.stderr,
             )
             return 1
-        return _write_doc(
-            args.gen_lock_order,
-            generate_lock_order_md(result.lock_order, result.lock_edges),
-        )
+        rc = 0
+        if args.gen_lock_order is not None:
+            from .interproc import generate_lock_order_md
+
+            rc = _write_doc(
+                args.gen_lock_order,
+                generate_lock_order_md(result.lock_order, result.lock_edges),
+            )
+        if args.gen_concurrency is not None and rc == 0:
+            from .rules_races import generate_concurrency_md
+
+            rc = _write_doc(
+                args.gen_concurrency,
+                generate_concurrency_md(result.guard_table),
+            )
+        return rc
 
     findings = result.findings
     if not args.strict and rules is None:

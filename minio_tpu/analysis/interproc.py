@@ -59,6 +59,7 @@ class IPResult:
     findings: list[Finding] = field(default_factory=list)
     lock_order: list[str] = field(default_factory=list)
     lock_edges: dict[str, list[str]] = field(default_factory=dict)
+    guard_table: list[dict] = field(default_factory=list)
 
 
 def run_passes(index: ProjectIndex, passes, suppressed=None) -> IPResult:
@@ -78,6 +79,12 @@ def run_passes(index: ProjectIndex, passes, suppressed=None) -> IPResult:
         res.findings.extend(eng.coherence_path())
     if "cancellation-reachable" in passes:
         res.findings.extend(eng.cancellation_reachable())
+    if "races" in passes:
+        from . import rules_races
+
+        findings, table = rules_races.run(index, suppressed)
+        res.findings.extend(findings)
+        res.guard_table = table
     res.findings.sort()
     return res
 

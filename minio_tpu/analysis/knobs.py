@@ -241,6 +241,13 @@ _ALL: list[Knob] = [
        "Event-loop stall watchdog threshold in seconds: the loop "
        "missing its monotonic tick for longer than this records one "
        "`loop.stall` sanitizer event with the loop thread's stack."),
+    _k("MINIO_TPU_SANITIZE_ATTRS", "1", "analysis",
+       "Attribute access witness under MINIO_TPU_SANITIZE=1: the "
+       "cross-context attributes the static `races` pass emitted into "
+       "docs/CONCURRENCY.md are descriptor-wrapped so every touch "
+       "records the accessing thread + held-lock witness; a live "
+       "lockset inconsistency reports an `attr.race` sanitizer event. "
+       "0 disables just this witness."),
     # -- qos --------------------------------------------------------------
     _k("MINIO_TPU_API_ADMIN_REQUESTS_MAX", None, "qos",
        "Admin-API inflight cap (helper default 64)."),

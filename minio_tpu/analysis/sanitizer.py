@@ -49,6 +49,9 @@ _TRUTHY = ("1", "on", "true", "yes")
 # module ring of sanitizer events; tests and admin surfaces read it
 _EVENTS: deque = deque(maxlen=256)
 _events_mu = threading.Lock()
+# persistent per-name violation counters (the ring is bounded; metrics
+# need monotonic series that survive ring turnover)
+_COUNTS: dict = {}
 
 _installed = False
 _real_lock = threading.Lock
@@ -92,12 +95,15 @@ def _report(name: str, **fields) -> None:
     rec.update(fields)
     with _events_mu:
         _EVENTS.append(rec)
+        _COUNTS[name] = _COUNTS.get(name, 0) + 1
     try:
         from minio_tpu import obs
 
         obs.publish(dict(rec))
     except Exception:
         pass  # reporting must never take the process down
+
+
 
 
 # -- lock-order witness -----------------------------------------------------
@@ -364,6 +370,280 @@ def uninstall() -> None:
     threading.Lock = _real_lock
     threading.RLock = _real_rlock
     _installed = False
+
+
+# -- attribute access witness ----------------------------------------------
+#
+# The dynamic half of the static `races` pass: the attributes the pass
+# proved reachable from more than one execution context
+# (docs/CONCURRENCY.md) are wrapped in a data descriptor that records,
+# per touch, the accessing thread and the set of witnessed locks it
+# holds (the lock witness's per-thread stack). Eraser-style lockset
+# refinement, coarse (per class attribute, not per instance) and
+# report-only:
+#
+# - while only one thread has ever touched the attribute, nothing is
+#   checked (exclusive phase — matches the static pass's
+#   init-before-spawn reasoning; `__init__` frames are skipped too);
+# - once a second thread appears, the candidate lockset is the running
+#   intersection of every touch's held locks; a WRITE in the shared
+#   phase with the intersection empty is a live lockset violation
+#   (`attr.race`);
+# - when the static table declared a guard, a shared-phase write that
+#   does not hold that specific lock reports `attr.race` with
+#   kind="guard-miss" — the runtime disagreeing with the inferred
+#   guard is exactly the cross-validation signal the static pass
+#   cannot produce alone.
+
+_WATCHED: dict = {}   # "module.Class.attr" -> _WitnessedAttr
+
+
+def load_concurrency_table(path: str | None = None) -> dict[str, str]:
+    """Parse docs/CONCURRENCY.md into {witness attr id: declared guard}
+    (empty string = no guard inferred). Returns {} when absent."""
+    if path is None:
+        path = os.path.join(
+            os.path.dirname(_PKG_DIR), "docs", "CONCURRENCY.md"
+        )
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            text = fh.read()
+    except OSError:
+        return {}
+    out: dict[str, str] = {}
+    for line in text.splitlines():
+        if not line.startswith("|"):
+            continue
+        cells = [c.strip() for c in line.strip().strip("|").split("|")]
+        # | attr | witness | contexts | guard | r/w | status |
+        if len(cells) != 6 or not cells[1].startswith("`"):
+            continue
+        witness = cells[1].strip("`")
+        guard = cells[3].strip("`_()")
+        if guard == "none":
+            guard = ""
+        if witness not in out:
+            out[witness] = guard
+        elif out[witness] != guard:
+            # several access paths share this leaf but disagree on the
+            # guard (two holders of one value class, each with its own
+            # lock): no single lock is THE guard, so the witness falls
+            # back to pure lockset refinement — a declared-guard check
+            # here would report false guard-misses
+            out[witness] = ""
+    return out
+
+
+class _WitnessedAttr:
+    """Data descriptor wrapping one class attribute with the access
+    witness. Plain-dict classes store the value under the same key in
+    the instance ``__dict__`` (data descriptors shadow it, so reads and
+    writes still flow through here and ``vars(obj)`` stays unchanged);
+    slotted classes delegate to the original slot descriptor."""
+
+    def __init__(self, name: str, attr_id: str, guard: str, base=None):
+        self.name = name
+        self.attr_id = attr_id
+        self.guard = guard
+        self.base = base  # original slot/member descriptor, if any
+        self._mu = _real_lock()
+        self._first_tid: int | None = None
+        self._shared = False
+        self._lockset: frozenset | None = None
+        self._shared_write = False
+        self._reported = False
+
+    # -- storage -----------------------------------------------------------
+
+    def _load(self, obj):
+        if self.base is not None:
+            return self.base.__get__(obj, type(obj))
+        try:
+            return obj.__dict__[self.name]
+        except KeyError:
+            raise AttributeError(self.name) from None
+
+    def _store(self, obj, value):
+        if self.base is not None:
+            self.base.__set__(obj, value)
+        else:
+            obj.__dict__[self.name] = value
+
+    # -- witness -----------------------------------------------------------
+
+    def _touch(self, rw: str) -> None:
+        st = _held
+        if st.reporting:
+            return
+        # constructor writes are ownership transfer, not sharing — the
+        # same init-before-spawn reasoning the static pass applies
+        if rw == "w":
+            f = sys._getframe(2)
+            if f is not None and f.f_code.co_name in (
+                "__init__", "__new__", "__post_init__",
+            ):
+                return
+        held = frozenset(c[0] for c in st.stack if c)
+        tid = threading.get_ident()
+        report = None
+        with self._mu:
+            if self._first_tid is None:
+                self._first_tid = tid
+            if tid != self._first_tid:
+                self._shared = True
+            if self._shared:
+                if self._lockset is None:
+                    self._lockset = held
+                else:
+                    self._lockset = self._lockset & held
+                if rw == "w":
+                    self._shared_write = True
+                    if self.guard and self.guard not in held \
+                            and not self._reported:
+                        self._reported = True
+                        report = ("guard-miss", held)
+                if (
+                    report is None
+                    and self._shared_write
+                    and not self._lockset
+                    and not self._reported
+                ):
+                    self._reported = True
+                    report = ("lockset-empty", held)
+        if report is not None:
+            kind, held_now = report
+            st.reporting = True
+            try:
+                _report(
+                    "attr.race",
+                    attr=self.attr_id,
+                    kind=kind,
+                    rw=rw,
+                    guard=self.guard,
+                    held=sorted(held_now),
+                    thread=threading.current_thread().name,
+                    stack="".join(traceback.format_stack(limit=10)),
+                )
+            finally:
+                st.reporting = False
+
+    # -- descriptor protocol ------------------------------------------------
+
+    def __get__(self, obj, objtype=None):
+        if obj is None:
+            return self
+        self._touch("r")
+        return self._load(obj)
+
+    def __set__(self, obj, value):
+        self._touch("w")
+        self._store(obj, value)
+
+    def __delete__(self, obj):
+        self._touch("w")
+        if self.base is not None:
+            self.base.__delete__(obj)
+        else:
+            obj.__dict__.pop(self.name, None)
+
+    def __repr__(self):
+        return f"<_WitnessedAttr {self.attr_id}>"
+
+
+def attrs_enabled() -> bool:
+    raw = os.environ.get("MINIO_TPU_SANITIZE_ATTRS", "1").lower()
+    return raw in _TRUTHY
+
+
+def watch_class_attr(cls, name: str, attr_id: str, guard: str = "") -> bool:
+    """Install the witness descriptor for one class attribute. Slotted
+    classes wrap the member descriptor; dict-backed classes shadow the
+    instance dict key. Idempotent."""
+    current = cls.__dict__.get(name)
+    if isinstance(current, _WitnessedAttr):
+        return True
+    base = None
+    if current is not None:
+        if hasattr(current, "__get__") and hasattr(current, "__set__"):
+            base = current  # slot/member descriptor
+        else:
+            return False  # class-level constant/method: not instance state
+    try:
+        setattr(cls, name, _WitnessedAttr(name, attr_id, guard, base=base))
+    except (AttributeError, TypeError):
+        return False
+    _WATCHED[attr_id] = (cls, name, cls.__dict__[name])
+    return True
+
+
+def arm_access_witness(table: dict[str, str] | None = None) -> int:
+    """Instrument every already-imported class the concurrency table
+    names. Call AFTER the serving modules are imported (server startup,
+    test setup) — classes imported later can be armed by calling again.
+    Returns how many attributes are actively witnessed."""
+    if not attrs_enabled():
+        return 0
+    if table is None:
+        table = load_concurrency_table()
+    armed = 0
+    for attr_id, guard in sorted(table.items()):
+        if attr_id in _WATCHED:
+            armed += 1
+            continue
+        parts = attr_id.split(".")
+        if len(parts) < 3:
+            continue
+        mod_name = "minio_tpu." + ".".join(parts[:-2])
+        cls_name, attr = parts[-2], parts[-1]
+        mod = sys.modules.get(mod_name)
+        if mod is None:
+            continue
+        cls = getattr(mod, cls_name, None)
+        if not isinstance(cls, type):
+            continue
+        try:
+            if watch_class_attr(cls, attr, attr_id, guard):
+                armed += 1
+        except Exception:
+            continue  # witness must never break imports/serving
+    return armed
+
+
+def witnessed_attrs() -> list[str]:
+    return sorted(_WATCHED)
+
+
+def disarm_access_witness() -> None:
+    """Remove every installed witness descriptor (tests)."""
+    for attr_id, (cls, name, desc) in list(_WATCHED.items()):
+        if cls.__dict__.get(name) is desc:
+            if desc.base is not None:
+                setattr(cls, name, desc.base)
+            else:
+                try:
+                    delattr(cls, name)
+                except AttributeError:
+                    pass
+        _WATCHED.pop(attr_id, None)
+
+
+def status() -> dict:
+    """Aggregate sanitizer state for the admin ``sanitizer/status``
+    endpoint and the metrics-v3 ``/api/sanitizer`` group."""
+    with _events_mu:
+        recent = list(_EVENTS)[-32:]
+        counts = dict(_COUNTS)
+    return {
+        "enabled": enabled(),
+        "lockWitnessInstalled": _installed,
+        "staticLockRanks": len(_ranks),
+        "witnessedAttrs": witnessed_attrs(),
+        "violations": counts,
+        "stallEpisodes": sum(w.stalls for w in _watchdogs),
+        "recent": [
+            {k: v for k, v in r.items() if k != "stack"} for r in recent
+        ],
+    }
 
 
 # -- event-loop stall watchdog ---------------------------------------------

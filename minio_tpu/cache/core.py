@@ -229,6 +229,21 @@ class DataCache:
         with self._mu:
             self.stats.hits += 1
 
+    def count_miss(self) -> None:
+        # counters are bumped from every executor-pool reader thread:
+        # += outside _mu is a lost update (miniovet races pass)
+        with self._mu:
+            self.stats.misses += 1
+
+    def restamp(self, ent: _DataEntry, epoch: int) -> None:
+        """Re-certify an entry after revalidation: the epoch/time stamps
+        are written under _mu — two concurrent readers revalidating the
+        same hot entry would otherwise interleave the pair."""
+        with self._mu:
+            ent.epoch = epoch
+            ent.t = time.monotonic()
+            self.stats.revalidations += 1
+
     def drop(self, k: tuple) -> None:
         """Internal removal (caller: SetCache choke point)."""
         with self._mu:
@@ -316,12 +331,17 @@ class SetCache:
         # not a thundering herd of them
         def attempt():
             if stale is not None and self._revalidate(key, stale):
-                self.fi_stats.hits += 1
-                self.fi_stats.revalidations += 1
+                # the singleflight owner runs on some pool thread while
+                # the hit path bumps the same counters under _mu — take
+                # it here too (miniovet races pass)
+                with self._mu:
+                    self.fi_stats.hits += 1
+                    self.fi_stats.revalidations += 1
                 span_lookup("fileinfo", bucket, obj, True)
                 return stale.fi, stale.metas, False  # re-stamped in place
             span_lookup("fileinfo", bucket, obj, False)
-            self.fi_stats.misses += 1
+            with self._mu:
+                self.fi_stats.misses += 1
             fi, metas = loader()
             return fi, metas, True
 
@@ -485,11 +505,9 @@ class SetCache:
             return None
         if ent.epoch != self._epoch or (self._needs_ttl_check(ent)):
             if not self._revalidate_data((bucket, obj, vid), ent):
-                _DATA.stats.misses += 1
+                _DATA.count_miss()
                 return None
-            ent.epoch = self._epoch
-            ent.t = time.monotonic()
-            _DATA.stats.revalidations += 1
+            _DATA.restamp(ent, self._epoch)
         _DATA.touch_hit()
         return ent.fi, ent.data
 

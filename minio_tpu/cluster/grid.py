@@ -496,7 +496,9 @@ class ClientStream:
     def cancel(self) -> None:
         """Abandon the stream: tell the server to cancel its handler (which
         may be parked waiting for credits) so neither side leaks state."""
-        if self._conn._streams.pop(self.mux, None) is None:
+        with self._conn._lock:
+            st = self._conn._streams.pop(self.mux, None)
+        if st is None:
             return  # already finished or errored
         try:
             self._conn._send(_frame(T_STR_CANCEL, self.mux))
@@ -650,30 +652,41 @@ class GridClient:
                 stats_add("rx_bytes", len(msg))
                 ftype, mux = _HDR.unpack_from(msg)
                 payload = msg[_HDR.size:]
+                # mux-table lookups take _lock: `_drop` (fired from the
+                # keepalive thread or any caller thread whose send
+                # failed) swaps _calls/_streams under it, and an
+                # unlocked pop here could deliver into the already-
+                # failed generation's table (miniovet races pass)
                 if ftype == T_RESP:
-                    q = self._calls.pop(mux, None)
+                    with self._lock:
+                        q = self._calls.pop(mux, None)
                     if q is not None:
                         q.put(payload)
                 elif ftype == T_STR_MSG:
-                    st = self._streams.get(mux)
+                    with self._lock:
+                        st = self._streams.get(mux)
                     if st is not None:
                         st._inbox.put(payload)
                 elif ftype == T_STR_EOF:
-                    st = self._streams.pop(mux, None)
+                    with self._lock:
+                        st = self._streams.pop(mux, None)
                     if st is not None:
                         st._inbox.put(None)
                 elif ftype == T_STR_ERR:
-                    st = self._streams.pop(mux, None)
+                    with self._lock:
+                        st = self._streams.pop(mux, None)
                     if st is not None:
                         et, em = msgpack.unpackb(payload, raw=False)
                         st._inbox.put(RemoteError(et, em))
                 elif ftype == T_STR_CREDIT:
-                    st = self._streams.get(mux)
+                    with self._lock:
+                        st = self._streams.get(mux)
                     if st is not None:
                         for _ in range(msgpack.unpackb(payload, raw=False)):
                             st._send_credits.release()
                 elif ftype == T_PONG:
-                    self._last_pong = time.monotonic()
+                    with self._lock:
+                        self._last_pong = time.monotonic()
         except (GridError, OSError):
             pass
         finally:
@@ -696,7 +709,9 @@ class GridClient:
             except OSError:
                 self._drop(ws)
                 return
-            if time.monotonic() - self._last_pong > 2 * self._ping_interval:
+            with self._lock:
+                last_pong = self._last_pong
+            if time.monotonic() - last_pong > 2 * self._ping_interval:
                 self._drop(ws)
                 return
 
@@ -762,10 +777,12 @@ class GridClient:
                 self._send(_frame(T_REQ, mux, msgpack.packb([handler, payload])))
                 resp = q.get(timeout=wait_s)
             except queue.Empty:
-                self._calls.pop(mux, None)
+                with self._lock:
+                    self._calls.pop(mux, None)
                 raise GridTimeout(f"grid call {handler}: timeout") from None
             except GridError:
-                self._calls.pop(mux, None)
+                with self._lock:
+                    self._calls.pop(mux, None)
                 raise
             if isinstance(resp, Exception):
                 raise resp

@@ -88,7 +88,15 @@ class PoolManager:
         return st
 
     def cancel_decommission(self, pool_index: int) -> None:
-        self._cancel.add(pool_index)
+        # written from the admin handler context, read by the _drain
+        # thread: set mutation rides the same lock as the decom table
+        # (miniovet races pass)
+        with self._mu:
+            self._cancel.add(pool_index)
+
+    def _cancelled(self, pool_index: int) -> bool:
+        with self._mu:
+            return pool_index in self._cancel
 
     def status(self, pool_index: int) -> DecomStatus | None:
         return self.decoms.get(pool_index) or self.load_checkpoint(pool_index)
@@ -115,7 +123,7 @@ class PoolManager:
         try:
             for b in src.list_buckets():
                 for raw in src.walk_objects(b.name):
-                    if st.pool_index in self._cancel:
+                    if self._cancelled(st.pool_index):
                         st.state = "canceled"
                         self._save(st)
                         return

@@ -1247,8 +1247,12 @@ class ErasureSet:
                 raise ObjectNotFound(f"{bucket}/{obj}")
             already = bool(fi.metadata.get(TRANSITION_TIER_META))
             if already and not restub:
+                # miniovet: ignore[coherence-path] -- nothing written,
+                # nothing stale: the object is already transitioned
                 return
             if restub and not already:
+                # miniovet: ignore[coherence-path] -- nothing written,
+                # nothing stale: no restored copy to re-stub
                 return
             old_data_dir = fi.data_dir
             nfi = FileInfo.from_dict(fi.to_dict())
@@ -1395,6 +1399,9 @@ class ErasureSet:
                 # metas and bytes re-resolve (fault-injected bitrot/
                 # torn-write repairs flow through here too)
                 self.cache.invalidate_object(bucket, obj)
+            # miniovet: ignore[coherence-path] -- the invalidation above
+            # is conditional on purpose: a heal that repaired nothing
+            # changed nothing, so there is nothing stale to drop
             return res
 
     def _heal_object_locked(

@@ -244,7 +244,7 @@ class EventNotifier:
                 try:
                     self._q.put_nowait(_Pending(record, rule.arn))
                 except queue.Full:
-                    self.stats["dropped"] += 1
+                    self._stat("dropped")
         # listen API subscribers see every event regardless of config
         with self._mu:
             subs = list(self._listeners)
@@ -270,16 +270,24 @@ class EventNotifier:
 
     # -- delivery ----------------------------------------------------------
 
+    def _stat(self, key: str) -> None:
+        # delivery counters are bumped from the S3 handler context AND
+        # the delivery worker thread; dict += is a load/add/store
+        # interleave under the GIL, so both sides take the lock
+        # (miniovet races pass)
+        with self._mu:
+            self.stats[key] += 1
+
     def _deliver_loop(self) -> None:
         while True:
             p = self._q.get()
             target = self.targets.get(p.arn)
             if target is None:
-                self.stats["dropped"] += 1
+                self._stat("dropped")
                 continue
             try:
                 target.send(p.record)
-                self.stats["sent"] += 1
+                self._stat("sent")
             except Exception:  # noqa: BLE001 — retry with backoff
                 p.attempts += 1
                 if p.attempts < 5:
@@ -287,7 +295,7 @@ class EventNotifier:
                         min(2 ** p.attempts, 30), lambda: self._q.put(p)
                     ).start()
                 else:
-                    self.stats["failed"] += 1
+                    self._stat("failed")
 
     # -- listen API --------------------------------------------------------
 

@@ -137,10 +137,14 @@ class IAMSys:
         without restart). When the store exposes watch_changes (etcd), a
         watcher thread reloads immediately on change; the periodic pass
         stays as the fallback for missed events."""
-        if getattr(self, "_refresh_stop", None) is not None:
-            return
-        self._refresh_stop = threading.Event()
-        stop = self._refresh_stop
+        # check-then-set under the IAM lock: two concurrent callers (a
+        # re-entered set_store, a test rig) must not each spawn a
+        # refresher thread pair (miniovet races pass)
+        with self._lock:
+            if getattr(self, "_refresh_stop", None) is not None:
+                return
+            self._refresh_stop = threading.Event()
+            stop = self._refresh_stop
 
         def reload_once():
             try:

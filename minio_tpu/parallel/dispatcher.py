@@ -163,6 +163,18 @@ class TpuDispatcher:
         )
         self._thread.start()
 
+    def stats_snapshot(self) -> dict:
+        """Consistent copy of the stats dict for observers (metrics,
+        admin, QoS): the dispatcher thread mutates `stats` under `_cv`,
+        so a snapshot taken under the same lock can never observe a
+        torn histogram or a mid-batch counter mix (miniovet races
+        pass)."""
+        with self._cv:
+            return {
+                k: (list(v) if isinstance(v, list) else v)
+                for k, v in self.stats.items()
+            }
+
     def submit(self, blocks: np.ndarray, priority: int | None = None) -> Future:
         """blocks: [k, d, n] -> Future of (shards [k, t, n], digests [k, t, 32]).
 
@@ -313,7 +325,8 @@ class TpuDispatcher:
                 fp.pack_chunk_major(all_blocks), d, p
             )
             self._fused_backoff = 8  # healthy again: reset the backoff
-            self.stats["fused"] += 1
+            with self._cv:
+                self.stats["fused"] += 1
             return (
                 fp.unpack_chunk_major(np.asarray(parity_cm)),
                 np.asarray(digests),
@@ -323,7 +336,8 @@ class TpuDispatcher:
             # hiccup must not degrade the server until restart
             self._fused_cooldown = self._fused_backoff
             self._fused_backoff = min(self._fused_backoff * 2, 1024)
-            self.stats["fused_failures"] += 1
+            with self._cv:
+                self.stats["fused_failures"] += 1
             return None
 
     # -- degradation ladder ------------------------------------------------
@@ -344,13 +358,17 @@ class TpuDispatcher:
 
     def _device_fault(self, err: Exception) -> None:
         self._device_fault_streak += 1
-        self.stats["device_faults"] += 1
-        if (
-            self.stats["backend_level"] != LEVEL_NUMPY
-            and self._device_fault_streak >= self._demote_threshold
-        ):
-            self.stats["backend_level"] = LEVEL_NUMPY
-            self.stats["demotions"] += 1
+        demoted = False
+        with self._cv:
+            self.stats["device_faults"] += 1
+            if (
+                self.stats["backend_level"] != LEVEL_NUMPY
+                and self._device_fault_streak >= self._demote_threshold
+            ):
+                self.stats["backend_level"] = LEVEL_NUMPY
+                self.stats["demotions"] += 1
+                demoted = True
+        if demoted:
             self._probe_countdown = self._probe_after
             fault_registry.emit(
                 "backend.demote", shape=self._shape, to="numpy",
@@ -362,7 +380,8 @@ class TpuDispatcher:
         materialization IS the probe verdict. User traffic keeps riding
         numpy until a probe succeeds — a flapping device never fails a
         live request."""
-        self.stats["probes"] += 1
+        with self._cv:
+            self.stats["probes"] += 1
         try:
             self._tpu_fault_hook()
             blocks = np.zeros((1, self.codec.data_shards, self.n), dtype=np.uint8)
@@ -404,11 +423,15 @@ class TpuDispatcher:
             t_start = _monotonic()
             # per-item queue wait: submit -> dispatch start
             max_wait = 0.0
-            for it in batch:
-                wait = max(t_start - it[3], 0.0)
-                max_wait = max(max_wait, wait)
-                self.stats["queue_wait_s"] += wait
-                _hist_add(self.stats["queue_wait_hist"], QUEUE_WAIT_BUCKETS, wait)
+            with self._cv:
+                for it in batch:
+                    wait = max(t_start - it[3], 0.0)
+                    max_wait = max(max_wait, wait)
+                    self.stats["queue_wait_s"] += wait
+                    _hist_add(
+                        self.stats["queue_wait_hist"], QUEUE_WAIT_BUCKETS,
+                        wait,
+                    )
             try:
                 all_blocks = np.concatenate([it[0] for it in batch], axis=0)
                 # malformed input is a CALLER error: it must propagate to
@@ -445,8 +468,9 @@ class TpuDispatcher:
                     if self._probe_countdown <= 0:
                         if self._probe_device():
                             level = LEVEL_XLA
-                            self.stats["backend_level"] = level
-                            self.stats["promotions"] += 1
+                            with self._cv:
+                                self.stats["backend_level"] = level
+                                self.stats["promotions"] += 1
                             self._device_fault_streak = 0
                             fault_registry.emit(
                                 "backend.promote", shape=self._shape
@@ -483,10 +507,11 @@ class TpuDispatcher:
                         # when the fused rung is faulted out (cooldown); a
                         # benign fused skip (unsupported shape, big bucket,
                         # MINIO_TPU_FUSED_CM=0) reads healthy
-                        if self._fused_cooldown > 0:
-                            self.stats["backend_level"] = LEVEL_XLA
-                        else:
-                            self.stats["backend_level"] = LEVEL_FUSED
+                        with self._cv:
+                            if self._fused_cooldown > 0:
+                                self.stats["backend_level"] = LEVEL_XLA
+                            else:
+                                self.stats["backend_level"] = LEVEL_FUSED
                     except Exception as e:  # noqa: BLE001 — serve degraded
                         # the device rung failed mid-batch: waiters get
                         # numpy results instead of errors, the ladder
@@ -497,33 +522,39 @@ class TpuDispatcher:
                     device_s = _monotonic() - t_dev
                 if parity is None:
                     parity, digests = self._encode_numpy(all_blocks[:k])
-                    self.stats["numpy_blocks"] += k
+                    with self._cv:
+                        self.stats["numpy_blocks"] += k
                 shards = np.concatenate(
                     [all_blocks[:k], parity], axis=1
                 )  # [B, t, n]
-                self.stats["dispatches"] += 1
-                self.stats["blocks"] += k
-                self.stats["max_batch"] = max(self.stats["max_batch"], k)
                 occupancy = 100.0 * k / max(all_blocks.shape[0], 1)
-                self.stats["occupancy_pct_sum"] += occupancy
-                self.stats["device_s"] += device_s
-                _hist_add(
-                    self.stats["device_time_hist"], DEVICE_TIME_BUCKETS, device_s
-                )
+                with self._cv:
+                    self.stats["dispatches"] += 1
+                    self.stats["blocks"] += k
+                    self.stats["max_batch"] = max(self.stats["max_batch"], k)
+                    self.stats["occupancy_pct_sum"] += occupancy
+                    self.stats["device_s"] += device_s
+                    _hist_add(
+                        self.stats["device_time_hist"], DEVICE_TIME_BUCKETS,
+                        device_s,
+                    )
+                    for it in batch:
+                        kk = it[0].shape[0]
+                        if it[2] == PRI_BACKGROUND:
+                            self.stats["bg_blocks"] += kk
+                        else:
+                            self.stats["fg_blocks"] += kk
                 off = 0
                 for it in batch:
-                    blocks, fut, pri = it[0], it[1], it[2]
+                    blocks, fut = it[0], it[1]
                     kk = blocks.shape[0]
-                    if pri == PRI_BACKGROUND:
-                        self.stats["bg_blocks"] += kk
-                    else:
-                        self.stats["fg_blocks"] += kk
                     fut.set_result(
                         (shards[off : off + kk], digests[off : off + kk])
                     )
                     off += kk
                 host_s = _monotonic() - t_start - device_s
-                self.stats["host_s"] += host_s
+                with self._cv:
+                    self.stats["host_s"] += host_s
                 if obs.active():
                     req_ids = sorted({it[4] for it in batch if it[4]})
                     obs.publish({
@@ -572,10 +603,12 @@ def get_dispatcher(codec, n: int) -> TpuDispatcher:
 
 def aggregate_stats() -> dict:
     """Summed stats across every live dispatcher (metrics/admin plane).
-    Histogram lists sum element-wise; max-style gauges take the max."""
+    Histogram lists sum element-wise; max-style gauges take the max.
+    Reads per-dispatcher snapshots (taken under each dispatcher's lock)
+    so a scrape racing a dispatch never mixes halves of one batch."""
     out: dict = {}
     for d in list(_dispatchers.values()):
-        for k, v in d.stats.items():
+        for k, v in d.stats_snapshot().items():
             if k == "backend_level":
                 # most-degraded rung across shapes: the alarming signal
                 out[k] = min(out.get(k, LEVEL_FUSED), v)

@@ -118,7 +118,10 @@ class AdmissionController:
             CLASS_BACKGROUND: policy(bg_max),
         })
 
-    def _state(self, name: str) -> _ClassState:
+    def _state_locked(self, name: str) -> _ClassState:
+        # `_locked` suffix = caller holds self._cv (every call site is a
+        # `with self._cv:` block); the lazy insert into _cls would be a
+        # lost-update race without it (miniovet races pass)
         st = self._cls.get(name)
         if st is None:  # unknown class: unlimited, but still observable
             st = self._cls[name] = _ClassState(
@@ -135,7 +138,7 @@ class AdmissionController:
         deadline, or sustained saturation would preferentially 503 the
         oldest requests."""
         with self._cv:
-            st = self._state(name)
+            st = self._state_locked(name)
             if st.policy.max_inflight <= 0 or (
                 st.inflight < st.policy.max_inflight and st.waiting == 0
             ):
@@ -153,7 +156,7 @@ class AdmissionController:
         Returns the absolute monotonic deadline, or None when the wait
         queue is full (caller answers SlowDown now)."""
         with self._cv:
-            st = self._state(name)
+            st = self._state_locked(name)
             if st.waiting >= st.policy.max_waiters:
                 st.rejected_full += 1
                 return None
@@ -164,7 +167,7 @@ class AdmissionController:
         """Blocking companion of begin_wait: wait for a slot until the
         absolute `deadline`. Always consumes the waiter reservation."""
         with self._cv:
-            st = self._state(name)
+            st = self._state_locked(name)
             try:
                 while True:
                     pol = st.policy  # re-read: set_policy retunes waiters
@@ -184,7 +187,7 @@ class AdmissionController:
         """Undo a begin_wait reservation whose finish_wait will never run
         (the executor task was cancelled before starting)."""
         with self._cv:
-            st = self._state(name)
+            st = self._state_locked(name)
             if st.waiting > 0:
                 st.waiting -= 1
 
@@ -202,7 +205,7 @@ class AdmissionController:
 
     def release(self, name: str) -> None:
         with self._cv:
-            st = self._state(name)
+            st = self._state_locked(name)
             if st.inflight > 0:
                 st.inflight -= 1
             self._cv.notify_all()
@@ -228,5 +231,5 @@ class AdmissionController:
     def set_policy(self, name: str, policy: ClassPolicy) -> None:
         """Runtime retune (admin/config plane; tests)."""
         with self._cv:
-            self._state(name).policy = policy
+            self._state_locked(name).policy = policy
             self._cv.notify_all()

@@ -181,7 +181,10 @@ class SiteReplicationSys:
                 except Exception:  # noqa: BLE001
                     pass
             raise
-        self.name, self.peers = my_name, peers
+        # group membership commits under _mu: `load` (lazy, any handler
+        # thread) and `join` write the same pair (miniovet races pass)
+        with self._mu:
+            self.name, self.peers = my_name, peers
         self.save()
         self._ensure_worker()
         self.initial_sync()
@@ -200,8 +203,9 @@ class SiteReplicationSys:
             )
             for p in doc["peers"]
         ]
-        self.name = doc["you"]
-        self.peers = peers
+        with self._mu:
+            self.name = doc["you"]
+            self.peers = peers
         self.save()
         if not peers:
             return  # disbanded
@@ -248,12 +252,12 @@ class SiteReplicationSys:
             self._q.put_nowait(
                 _SyncItem(kind, payload, pending=[p.name for p in self.others()])
             )
-            self.stats["queued"] += 1
+            self._stat("queued")
         except queue.Full:
             if kind == "iam":
                 with self._mu:
                     self._iam_pending = False
-            self.stats["failed"] += 1
+            self._stat("failed")
 
     def sync_bucket_create(self, bucket: str) -> None:
         self._enqueue("bucket-create", {"bucket": bucket})
@@ -289,6 +293,13 @@ class SiteReplicationSys:
                 "ldap_policy_map": dict(iam.ldap_policy_map),
             }
 
+    def _stat(self, key: str) -> None:
+        # sync counters are bumped from handler contexts and the
+        # site-repl worker thread; dict += is not atomic under the GIL
+        # (miniovet races pass)
+        with self._mu:
+            self.stats[key] += 1
+
     def _ensure_worker(self) -> None:
         with self._mu:
             if self._worker_started:
@@ -320,7 +331,7 @@ class SiteReplicationSys:
                     )
                     if r.status != 200:
                         raise RuntimeError(f"HTTP {r.status}")
-                    self.stats["synced"] += 1
+                    self._stat("synced")
                 except Exception:  # noqa: BLE001 — peer down: retry below
                     remaining.append(pname)
             if remaining:
@@ -332,7 +343,7 @@ class SiteReplicationSys:
                         lambda it=item: self._q.put(it),
                     ).start()
                 else:
-                    self.stats["failed"] += 1
+                    self._stat("failed")
 
     # -- inbound apply -----------------------------------------------------
 

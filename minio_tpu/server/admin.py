@@ -573,6 +573,13 @@ async def handle_admin(server, request: web.Request, access_key: str, subpath: s
     if op == "trace" and m == "GET":
         authz("admin:ServerTrace")
         return await _stream_trace(server, request)
+    if op == "sanitizer/status" and m == "GET":
+        # runtime sanitizer state: lock witness, access witness,
+        # stall episodes, violation counters + recent ring (stackless)
+        authz("admin:OBDInfo")
+        from ..analysis import sanitizer
+
+        return _json(sanitizer.status())
     if op == "datausageinfo" and m == "GET":
         authz("admin:DataUsageInfo")
         bg = server.background

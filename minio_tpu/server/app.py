@@ -190,6 +190,9 @@ class S3Server(
         from ..replication.site import SiteReplicationSys
 
         self.site = SiteReplicationSys(self)
+        # miniovet: ignore[races] -- set_store runs exactly once at
+        # bootstrap, before the server accepts traffic; the callback
+        # wiring cannot be re-entered concurrently
         self.buckets.on_change = (
             lambda bucket, bm: self.site.sync_bucket_meta(bucket, bm)
         )
@@ -1291,6 +1294,15 @@ def main(argv: list[str] | None = None) -> None:
             app["sanitize_watchdog"] = sanitizer.watch_loop(
                 asyncio.get_running_loop()
             )
+            # access witness: every serving module is imported by now,
+            # so the cross-context attributes docs/CONCURRENCY.md names
+            # (static races pass) get their touch-recording descriptors
+            armed = sanitizer.arm_access_witness()
+            if armed:
+                print(
+                    f"sanitizer: access witness armed on {armed} "
+                    "attributes", flush=True,
+                )
 
     async def on_stop(app):
         wd = app.get("sanitize_watchdog")

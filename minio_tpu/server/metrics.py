@@ -245,8 +245,13 @@ class TracePubSub:
             try:
                 sub.q.put_nowait(record)
             except Exception:  # noqa: BLE001 — slow subscriber drops records
-                sub.dropped += 1
-                self.dropped_total += 1
+                # publish() runs on whatever thread produced the record
+                # (handlers, dispatcher, watchdog): the drop counters are
+                # load/add/store interleaves without the lock (miniovet
+                # races pass)
+                with self._mu:
+                    sub.dropped += 1
+                    self.dropped_total += 1
 
     def subscriber_stats(self) -> list[dict]:
         with self._mu:
@@ -935,6 +940,34 @@ def _g_api_cache(server) -> list[str]:
     return out
 
 
+def _g_api_sanitizer(server) -> list[str]:
+    """Runtime sanitizer (analysis/sanitizer.py): violation counters by
+    kind, the attributes under the access witness, and loop-stall
+    episodes — chaos/load runs scrape this group to assert a run
+    completed with zero race witnesses."""
+    from ..analysis import sanitizer
+
+    out: list[str] = []
+    st = sanitizer.status()
+    _fmt(out, "minio_sanitizer_enabled", "gauge",
+         [({}, int(st["enabled"]))],
+         "1 when MINIO_TPU_SANITIZE is active in this process")
+    _fmt(out, "minio_sanitizer_violations_total", "counter",
+         [({"kind": k}, v) for k, v in sorted(st["violations"].items())],
+         "Sanitizer violations by kind (lock.order, attr.race, "
+         "loop.stall, env.leak)")
+    _fmt(out, "minio_sanitizer_witnessed_attributes", "gauge",
+         [({}, len(st["witnessedAttrs"]))],
+         "Cross-context attributes under the runtime access witness")
+    _fmt(out, "minio_sanitizer_static_lock_ranks", "gauge",
+         [({}, st["staticLockRanks"])],
+         "Lock ids loaded from the static docs/LOCK_ORDER.md ordering")
+    _fmt(out, "minio_sanitizer_loop_stall_episodes_total", "counter",
+         [({}, st["stallEpisodes"])],
+         "Event-loop stall episodes the watchdog reported")
+    return out
+
+
 def _g_system_drive_latency(server) -> list[str]:
     """Per-drive, per-op latency (HealthCheckedDisk accounting): lets a
     slow p99 GET be attributed to one laggy disk instead of the whole
@@ -964,6 +997,7 @@ V3_GROUPS = {
     "/api/trace": _g_api_trace,
     "/api/fault": _g_api_fault,
     "/api/cache": _g_api_cache,
+    "/api/sanitizer": _g_api_sanitizer,
     "/system/drive/latency": _g_system_drive_latency,
     "/system/network/internode": _g_system_network,
     "/system/drive": _g_system_drive,

@@ -48,6 +48,41 @@ def test_lock_order_doc_in_sync(project_result):
     )
 
 
+def test_concurrency_doc_in_sync(project_result):
+    from minio_tpu.analysis.rules_races import generate_concurrency_md
+
+    path = os.path.join(REPO_ROOT, "docs", "CONCURRENCY.md")
+    with open(path, "r", encoding="utf-8") as fh:
+        on_disk = fh.read()
+    expected = generate_concurrency_md(project_result.guard_table)
+    assert on_disk == expected, (
+        "docs/CONCURRENCY.md is stale; regenerate with "
+        "`python -m minio_tpu.analysis --gen-concurrency` (make docs)"
+    )
+
+
+def test_concurrency_table_covers_known_cross_context_state(project_result):
+    # the facts the runtime access witness relies on: the grid client's
+    # mux tables are cross-thread and guarded by the client lock
+    rows = {r["attr"]: r for r in project_result.guard_table}
+    calls = rows["cluster.grid.GridClient._calls"]
+    assert calls["status"] == "guarded"
+    assert calls["guard"] == "cluster.grid.GridClient._lock"
+    assert len(calls["contexts"]) >= 2
+
+
+def test_warm_check_stays_under_perf_budget(tmp_path):
+    # the incremental-cache win the interprocedural passes must not
+    # erode: a warm whole-package run (per-file summaries cached AND the
+    # interproc result replayed by digest) stays well under half a second
+    cache = str(tmp_path / "cache.json")
+    analyze_project([PKG_DIR], cache_path=cache)
+    warm = analyze_project([PKG_DIR], cache_path=cache)
+    assert warm.stats["cached"] == warm.stats["files"]
+    assert warm.stats["interproc_cached"] is True
+    assert warm.stats["total_s"] < 0.5, warm.stats
+
+
 def test_lock_order_covers_cross_subsystem_edges(project_result):
     # the orderings the runtime witness relies on: the ns-lock is taken
     # before the cache tiers' mutexes on the mutation paths

@@ -421,6 +421,68 @@ def test_incremental_cache_reparses_only_changed_file(tmp_path):
     assert "blocking" in _rules(res.findings)
 
 
+def test_interproc_result_keyed_on_full_digest_set(tmp_path):
+    """Cross-module facts (lock graph, guard table) are whole-program:
+    the cached interprocedural result replays only while EVERY
+    contributing file's content sha is unchanged — editing one file
+    anywhere recomputes it (per-file keying would serve stale facts)."""
+    root = _write_tree(tmp_path, {
+        "m_a.py": "import threading\n\nouter = threading.Lock()\n"
+                  "\n\ndef f():\n    with outer:\n        pass\n",
+        "m_b.py": "def g():\n    pass\n",
+    })
+    cache = str(tmp_path / "cache.json")
+    cold = analyze_project([root], cache_path=cache)
+    assert cold.stats["interproc_cached"] is False
+    warm = analyze_project([root], cache_path=cache)
+    assert warm.stats["interproc_cached"] is True
+    assert warm.findings == cold.findings
+    assert warm.lock_order == cold.lock_order
+    assert warm.lock_edges == cold.lock_edges
+    assert warm.guard_table == cold.guard_table
+
+    # editing ONE file (a new blocking helper reached from async code in
+    # the OTHER file would change interprocedural facts) must recompute
+    (tmp_path / "m_b.py").write_text(
+        "import time\nfrom m_a import f\n\n\ndef g():\n"
+        "    time.sleep(1)\n\n\nasync def h():\n    g()\n"
+    )
+    edited = analyze_project([root], cache_path=cache)
+    assert edited.stats["interproc_cached"] is False
+    assert edited.stats["parsed"] == 1  # per-file summaries still reuse
+    assert "blocking-reachable" in _rules(edited.findings)
+    # and the fresh result replaces the stored one
+    rewarm = analyze_project([root], cache_path=cache)
+    assert rewarm.stats["interproc_cached"] is True
+    assert rewarm.findings == edited.findings
+
+
+def test_interproc_cache_replays_pragma_accounting(tmp_path):
+    """A suppression consumed by an interprocedural pass must stay
+    'used' on warm replays, or --strict would start flagging the pragma
+    as rotten on every second run."""
+    root = _write_tree(tmp_path, {
+        "svc.py": """
+import time
+
+
+def pace():
+    # miniovet: ignore[blocking, blocking-reachable] -- test pacing stub
+    time.sleep(0.5)
+
+
+async def handler():
+    pace()
+""",
+    })
+    cache = str(tmp_path / "cache.json")
+    cold = analyze_project([root], cache_path=cache)
+    assert cold.findings == []
+    warm = analyze_project([root], cache_path=cache)
+    assert warm.stats["interproc_cached"] is True
+    assert warm.findings == []  # no pragma finding on replay either
+
+
 def test_subset_run_does_not_clobber_cache(tmp_path):
     root = _write_tree(tmp_path, {
         "pkg/a.py": "def f():\n    pass\n",

@@ -1,0 +1,637 @@
+"""`races` interprocedural pass: execution-context inference, guarded-by
+inference, RacerD-style findings, reasoned suppressions, the generated
+concurrency table (analysis/rules_races.py) — plus concurrency
+regressions for the real races the pass surfaced in the tree."""
+
+import os
+import threading
+
+from minio_tpu.analysis.project import analyze_project
+from minio_tpu.analysis.rules_races import generate_concurrency_md
+
+import minio_tpu
+
+PKG_DIR = os.path.dirname(minio_tpu.__file__)
+
+
+def _write_tree(base, files):
+    for rel, src in files.items():
+        p = base / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+    return str(base)
+
+
+def _races(res):
+    return [f for f in res.findings if f.rule == "races"]
+
+
+# -- seeded race fixtures (the pass must catch these) -----------------------
+
+_WRITE_WRITE = """
+import threading
+
+class Svc:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.n = 0
+        threading.Thread(target=self._work, name="svc-worker").start()
+
+    def _work(self):
+        self.n += 1  # daemon thread, no lock
+
+    def bump(self):
+        self.n += 1  # caller context, no lock
+
+async def handler():
+    s = Svc()
+    s.bump()
+"""
+
+
+def test_seeded_write_write_race_across_contexts(tmp_path):
+    root = _write_tree(tmp_path, {"svc.py": _WRITE_WRITE})
+    hits = _races(analyze_project([root]))
+    assert len(hits) == 1
+    msg = hits[0].message
+    assert "write/write" in msg
+    assert "svc.Svc.n" in msg
+    assert "thread:svc-worker" in msg and "loop" in msg
+    # both access chains are printed with their boundaries
+    assert "=thread=>" in msg
+    assert "no locks" in msg
+
+
+_WRITE_READ = """
+import threading
+
+class Svc:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.n = 0
+        threading.Thread(target=self._work).start()
+
+    def _work(self):
+        self.n = self.n + 1  # writer thread, unlocked
+
+    def peek(self):
+        return self.n  # unlocked read
+
+async def handler():
+    s = Svc()
+    return s.peek()
+"""
+
+
+def test_seeded_write_read_race_flagged(tmp_path):
+    root = _write_tree(tmp_path, {"svc.py": _WRITE_READ})
+    hits = _races(analyze_project([root]))
+    assert len(hits) == 1
+    # unguarded writes never earn the atomic-read exemption
+    assert "unsynchronized read" in hits[0].message \
+        or "write/write" in hits[0].message
+
+
+def test_common_guard_is_clean(tmp_path):
+    root = _write_tree(tmp_path, {"svc.py": """
+import threading
+
+class Svc:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.n = 0
+        threading.Thread(target=self._work).start()
+
+    def _work(self):
+        with self._mu:
+            self.n += 1
+
+    def bump(self):
+        with self._mu:
+            self.n += 1
+
+async def handler():
+    s = Svc()
+    s.bump()
+"""})
+    res = analyze_project([root])
+    assert _races(res) == []
+    row = next(r for r in res.guard_table if r["attr"] == "svc.Svc.n")
+    assert row["status"] == "guarded"
+    assert row["guard"] == "svc.Svc._mu"
+
+
+# -- reasoned suppressions --------------------------------------------------
+
+
+def test_init_before_spawn_is_confined(tmp_path):
+    root = _write_tree(tmp_path, {"svc.py": """
+import threading
+
+class Svc:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.limit = 100  # written ONLY before the thread exists
+        threading.Thread(target=self._work).start()
+
+    def _work(self):
+        return self.limit
+
+async def handler():
+    s = Svc()
+    return s.limit
+"""})
+    res = analyze_project([root])
+    assert _races(res) == []
+    row = next(r for r in res.guard_table if r["attr"] == "svc.Svc.limit")
+    assert row["status"] == "read-only"
+
+
+def test_loop_confined_attributes_need_no_lock(tmp_path):
+    root = _write_tree(tmp_path, {"svc.py": """
+import threading
+
+class Registry:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.entries = {}
+
+_REG = Registry()
+
+async def add(k, v):
+    _REG.entries[k] = v
+
+async def drop(k):
+    _REG.entries.pop(k, None)
+"""})
+    res = analyze_project([root])
+    assert _races(res) == []
+
+
+def test_atomic_read_only_snapshot_idiom(tmp_path):
+    root = _write_tree(tmp_path, {"svc.py": """
+import threading
+
+class Svc:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.hits = 0
+        threading.Thread(target=self._work).start()
+
+    def _work(self):
+        with self._mu:
+            self.hits += 1
+
+    def snapshot_hits(self):
+        return self.hits  # stale-tolerant metrics read, GIL-atomic
+
+async def scrape():
+    s = Svc()
+    return s.snapshot_hits()
+"""})
+    res = analyze_project([root])
+    assert _races(res) == []
+    row = next(r for r in res.guard_table if r["attr"] == "svc.Svc.hits")
+    assert row["status"] == "atomic-read"
+    assert row["guard"] == "svc.Svc._mu"
+
+
+def test_thread_local_subclass_is_confined(tmp_path):
+    root = _write_tree(tmp_path, {"svc.py": """
+import threading
+
+class State(threading.local):
+    def __init__(self):
+        self.stack = []
+
+class Svc:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.tl = State()
+        threading.Thread(target=self._work).start()
+
+    def _work(self):
+        self.tl.stack.append(1)
+
+async def handler():
+    s = Svc()
+    s.tl.stack.append(2)
+"""})
+    res = analyze_project([root])
+    assert all("stack" not in f.message for f in _races(res))
+
+
+# -- guarded-by edge cases --------------------------------------------------
+
+
+def test_locked_suffix_convention_credits_class_lock(tmp_path):
+    root = _write_tree(tmp_path, {"svc.py": """
+import threading
+
+class Svc:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.n = 0
+        threading.Thread(target=self._work).start()
+
+    def _work(self):
+        with self._mu:
+            self._bump_locked()
+
+    def _bump_locked(self):
+        self.n += 1  # `_locked` = caller holds self._mu
+
+    async def serve(self):
+        with self._mu:
+            self._bump_locked()
+"""})
+    res = analyze_project([root])
+    assert _races(res) == []
+    row = next(r for r in res.guard_table if r["attr"] == "svc.Svc.n")
+    assert row["status"] == "guarded"
+
+
+def test_rlock_reentrant_nesting_is_one_guard(tmp_path):
+    root = _write_tree(tmp_path, {"svc.py": """
+import threading
+
+class Svc:
+    def __init__(self):
+        self._mu = threading.RLock()
+        self.n = 0
+        threading.Thread(target=self._work).start()
+
+    def _work(self):
+        with self._mu:
+            self._inner()
+
+    def _inner(self):
+        with self._mu:  # reentrant acquire of the same RLock
+            self.n += 1
+
+    async def serve(self):
+        with self._mu:
+            self.n += 1
+"""})
+    res = analyze_project([root])
+    assert _races(res) == []
+
+
+def test_lockish_attr_identity_distinguishes_locks(tmp_path):
+    # `mutex` and `cond` both register as guards (the _LOCKISH_ATTRS
+    # heuristic), but DIFFERENT lock attrs never satisfy each other
+    root = _write_tree(tmp_path, {"svc.py": """
+import threading
+
+class Svc:
+    def __init__(self):
+        self.mutex = threading.Lock()
+        self.cond = threading.Condition()
+        self.n = 0
+        threading.Thread(target=self._work).start()
+
+    def _work(self):
+        with self.mutex:
+            self.n += 1
+
+    def bump(self):
+        with self.cond:
+            self.n += 1  # wrong lock: disjoint from the writer thread's
+
+async def handler():
+    s = Svc()
+    s.bump()
+"""})
+    hits = _races(analyze_project([root]))
+    assert len(hits) == 1
+    assert "svc.Svc.mutex" in hits[0].message \
+        or "svc.Svc.cond" in hits[0].message
+
+
+def test_executor_pool_identity_distinct_contexts(tmp_path):
+    root = _write_tree(tmp_path, {"svc.py": """
+import threading
+
+class Svc:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.n = 0
+
+_S = Svc()
+
+def bump_a():
+    _S.n += 1
+
+def bump_b():
+    _S.n += 1
+
+async def go(pool_a, pool_b):
+    pool_a.submit(bump_a)
+    pool_b.submit(bump_b)
+"""})
+    hits = _races(analyze_project([root]))
+    assert len(hits) == 1
+    # pools are distinct contexts named by their receiver identity
+    assert "pool:pool_a" in hits[0].message
+    assert "pool:pool_b" in hits[0].message
+
+
+def test_single_pool_races_with_itself(tmp_path):
+    # one executor pool has many worker threads: a fn submitted to it
+    # can run twice at once, so an unlocked write races with itself
+    root = _write_tree(tmp_path, {"svc.py": """
+import threading
+
+class Svc:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.n = 0
+
+_S = Svc()
+
+def bump():
+    _S.n += 1
+
+async def go(pool):
+    pool.submit(bump)
+"""})
+    hits = _races(analyze_project([root]))
+    assert len(hits) == 1
+    assert "pool:pool" in hits[0].message
+
+
+def test_mutator_method_counts_as_write(tmp_path):
+    root = _write_tree(tmp_path, {"svc.py": """
+import threading
+
+class Svc:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.q = []
+        threading.Thread(target=self._work).start()
+
+    def _work(self):
+        self.q.append(1)  # container mutation = write
+
+    def drain(self):
+        return list(self.q)
+
+async def handler():
+    s = Svc()
+    return s.drain()
+"""})
+    hits = _races(analyze_project([root]))
+    assert len(hits) == 1
+    assert "svc.Svc.q" in hits[0].message
+
+
+def test_fork_shared_subprocess_state_not_flagged(tmp_path):
+    # server/worker.py shape: a supervisor herding subprocess children —
+    # separate PROCESSES share no memory, and nothing here crosses a
+    # thread/executor boundary, so supervisor-private state is quiet
+    root = _write_tree(tmp_path, {"sup.py": """
+import subprocess
+import threading
+
+class Herd:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.procs = {}
+        self.crashes = {}
+
+    def spawn(self, i):
+        self.procs[i] = subprocess.Popen(["worker"])
+
+    def supervise(self):
+        for i, p in list(self.procs.items()):
+            if p.poll() is not None:
+                self.crashes[i] = self.crashes.get(i, 0) + 1
+                self.spawn(i)
+
+def main():
+    h = Herd()
+    h.spawn(0)
+    h.supervise()
+"""})
+    assert _races(analyze_project([root])) == []
+
+
+def test_real_worker_pool_supervisor_is_quiet():
+    # the real SO_REUSEPORT supervisor: children are subprocesses, its
+    # bookkeeping is process-private — the pass must not invent races
+    res = analyze_project([os.path.join(PKG_DIR, "server", "worker.py")])
+    assert _races(res) == []
+
+
+# -- pragmas + generated table ----------------------------------------------
+
+
+def test_pragma_suppresses_races_and_counts_used(tmp_path):
+    root = _write_tree(tmp_path, {"svc.py": """
+import threading
+
+class Svc:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.n = 0
+        threading.Thread(target=self._work).start()
+
+    def _work(self):
+        # miniovet: ignore[races] -- test fixture: benign by design
+        self.n += 1
+
+    def bump(self):
+        self.n += 1
+
+async def handler():
+    s = Svc()
+    s.bump()
+"""})
+    res = analyze_project([root])
+    rules = {f.rule for f in res.findings}
+    assert "races" not in rules
+    assert "pragma" not in rules  # the suppression counted as used
+
+
+def test_concurrency_md_contains_inferred_guards(tmp_path):
+    root = _write_tree(tmp_path, {"svc.py": """
+import threading
+
+class Svc:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.n = 0
+        threading.Thread(target=self._work).start()
+
+    def _work(self):
+        with self._mu:
+            self.n += 1
+
+    def bump(self):
+        with self._mu:
+            self.n += 1
+
+async def handler():
+    s = Svc()
+    s.bump()
+"""})
+    res = analyze_project([root])
+    md = generate_concurrency_md(res.guard_table)
+    assert "| `svc.Svc.n` | `svc.Svc.n` |" in md
+    assert "`svc.Svc._mu`" in md
+    assert "guarded" in md
+
+
+def test_access_path_keying_separates_instances(tmp_path):
+    # two holders of the same value class must not alias: guarded writes
+    # via holder A never certify unguarded writes via holder B
+    root = _write_tree(tmp_path, {"svc.py": """
+import threading
+
+class Counter:
+    __slots__ = ("n",)
+    def __init__(self):
+        self.n = 0
+
+class A:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.stats = Counter()
+        threading.Thread(target=self._work).start()
+
+    def _work(self):
+        with self._mu:
+            self.stats.n += 1
+
+    def bump(self):
+        with self._mu:
+            self.stats.n += 1
+
+class B:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.stats = Counter()
+        threading.Thread(target=self._work).start()
+
+    def _work(self):
+        self.stats.n += 1
+
+    def bump(self):
+        self.stats.n += 1
+
+async def handler():
+    a = A()
+    a.bump()
+    b = B()
+    b.bump()
+"""})
+    res = analyze_project([root])
+    hits = _races(res)
+    # only B's path races; A's guarded path must not be polluted by it
+    assert len(hits) == 1
+    assert "svc.B.stats.n" in hits[0].message
+    attrs = {r["attr"]: r for r in res.guard_table}
+    assert attrs["svc.A.stats.n"]["status"] == "guarded"
+    assert attrs["svc.B.stats.n"]["status"] == "racy"
+    # both share the leaf witness target the runtime instruments
+    assert attrs["svc.A.stats.n"]["witness"] == "svc.Counter.n"
+
+
+# -- triage regressions: the real races the pass surfaced --------------------
+
+
+def test_dispatcher_stats_snapshot_consistent_under_load():
+    """parallel/dispatcher.py triage: stats mutate under _cv and
+    observers read consistent snapshots — a scrape racing a dispatch
+    must never see torn histograms or lose blocks."""
+    import numpy as np
+
+    from minio_tpu.ops import rs_jax
+    from minio_tpu.parallel.dispatcher import (
+        QUEUE_WAIT_BUCKETS, TpuDispatcher,
+    )
+
+    codec = rs_jax.get_tpu_codec(4, 2)
+    disp = TpuDispatcher(codec, 256, window_s=0.0)
+    rng = np.random.default_rng(7)
+    blocks = rng.integers(0, 256, size=(1, 4, 256), dtype=np.uint8)
+    disp.encode(blocks)  # warm
+
+    stop = threading.Event()
+    torn: list = []
+
+    def scraper():
+        while not stop.is_set():
+            snap = disp.stats_snapshot()
+            if len(snap["queue_wait_hist"]) != len(QUEUE_WAIT_BUCKETS) + 1:
+                torn.append(snap)
+            if snap["blocks"] < 0 or snap["dispatches"] < 0:
+                torn.append(snap)
+
+    threads = [threading.Thread(target=scraper) for _ in range(3)]
+    for t in threads:
+        t.start()
+    total = 0
+    for _ in range(40):
+        disp.encode(blocks)
+        total += 1
+    stop.set()
+    for t in threads:
+        t.join()
+    assert torn == []
+    snap = disp.stats_snapshot()
+    assert snap["blocks"] >= total
+    # the snapshot is a COPY: mutating it must not poison live stats
+    snap["queue_wait_hist"][0] = -999
+    assert disp.stats["queue_wait_hist"][0] != -999
+
+
+def test_notifier_stat_counters_are_lost_update_free():
+    """events/notify.py triage: delivery counters are bumped from the
+    handler context and the delivery worker concurrently; the locked
+    _stat path must account every increment exactly."""
+    from minio_tpu.events.notify import EventNotifier
+
+    class _Buckets:
+        def get(self, _name):
+            raise AssertionError("unused")
+
+    n = EventNotifier(_Buckets(), targets={})
+    workers = 8
+    per = 2000
+    barrier = threading.Barrier(workers)
+
+    def worker():
+        barrier.wait()
+        for _ in range(per):
+            n._stat("sent")
+
+    ts = [threading.Thread(target=worker) for _ in range(workers)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert n.stats["sent"] == workers * per
+
+
+def test_data_cache_miss_counter_exact_across_threads():
+    """cache/core.py triage: DataCache counters bumped from every
+    executor-pool reader thread go through the locked helpers."""
+    from minio_tpu.cache.core import DataCache
+
+    dc = DataCache()
+    workers = 8
+    per = 2000
+    barrier = threading.Barrier(workers)
+
+    def worker():
+        barrier.wait()
+        for _ in range(per):
+            dc.count_miss()
+
+    ts = [threading.Thread(target=worker) for _ in range(workers)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert dc.stats.misses == workers * per

@@ -221,3 +221,18 @@ def test_prometheus_jwt_bearer(server, cli, monkeypatch):
     assert scrape(mint("minioadmin", "minioadmin")) == 200  # valid JWT
     assert scrape(mint("wrong-secret", "minioadmin")) == 403  # bad signature
     assert scrape(mint("minioadmin", "minioadmin", exp_delta=-5)) == 403  # expired
+
+
+def test_v3_sanitizer_group_and_admin_status(cli):
+    # /api/sanitizer: the series chaos/load runs assert on (zero race
+    # witnesses after a run)
+    text = _get(cli, "/api/sanitizer").body.decode()
+    assert "minio_sanitizer_enabled" in text
+    assert "minio_sanitizer_witnessed_attributes" in text
+    assert "minio_sanitizer_loop_stall_episodes_total" in text
+    # admin surface mirrors the same state with the recent-event ring
+    st = json.loads(
+        cli.request("GET", "/minio/admin/v3/sanitizer/status").body
+    )
+    assert "violations" in st and "witnessedAttrs" in st
+    assert "stallEpisodes" in st
