@@ -319,6 +319,180 @@ def _bench_degraded(np) -> dict:
         shutil.rmtree(base, ignore_errors=True)
 
 
+def _bench_ranged_get(np) -> dict:
+    """Ranged hot-GET metric (range-segment cache tentpole, round 8):
+    p50/p99 latency + IOPS of 1 MiB ranged GETs over ONE 64 MiB object
+    (far above MINIO_TPU_CACHE_OBJECT_MAX) at the erasure layer, through
+    the same ``open_object(range_hint)`` API the S3 handler uses:
+
+    - **cold**: segment tier off — every request pays ns-lock + N-drive
+      FileInfo + shard reads + verify for its range;
+    - **warm_memory**: segments filled and resident in memory — a hit
+      skips open_object entirely;
+    - **warm_disk**: a tiny memory budget + an NVMe-tier budget so the
+      warm set lives in segment FILES — hits pay a read + sha256 verify
+      + promote;
+    - **prefetched**: a fresh sequential pass with read-ahead running
+      ahead of the client (first requests excluded as warm-up).
+    """
+    import os
+    import shutil
+    import tempfile
+
+    from minio_tpu.erasure.set import ErasureSet
+    from minio_tpu.storage.xlstorage import XLStorage
+
+    MIB = 1 << 20
+    SIZE_MIB = 64
+    keys = (
+        "MINIO_TPU_CACHE", "MINIO_TPU_CACHE_SEGMENTS",
+        "MINIO_TPU_CACHE_ADMIT_TOUCHES", "MINIO_TPU_CACHE_MEM_MB",
+        "MINIO_TPU_CACHE_DISK_MB", "MINIO_TPU_CACHE_DISK_DIR",
+        "MINIO_TPU_CACHE_PREFETCH_SEGMENTS",
+    )
+    saved = {k: os.environ.get(k) for k in keys}
+    base = tempfile.mkdtemp(prefix="bench-ranged-")
+    rng = np.random.default_rng(8)
+
+    def rig(tag: str) -> ErasureSet:
+        es = ErasureSet(
+            [XLStorage(f"{base}/{tag}/d{i}") for i in range(8)]
+        )
+        es.make_bucket("rbkt")
+        return es
+
+    def measure(es, key: str, order, samples_per_off: int = 1):
+        lats = []
+        t_all0 = time.perf_counter()
+        n_req = 0
+        for _ in range(samples_per_off):
+            for off_mib in order:
+                off = off_mib * MIB
+                t0 = time.perf_counter()
+                _oi, h = es.open_object(
+                    "rbkt", key, "", ("abs", off, off + MIB - 1)
+                )
+                n = 0
+                for c in h.read(off, MIB):
+                    n += len(c)
+                lats.append(time.perf_counter() - t0)
+                n_req += 1
+                assert n == MIB
+        total = time.perf_counter() - t_all0
+        lats.sort()
+        return (
+            lats[len(lats) // 2],
+            lats[min(len(lats) - 1, int(len(lats) * 0.99))],
+            n_req / total,
+            lats,
+        )
+
+    try:
+        os.environ["MINIO_TPU_CACHE"] = "1"
+        os.environ["MINIO_TPU_CACHE_ADMIT_TOUCHES"] = "2"
+        os.environ["MINIO_TPU_CACHE_PREFETCH_SEGMENTS"] = "0"
+        body = rng.integers(0, 256, size=SIZE_MIB * MIB, dtype=np.uint8).tobytes()
+        order = list(range(SIZE_MIB))
+        import random as _random
+
+        _random.Random(42).shuffle(order)
+
+        # cold: segment tier off
+        es = rig("cold")
+        es.put_object("rbkt", "big", body)
+        os.environ["MINIO_TPU_CACHE_SEGMENTS"] = "0"
+        cold_p50, cold_p99, cold_iops, _ = measure(es, "big", order)
+
+        # warm memory: fill (two passes for admission), then measure
+        os.environ["MINIO_TPU_CACHE_SEGMENTS"] = "1"
+        os.environ["MINIO_TPU_CACHE_MEM_MB"] = "256"
+        os.environ["MINIO_TPU_CACHE_DISK_MB"] = "0"
+        for _ in range(2):
+            measure(es, "big", order)
+        from minio_tpu.cache import segment as segmod
+
+        s0 = segmod.segment_cache().snapshot()
+        wm_p50, wm_p99, wm_iops, _ = measure(es, "big", order, 3)
+        s1 = segmod.segment_cache().snapshot()
+        hit_ratio = (s1["range_hits"] - s0["range_hits"]) / max(
+            (s1["range_hits"] - s0["range_hits"])
+            + (s1["range_misses"] - s0["range_misses"]), 1
+        )
+
+        # the previous phase's 64 MiB of resident segments would eat the
+        # tiny budget below (the cache is process-wide); phases and
+        # repeat epochs must start clean
+        es.cache.clear()
+
+        # warm disk: tiny memory budget, NVMe budget — fill, let the
+        # tier demote, measure (hits promote from files, digest-checked)
+        os.environ["MINIO_TPU_CACHE_MEM_MB"] = "8"
+        os.environ["MINIO_TPU_CACHE_DISK_MB"] = "512"
+        os.environ["MINIO_TPU_CACHE_DISK_DIR"] = f"{base}/spool"
+        es_d = rig("disk")
+        es_d.put_object("rbkt", "big", body)
+        for _ in range(2):
+            measure(es_d, "big", order)
+        d0 = segmod.segment_cache().snapshot()
+        wd_p50, wd_p99, wd_iops, _ = measure(es_d, "big", order, 3)
+        d1 = segmod.segment_cache().snapshot()
+        promotes = d1["promotions"] - d0["promotions"]
+
+        es_d.cache.clear()
+
+        # prefetched: fresh object + sequential pass, read-ahead on
+        os.environ["MINIO_TPU_CACHE_MEM_MB"] = "256"
+        os.environ["MINIO_TPU_CACHE_DISK_MB"] = "0"
+        os.environ["MINIO_TPU_CACHE_PREFETCH_SEGMENTS"] = "8"
+        from minio_tpu.cache import prefetch as pfmod
+
+        pf0 = pfmod.stats()
+        es_p = rig("pf")
+        es_p.put_object("rbkt", "pf", body)
+        warmup = 4
+        _p50, _p99, _iops, lats = measure(
+            es_p, "pf", list(range(SIZE_MIB))
+        )
+        lats = sorted(lats[warmup:])
+        pf_p50 = lats[len(lats) // 2]
+        pf_p99 = lats[min(len(lats) - 1, int(len(lats) * 0.99))]
+        pf_iops = len(lats) / max(sum(lats), 1e-9)
+        pf_stats = pfmod.stats()
+        es_p.cache.clear()  # repeat epochs start clean
+        from minio_tpu.parallel import dispatcher as disp
+
+        deferred = disp.aggregate_stats().get("fg_deferred_behind_bg", 0)
+
+        return {
+            "ranged_get_p50_ms_cold": round(cold_p50 * 1e3, 3),
+            "ranged_get_p99_ms_cold": round(cold_p99 * 1e3, 3),
+            "ranged_get_iops_cold": round(cold_iops, 1),
+            "ranged_get_p50_ms_warm_mem": round(wm_p50 * 1e3, 3),
+            "ranged_get_p99_ms_warm_mem": round(wm_p99 * 1e3, 3),
+            "ranged_get_iops_warm_mem": round(wm_iops, 1),
+            "ranged_get_p50_ms_warm_disk": round(wd_p50 * 1e3, 3),
+            "ranged_get_p99_ms_warm_disk": round(wd_p99 * 1e3, 3),
+            "ranged_get_iops_warm_disk": round(wd_iops, 1),
+            "ranged_get_p50_ms_prefetched": round(pf_p50 * 1e3, 3),
+            "ranged_get_p99_ms_prefetched": round(pf_p99 * 1e3, 3),
+            "ranged_get_iops_prefetched": round(pf_iops, 1),
+            "ranged_warm_hit_ratio": round(hit_ratio, 4),
+            "ranged_disk_promotions": promotes,
+            "ranged_prefetch_runs": pf_stats.get("runs_detected", 0)
+            - pf0.get("runs_detected", 0),
+            "ranged_prefetch_bytes": pf_stats.get("bytes_read", 0)
+            - pf0.get("bytes_read", 0),
+            "fg_deferred_behind_bg": deferred,
+        }
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        shutil.rmtree(base, ignore_errors=True)
+
+
 def _bench_hot_get(np) -> dict:
     """Hot-GET metric (cache/ tentpole): p50/p99 latency + IOPS of
     repeated full GETs of ONE 1 MiB object over 8 local drives, with the
@@ -456,6 +630,10 @@ def main() -> None:
         hot_get = _bench_hot_get(np)
     except Exception:  # noqa: BLE001 — cache metric must not sink the line
         hot_get = {}
+    try:
+        ranged_get = _bench_ranged_get(np)
+    except Exception:  # noqa: BLE001 — segment metric must not sink it
+        ranged_get = {}
     print(
         json.dumps(
             {
@@ -475,6 +653,7 @@ def main() -> None:
                 **qos,
                 **degraded,
                 **hot_get,
+                **ranged_get,
             }
         )
     )

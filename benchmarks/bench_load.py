@@ -1,8 +1,9 @@
-"""Closed-loop production load harness (round 7: many-core data plane).
+"""Closed-loop production load harness (round 7: many-core data plane;
+round 8: ranged-GET segment-cache phases).
 
 Drives a REAL server process (optionally an SO_REUSEPORT worker pool,
 ``MINIO_TPU_WORKERS``) with production-shaped traffic and emits the
-numbers PERF.md and BENCH_r07.json track:
+numbers PERF.md and BENCH_r07/r08.json track:
 
 - **Mixed closed-loop phase**: N virtual clients, each a coroutine that
   issues its next request only after the previous one completes (closed
@@ -20,6 +21,11 @@ numbers PERF.md and BENCH_r07.json track:
   ``fg_deferred_behind_bg`` invariant read from the pool-aggregated
   metrics — the "bg must ride leftover capacity only" proof under real
   HTTP load rather than the dispatcher microbench in bench.py.
+- **Ranged (segment cache) phases**: 1 MiB ranged GETs over a 64 MiB
+  object — cold vs warm (memory tier and NVMe tier on separate fresh
+  servers, median-of-N warm passes) vs a prefetched sequential pass;
+  the mixed phase additionally carries an RGET request class so the
+  segment path is exercised under production load.
 
 Worker count and nproc are recorded in the JSON so cross-host numbers
 are never compared blindly.
@@ -66,7 +72,7 @@ class Server:
     local drives, EC 8+8 when 16 drives."""
 
     def __init__(self, base: str, port: int, drives: int, workers: int,
-                 scan_interval: float):
+                 scan_interval: float, extra_env: dict | None = None):
         self.port = port
         self.drives = [os.path.join(base, f"d{i}") for i in range(drives)]
         env = dict(
@@ -75,6 +81,7 @@ class Server:
             MINIO_TPU_SCAN_INTERVAL=str(scan_interval),
             MINIO_COMPRESSION_ENABLE="off",
         )
+        env.update(extra_env or {})
         # the readiness probes below assume the default control-port
         # layout (port+1000+i); scrub inherited pool identity/overrides
         # so an operator env can't silently shift the workers elsewhere
@@ -147,11 +154,14 @@ class AsyncS3:
         )
 
     async def request(self, method: str, path: str, query: str = "",
-                      body: bytes = b"", read: bool = True):
-        headers = self._signed(method, path, query)
+                      body: bytes = b"", read: bool = True,
+                      headers: dict | None = None):
+        hdrs = self._signed(method, path, query)
+        if headers:
+            hdrs.update(headers)  # unsigned extras (Range) are S3-legal
         url = f"{self.base}{path}" + (f"?{query}" if query else "")
         async with self.session.request(
-            method, url, data=body if body else None, headers=headers
+            method, url, data=body if body else None, headers=hdrs
         ) as resp:
             data = await resp.read() if read else b""
             return resp.status, data
@@ -191,7 +201,7 @@ class Stats:
         self.lat.setdefault(cls, []).append(dt)
         self.ops += 1
         self.bytes += nbytes
-        if status != 200:
+        if status not in (200, 206):  # 206: ranged GET partial content
             self.errors += 1
 
     def summary(self, wall: float) -> dict:
@@ -218,12 +228,18 @@ class Stats:
 
 
 async def run_mixed(cli: AsyncS3, clients: int, duration: float,
-                    keyspace: int, obj_kb: int, put_frac: float) -> Stats:
-    """Closed-loop mixed GET/PUT/HEAD/LIST phase over a zipf-hot keyspace."""
+                    keyspace: int, obj_kb: int, put_frac: float,
+                    ranged_key: str = "", ranged_mib: int = 0) -> Stats:
+    """Closed-loop mixed GET/PUT/HEAD/LIST phase over a zipf-hot keyspace,
+    plus an RGET class (Range header over a large object) when
+    ``ranged_key`` is set — the segment-cache path exercised under mixed
+    production load, with its own p50/p99/IOPS row."""
     stats = Stats()
     cdf = zipf_cdf(keyspace)
     stop_at = time.monotonic() + duration
     body = os.urandom(obj_kb * 1024)
+    rget_frac = 0.05 if ranged_key else 0.0
+    ranged_blocks = max(ranged_mib, 1)
 
     async def one_client(cid: int) -> None:
         rng = random.Random(cid)
@@ -237,9 +253,16 @@ async def run_mixed(cli: AsyncS3, clients: int, duration: float,
                         "PUT", f"/{BUCKET}/{key}", body=body, read=False
                     )
                     stats.add("PUT", time.perf_counter() - t0, len(body), st)
-                elif r < put_frac + 0.60:
+                elif r < put_frac + 0.60 - rget_frac:
                     st, data = await cli.request("GET", f"/{BUCKET}/{key}")
                     stats.add("GET", time.perf_counter() - t0, len(data), st)
+                elif r < put_frac + 0.60:
+                    off = rng.randrange(ranged_blocks) * MIB
+                    st, data = await cli.request(
+                        "GET", f"/{BUCKET}/{ranged_key}",
+                        headers={"Range": f"bytes={off}-{off + MIB - 1}"},
+                    )
+                    stats.add("RGET", time.perf_counter() - t0, len(data), st)
                 elif r < put_frac + 0.75:
                     st, _ = await cli.request("HEAD", f"/{BUCKET}/{key}")
                     stats.add("HEAD", time.perf_counter() - t0, 0, st)
@@ -304,6 +327,186 @@ async def run_put_throughput(cli: AsyncS3, streams: int, obj_mib: int,
     await asyncio.gather(*(one(i) for i in range(streams)))
     wall = time.perf_counter() - t0
     return streams * repeats * obj_mib / wall
+
+
+# ------------------------------------------------------------ ranged GETs
+
+
+async def run_ranged_pass(cli: AsyncS3, key: str, size_mib: int,
+                          order: list[int], concurrency: int) -> Stats:
+    """One pass of 1 MiB ranged GETs over `key` at the given offsets
+    (MiB units), `concurrency` closed-loop workers draining the list."""
+    stats = Stats()
+    queue: list[int] = list(order)
+
+    async def worker() -> None:
+        while queue:
+            off = queue.pop() * MIB
+            t0 = time.perf_counter()
+            try:
+                st, data = await cli.request(
+                    "GET", f"/{BUCKET}/{key}",
+                    headers={"Range": f"bytes={off}-{off + MIB - 1}"},
+                )
+                stats.add("RGET", time.perf_counter() - t0, len(data), st)
+                if st == 206 and len(data) != MIB:
+                    stats.errors += 1
+            except Exception:  # noqa: BLE001
+                stats.add("ERR", time.perf_counter() - t0, 0, 599)
+
+    t0 = time.monotonic()
+    await asyncio.gather(*(worker() for _ in range(concurrency)))
+    stats.wall = time.monotonic() - t0
+    return stats
+
+
+def _median(xs: list[float]) -> float:
+    xs = sorted(xs)
+    return xs[len(xs) // 2]
+
+
+async def ranged_round(port: int, size_mib: int, repeats: int,
+                       concurrency: int = 8) -> dict:
+    """The segment-path benchmark: 1 MiB ranged GETs over one
+    `size_mib` object — cold (first pass, shuffled so no sequential run
+    forms), warm (repeat passes served from the segment tiers,
+    median-of-`repeats`), and prefetched (a fresh sequential pass with
+    read-ahead running ahead of the client; warm-up requests excluded).
+    The caller picks the tier the warm passes land in via the server's
+    cache env (big memory budget -> memory tier; tiny memory budget +
+    disk budget -> NVMe tier)."""
+    import aiohttp
+
+    conn = aiohttp.TCPConnector(limit=0)
+    timeout = aiohttp.ClientTimeout(total=300)
+    async with aiohttp.ClientSession(
+        connector=conn, timeout=timeout, auto_decompress=False
+    ) as session:
+        cli = AsyncS3(session, "127.0.0.1", port)
+        body = os.urandom(size_mib * MIB)
+        st, _ = await cli.request(
+            "PUT", f"/{BUCKET}/r-main", body=body, read=False
+        )
+        assert st == 200, f"ranged preload PUT: HTTP {st}"
+
+        order = list(range(size_mib))
+        random.Random(4242).shuffle(order)  # no run -> no prefetch
+        cold = await run_ranged_pass(cli, "r-main", size_mib, order, concurrency)
+
+        warm_iops, warm_p50, warm_p99 = [], [], []
+        for i in range(repeats):
+            random.Random(100 + i).shuffle(order)
+            w = await run_ranged_pass(
+                cli, "r-main", size_mib, order, concurrency
+            )
+            s = w.summary(w.wall)
+            warm_iops.append(s["iops"])
+            warm_p50.append(s["per_class"]["RGET"]["p50_ms"])
+            warm_p99.append(s["per_class"]["RGET"]["p99_ms"])
+
+        # prefetched: fresh object, strictly sequential, single client so
+        # the read-ahead (not concurrency) is what hides the misses
+        st, _ = await cli.request(
+            "PUT", f"/{BUCKET}/r-seq", body=body, read=False
+        )
+        assert st == 200
+        warmup = 4
+        seq = await run_ranged_pass(
+            cli, "r-seq", size_mib, list(range(size_mib))[::-1], 1
+        )  # reversed because workers pop() from the tail -> ascending
+        seq_lat = sorted(seq.lat.get("RGET", [0.0])[warmup:])
+
+        cold_s = cold.summary(cold.wall)
+        return {
+            "object_mib": size_mib,
+            "concurrency": concurrency,
+            "repeats": repeats,
+            "cold": {
+                "iops": cold_s["iops"],
+                "p50_ms": cold_s["per_class"]["RGET"]["p50_ms"],
+                "p99_ms": cold_s["per_class"]["RGET"]["p99_ms"],
+                "errors": cold_s["errors"],
+            },
+            "warm": {
+                "iops": _median(warm_iops),
+                "p50_ms": _median(warm_p50),
+                "p99_ms": _median(warm_p99),
+            },
+            "prefetched_seq": {
+                "iops": round(
+                    len(seq_lat) / max(sum(seq_lat), 1e-9), 1
+                ),
+                "p50_ms": round(seq_lat[len(seq_lat) // 2] * 1e3, 3),
+                "p99_ms": round(
+                    seq_lat[min(len(seq_lat) - 1,
+                                int(len(seq_lat) * 0.99))] * 1e3, 3),
+                "warmup_excluded": warmup,
+            },
+        }
+
+
+def scrape_cache_series(port: int) -> dict:
+    """Segment/prefetch counters from metrics v3 (pool-aggregated)."""
+    cli = S3Client(f"127.0.0.1:{port}")
+    r = cli.request("GET", "/minio/metrics/v3/api/cache")
+    assert r.status == 200, f"cache metrics scrape failed: HTTP {r.status}"
+    out: dict[str, float] = {}
+    for line in r.body.decode().splitlines():
+        if line.startswith("#") or " " not in line:
+            continue
+        name, val = line.rsplit(" ", 1)
+        try:
+            out[name] = out.get(name, 0) + float(val)
+        except ValueError:
+            pass
+    return {
+        k: v for k, v in out.items()
+        if "segment" in k or "prefetch" in k
+    }
+
+
+def bench_ranged(cfg: argparse.Namespace) -> dict:
+    """Run the ranged benchmark twice: once against a memory-budget
+    server (warm passes hit the memory tier) and once against a
+    tiny-memory + NVMe-budget server (warm passes promote from the disk
+    tier). Each server is fresh — the two tiers are measured in
+    isolation."""
+    out: dict = {}
+    tiers = {
+        "memory": {
+            "MINIO_TPU_CACHE_DISK_MB": "0",
+        },
+        "disk": {
+            # memory can hold only a fraction of the object: warm passes
+            # must come off the NVMe tier (promote-on-hit)
+            "MINIO_TPU_CACHE_MEM_MB": str(max(cfg.ranged_object_mib // 4, 8)),
+            "MINIO_TPU_CACHE_DISK_MB": str(cfg.ranged_object_mib * 8),
+        },
+    }
+    for tier, env in tiers.items():
+        base = tempfile.mkdtemp(prefix=f"bench-ranged-{tier}-")
+        srv = Server(base, cfg.port, cfg.drives, 1,
+                     scan_interval=300.0, extra_env=env)
+        try:
+            cli = S3Client(f"127.0.0.1:{cfg.port}")
+            assert cli.make_bucket(BUCKET).status == 200
+            res = asyncio.run(ranged_round(
+                cfg.port, cfg.ranged_object_mib, cfg.ranged_repeats
+            ))
+            res["cache_env"] = env
+            res["segment_series"] = scrape_cache_series(cfg.port)
+            res["fg_deferred_behind_bg"] = scrape_counter(
+                cfg.port, "minio_tpu_dispatch_fg_deferred_behind_bg_total"
+            )
+            out[tier] = res
+        finally:
+            srv.stop()
+            shutil.rmtree(base, ignore_errors=True)
+    if out["memory"]["cold"]["iops"]:
+        out["speedup_warm_memory_vs_cold_iops"] = round(
+            out["memory"]["warm"]["iops"] / out["memory"]["cold"]["iops"], 1
+        )
+    return out
 
 
 # ----------------------------------------------------------- qos plumbing
@@ -387,12 +590,20 @@ async def run_round(port: int, cfg: argparse.Namespace) -> dict:
 
         t0 = time.monotonic()
         await asyncio.gather(*(put_one(i) for i in range(cfg.keyspace)))
+        # one large object for the mixed phase's RGET class (the segment
+        # path exercised under production load, not just in isolation)
+        st, _ = await cli.request(
+            "PUT", f"/{BUCKET}/rmix",
+            body=os.urandom(cfg.ranged_object_mib * MIB), read=False,
+        )
+        assert st == 200, f"ranged preload PUT: HTTP {st}"
         preload_s = time.monotonic() - t0
 
         # mixed closed loop with scanner/ILM live
         mixed = await run_mixed(
             cli, cfg.clients, cfg.duration, cfg.keyspace, cfg.object_kb,
-            put_frac=0.20,
+            put_frac=0.20, ranged_key="rmix",
+            ranged_mib=cfg.ranged_object_mib,
         )
 
         # large-PUT aggregate throughput (the EC 8+8 target metric)
@@ -474,6 +685,11 @@ def main() -> int:
     ap.add_argument("--put-object-mib", type=int, default=64)
     ap.add_argument("--put-repeats", type=int, default=3)
     ap.add_argument("--scan-interval", type=float, default=30.0)
+    ap.add_argument("--ranged-object-mib", type=int, default=64,
+                    help="object size for the ranged-GET (segment cache) "
+                         "phases")
+    ap.add_argument("--ranged-repeats", type=int, default=5,
+                    help="warm ranged passes (median reported)")
     ap.add_argument("--port", type=int, default=19801)
     ap.add_argument("--out", default="",
                     help="write the JSON here too (stdout always)")
@@ -494,6 +710,8 @@ def main() -> int:
         args.put_object_mib = 4
         args.put_repeats = 2
         args.scan_interval = 5.0
+        args.ranged_object_mib = 8
+        args.ranged_repeats = 2
     worker_counts = [
         int(w) for w in (
             args.workers.split(",") if args.workers
@@ -510,6 +728,10 @@ def main() -> int:
         print(f"=== round: {w} worker(s) ===", file=sys.stderr, flush=True)
         runs.append(bench_one_worker_count(w, args))
 
+    print("=== round: ranged (segment cache) ===", file=sys.stderr,
+          flush=True)
+    ranged = bench_ranged(args)
+
     result = {
         "metric": "load_harness_closed_loop",
         "nproc": os.cpu_count(),
@@ -517,6 +739,7 @@ def main() -> int:
         "ec": "8+8" if args.drives >= 16 else "default",
         "quick": bool(args.quick),
         "runs": runs,
+        "ranged": ranged,
     }
     by_w = {r["workers"]: r["put_throughput_mibs"] for r in runs}
     if 1 in by_w and len(by_w) > 1:

@@ -58,6 +58,31 @@ _ALL: list[Knob] = [
        "(single-drive modTime probe) before serving them; bounds the "
        "staleness window of a lost cross-node invalidation. 0 trusts "
        "invalidations alone; single-node deployments never revalidate."),
+    _k("MINIO_TPU_CACHE_SEGMENTS", "1", "cache",
+       "Range-segment data cache for objects above "
+       "MINIO_TPU_CACHE_OBJECT_MAX: ranged GETs cache and serve "
+       "stripe-block (1 MiB) aligned segments, skipping open_object "
+       "entirely on full coverage; 0 disables the tier (and prefetch)."),
+    _k("MINIO_TPU_CACHE_DISK_MB", "0", "cache",
+       "Disk/NVMe second-tier byte budget (MiB) for the range-segment "
+       "cache, per worker process: memory-budget evictions demote the "
+       "coldest segments to digest-stamped files (HighwayHash-256 when "
+       "the native plane is built, sha256 otherwise); a disk hit "
+       "promotes back to memory after re-verification. 0 disables the "
+       "tier."),
+    _k("MINIO_TPU_CACHE_DISK_DIR", "", "cache",
+       "Root directory for the disk/NVMe segment tier (each worker "
+       "process keeps its own subdirectory, removed at exit); empty "
+       "uses <tmpdir>/minio-tpu-segcache."),
+    _k("MINIO_TPU_CACHE_PREFETCH_SEGMENTS", "4", "cache",
+       "Sequential read-ahead depth: after a detected run of contiguous "
+       "ranged reads, this many stripe blocks past the observed end are "
+       "read through the erasure path on the QoS background lane and "
+       "cached. 0 disables prefetch."),
+    _k("MINIO_TPU_CACHE_PREFETCH_MIN_RUN", "2", "cache",
+       "Consecutive forward-contiguous ranged reads of one object "
+       "before read-ahead engages (floor 2 — a single ranged read is "
+       "not yet a sequential pattern)."),
     # -- erasure / object layer ------------------------------------------
     _k("MINIO_TPU_BACKEND", "jax", "erasure",
        "Erasure codec backend: `jax` (TPU/XLA bit-plane kernels) or "

@@ -18,8 +18,12 @@ This rule flags, outside the cache subsystem's own modules:
 - subscript writes/deletes into the listing metacache's ``_MC_MEM``.
 
 Read-side APIs (``fileinfo``, ``data_get``, ``data_put``,
-``data_admit``, ``snapshot``) are allowed — they ARE the cache's public
-surface and maintain their own bookkeeping.
+``data_admit``, ``snapshot``, and the segment tier's ``segment_open`` /
+``segment_admit`` / ``segment_put`` / ``segment_observe``) are allowed —
+they ARE the cache's public surface and maintain their own bookkeeping.
+The segment cache's disk files and directories
+(``segment.SegmentCache``) count as cache state like any LRU: erasure/
+server code must never touch ``segment_cache()`` internals directly.
 """
 
 from __future__ import annotations
@@ -41,9 +45,21 @@ _ALLOWED_API = frozenset({
     "bump_epoch", "clear",
     # read side + fills (their bookkeeping is internal to the cache)
     "fileinfo", "data_get", "data_put", "data_admit", "snapshot",
+    # range-segment tier (cache/segment.py storage, same discipline:
+    # lookups/fills only — segment/disk-tier REMOVAL is reachable solely
+    # through the choke points above, so the broadcast plane always sees
+    # it)
+    "segment_open", "segment_admit", "segment_put", "segment_observe",
 })
 
 _METACACHE_STATE = frozenset({"_MC_MEM", "_MC_STATS"})
+
+# process-wide cache singletons (cache/core.py data_cache(),
+# cache/segment.py segment_cache()): outside the cache package only the
+# read-only snapshot surface may be touched — every mutating method
+# (drop_where, put, demote, ...) is choke-point-internal
+_CACHE_FACTORIES = frozenset({"data_cache", "segment_cache"})
+_FACTORY_ALLOWED = frozenset({"snapshot"})
 
 
 def _exempt(relpath: str) -> bool:
@@ -95,6 +111,15 @@ def check_cache_discipline(tree: ast.AST, ctx) -> Iterator[Finding]:
             chain = _cache_chain(node.func)
             if chain and len(chain) == 1 and chain[0] not in _ALLOWED_API:
                 flag(node, f"call to non-choke-point `cache.{chain[0]}()`")
+        # data_cache()/segment_cache() singleton reached directly: only
+        # the read-only snapshot surface is public outside cache/
+        if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Call):
+            base = dotted_name(node.value.func) or ""
+            if (
+                base.split(".")[-1] in _CACHE_FACTORIES
+                and node.attr not in _FACTORY_ALLOWED
+            ):
+                flag(node, f"access to `{base}().{node.attr}`")
         # direct writes into the listing metacache's module state
         if isinstance(node, (ast.Assign, ast.AugAssign, ast.Delete)):
             targets = (

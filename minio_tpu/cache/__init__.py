@@ -8,6 +8,13 @@ Three tiers over the GET/HEAD hot path (see docs/CACHING.md):
 - **Hot-object data cache** (``core.DataCache``, process-wide byte
   budget): repeat GETs of small/hot objects are served from memory with
   etag/bitrot identity preserved.
+- **Range-segment cache** (``segment.SegmentCache``, process-wide):
+  objects ABOVE the whole-object size gate cache per 1 MiB stripe
+  block; a ranged GET whose covering segments are resident skips
+  ``open_object`` entirely. Memory evictions demote to a larger
+  disk/NVMe tier (``MINIO_TPU_CACHE_DISK_MB``) with digest-verified
+  promotion; sequential runs read ahead (``prefetch``) on the QoS
+  background lane.
 - **Listing metacache** (``erasure/listing.py``): repeated
   ``list_objects`` scans reuse recent prefix walks.
 
@@ -28,3 +35,4 @@ from .core import (  # noqa: F401
     store_caches,
 )
 from . import coherence  # noqa: F401
+from .segment import segment_cache, segments_enabled  # noqa: F401

@@ -220,6 +220,15 @@ class DataCache:
                 ]:
                     _bytes_add(-len(self._lru.pop(dk).data))
                     self.stats.evictions += 1
+        if _bytes_total() > budget:
+            # the budget is shared with the segment tier, which has an
+            # NVMe tier to overflow into — shed its cold segments first
+            # (OUTSIDE _mu: demotion does disk I/O), or a warm segment
+            # tier would starve this one to zero instead of spilling
+            from . import segment as segmod
+
+            segmod.segment_cache().shed_to_budget()
+        with self._mu:
             while self._lru and _bytes_total() > budget:
                 _, ev = self._lru.popitem(last=False)
                 _bytes_add(-len(ev.data))
@@ -568,6 +577,124 @@ class SetCache:
                 return  # overwritten since the read began: stale bytes
             _DATA.put(es, bucket, obj, vid, fi, data, self._epoch)
 
+    # -- segment tier (range-granular; cache/segment.py) -------------------
+
+    def segment_open(self, bucket: str, obj: str, vid: str, range_hint):
+        """Serve a ranged GET entirely from cached verified stripe-block
+        segments: ``range_hint`` is the syntactically-parsed Range header
+        (``("abs", start, end_or_None)`` / ``("suffix", n)``), resolved
+        here against the cached FileInfo's size with the same semantics
+        as the S3 layer's range parser. Returns
+        ``(fi, start, length, [(abs_offset, bytes)])`` or None (miss /
+        unresolvable range → the caller takes the erasure path)."""
+        from . import segment as segmod
+
+        if not segmod.segments_enabled() or range_hint is None:
+            return None
+        es = self._es()
+        if es is None:
+            return None
+        sc = segmod.segment_cache()
+        d = sc.directory(es, bucket, obj, vid)
+        if d is None:
+            return None
+        key = (bucket, obj, vid)
+        if d.epoch != self._epoch or self._needs_ttl_check(d):
+            if not self._revalidate_segments(key, d):
+                return None
+            sc.restamp(d, self._epoch, time.monotonic())
+        resolved = _resolve_range(range_hint, d.fi.size)
+        if resolved is None:
+            return None
+        start, length = resolved
+        rows = sc.read_range(d, start, length)
+        if rows is None:
+            return None
+        span_lookup("segment", bucket, obj, True)
+        return d.fi, start, length, rows
+
+    def _revalidate_segments(self, key: tuple, d) -> bool:
+        es = self._es()
+        if es is not None and self._stamp_live(
+            es, key, d.stamp, d.fi.erasure.parity_blocks
+        ):
+            return True
+        if es is not None:
+            from . import segment as segmod
+
+            segmod.segment_cache().drop_where(
+                lambda k: k == (id(es),) + key
+            )
+        return False
+
+    def segment_admit(self, bucket: str, obj: str, vid: str, fi) -> int | None:
+        """Should this ranged read's decoded stripe blocks fill the
+        segment cache? Same token contract as ``data_admit``; only
+        objects ABOVE the whole-object tier's size gate are eligible
+        (below it the whole-object tier is strictly better).
+        Transformed objects (SSE/compression) are excluded: their GET
+        path issues multiple reads per response through one pinned
+        handle, which the segment tier's range-scoped handle cannot
+        honor version-stably."""
+        from . import segment as segmod
+
+        if not segmod.segments_enabled():
+            return None
+        es = self._es()
+        if es is None or fi.deleted or fi.size <= 0:
+            return None
+        if fi.size <= object_max():
+            return None
+        if not fi.parts or fi.inline_data is not None:
+            return None
+        if _transformed(fi):
+            return None
+        if not segmod.segment_cache().admit(
+            (id(es), bucket, obj, vid), time.monotonic()
+        ):
+            return None
+        with self._mu:
+            return self._inv_seq
+
+    def segment_put(self, bucket: str, obj: str, vid: str, fi, pnum: int,
+                    bi: int, data, token: int) -> None:
+        """Insert one bitrot-verified decoded stripe block. Token check +
+        insert under one _mu hold (same rationale as ``data_put``); disk
+        demotion I/O runs after _mu is released."""
+        from . import segment as segmod
+
+        es = self._es()
+        if es is None or not segmod.segments_enabled():
+            return
+        sc = segmod.segment_cache()
+        with self._mu:
+            if self._invalidated_since_locked((bucket, obj, vid), token):
+                return
+            victims, orphans = sc.put(
+                es, bucket, obj, vid, fi, pnum, bi, data,
+                self._epoch, time.monotonic(),
+            )
+        sc.demote(victims, orphans)
+
+    def segment_observe(self, bucket: str, obj: str, vid: str,
+                        start: int, length: int, fi) -> None:
+        """Feed the sequential-read detector (cache/prefetch.py) with one
+        observed request range; called from the ranged-GET read path for
+        hits and misses alike. Only segment-ELIGIBLE objects are tracked
+        — read-ahead over an object the tier will never admit is pure
+        wasted I/O."""
+        if fi.deleted or fi.size <= object_max():
+            return
+        if not fi.parts or fi.inline_data is not None:
+            return
+        if _transformed(fi):
+            return  # never admitted (see segment_admit): don't read ahead
+        from . import prefetch
+
+        es = self._es()
+        if es is not None:
+            prefetch.observe(es, bucket, obj, vid, start, length)
+
     # -- choke-point mutations (the ONLY write API; see cache-discipline) --
 
     def invalidate_object(self, bucket: str, obj: str,
@@ -587,6 +714,11 @@ class SetCache:
             self._by_obj.pop((bucket, obj), None)
         if es is not None:
             _DATA.drop_where(
+                lambda k: k[0] == id(es) and k[1] == bucket and k[2] == obj
+            )
+            from . import segment as segmod
+
+            segmod.segment_cache().drop_where(
                 lambda k: k[0] == id(es) and k[1] == bucket and k[2] == obj
             )
         from ..erasure import listing
@@ -618,6 +750,12 @@ class SetCache:
                 lambda k: k[0] == id(es) and k[1] == bucket
                 and k[2].startswith(prefix)
             )
+            from . import segment as segmod
+
+            segmod.segment_cache().drop_where(
+                lambda k: k[0] == id(es) and k[1] == bucket
+                and k[2].startswith(prefix)
+            )
         from ..erasure import listing
 
         listing.invalidate_bucket(bucket)
@@ -639,6 +777,11 @@ class SetCache:
                 self.fi_stats.invalidations += 1
         if es is not None:
             _DATA.drop_where(lambda k: k[0] == id(es) and k[1] == bucket)
+            from . import segment as segmod
+
+            segmod.segment_cache().drop_where(
+                lambda k: k[0] == id(es) and k[1] == bucket
+            )
         from ..erasure import listing
 
         listing.invalidate_bucket(bucket)
@@ -670,6 +813,9 @@ class SetCache:
             self._by_obj.clear()
         if es is not None:
             n += _DATA.drop_where(lambda k: k[0] == id(es))
+            from . import segment as segmod
+
+            n += segmod.segment_cache().drop_where(lambda k: k[0] == id(es))
         return n
 
     # -- observability -----------------------------------------------------
@@ -708,6 +854,9 @@ def aggregate_stats(store) -> dict:
         epoch = max(epoch, snap["epoch"])
         for k, v in snap["fileinfo"].items():
             setattr(fi, k, getattr(fi, k) + v)
+    from . import prefetch
+    from . import segment as segmod
+
     return {
         "enabled": enabled(),
         "epoch": epoch,
@@ -718,6 +867,8 @@ def aggregate_stats(store) -> dict:
             "entries": _DATA.entry_count(),
             "bytes": _DATA.byte_count(),
         },
+        "segments": segmod.segment_cache().snapshot(),
+        "prefetch": prefetch.stats(),
         "listing": listing.metacache_stats(),
     }
 
@@ -731,6 +882,42 @@ def clear_store(store) -> int:
         n += c.clear()
     n += listing.clear_metacache()
     return n
+
+
+def _transformed(fi) -> bool:
+    """True when the object's stored bytes are SSE/compression
+    transformed — those responses read through one version-pinned handle
+    in multiple passes, which the segment tier must not serve."""
+    try:
+        from ..server import transforms
+
+        return transforms.is_transformed(fi.metadata)
+    except Exception:  # noqa: BLE001 — can't tell: stay off the fast path
+        return True
+
+
+def _resolve_range(range_hint, size: int) -> tuple[int, int] | None:
+    """Resolve a syntactically-parsed Range hint against the object size
+    — the same clamping the S3 layer's ``_parse_range`` applies, so a
+    segment-cache hit serves byte-identical ranges to the erasure path.
+    Returns (start, length) or None when the hint is unserveable (the
+    caller falls through to the real path, which raises the proper S3
+    error)."""
+    if size <= 0:
+        return None
+    kind = range_hint[0]
+    if kind == "suffix":
+        n = range_hint[1]
+        if n <= 0:
+            return None
+        start, end = max(size - n, 0), size - 1
+    else:
+        start = range_hint[1]
+        end = range_hint[2] if range_hint[2] is not None else size - 1
+        if start < 0 or start >= size or start > end:
+            return None
+        end = min(end, size - 1)
+    return start, end - start + 1
 
 
 def span_lookup(kind: str, bucket: str, obj: str, hit: bool):

@@ -1,0 +1,187 @@
+"""Sequential read-ahead for the range-segment cache.
+
+A checkpoint/training-shard reader walks a multi-GiB object in
+contiguous ranged GETs. The segment tier turns the *second* pass over a
+range into memory hits; this module removes the first-pass miss for
+everything after the detected run start: every ranged open
+(``SetCache.segment_observe`` — the obs span layer already carries these
+request ranges; this is the same signal at the same choke point) feeds a
+per-(set, bucket, object, version) run detector, and once
+``MINIO_TPU_CACHE_PREFETCH_MIN_RUN`` consecutive forward-contiguous
+reads are seen, the next ``MINIO_TPU_CACHE_PREFETCH_SEGMENTS`` stripe
+blocks are read through the normal bitrot-verified erasure path on a
+dedicated single background worker — under ``qos.background_context()``
++ ``qos.prefetch_context()``, so any dispatcher work rides the
+background lane (leftover batch capacity only; the
+``fg_deferred_behind_bg`` guard metric stays flat) and the shared read
+pool sees at most one prefetch stream at a time.
+
+Prefetched bytes enter the cache through the same admission + token
+path as foreground fills (by the time a run is detected the object has
+the two touches admission wants), so coherence is unchanged: an
+overwrite racing a prefetch rejects the fill via the invalidation
+token, exactly as it would a foreground fill.
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from concurrent.futures import ThreadPoolExecutor
+
+from .. import obs
+from .core import _int_env
+
+__all__ = ["observe", "stats", "reset", "drain_for_tests"]
+
+
+def prefetch_segments() -> int:
+    """How many stripe blocks to read ahead; 0 disables prefetch."""
+    return max(0, _int_env("MINIO_TPU_CACHE_PREFETCH_SEGMENTS", 4))
+
+
+def _min_run() -> int:
+    return max(2, _int_env("MINIO_TPU_CACHE_PREFETCH_MIN_RUN", 2))
+
+
+_mu = threading.Lock()
+# key (id(es), bucket, obj, vid) -> [last_end, run_len, prefetched_until]
+_table: dict[tuple, list[int]] = {}
+_inflight: set[tuple] = set()
+_pool: ThreadPoolExecutor | None = None
+_stats = {
+    "observed": 0, "runs_detected": 0, "scheduled": 0,
+    "skipped_inflight": 0, "completed": 0, "already_resident": 0,
+    "errors": 0, "bytes_read": 0,
+}
+
+
+def _worker_pool() -> ThreadPoolExecutor:
+    global _pool
+    if _pool is None:
+        # one worker: at most one prefetch stream competes for the shared
+        # shard-read pool, and queued prefetches collapse via _inflight
+        _pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="cache-prefetch"
+        )
+    return _pool
+
+
+def stats() -> dict:
+    with _mu:
+        return dict(_stats, tracked=len(_table), inflight=len(_inflight))
+
+
+def reset() -> None:
+    """Test hook: forget every tracked run (stats survive)."""
+    with _mu:
+        _table.clear()
+
+
+def drain_for_tests(timeout: float = 10.0) -> None:
+    """Block until the queued prefetch work has run (tests only)."""
+    ev = threading.Event()
+    _worker_pool().submit(ev.set)
+    ev.wait(timeout)
+
+
+def observe(es, bucket: str, obj: str, vid: str, start: int,
+            length: int) -> None:
+    """One observed ranged read. Contiguous-forward extends the run;
+    anything else restarts it. Crossing the min-run threshold schedules
+    a read of the next K stripe blocks (skipping what is already
+    resident and whatever an earlier prefetch already covered)."""
+    from ..qos.context import in_prefetch
+    from .segment import _block_size, segments_enabled
+
+    if in_prefetch():
+        return  # our own read-ahead must never extend the run it serves
+    k = prefetch_segments()
+    if k <= 0 or length <= 0 or not segments_enabled():
+        return
+    bs = _block_size()
+    key = (id(es), bucket, obj, vid)
+    end = start + length
+    with _mu:
+        _stats["observed"] += 1
+        ent = _table.get(key)
+        if ent is not None and 0 <= start - ent[0] <= bs:
+            ent[0] = end
+            ent[1] += 1
+        else:
+            ent = _table[key] = [end, 1, 0]
+        if len(_table) > 2048:  # bounded: drop an arbitrary cold entry
+            _table.pop(next(iter(_table)))
+        if ent[1] < _min_run():
+            return
+        if ent[1] == _min_run():
+            _stats["runs_detected"] += 1
+        # read-ahead window: the K blocks after the observed end, block
+        # aligned so fills are whole stripe blocks
+        pf_start = (end // bs) * bs
+        pf_end = pf_start + k * bs
+        if pf_end <= ent[2]:
+            return  # an earlier prefetch already covers this window
+        ent[2] = pf_end
+        if key in _inflight:
+            _stats["skipped_inflight"] += 1
+            return
+        _inflight.add(key)
+        _stats["scheduled"] += 1
+    _worker_pool().submit(
+        _prefetch, weakref.ref(es), bucket, obj, vid, pf_start,
+        pf_end - pf_start, key,
+    )
+
+
+def _prefetch(es_ref, bucket: str, obj: str, vid: str, offset: int,
+              length: int, key: tuple) -> None:
+    """Worker body: read [offset, offset+length) through the normal
+    erasure path with segment fills armed, discarding the bytes. Runs
+    under the QoS background + prefetch contexts so it can never
+    compete with foreground traffic for batch capacity."""
+    from ..qos.context import background_context, prefetch_context
+    from . import segment as segmod
+
+    try:
+        es = es_ref()
+        if es is None:
+            return
+        with background_context(), prefetch_context():
+            sc = segmod.segment_cache()
+            d = sc.directory(es, bucket, obj, vid)
+            if d is not None:
+                covered = sc.coverage(d, offset, length)
+                offset += covered
+                length -= covered
+                if length <= 0 or offset >= d.fi.size:
+                    with _mu:
+                        _stats["already_resident"] += 1
+                    return
+            with obs.span(
+                obs.TYPE_INTERNAL, "cache.prefetch",
+                bucket=bucket, object=obj, offset=offset, bytes=length,
+            ):
+                oi, h = es.open_object(bucket, obj, vid)
+                try:
+                    if offset >= oi.size:
+                        with _mu:
+                            _stats["already_resident"] += 1
+                        return
+                    length = min(length, oi.size - offset)
+                    n = 0
+                    for chunk in h.read(offset, length,
+                                        close_when_done=False):
+                        n += len(chunk)
+                    with _mu:
+                        _stats["bytes_read"] += n
+                finally:
+                    h.close()
+        with _mu:
+            _stats["completed"] += 1
+    except Exception:  # noqa: BLE001 — read-ahead is best-effort
+        with _mu:
+            _stats["errors"] += 1
+    finally:
+        with _mu:
+            _inflight.discard(key)

@@ -1,0 +1,666 @@
+"""Range-segment data cache: stripe-block granular tier + NVMe second tier.
+
+The whole-object data cache (``core.DataCache``) only admits objects
+below ``MINIO_TPU_CACHE_OBJECT_MAX`` — the checkpoint/training-shard
+workload (ranged GETs over multi-GiB objects) paid the full
+ns-lock + N-drive fan-out + decode path on every request. This module
+caches those objects **per stripe block** (1 MiB, ``erasure/coder.py
+BLOCK_SIZE``): cache keys are ``(set, bucket, object, versionId,
+part#, block#)``, fills ride the existing bitrot-verified windowed read
+path (a segment is admitted only after its stripe block decoded and
+verified), and a ranged GET whose covering segments are all cached
+short-circuits ``open_object`` entirely — no namespace lock, no
+metadata fan-out, no shard I/O. Serving from cached verified segments
+shrinks per-request GF/decode work the same way XOR-schedule program
+optimization shrinks it on-chip (arXiv:2108.02692): survivor bytes are
+never re-read or re-verified (the repair-bandwidth framing of
+arXiv:1412.3022 applied to the serving path).
+
+**Second tier**: a much larger disk/NVMe tier (``MINIO_TPU_CACHE_DISK_MB``
+under ``MINIO_TPU_CACHE_DISK_DIR``). Memory-budget evictions demote the
+coldest segments to disk files instead of dropping them; a disk hit
+promotes the segment back into memory. Every segment carries a sha256
+recorded at demote time and re-checked at promote time: a torn write,
+bit flip, or injected fault quarantines the entry and the read falls
+back to the erasure path — wrong bytes can never be served. The disk
+tier sits behind the same two-touch admission policy and the same
+``SetCache.invalidate_*`` choke point + grid broadcast coherence plane
+as every other tier (a segment directory is stamped with the quorum
+identity ``(mod_time, data_dir)`` and revalidates on epoch bumps).
+
+Budget note: the memory side shares the process-wide
+``MINIO_TPU_CACHE_MEM_MB`` budget with the whole-object tier; the disk
+budget is per worker process (each SO_REUSEPORT worker keeps its own
+subdirectory — segments are node-local state, like the memory tiers).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import tempfile
+import threading
+from collections import OrderedDict
+
+from ..fault import registry as fault_registry
+from .core import (
+    TierStats,
+    _bytes_add,
+    _bytes_total,
+    _int_env,
+    _mem_budget,
+    enabled,
+)
+
+__all__ = ["SegmentCache", "segment_cache", "segments_enabled"]
+
+
+def segments_enabled() -> bool:
+    return enabled() and os.environ.get("MINIO_TPU_CACHE_SEGMENTS", "1") != "0"
+
+
+def disk_budget() -> int:
+    """Disk-tier byte budget; 0 disables the tier."""
+    return _int_env("MINIO_TPU_CACHE_DISK_MB", 0) << 20
+
+
+def disk_dir() -> str:
+    return os.environ.get("MINIO_TPU_CACHE_DISK_DIR", "")
+
+
+def _block_size() -> int:
+    from ..erasure.coder import BLOCK_SIZE
+
+    return BLOCK_SIZE
+
+
+def _admit_touches() -> int:
+    return max(1, _int_env("MINIO_TPU_CACHE_ADMIT_TOUCHES", 2))
+
+
+def _seg_digest(data) -> bytes:
+    """Integrity stamp for demoted segment files: HighwayHash-256 (the
+    same family as the bitrot plane, ~5x sha256 on this host) when the
+    native plane is built, sha256 otherwise — the PURE-python
+    HighwayHash fallback would cost more than the read it protects."""
+    from .. import native
+
+    if native.available():
+        from ..ops.bitrot import fast_hash256
+
+        return fast_hash256(data)
+    return hashlib.sha256(data).digest()
+
+
+def object_layout(fi) -> list[tuple[int, int, int, int]]:
+    """(abs_offset, length, part#, block#) for every stripe block of the
+    object, in byte order. Mirrors the windowed read path's plan
+    (``coder.shard_sizes_for`` per part): full blocks are BLOCK_SIZE,
+    each part's final block carries the remainder."""
+    bs = _block_size()
+    out: list[tuple[int, int, int, int]] = []
+    pos = 0
+    for part in fi.parts:
+        full = part.size // bs
+        for bi in range(full):
+            out.append((pos + bi * bs, bs, part.number, bi))
+        tail = part.size - full * bs
+        if tail:
+            out.append((pos + full * bs, tail, part.number, full))
+        pos += part.size
+    return out
+
+
+class _Seg:
+    """One cached stripe block: ``data`` (memory tier) and/or ``path`` +
+    ``digest`` (disk tier) — a promoted segment keeps its verified file,
+    so evicting it from memory again is free (no rewrite, no re-hash);
+    dual residency counts against both budgets. ``dropped`` marks
+    entries invalidated while off-lock I/O (demote write / promote read)
+    was in flight, so the I/O's completion can discard instead of
+    resurrect."""
+
+    __slots__ = ("key", "size", "data", "path", "digest", "dropped")
+
+    def __init__(self, key: tuple, size: int, data: bytes):
+        self.key = key          # (dir_key, pnum, bi)
+        self.size = size
+        self.data = data
+        self.path: str | None = None
+        self.digest: bytes | None = None
+        self.dropped = False
+
+
+class _SegDir:
+    """Per-object segment directory: the FileInfo the segments were read
+    under (identity + layout source) and the live segment map."""
+
+    __slots__ = ("fi", "stamp", "epoch", "t", "ref", "segs", "layout", "by_block")
+
+    def __init__(self, fi, epoch: int, ref, monotonic: float):
+        self.fi = fi
+        self.stamp = (fi.mod_time, fi.data_dir)
+        self.epoch = epoch
+        self.t = monotonic
+        self.ref = ref  # weakref to the owning ErasureSet (id-reuse guard)
+        self.segs: dict[tuple[int, int], _Seg] = {}
+        self.layout = object_layout(fi)
+        self.by_block = {(p, b): (lo, ln) for lo, ln, p, b in self.layout}
+
+
+class SegmentCache:
+    """Process-wide range-segment cache (memory tier + optional disk
+    tier). All bookkeeping is under ``_mu``; bulk I/O (demote writes,
+    promote reads) happens OFF the lock with dropped-flag reconciliation
+    so invalidations are never outraced by in-flight file I/O."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._dirs: dict[tuple, _SegDir] = {}
+        # memory-tier LRU over segment keys; disk-tier LRU separate
+        self._mem_lru: OrderedDict[tuple, _Seg] = OrderedDict()
+        self._disk_lru: OrderedDict[tuple, _Seg] = OrderedDict()
+        self._disk_bytes = 0
+        self._touches: dict[tuple, tuple[int, float]] = {}
+        self._dir_path: str | None = None
+        self._dir_for: str | None = None  # configured root it was made under
+        self._file_seq = 0
+        self.stats = TierStats()
+        # disk/prefetch-plane extras not covered by TierStats
+        self.xstats = {
+            "demotions": 0, "promotions": 0, "quarantined": 0,
+            "disk_evictions": 0, "disk_hits": 0, "disk_write_errors": 0,
+            "range_hits": 0, "range_misses": 0,
+        }
+
+    # -- disk-tier plumbing -------------------------------------------------
+
+    def _disk_root_locked(self) -> str | None:
+        """Lazily-created per-process spool directory, or None when the
+        tier is disabled or the directory cannot be created."""
+        if disk_budget() <= 0:
+            return None
+        root = disk_dir() or os.path.join(
+            tempfile.gettempdir(), "minio-tpu-segcache"
+        )
+        # revalidate, don't just memoize: the configured root can change
+        # (or be deleted) mid-process — tests, benches, operator re-config.
+        # Stale entries pointing into a vanished dir fail their digest
+        # read and quarantine; new demotions must land somewhere real.
+        if (
+            self._dir_path is not None
+            and self._dir_for == root
+            and os.path.isdir(self._dir_path)
+        ):
+            return self._dir_path
+        # per-process subdirectory: SO_REUSEPORT workers share the knob
+        # value but must never share segment files (each worker's tier is
+        # invalidated by its own broadcast receiver)
+        path = os.path.join(root, f"w{os.getpid()}")
+        try:
+            os.makedirs(path, exist_ok=True)
+        except OSError:
+            return None
+        self._dir_path = path
+        self._dir_for = root
+        import atexit
+        import shutil
+
+        atexit.register(shutil.rmtree, path, ignore_errors=True)
+        return path
+
+    def _write_segment_file(self, root: str, seg: _Seg) -> str | None:
+        """Demote write (OFF _mu): spool the segment's bytes; returns the
+        path or None on failure. The chaos boundary injects here —
+        a torn write leaves a short file that the promote-time digest
+        check quarantines."""
+        with self._mu:
+            self._file_seq += 1
+            name = f"{self._file_seq:012d}.seg"
+        path = os.path.join(root, name)
+        rule = fault_registry.check(
+            "storage", "cache-disk", "write",
+            modes=("error", "torn-write", "enospc", "latency"),
+        )
+        try:
+            data = seg.data or b""
+            if rule is not None:
+                if rule.mode == "latency":
+                    fault_registry.sleep_latency(rule)
+                elif rule.mode == "torn-write":
+                    with open(path, "wb") as fh:
+                        fh.write(data[: len(data) // 2])
+                    return path  # torn on disk: caught by the digest check
+                else:  # error / enospc
+                    raise OSError("injected cache-disk write fault")
+            with open(path, "wb") as fh:
+                fh.write(data)
+            return path
+        except OSError:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            with self._mu:
+                self.xstats["disk_write_errors"] += 1
+            return None
+
+    def _read_segment_file(self, seg: _Seg) -> bytes | None:
+        """Promote read (OFF _mu) with integrity verification; any
+        failure — I/O error, short file, digest mismatch, injected
+        fault — quarantines the entry (the caller falls back to the
+        erasure read path, so wrong bytes are structurally unservable)."""
+        rule = fault_registry.check(
+            "storage", "cache-disk", "read",
+            modes=("error", "bitrot", "latency"),
+        )
+        try:
+            if rule is not None:
+                if rule.mode == "latency":
+                    fault_registry.sleep_latency(rule)
+                elif rule.mode == "error":
+                    raise OSError("injected cache-disk read fault")
+            with open(seg.path, "rb") as fh:  # type: ignore[arg-type]
+                data = fh.read()
+            if rule is not None and rule.mode == "bitrot" and data:
+                buf = bytearray(data)
+                buf[rule.rng.randrange(len(buf))] ^= 0xFF
+                data = bytes(buf)
+            if len(data) != seg.size or (
+                seg.digest is not None and _seg_digest(data) != seg.digest
+            ):
+                self._quarantine(seg)
+                return None
+            return data
+        except OSError:
+            self._quarantine(seg)
+            return None
+
+    def _quarantine(self, seg: _Seg) -> None:
+        """Drop a disk entry whose bytes can no longer be trusted."""
+        with self._mu:
+            if not seg.dropped:
+                seg.dropped = True
+                self.xstats["quarantined"] += 1
+                self._disk_lru.pop(seg.key, None)
+                if seg.path is not None:
+                    self._disk_bytes -= seg.size
+                d = self._dirs.get(seg.key[0])
+                if d is not None:
+                    d.segs.pop(seg.key[1:], None)
+            path = seg.path
+        fault_registry.emit(
+            "cache.segment.quarantine", key=str(seg.key[0][1:]),
+            block=str(seg.key[1:]),
+        )
+        self._unlink(path)
+
+    @staticmethod
+    def _unlink(path: str | None) -> None:
+        if path:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+    # -- budget enforcement -------------------------------------------------
+
+    def _evict_mem_locked(self) -> tuple[list[_Seg], list[str]]:
+        """Pop memory-LRU tails past the shared byte budget; returns the
+        victims for off-lock demotion (or dropping) plus orphaned disk
+        paths to unlink. Dead-set directories reclaim first — nobody can
+        invalidate them anymore."""
+        budget = _mem_budget()
+        if _bytes_total() <= budget:
+            return [], []
+        paths: list[str] = []
+        for dk in [k for k, d in self._dirs.items() if d.ref() is None]:
+            paths.extend(self._drop_dir_locked(dk))
+        victims: list[_Seg] = []
+        while self._mem_lru and _bytes_total() > budget:
+            _, seg = self._mem_lru.popitem(last=False)
+            _bytes_add(-seg.size)
+            victims.append(seg)
+        return victims, paths
+
+    def demote(self, victims: list[_Seg], paths: list[str] = ()) -> None:
+        """OFF every lock: write eviction victims to the disk tier
+        (budget allowing) or drop them, and unlink orphaned files. A
+        victim invalidated mid-write is unlinked, never resurrected.
+        Callers that evict under SetCache._mu (``put`` via
+        ``SetCache.segment_put``) hand the victims back out so multi-MiB
+        disk writes never run under a cache-wide lock."""
+        for p in paths:
+            self._unlink(p)
+        if not victims:
+            return
+        with self._mu:
+            root = self._disk_root_locked()
+        for seg in victims:
+            with self._mu:
+                if seg.path is not None and not seg.dropped:
+                    # promoted earlier and the verified file was kept:
+                    # demotion is free — just release the memory copy
+                    seg.data = None
+                    self._disk_lru.move_to_end(seg.key)
+                    self.xstats["demotions"] += 1
+                    continue
+            path = None
+            if root is not None and seg.size <= disk_budget():
+                path = self._write_segment_file(root, seg)
+            drop_path: str | None = None
+            evict: list[str] = []
+            with self._mu:
+                if path is None or seg.dropped:
+                    if not seg.dropped:
+                        seg.dropped = True
+                        d = self._dirs.get(seg.key[0])
+                        if d is not None:
+                            d.segs.pop(seg.key[1:], None)
+                        self.stats.evictions += 1
+                    drop_path = path
+                else:
+                    seg.digest = _seg_digest(seg.data or b"")
+                    seg.path = path
+                    seg.data = None
+                    self._disk_lru[seg.key] = seg
+                    self._disk_bytes += seg.size
+                    self.xstats["demotions"] += 1
+                    evict = self._evict_disk_locked()
+            self._unlink(drop_path)
+            for ev in evict:
+                self._unlink(ev)
+
+    def _evict_disk_locked(self) -> list[str]:
+        """Disk-LRU tails past the disk budget; returns paths to unlink
+        off-lock."""
+        out: list[str] = []
+        budget = disk_budget()
+        while self._disk_lru and self._disk_bytes > budget:
+            _, seg = self._disk_lru.popitem(last=False)
+            self._disk_bytes -= seg.size
+            if seg.path:
+                out.append(seg.path)
+            seg.path = None
+            seg.digest = None
+            self.xstats["disk_evictions"] += 1
+            if seg.data is None:
+                # no memory copy either: the segment is gone entirely
+                seg.dropped = True
+                d = self._dirs.get(seg.key[0])
+                if d is not None:
+                    d.segs.pop(seg.key[1:], None)
+        return out
+
+    def shed_to_budget(self) -> None:
+        """Evict this tier's coldest memory segments until the SHARED
+        byte budget fits again. Called by the whole-object tier when a
+        fill finds the budget blown: segments overflow to the NVMe tier
+        instead of the data cache evicting itself to zero. The
+        accounting happens inline (the caller needs the room NOW); the
+        demotion's file I/O runs on a helper thread — this path can be
+        reached under SetCache._mu via data_put, which must never wait
+        on disk writes."""
+        with self._mu:
+            victims, paths = self._evict_mem_locked()
+        if victims or paths:
+            _demote_pool().submit(self.demote, victims, paths)
+
+    # -- admission ----------------------------------------------------------
+
+    def admit(self, dir_key: tuple, monotonic: float) -> bool:
+        """Two-touch admission per OBJECT (not per segment): a ranged
+        object earns segment residency by being read twice, so a one-pass
+        sequential scan cannot flush the tier; once admitted, every
+        segment of the stream fills."""
+        need = _admit_touches()
+        if need <= 1:
+            return True
+        with self._mu:
+            if dir_key in self._dirs:
+                return True  # already resident: later fills extend it
+            n, _ = self._touches.get(dir_key, (0, monotonic))
+            n += 1
+            self._touches[dir_key] = (n, monotonic)
+            if len(self._touches) > 4096:
+                for old in sorted(
+                    self._touches, key=lambda x: self._touches[x][1]
+                )[:1024]:
+                    del self._touches[old]
+        return n >= need
+
+    # -- fills ---------------------------------------------------------------
+
+    def put(self, es, bucket: str, obj: str, vid: str, fi, pnum: int,
+            bi: int, data, epoch: int,
+            monotonic: float) -> tuple[list[_Seg], list[str]]:
+        """Insert one verified stripe block. ``data`` may be longer than
+        the block's logical length (decode padding) — it is trimmed; a
+        SHORT payload is rejected (partial block from a ranged native
+        span). Caller (SetCache.segment_put) holds the invalidation-token
+        check under ITS lock, so this method only stores — it returns any
+        eviction victims + orphan paths for the caller to ``demote()``
+        after releasing that lock (disk writes must not run under
+        SetCache._mu)."""
+        import weakref
+
+        dk = (id(es), bucket, obj, vid)
+        orphans: list[str] = []
+        with self._mu:
+            d = self._dirs.get(dk)
+            if d is not None and (
+                d.ref() is not es or d.stamp != (fi.mod_time, fi.data_dir)
+            ):
+                orphans = self._drop_dir_locked(dk)
+                d = None
+            if d is None:
+                d = self._dirs[dk] = _SegDir(
+                    fi, epoch, weakref.ref(es), monotonic
+                )
+            want = d.by_block.get((pnum, bi))
+            if want is None:
+                return [], orphans
+            length = want[1]
+            if len(data) < length:
+                self.stats.rejected += 1
+                return [], orphans
+            if d.segs.get((pnum, bi)) is not None:
+                return [], orphans  # already cached (racing fills)
+            seg = _Seg((dk, pnum, bi), length, bytes(data[:length]))
+            d.segs[(pnum, bi)] = seg
+            self._mem_lru[seg.key] = seg
+            _bytes_add(length)
+            self.stats.fills += 1
+            victims, paths = self._evict_mem_locked()
+        return victims, orphans + paths
+
+    # -- lookups -------------------------------------------------------------
+
+    def directory(self, es, bucket: str, obj: str, vid: str) -> _SegDir | None:
+        """The object's segment directory when it belongs to this live
+        set (weakref id-reuse guard) — freshness is judged by the caller
+        (SetCache owns epoch/TTL policy)."""
+        dk = (id(es), bucket, obj, vid)
+        with self._mu:
+            d = self._dirs.get(dk)
+            if d is None or d.ref() is not es:
+                return None
+            return d
+
+    def restamp(self, d: _SegDir, epoch: int, monotonic: float) -> None:
+        with self._mu:
+            d.epoch = epoch
+            d.t = monotonic
+            self.stats.revalidations += 1
+
+    def covering(self, d: _SegDir, start: int, length: int):
+        """The (abs_offset, length, part#, block#) rows covering
+        [start, start+length), or None when the range is out of bounds."""
+        import bisect
+
+        if length <= 0 or start < 0 or start + length > d.fi.size:
+            return None
+        starts = [row[0] for row in d.layout]
+        lo_i = bisect.bisect_right(starts, start) - 1
+        hi_i = bisect.bisect_left(starts, start + length)
+        return d.layout[max(lo_i, 0):hi_i]
+
+    def read_range(self, d: _SegDir, start: int, length: int):
+        """[(abs_offset, bytes)] covering the range when EVERY covering
+        segment is resident (promoting disk entries back to memory on the
+        way), else None — the caller falls back to the erasure path.
+        Promotion failures (torn file, bitrot, injected fault) quarantine
+        and miss; they can never surface wrong bytes."""
+        rows = self.covering(d, start, length)
+        if rows is None:
+            return None
+        found: list[tuple[int, _Seg, bytes | None]] = []
+        with self._mu:
+            for lo, ln, pnum, bi in rows:
+                seg = d.segs.get((pnum, bi))
+                if seg is None or seg.dropped:
+                    self.stats.misses += 1
+                    self.xstats["range_misses"] += 1
+                    return None
+                if seg.data is not None and seg.key in self._mem_lru:
+                    # membership-checked: an eviction may have popped the
+                    # key while seg.data awaits the off-lock demote write
+                    self._mem_lru.move_to_end(seg.key, last=True)
+                found.append((lo, seg, seg.data))
+        # disk entries read + verify OFF the lock, then promote
+        promoted: dict[tuple, bytes] = {}
+        need_disk = [seg for _, seg, data in found if data is None]
+        for seg in need_disk:
+            data = self._read_segment_file(seg)
+            if data is None:
+                with self._mu:
+                    self.stats.misses += 1
+                    self.xstats["range_misses"] += 1
+                return None
+            promoted[seg.key] = data
+        if need_disk:
+            with self._mu:
+                if any(seg.dropped for seg in need_disk):
+                    # invalidated while reading: the bytes may predate an
+                    # overwrite — do not serve, do not resurrect
+                    self.stats.misses += 1
+                    self.xstats["range_misses"] += 1
+                    return None
+                for seg in need_disk:
+                    self.xstats["disk_hits"] += 1
+                    if seg.data is not None:
+                        # a concurrent reader promoted it while we were
+                        # reading the file: it already occupies the
+                        # budget exactly once — re-adding would leak
+                        # phantom bytes into the shared counter forever
+                        continue
+                    # keep the verified file + digest: the next memory
+                    # eviction of this segment demotes without a rewrite
+                    seg.data = promoted[seg.key]
+                    _bytes_add(seg.size)
+                    self._mem_lru[seg.key] = seg
+                    if seg.key in self._disk_lru:
+                        self._disk_lru.move_to_end(seg.key)
+                    self.xstats["promotions"] += 1
+                victims, orphans = self._evict_mem_locked()
+            self.demote(victims, orphans)
+        with self._mu:
+            self.stats.hits += len(rows)
+            self.xstats["range_hits"] += 1
+        return [
+            (lo, data if data is not None else promoted[seg.key])
+            for lo, seg, data in found
+        ]
+
+    def coverage(self, d: _SegDir, start: int, length: int) -> int:
+        """How many leading bytes of [start, start+length) are already
+        resident — the prefetcher trims its read to the uncovered tail."""
+        rows = self.covering(d, start, length)
+        if not rows:
+            return 0
+        covered = 0
+        with self._mu:
+            for lo, ln, pnum, bi in rows:
+                seg = d.segs.get((pnum, bi))
+                if seg is None or seg.dropped:
+                    break
+                covered = min(lo + ln, start + length) - start
+        return max(covered, 0)
+
+    # -- removal (called ONLY from the SetCache choke points) ----------------
+
+    def _drop_dir_locked(self, dk: tuple) -> list[str]:
+        d = self._dirs.pop(dk, None)
+        self._touches.pop(dk, None)
+        if d is None:
+            return []
+        paths: list[str] = []
+        for seg in d.segs.values():
+            seg.dropped = True
+            # a segment may be resident in BOTH tiers (promoted with its
+            # file kept): release each side it holds
+            if seg.data is not None:
+                _bytes_add(-seg.size)
+                self._mem_lru.pop(seg.key, None)
+            if seg.path is not None:
+                self._disk_bytes -= seg.size
+                self._disk_lru.pop(seg.key, None)
+                paths.append(seg.path)
+            self.stats.invalidations += 1
+        d.segs.clear()
+        return paths
+
+    def drop_where(self, pred) -> int:
+        """Invalidate every object directory whose key matches ``pred``
+        (same contract as DataCache.drop_where; key = (id(es), bucket,
+        obj, vid)). Disk files unlink off-lock."""
+        with self._mu:
+            victims = [k for k in self._dirs if pred(k)]
+            paths: list[str] = []
+            for k in victims:
+                paths.extend(self._drop_dir_locked(k))
+        for p in paths:
+            self._unlink(p)
+        return len(victims)
+
+    # -- observability --------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        with self._mu:
+            mem_bytes = sum(s.size for s in self._mem_lru.values())
+            return {
+                **self.stats.snapshot(),
+                **self.xstats,
+                "objects": len(self._dirs),
+                "entries": len(self._mem_lru) + len(self._disk_lru),
+                "mem_entries": len(self._mem_lru),
+                "mem_bytes": mem_bytes,
+                "disk_entries": len(self._disk_lru),
+                "disk_bytes": self._disk_bytes,
+                "disk_budget": disk_budget(),
+                "disk_dir": self._dir_path or "",
+            }
+
+
+_SEG = SegmentCache()
+
+_DEMOTE_POOL = None
+_DEMOTE_POOL_MU = threading.Lock()
+
+
+def _demote_pool():
+    """Single helper thread for off-critical-path demotion writes."""
+    global _DEMOTE_POOL
+    if _DEMOTE_POOL is None:
+        from concurrent.futures import ThreadPoolExecutor
+
+        with _DEMOTE_POOL_MU:
+            if _DEMOTE_POOL is None:
+                _DEMOTE_POOL = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="segcache-demote"
+                )
+    return _DEMOTE_POOL
+
+
+def segment_cache() -> SegmentCache:
+    return _SEG

@@ -102,11 +102,12 @@ class ServerPools:
             bucket, obj, version_id, *a, **kw
         )
 
-    def open_object(self, bucket: str, obj: str, version_id: str = ""):
+    def open_object(self, bucket: str, obj: str, version_id: str = "",
+                    range_hint=None):
         # the returned handle is bound to the concrete set that holds the
         # object — later reads never re-resolve pools
         return self._pool_holding(bucket, obj, version_id).open_object(
-            bucket, obj, version_id
+            bucket, obj, version_id, range_hint
         )
 
     def get_object_info(self, bucket: str, obj: str, version_id: str = "") -> ObjectInfo:

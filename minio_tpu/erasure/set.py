@@ -686,7 +686,8 @@ class ErasureSet:
         return self._to_object_info(bucket, obj, fi)
 
     def open_object(
-        self, bucket: str, obj: str, version_id: str = ""
+        self, bucket: str, obj: str, version_id: str = "",
+        range_hint=None,
     ) -> tuple[ObjectInfo, "ObjectHandle"]:
         """One quorum metadata read under a namespace read lock; the handle
         serves any number of ranged reads without re-reading metadata.
@@ -694,7 +695,13 @@ class ErasureSet:
         immutable verified snapshot from memory — no lock, no metadata
         fan-out, no shard I/O (invalidation through the cache choke point
         happens under the writer's lock BEFORE it releases, so any entry
-        found here was the live version when the lookup happened)."""
+        found here was the live version when the lookup happened).
+
+        ``range_hint`` is the syntactically-parsed Range header of a
+        ranged GET (``("abs", start, end|None)`` / ``("suffix", n)``):
+        when every stripe-block segment covering the range is cached
+        (range-segment tier, objects far above the whole-object size
+        gate), the same short-circuit applies."""
         hit = self.cache.data_get(bucket, obj, version_id)
         if hit is not None:
             fi, data = hit
@@ -705,6 +712,17 @@ class ErasureSet:
                 self._to_object_info(bucket, obj, fi),
                 CachedObjectHandle(fi, data),
             )
+        if range_hint is not None:
+            seg = self.cache.segment_open(bucket, obj, version_id, range_hint)
+            if seg is not None:
+                fi, start, length, rows = seg
+                return (
+                    self._to_object_info(bucket, obj, fi),
+                    SegmentCachedObjectHandle(
+                        self, bucket, obj, version_id, fi, start, length,
+                        rows,
+                    ),
+                )
         with obs.span(
             obs.TYPE_INTERNAL, "erasure.open_object", bucket=bucket, object=obj
         ):
@@ -765,6 +783,7 @@ class ErasureSet:
         metas: list[FileInfo | None],
         offset: int,
         length: int,
+        seg_sink=None,
     ) -> Iterator[bytes]:
         """Span shim over ``_read_range_inner``: the stripe verify +
         reconstruct compute is the GET path's kernel stage, traced as one
@@ -774,7 +793,9 @@ class ErasureSet:
             obs.TYPE_TPU, "stripe.read-verify",
             bucket=bucket, object=obj, offset=offset, bytes=length,
         ):
-            yield from self._read_range_inner(bucket, obj, fi, metas, offset, length)
+            yield from self._read_range_inner(
+                bucket, obj, fi, metas, offset, length, seg_sink
+            )
 
     def _read_range_inner(
         self,
@@ -784,6 +805,7 @@ class ErasureSet:
         metas: list[FileInfo | None],
         offset: int,
         length: int,
+        seg_sink=None,
     ) -> Iterator[bytes]:
         """Windowed parallel striped read: per-shard reads fan out on a
         thread pool (greedy data-first, parity spill on failure), whole
@@ -793,7 +815,12 @@ class ErasureSet:
         readahead (/root/reference/cmd/erasure-decode.go:32,127-235,
         cmd/erasure-object.go:1429) but trades its per-block goroutine
         choreography for window-batched decode — the TPU-shaped version.
-        Spans multiple parts (each part is its own erasure stream)."""
+        Spans multiple parts (each part is its own erasure stream).
+
+        ``seg_sink(part#, block#, block_bytes)``: every stripe block the
+        read fully materializes (verified + decoded) is offered to the
+        range-segment cache — a partial first/last block of a native
+        span is offered too and rejected there by length."""
         if length == 0:
             return
         d = fi.erasure.data_blocks
@@ -945,6 +972,20 @@ class ErasureSet:
                     k = start
                     ok = False
                     break
+                if seg_sink is not None:
+                    # offer whole stripe blocks of this span to the
+                    # segment cache (partial head/tail slices are length-
+                    # rejected there); bytes are post-verify, same as the
+                    # reconstructing path's fills
+                    o = 0
+                    frame = DIGEST + coder.shard_size
+                    for pnum_s, _per_s, f_off_s, lo_s, hi_s in span:
+                        if lo_s == 0:
+                            seg_sink(
+                                pnum_s, f_off_s // frame,
+                                out[o : o + hi_s - lo_s],
+                            )
+                        o += hi_s - lo_s
                 mv = memoryview(out)
                 for o in range(0, tot, 1 << 20):
                     yield mv[o : o + (1 << 20)]
@@ -1123,6 +1164,15 @@ class ErasureSet:
                     futs = start_window(windows[wi + 1])  # readahead
                 blocks = decode_window(win, got)
                 for (pnum, per, f_off, lo, hi), block in zip(win, blocks):
+                    if seg_sink is not None:
+                        # the decode always materializes the FULL stripe
+                        # block (ranged reads only slice at yield time),
+                        # so even a partial-range request fills whole
+                        # verified segments
+                        seg_sink(
+                            pnum, f_off // (DIGEST + coder.shard_size),
+                            block,
+                        )
                     yield block[lo:hi]
         finally:
             # abandoned iterator (client hung up) or error: don't let
@@ -1684,13 +1734,37 @@ class ObjectHandle:
             fill_token = self.es.cache.data_admit(
                 self.bucket, self.obj, self._vid, self.fi
             )
+        # objects ABOVE the whole-object size gate fill the range-segment
+        # tier instead: every stripe block this read fully decodes (and
+        # bitrot-verified) is offered per-segment, under the same
+        # invalidation-token discipline
+        seg_token = None
+        if fill_token is None:
+            seg_token = self.es.cache.segment_admit(
+                self.bucket, self.obj, self._vid, self.fi
+            )
+        if offset != 0 or length != self.fi.size:
+            # feed the sequential-read detector (prefetch plane) with the
+            # observed range — misses included, or a run could never form
+            self.es.cache.segment_observe(
+                self.bucket, self.obj, self._vid, offset, length, self.fi
+            )
+
+        seg_sink = None
+        if seg_token is not None:
+            def seg_sink(pnum: int, bi: int, data) -> None:
+                self.es.cache.segment_put(
+                    self.bucket, self.obj, self._vid, self.fi, pnum, bi,
+                    data, seg_token,
+                )
 
         def gen():
             last_refresh = _time.monotonic()
             collected: list[bytes] | None = [] if fill_token is not None else None
             try:
                 for chunk in self.es._read_range(
-                    self.bucket, self.obj, self.fi, self.metas, offset, length
+                    self.bucket, self.obj, self.fi, self.metas, offset,
+                    length, seg_sink,
                 ):
                     now = _time.monotonic()
                     if self._mutex is not None and now - last_refresh > self._REFRESH_EVERY:
@@ -1707,6 +1781,70 @@ class ObjectHandle:
             finally:
                 if close_when_done:
                     self.close()
+
+        return gen()
+
+
+class SegmentCachedObjectHandle:
+    """ObjectHandle-compatible view over cached range segments: the
+    hinted range is served by slicing immutable verified stripe-block
+    snapshots pinned at open time — no namespace lock, no metadata
+    fan-out, no shard I/O (same safety argument as CachedObjectHandle:
+    invalidation through the choke point removed any overwritten entry
+    before the writer returned, and these bytes are pinned). Reads
+    OUTSIDE the hinted range (multi-range callers, SSE per-part decode)
+    fall back to a real per-read handle so semantics never narrow."""
+
+    def __init__(self, es: ErasureSet, bucket: str, obj: str, vid: str,
+                 fi: FileInfo, start: int, length: int, rows):
+        self.es = es
+        self.bucket = bucket
+        self.obj = obj
+        self._vid = vid
+        self.fi = fi
+        self._start = start
+        self._length = length
+        self._rows = rows  # [(abs_offset, bytes)] covering the range
+
+    def close(self) -> None:
+        pass
+
+    def read(
+        self, offset: int = 0, length: int = -1, close_when_done: bool = True
+    ) -> Iterator[bytes]:
+        if length < 0:
+            length = self.fi.size - offset
+        if offset < 0 or offset + length > self.fi.size:
+            raise ValueError("invalid range")
+        if offset != 0 or length != self.fi.size:
+            self.es.cache.segment_observe(
+                self.bucket, self.obj, self._vid, offset, length, self.fi
+            )
+        if not (
+            offset >= self._start
+            and offset + length <= self._start + self._length
+        ):
+            # outside the pinned range: open a real handle for this read
+            # (always self-closing — a leaked rlock would outlive us),
+            # pinned to THIS handle's version where one exists — a
+            # concurrent overwrite must not splice newer bytes into a
+            # response whose headers came from self.fi
+            vid = self._vid or (self.fi.version_id or "")
+            _oi, h = self.es.open_object(self.bucket, self.obj, vid)
+            return h.read(offset, length)
+
+        def gen():
+            end = offset + length
+            for abs_off, data in self._rows:
+                if abs_off + len(data) <= offset:
+                    continue
+                if abs_off >= end:
+                    break
+                mv = memoryview(data)[
+                    max(offset - abs_off, 0) : end - abs_off
+                ]
+                for o in range(0, len(mv), 1 << 20):
+                    yield mv[o : o + (1 << 20)]
 
         return gen()
 

@@ -92,8 +92,11 @@ class ErasureSets:
     def get_object(self, bucket: str, obj: str, *a, **kw):
         return self.get_hashed_set(obj).get_object(bucket, obj, *a, **kw)
 
-    def open_object(self, bucket: str, obj: str, version_id: str = ""):
-        return self.get_hashed_set(obj).open_object(bucket, obj, version_id)
+    def open_object(self, bucket: str, obj: str, version_id: str = "",
+                    range_hint=None):
+        return self.get_hashed_set(obj).open_object(
+            bucket, obj, version_id, range_hint
+        )
 
     def get_object_info(self, bucket: str, obj: str, version_id: str = "") -> ObjectInfo:
         return self.get_hashed_set(obj).get_object_info(bucket, obj, version_id)

@@ -37,7 +37,12 @@ import numpy as np
 
 from .. import obs
 from ..fault import registry as fault_registry
-from ..qos.context import PRI_BACKGROUND, PRI_FOREGROUND, current_priority
+from ..qos.context import (
+    PRI_BACKGROUND,
+    PRI_FOREGROUND,
+    current_priority,
+    in_prefetch,
+)
 
 # backend degradation ladder (fault/ tpu boundary): fused Pallas
 # mega-kernel -> row-major XLA -> pure-numpy CPU. Repeated device faults
@@ -141,6 +146,9 @@ class TpuDispatcher:
             "dispatches": 0, "blocks": 0, "max_batch": 0,
             "fg_blocks": 0, "bg_blocks": 0, "bg_forced": 0,
             "bg_batch_max": 0, "fg_deferred_behind_bg": 0,
+            # prefetch lane: cache read-ahead blocks riding the bg lane
+            # (cache/prefetch.py marks them via qos.prefetch_context)
+            "prefetch_blocks": 0,
             "fused": 0, "fused_failures": 0,
             # degradation ladder (metrics-v3 /api/fault): current rung,
             # device-fault streak witnesses, demote/promote transitions.
@@ -188,7 +196,8 @@ class TpuDispatcher:
         # only while someone is tracing) so the batch record can name the
         # requests it served
         req_id = obs.current_request_id() if obs.active() else ""
-        item = (blocks, fut, priority, _monotonic(), req_id)
+        item = (blocks, fut, priority, _monotonic(), req_id,
+                priority == PRI_BACKGROUND and in_prefetch())
         with self._cv:
             (self._bg if priority == PRI_BACKGROUND else self._fg).append(item)
             self._cv.notify()
@@ -542,6 +551,8 @@ class TpuDispatcher:
                         kk = it[0].shape[0]
                         if it[2] == PRI_BACKGROUND:
                             self.stats["bg_blocks"] += kk
+                            if it[5]:
+                                self.stats["prefetch_blocks"] += kk
                         else:
                             self.stats["fg_blocks"] += kk
                 off = 0

@@ -23,6 +23,9 @@ PRI_BACKGROUND = 1
 _BACKGROUND: contextvars.ContextVar[bool] = contextvars.ContextVar(
     "minio_tpu_qos_background", default=False
 )
+_PREFETCH: contextvars.ContextVar[bool] = contextvars.ContextVar(
+    "minio_tpu_qos_prefetch", default=False
+)
 
 
 @contextmanager
@@ -37,8 +40,25 @@ def background_context():
         _BACKGROUND.reset(token)
 
 
+@contextmanager
+def prefetch_context():
+    """Cache read-ahead (cache/prefetch.py) rides the background lane
+    like every other background plane, but carries its own tag so the
+    dispatcher can account prefetch blocks separately — the prefetch
+    lane is observable without being schedulable ahead of anything."""
+    token = _PREFETCH.set(True)
+    try:
+        yield
+    finally:
+        _PREFETCH.reset(token)
+
+
 def in_background() -> bool:
     return bool(_BACKGROUND.get())
+
+
+def in_prefetch() -> bool:
+    return bool(_PREFETCH.get())
 
 
 def current_priority() -> int:

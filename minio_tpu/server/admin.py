@@ -549,14 +549,19 @@ async def handle_admin(server, request: web.Request, access_key: str, subpath: s
         st["promotions"] = ds.get("promotions", 0)
         return _json(st)
 
-    # -- caching layer (cache/: FileInfo + data + listing tiers) -----------
+    # -- caching layer (cache/: FileInfo + data + segment + listing tiers) -
     if op == "cache/status" and m == "GET":
         authz("admin:OBDInfo")
         from .. import cache
         from ..cache import coherence as cache_coherence
+        from ..cache import segment as cache_segment
 
         st = await server._run(cache.aggregate_stats, server.store)
         st["coherence"] = cache_coherence.stats()
+        # operator-facing tier config: is the range-segment tier live,
+        # and where/how big is this worker's NVMe spool
+        st["segmentsEnabled"] = cache_segment.segments_enabled()
+        st["segments"]["disk_enabled"] = cache_segment.disk_budget() > 0
         return _json(st)
     if op == "cache/clear" and m == "POST":
         authz("admin:ServerUpdate")

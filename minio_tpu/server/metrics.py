@@ -901,7 +901,7 @@ def _g_api_cache(server) -> list[str]:
     if server.store is None:
         return out
     st = cache.aggregate_stats(server.store)
-    tiers = ("fileinfo", "data", "listing")
+    tiers = ("fileinfo", "data", "segments", "listing")
 
     def rows(key: str):
         return [({"tier": t}, st[t].get(key, 0)) for t in tiers]
@@ -911,20 +911,56 @@ def _g_api_cache(server) -> list[str]:
          "Cache hits per tier")
     _fmt(out, "minio_cache_misses_total", "counter", rows("misses"))
     _fmt(out, "minio_cache_evictions_total", "counter",
-         [({"tier": t}, st[t].get("evictions", 0)) for t in ("fileinfo", "data")])
+         [({"tier": t}, st[t].get("evictions", 0))
+          for t in ("fileinfo", "data", "segments")])
     _fmt(out, "minio_cache_invalidations_total", "counter", rows("invalidations"))
     _fmt(out, "minio_cache_revalidations_total", "counter",
-         [({"tier": t}, st[t].get("revalidations", 0)) for t in ("fileinfo", "data")])
+         [({"tier": t}, st[t].get("revalidations", 0))
+          for t in ("fileinfo", "data", "segments")])
     _fmt(out, "minio_cache_entries", "gauge", rows("entries"))
     _fmt(out, "minio_cache_bytes", "gauge",
          [({"tier": "data"}, st["data"].get("bytes", 0)),
+          ({"tier": "segments"}, st["segments"].get("mem_bytes", 0)),
           ({"tier": "total"}, st["bytesTotal"])],
          "Cached bytes vs the MINIO_TPU_CACHE_MEM_MB budget")
     _fmt(out, "minio_cache_singleflight_shared_total", "counter",
          [({}, st["fileinfo"].get("singleflight_shared", 0))],
          "Concurrent metadata misses that shared one quorum read")
     _fmt(out, "minio_cache_data_fills_total", "counter",
-         [({}, st["data"].get("fills", 0))])
+         [({"tier": "data"}, st["data"].get("fills", 0)),
+          ({"tier": "segments"}, st["segments"].get("fills", 0))])
+    # range-segment tier: per-request range outcomes + the disk/NVMe
+    # second tier's movement and integrity counters
+    sg = st["segments"]
+    _fmt(out, "minio_cache_segment_range_requests_total", "counter",
+         [({"result": "hit"}, sg.get("range_hits", 0)),
+          ({"result": "miss"}, sg.get("range_misses", 0))],
+         "Ranged GETs fully served from cached segments vs fallen "
+         "through to the erasure path")
+    _fmt(out, "minio_cache_segment_disk_entries", "gauge",
+         [({}, sg.get("disk_entries", 0))])
+    _fmt(out, "minio_cache_segment_disk_bytes", "gauge",
+         [({"kind": "used"}, sg.get("disk_bytes", 0)),
+          ({"kind": "budget"}, sg.get("disk_budget", 0))],
+         "Disk/NVMe segment tier fill vs MINIO_TPU_CACHE_DISK_MB")
+    _fmt(out, "minio_cache_segment_disk_moves_total", "counter",
+         [({"dir": "demote"}, sg.get("demotions", 0)),
+          ({"dir": "promote"}, sg.get("promotions", 0)),
+          ({"dir": "evict"}, sg.get("disk_evictions", 0))])
+    _fmt(out, "minio_cache_segment_quarantined_total", "counter",
+         [({}, sg.get("quarantined", 0))],
+         "Disk-tier entries dropped on failed integrity verification "
+         "(torn write / bitrot / read error); reads fell back to the "
+         "erasure path")
+    pf = st["prefetch"]
+    _fmt(out, "minio_cache_prefetch_runs_total", "counter",
+         [({"event": "detected"}, pf.get("runs_detected", 0)),
+          ({"event": "scheduled"}, pf.get("scheduled", 0)),
+          ({"event": "completed"}, pf.get("completed", 0)),
+          ({"event": "error"}, pf.get("errors", 0))],
+         "Sequential read-ahead activity (cache/prefetch.py)")
+    _fmt(out, "minio_cache_prefetch_bytes_total", "counter",
+         [({}, pf.get("bytes_read", 0))])
     _fmt(out, "minio_cache_epoch", "gauge", [({}, st["epoch"])],
          "Coherence epoch (bumped on detected lost invalidations)")
     co = cache_coherence.stats()

@@ -690,6 +690,27 @@ class ObjectHandlersMixin:
         )
         return web.Response(body=xml.encode(), content_type="application/xml", headers=headers)
 
+    @staticmethod
+    def _range_hint(request):
+        """Syntactic parse of the Range header — no object size needed,
+        so it can run BEFORE any metadata read: the cache's range-segment
+        tier resolves it against the cached FileInfo and a full-coverage
+        hit skips open_object's lock + fan-out entirely. Anything
+        unusual (multi-range, malformed) -> None, the real path decides."""
+        rng = request.headers.get("Range")
+        if not rng or not rng.startswith("bytes="):
+            return None
+        spec = rng[len("bytes=") :]
+        if "," in spec:
+            return None
+        start_s, _, end_s = spec.partition("-")
+        try:
+            if start_s == "":
+                return ("suffix", int(end_s))
+            return ("abs", int(start_s), int(end_s) if end_s else None)
+        except ValueError:
+            return None
+
     def _parse_range(self, request, size: int) -> tuple[int, int] | None:
         rng = request.headers.get("Range")
         if not rng or not rng.startswith("bytes="):
@@ -721,7 +742,10 @@ class ObjectHandlersMixin:
         if vid == "null":
             vid = ""
         try:
-            oi, handle = await self._run(self.store.open_object, bucket, key, vid)
+            oi, handle = await self._run(
+                self.store.open_object, bucket, key, vid,
+                self._range_hint(request),
+            )
         except (quorum.ObjectNotFound, quorum.VersionNotFound):
             # not (yet) here: replication lag in an active-active pair —
             # proxy the read to a remote target rather than 404ing

@@ -724,6 +724,61 @@ def test_cache_discipline_exempts_cache_package_and_listing():
                rules=["cache-discipline"]) == []
 
 
+GOOD_SEGMENT_READ_SIDE = """
+    def serve(es, bucket, obj, vid, fi, hint, data, tok):
+        seg = es.cache.segment_open(bucket, obj, vid, hint)
+        tok2 = es.cache.segment_admit(bucket, obj, vid, fi)
+        es.cache.segment_put(bucket, obj, vid, fi, 1, 0, data, tok)
+        es.cache.segment_observe(bucket, obj, vid, 0, 100, fi)
+        return seg
+"""
+
+BAD_SEGMENT_DIRECT_DROP = """
+    from ..cache.segment import segment_cache
+
+    def purge(es):
+        segment_cache().drop_where(lambda k: True)
+"""
+
+BAD_SEGMENT_INTERNAL_STATE = """
+    from ..cache import segment
+
+    def peek(es):
+        return segment.segment_cache()._dirs
+"""
+
+GOOD_SEGMENT_SNAPSHOT = """
+    from ..cache.segment import segment_cache
+
+    def stats():
+        return segment_cache().snapshot()
+"""
+
+
+def test_cache_discipline_allows_segment_read_side():
+    assert run(GOOD_SEGMENT_READ_SIDE, relpath="erasure/set.py",
+               rules=["cache-discipline"]) == []
+
+
+def test_cache_discipline_flags_direct_segment_drop():
+    fs = run(BAD_SEGMENT_DIRECT_DROP, relpath="erasure/set.py",
+             rules=["cache-discipline"])
+    assert fs and "segment_cache().drop_where" in fs[0].message
+
+
+def test_cache_discipline_flags_segment_internal_state():
+    fs = run(BAD_SEGMENT_INTERNAL_STATE, relpath="server/admin.py",
+             rules=["cache-discipline"])
+    assert fs and "_dirs" in fs[0].message
+
+
+def test_cache_discipline_allows_segment_snapshot_and_own_package():
+    assert run(GOOD_SEGMENT_SNAPSHOT, relpath="server/metrics.py",
+               rules=["cache-discipline"]) == []
+    assert run(BAD_SEGMENT_DIRECT_DROP, relpath="cache/core.py",
+               rules=["cache-discipline"]) == []
+
+
 # -- knob-native: getenv() in C++ sources checked against the registry ----
 
 from minio_tpu.analysis.rules_native import scan_native_source  # noqa: E402

@@ -509,7 +509,10 @@ def test_dispatch_background_starvation_protection():
     blocks = _blocks(1)
     with disp._cv:
         # aged far past MINIO_TPU_QOS_BG_MAX_AGE_MS (default 50 ms)
-        disp._bg.append((blocks, aged_fut, PRI_BACKGROUND, time.monotonic() - 10.0))
+        disp._bg.append(
+            (blocks, aged_fut, PRI_BACKGROUND, time.monotonic() - 10.0,
+             "", False)
+        )
         disp._cv.notify()
     shards, digests = aged_fut.result(timeout=10)
     assert shards.shape == (1, 6, 256)

@@ -69,6 +69,12 @@ def pool(tmp_path_factory):
     # (test_sse_compression turns compression on process-wide); the
     # etag assertions below require identity storage
     env["MINIO_COMPRESSION_ENABLE"] = "off"
+    # range-segment tier with a small memory budget + an NVMe tier so
+    # the cross-worker invalidation test below covers disk-resident
+    # segments too (demotion needs real memory pressure)
+    env["MINIO_TPU_CACHE_MEM_MB"] = "16"
+    env["MINIO_TPU_CACHE_DISK_MB"] = "256"
+    env["MINIO_TPU_CACHE_DISK_DIR"] = str(base / "segspool")
     env["PYTHONPATH"] = REPO
     env.pop("JAX_PLATFORMS", None)
     proc = subprocess.Popen(
@@ -291,6 +297,58 @@ def test_bitrot_heal_with_two_workers(pool):
     assert healed["scanned"] >= 1
     g = w1.get_object(BUCKET, "rot")
     assert g.status == 200 and g.body == body
+
+
+def test_ranged_segment_cache_cross_worker_invalidation(pool):
+    """Range-segment tier coherence across the pool: worker 1 warms its
+    segment cache (memory + disk-demoted entries — the fixture's 16 MiB
+    budget forces demotion) over a large object, worker 0 overwrites it,
+    and worker 1 must serve the NEW bytes/etag immediately — the
+    invalidation broadcast covers segment directories and their NVMe
+    files like every other tier."""
+    w0, w1 = pool["w0"], pool["w1"]
+    mib = 1 << 20
+    size = 24 * mib  # > the 16 MiB memory budget: part of it demotes
+    body = os.urandom(size)
+    assert w0.put_object(BUCKET, "rseg", body).status == 200
+
+    def ranged(cli, off):
+        r = cli.request(
+            "GET", f"/{BUCKET}/rseg",
+            headers={"Range": f"bytes={off}-{off + mib - 1}"},
+        )
+        assert r.status == 206, r.status
+        return r.body
+
+    # two passes warm w1 (two-touch admission, then fills)
+    for _ in range(2):
+        for off in range(0, size, mib):
+            assert ranged(w1, off) == body[off : off + mib]
+    st = json.loads(
+        w1.request("GET", "/minio/admin/v3/cache/status").body
+    )
+    assert st["segmentsEnabled"] and st["segments"]["fills"] > 0
+    assert st["segments"]["disk_entries"] > 0, (
+        "expected demoted segments under the 16 MiB budget",
+        st["segments"],
+    )
+    # overwrite THROUGH THE SIBLING: w1's segment directory and its
+    # NVMe files must invalidate before w0's PUT returns
+    body2 = os.urandom(size)
+    etag2 = hashlib.md5(body2).hexdigest()
+    assert w0.put_object(BUCKET, "rseg", body2).status == 200
+    for off in (0, 8 * mib, size - mib):
+        r = w1.request(
+            "GET", f"/{BUCKET}/rseg",
+            headers={"Range": f"bytes={off}-{off + mib - 1}"},
+        )
+        assert r.status == 206
+        assert r.body == body2[off : off + mib], f"stale bytes at {off}"
+        assert r.headers["etag"].strip('"') == etag2, "stale etag"
+    st2 = json.loads(
+        w1.request("GET", "/minio/admin/v3/cache/status").body
+    )
+    assert st2["segments"]["invalidations"] > st["segments"]["invalidations"]
 
 
 def test_supervisor_restarts_crashed_worker(pool):
