@@ -23,6 +23,7 @@ docs:
 	$(PY) -m minio_tpu.analysis --gen-config-docs docs/CONFIG.md
 	$(PY) -m minio_tpu.analysis minio_tpu/ --cache --gen-lock-order docs/LOCK_ORDER.md
 	$(PY) -m minio_tpu.analysis minio_tpu/ --cache --gen-concurrency docs/CONCURRENCY.md
+	$(PY) -m minio_tpu.analysis minio_tpu/ --cache --gen-resources docs/RESOURCES.md
 
 # harness-stays-runnable gate: the closed-loop load harness end to end
 # (worker pool, mixed zipf traffic, heal flood, QoS guard metrics) in

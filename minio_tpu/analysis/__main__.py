@@ -5,6 +5,8 @@
                                  [--cache [PATH] | --no-cache] [--clean-cache]
     python -m minio_tpu.analysis --gen-config-docs [PATH]
     python -m minio_tpu.analysis --gen-lock-order [PATH]
+    python -m minio_tpu.analysis --gen-concurrency [PATH]
+    python -m minio_tpu.analysis --gen-resources [PATH]
     python -m minio_tpu.analysis --list-rules
 
 Findings print as ``file:line: rule: message`` (clickable); exit status
@@ -12,7 +14,8 @@ is non-zero when anything is found. ``--strict`` additionally fails on
 unused ``# miniovet: ignore[...]`` pragmas. With no paths, the installed
 ``minio_tpu`` package is analyzed — per-file rules plus the
 interprocedural passes (blocking-reachable, lock-order, coherence-path,
-cancellation-reachable) over the whole program.
+cancellation-reachable, races, resources, error-taint, dead-knob) over
+the whole program.
 
 ``--cache`` keeps per-file summaries in a content-hash-keyed JSON file
 (default ``.miniovet-cache.json`` next to the package) so warm runs
@@ -89,6 +92,13 @@ def main(argv: list[str] | None = None) -> int:
              "(the runtime access witness loads it) and exit "
              "('-' prints to stdout)",
     )
+    ap.add_argument(
+        "--gen-resources", nargs="?", const="docs/RESOURCES.md",
+        default=None, metavar="PATH",
+        help="write the resource ownership table proved by the "
+             "resources pass (the runtime leak witness cross-validates "
+             "it) and exit ('-' prints to stdout)",
+    )
     args = ap.parse_args(argv)
 
     if args.list_rules:
@@ -116,6 +126,9 @@ def main(argv: list[str] | None = None) -> int:
         if args.gen_concurrency is not None and "races" not in rules:
             # same contract for the guarded-by table
             rules.append("races")
+        if args.gen_resources is not None and "resources" not in rules:
+            # and for the ownership table
+            rules.append("resources")
 
     cache_path = None
     if (args.cache or args.cache_file) and not args.no_cache:
@@ -133,14 +146,16 @@ def main(argv: list[str] | None = None) -> int:
         # paths always analyze — deleting the cache must never skip them
         if not args.paths and cache_path is None \
                 and args.gen_lock_order is None \
-                and args.gen_concurrency is None:
+                and args.gen_concurrency is None \
+                and args.gen_resources is None:
             return 0
 
     result = analyze_project(
         paths, rules=rules, jobs=max(args.jobs, 1), cache_path=cache_path
     )
 
-    if args.gen_lock_order is not None or args.gen_concurrency is not None:
+    if args.gen_lock_order is not None or args.gen_concurrency is not None \
+            or args.gen_resources is not None:
         gate = result.findings
         if not args.strict:  # same pragma filtering as the normal path
             gate = [f for f in gate if f.rule != "pragma"]
@@ -166,6 +181,13 @@ def main(argv: list[str] | None = None) -> int:
             rc = _write_doc(
                 args.gen_concurrency,
                 generate_concurrency_md(result.guard_table),
+            )
+        if args.gen_resources is not None and rc == 0:
+            from .rules_resources import generate_resources_md
+
+            rc = _write_doc(
+                args.gen_resources,
+                generate_resources_md(result.resource_table),
             )
         return rc
 

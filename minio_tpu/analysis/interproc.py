@@ -1,6 +1,10 @@
 """Interprocedural passes over the project call graph.
 
-Four whole-program properties the per-file rules cannot see:
+This module implements four whole-program properties the per-file rules
+cannot see (the ``races``, ``resources``, ``error-taint``, and
+``dead-knob`` passes live in their own modules — rules_races.py,
+rules_resources.py, rules_errors.py, rules_knobs.py — and are driven
+from ``run_passes`` here):
 
 - ``blocking-reachable``      a blocking primitive (``time.sleep``, sync
   socket/DNS, ``subprocess.run``, ``requests.*``, ``Future.result()``)
@@ -60,10 +64,14 @@ class IPResult:
     lock_order: list[str] = field(default_factory=list)
     lock_edges: dict[str, list[str]] = field(default_factory=dict)
     guard_table: list[dict] = field(default_factory=list)
+    resource_table: list[dict] = field(default_factory=list)
 
 
-def run_passes(index: ProjectIndex, passes, suppressed=None) -> IPResult:
-    """`suppressed(relpath, line, tag) -> bool` declassifies sources."""
+def run_passes(index: ProjectIndex, passes, suppressed=None,
+               native_knob_reads=frozenset()) -> IPResult:
+    """`suppressed(relpath, line, tag) -> bool` declassifies sources.
+    `native_knob_reads` feeds the dead-knob pass with getenv evidence
+    from native sources (they have no summaries)."""
     if suppressed is None:
         suppressed = lambda relpath, line, tag: False  # noqa: E731
     res = IPResult()
@@ -79,12 +87,37 @@ def run_passes(index: ProjectIndex, passes, suppressed=None) -> IPResult:
         res.findings.extend(eng.coherence_path())
     if "cancellation-reachable" in passes:
         res.findings.extend(eng.cancellation_reachable())
+    shared_contexts: dict | None = None
     if "races" in passes:
         from . import rules_races
 
-        findings, table = rules_races.run(index, suppressed)
+        races_eng = rules_races.RacesEngine(index, suppressed)
+        findings, table = rules_races.run(index, suppressed,
+                                          engine=races_eng)
         res.findings.extend(findings)
         res.guard_table = table
+        # the error-taint pass reuses this execution-context fixpoint
+        # instead of recomputing the whole-program map
+        shared_contexts = races_eng.contexts
+    if "resources" in passes:
+        from . import rules_resources
+
+        findings, table = rules_resources.run(index, suppressed)
+        res.findings.extend(findings)
+        res.resource_table = table
+    if "error-taint" in passes:
+        from . import rules_errors
+
+        res.findings.extend(
+            rules_errors.run(index, suppressed,
+                             contexts=shared_contexts)
+        )
+    if "dead-knob" in passes:
+        from .rules_knobs import dead_knob_findings
+
+        res.findings.extend(
+            dead_knob_findings(index, native_knob_reads, suppressed)
+        )
     res.findings.sort()
     return res
 

@@ -266,6 +266,14 @@ _ALL: list[Knob] = [
        "Event-loop stall watchdog threshold in seconds: the loop "
        "missing its monotonic tick for longer than this records one "
        "`loop.stall` sanitizer event with the loop thread's stack."),
+    _k("MINIO_TPU_SANITIZE_LEAKS", "1", "analysis",
+       "Resource leak witness under MINIO_TPU_SANITIZE=1: acquisition "
+       "wrappers on the resource classes in docs/RESOURCES.md register "
+       "weakref finalizers, and a resource garbage-collected without "
+       "its release method having run (a dropped ObjectHandle stranding "
+       "a namespace read lock, an unclosed spool file) reports a "
+       "`resource.leak` sanitizer event with the acquisition stack. "
+       "0 disables just this witness."),
     _k("MINIO_TPU_SANITIZE_ATTRS", "1", "analysis",
        "Attribute access witness under MINIO_TPU_SANITIZE=1: the "
        "cross-context attributes the static `races` pass emitted into "

@@ -19,6 +19,10 @@ SARIF_SCHEMA = (
     "Schemata/sarif-schema-2.1.0.json"
 )
 
+# every rule id anchors a heading in the rule index of docs/ANALYSIS.md;
+# code-scanning UIs surface this next to each annotation
+HELP_BASE = "docs/ANALYSIS.md"
+
 
 def findings_json(findings: list[Finding], stats: dict | None = None) -> str:
     doc: dict = {
@@ -43,8 +47,36 @@ def findings_json(findings: list[Finding], stats: dict | None = None) -> str:
     return json.dumps(doc, indent=2, sort_keys=False) + "\n"
 
 
+def _region(f: Finding, line_cache: dict) -> dict:
+    """Full region for a finding: the whole source line, so code-scanning
+    annotations highlight the statement instead of a zero-width caret at
+    column 1. Start column skips the indentation; files that cannot be
+    read fall back to the start position only (still valid SARIF)."""
+    start = max(f.line, 1)
+    region: dict = {"startLine": start}
+    lines = line_cache.get(f.file)
+    if lines is None:
+        try:
+            with open(f.file, "r", encoding="utf-8",
+                      errors="replace") as fh:
+                lines = fh.read().splitlines()
+        except OSError:
+            lines = []
+        line_cache[f.file] = lines
+    if 0 < start <= len(lines):
+        text = lines[start - 1]
+        stripped = text.rstrip()
+        indent = len(text) - len(text.lstrip())
+        if stripped:
+            region["startColumn"] = indent + 1
+            region["endLine"] = start
+            region["endColumn"] = len(stripped) + 1
+    return region
+
+
 def findings_sarif(findings: list[Finding]) -> str:
     rules = sorted({f.rule for f in findings})
+    line_cache: dict = {}
     results = [
         {
             "ruleId": f.rule,
@@ -54,7 +86,7 @@ def findings_sarif(findings: list[Finding]) -> str:
                 {
                     "physicalLocation": {
                         "artifactLocation": {"uri": f.file},
-                        "region": {"startLine": max(f.line, 1)},
+                        "region": _region(f, line_cache),
                     }
                 }
             ],
@@ -69,7 +101,10 @@ def findings_sarif(findings: list[Finding]) -> str:
                 "tool": {
                     "driver": {
                         "name": "miniovet",
-                        "rules": [{"id": r} for r in rules],
+                        "rules": [
+                            {"id": r, "helpUri": f"{HELP_BASE}#{r}"}
+                            for r in rules
+                        ],
                     }
                 },
                 "results": results,

@@ -31,6 +31,7 @@ import ast
 import hashlib
 import json
 import os
+import re
 import time
 from dataclasses import dataclass, field
 
@@ -44,7 +45,7 @@ from .core import (
 )
 
 # bump to invalidate every cache entry on engine-format changes
-ENGINE_VERSION = "miniovet-ip-2"
+ENGINE_VERSION = "miniovet-ip-3"
 
 # interprocedural pass ids (per-file rule ids live in core.ALL_RULES)
 INTERPROC_PASSES = (
@@ -53,6 +54,9 @@ INTERPROC_PASSES = (
     "coherence-path",
     "cancellation-reachable",
     "races",
+    "resources",
+    "error-taint",
+    "dead-knob",
 )
 
 # blocking primitives for reachability (names matched on the dotted call
@@ -90,6 +94,67 @@ _MUTATOR_METHODS = frozenset({
     "pop", "popleft", "popitem", "remove", "update", "setdefault",
     "move_to_end", "sort", "reverse", "rotate",
 })
+
+
+# -- resource lifetimes (the `resources` pass) ------------------------------
+#
+# Acquisition shapes that hand the caller something it must release,
+# transfer, or deliberately anchor. Per kind: the methods that balance
+# the acquisition when called on the bound name. The per-exit proof
+# lives in rules_resources.py; the extractor only records the raw facts.
+RESOURCE_RELEASES: dict[str, tuple[str, ...]] = {
+    "nslock": ("unlock", "runlock", "close"),
+    "future": ("result", "cancel", "exception", "add_done_callback"),
+    "task": ("cancel", "result", "add_done_callback"),
+    "spool": ("close", "cleanup", "unlink"),
+    "file": ("close",),
+    "span": ("close", "finish"),
+}
+
+# free functions that release/consume the resource passed as an argument
+FREE_RELEASERS = frozenset({
+    "os.close", "os.unlink", "os.remove", "os.replace", "os.rename",
+    "os.rmdir", "os.removedirs", "shutil.rmtree", "shutil.move",
+})
+
+# calls that anchor futures/tasks handed to them (the waiter owns them)
+WAITER_CALLS = frozenset({
+    "as_completed", "concurrent.futures.as_completed",
+    "futures.as_completed", "concurrent.futures.wait", "futures.wait",
+    "asyncio.wait", "asyncio.gather", "asyncio.wait_for",
+    "asyncio.wrap_future",
+})
+
+_SPOOL_CTORS = frozenset({
+    "tempfile.NamedTemporaryFile", "NamedTemporaryFile",
+    "tempfile.TemporaryDirectory", "TemporaryDirectory",
+    "tempfile.mkstemp", "mkstemp", "tempfile.mkdtemp", "mkdtemp",
+})
+
+_FILE_CTORS = frozenset({"open", "io.open", "os.fdopen"})
+
+# container-add methods whose Name arguments escape to the container's
+# lifetime (an anchored future/task is the collection owner's problem)
+_CONTAINER_ADDS = frozenset({"append", "appendleft", "add", "put",
+                             "register", "add_done_callback"})
+
+_ALL_RELEASE_ATTRS = frozenset(
+    a for attrs in RESOURCE_RELEASES.values() for a in attrs
+)
+
+_KNOB_LIT_RE = re.compile(r"^MINIO_[A-Z0-9_]*$")
+
+
+def acquisition_kind(expr: str) -> str | None:
+    """Resource kind acquired by a call with this dotted shape, or None."""
+    attr = expr.split(".")[-1]
+    if attr == "submit":
+        return "future"
+    if attr in ("create_task", "ensure_future"):
+        return "task"
+    if expr in _SPOOL_CTORS:
+        return "spool"
+    return None
 
 
 def _is_lockish(name: str) -> bool:
@@ -164,6 +229,13 @@ def _boundary_via(expr: str, attr: str, call: ast.Call) -> str:
             if kw.arg == "name" and isinstance(kw.value, ast.Constant) \
                     and isinstance(kw.value.value, str):
                 return kw.value.value
+            if kw.arg == "name" and isinstance(kw.value, ast.JoinedStr) \
+                    and kw.value.values \
+                    and isinstance(kw.value.values[0], ast.Constant) \
+                    and isinstance(kw.value.values[0].value, str):
+                # f"tpu-dispatch-{d}+{p}": the constant head is the
+                # thread's identity (parameterized suffix)
+                return kw.value.values[0].value
         for kw in call.keywords:
             if kw.arg == "target":
                 ref = _callable_ref(kw.value)
@@ -180,23 +252,39 @@ class _FunctionExtractor:
     def __init__(self, fn: ast.AST, qualname: str, cls: str | None,
                  want_exits: bool):
         self.fn = fn
+        args = fn.args
+        params = [a.arg for a in
+                  (args.posonlyargs + args.args + args.kwonlyargs)]
         self.sum: dict = {
             "name": qualname,
             "line": fn.lineno,
             "async": isinstance(fn, ast.AsyncFunctionDef),
             "class": cls,
-            "calls": [],       # {expr, line, kind}
+            "params": params,  # declared parameter names, in order
+            "calls": [],       # {expr, line, kind[, argv, kw]}
             "prims": [],       # {what, line}
             "waits": [],       # {expr, line} -- .result()-style sync waits
             "holds": [],       # {lock, line, calls, acquires}
             "acquires": [],    # {lock, line} -- every acquire in this fn
             "locals": {},      # var -> class-ref expr (light type inference)
             "broad_trys": [],  # {line, calls} (async fns only)
-            "exits": [],       # {line, kind, before, tail}
+            "exits": [],       # {line, kind, before, tail, names}
             "attrs": [],       # {recv, attr, rw, line, locks} (races pass)
+            "resources": [],   # {kind, var, line, expr, cm, loose}
+            "releases": [],    # {var, how, line} -- release-shaped events
+            "escapes": [],     # names stored on self/containers (lifetime
+                               # escapes: the owner releases, not this fn)
+            "raises": [],      # {type, line}
+            "swallows": [],    # {line, cleanup} broad no-reraise handlers
+            "catches": [],     # typed exception names caught here
         }
         self.want_exits = want_exits
         self._active_holds: list[dict] = []
+        self._loop_depth = 0     # inside For/While: exits can't see body
+        self._branch_depth = 0   # inside If/except: acquisition conditional
+        self._cleanup_depth = 0  # inside except/finally: unwinding context
+        self._finally_trys: list[int] = []  # try linenos whose finally
+        # we are inside: releases there credit exits of THAT try only
 
     def run(self) -> dict:
         self._walk_block(self.fn.body)
@@ -208,6 +296,8 @@ class _FunctionExtractor:
         for h in self.sum["holds"]:
             h["calls"] = sorted(set(h["calls"]))
             h["acquires"] = sorted(set(h["acquires"]))
+        self.sum["escapes"] = sorted(set(self.sum["escapes"]))
+        self.sum["catches"] = sorted(set(self.sum["catches"]))
         return self.sum
 
     # -- expression-level collection ------------------------------------
@@ -217,8 +307,15 @@ class _FunctionExtractor:
         into nested function/class definitions."""
         awaited: set[int] = set()
         for n in ast.walk(node):
-            if isinstance(n, ast.Await) and isinstance(n.value, ast.Call):
-                awaited.add(id(n.value))
+            if isinstance(n, ast.Await):
+                if isinstance(n.value, ast.Call):
+                    awaited.add(id(n.value))
+                elif isinstance(n.value, ast.Name):
+                    # `await task` anchors the task: the awaiter owns it
+                    self.sum["releases"].append(
+                        {"var": n.value.id, "how": "await",
+                         "line": n.lineno}
+                    )
         for n in ast.walk(node):
             if isinstance(n, ast.Call):
                 self._record_call(n, awaited=id(n) in awaited)
@@ -282,6 +379,38 @@ class _FunctionExtractor:
             return
         line = call.lineno
         attr = expr.split(".")[-1]
+        # raw facts for the resources pass: Name arguments (release by
+        # free function, ownership transfer into callees), release-shaped
+        # method calls on locals, and container-add escapes
+        argv = [a.id for a in call.args if isinstance(a, ast.Name)]
+        kwv = {
+            kw.arg: kw.value.id for kw in call.keywords
+            if kw.arg and isinstance(kw.value, ast.Name)
+        }
+        parts = expr.split(".")
+        if len(parts) == 2 and attr in _ALL_RELEASE_ATTRS:
+            rel: dict = {"var": parts[0], "how": attr, "line": line}
+            if self._finally_trys:
+                rel["fin"] = self._finally_trys[-1]
+            self.sum["releases"].append(rel)
+        if expr in FREE_RELEASERS or expr in WAITER_CALLS \
+                or attr in ("as_completed", "wait_futures"):
+            for name in argv:
+                rel = {"var": name, "how": expr, "line": line}
+                if self._finally_trys:
+                    rel["fin"] = self._finally_trys[-1]
+                self.sum["releases"].append(rel)
+        if attr in _CONTAINER_ADDS:
+            self.sum["escapes"].extend(argv)
+            self.sum["escapes"].extend(kwv.values())
+        if expr == "isinstance" and len(call.args) == 2:
+            # isinstance dispatch is typed handling too (the quorum
+            # reducer / retry predicates classify errors this way)
+            t = call.args[1]
+            for el in (t.elts if isinstance(t, ast.Tuple) else [t]):
+                d = _dotted(el)
+                if d:
+                    self.sum["catches"].append(d.split(".")[-1])
         # executor/thread boundaries: the submitted callable runs off the
         # event loop — record the edge with its kind so reachability can
         # stop (executor/thread) or continue (task: runs ON the loop)
@@ -332,10 +461,13 @@ class _FunctionExtractor:
         # an awaited call can only target an awaitable — linking it to a
         # sync def (via the unique-name fallback, say) would be wrong by
         # construction, so the edge carries its own kind
-        self.sum["calls"].append(
-            {"expr": expr, "line": line,
-             "kind": "await" if awaited else "call"}
-        )
+        rec: dict = {"expr": expr, "line": line,
+                     "kind": "await" if awaited else "call"}
+        if argv:
+            rec["argv"] = argv
+        if kwv:
+            rec["kw"] = kwv
+        self.sum["calls"].append(rec)
         for h in self._active_holds:
             h["calls"].append(expr)
 
@@ -372,8 +504,15 @@ class _FunctionExtractor:
             pending_nslock = None
             acq = self._nslock_acquire_in(st)
             if acq is not None:
-                self._acquire("<nslock>", acq)
-                pending_nslock = acq
+                acq_line, acq_var = acq
+                self._acquire("<nslock>", acq_line)
+                self.sum["resources"].append({
+                    "kind": "nslock", "var": acq_var, "line": acq_line,
+                    "expr": "<nslock>", "cm": False,
+                    "loose": bool(self._loop_depth or self._branch_depth
+                                  or self._cleanup_depth),
+                })
+                pending_nslock = acq_line
             self._walk_stmt(st)
 
     def _walk_stmt(self, st: ast.stmt) -> None:
@@ -391,18 +530,132 @@ class _FunctionExtractor:
                 if lock is not None:
                     held.append(self._open_hold(lock, st.lineno))
                 else:
+                    # context-manager acquisitions are balanced by
+                    # construction — table rows, never findings
+                    if isinstance(ce, ast.Call):
+                        ref = _dotted(ce.func) or ""
+                        kind = acquisition_kind(ref)
+                        if kind is None and ref in _FILE_CTORS:
+                            kind = "file"
+                        if kind is None and ref.split(".")[-1] == "span":
+                            kind = "span"
+                        if kind is not None:
+                            var = None
+                            if isinstance(item.optional_vars, ast.Name):
+                                var = item.optional_vars.id
+                            self.sum["resources"].append({
+                                "kind": kind, "var": var,
+                                "line": st.lineno, "expr": ref,
+                                "cm": True, "loose": False,
+                            })
                     self._scan_expr(ce)
             self._walk_block(st.body)
             for h in held:
                 self._close_hold(h)
             return
-        if isinstance(st, ast.Assign) and isinstance(st.value, ast.Call):
-            # light local type inference: v = ClassRef(...)
-            ref = _dotted(st.value.func)
-            if ref and len(st.targets) == 1 and isinstance(st.targets[0], ast.Name):
-                seg = ref.split(".")[-1]
-                if seg[:1].isupper() or seg == "new":
-                    self.sum["locals"][st.targets[0].id] = ref
+        if isinstance(st, ast.If):
+            self._scan_expr(st.test)
+            self._branch_depth += 1
+            self._walk_block(st.body)
+            self._walk_block(st.orelse)
+            self._branch_depth -= 1
+            return
+        if isinstance(st, (ast.For, ast.AsyncFor, ast.While)):
+            for fieldname, value in ast.iter_fields(st):
+                if fieldname in ("body", "orelse"):
+                    continue
+                if isinstance(value, ast.AST):
+                    self._scan_expr(value)
+            self._loop_depth += 1
+            self._walk_block(st.body)
+            self._walk_block(st.orelse)
+            self._loop_depth -= 1
+            return
+        if isinstance(st, ast.Try):
+            self._collect_try(st)
+            self._walk_block(st.body)
+            self._walk_block(st.orelse)
+            # handler/finally bodies run while unwinding: a broad
+            # swallow nested in one is cleanup, and acquisitions there
+            # are conditional
+            self._branch_depth += 1
+            self._cleanup_depth += 1
+            for hdl in st.handlers:
+                self._walk_block(hdl.body)
+            # a finally block runs on every exit path of its try — a
+            # release there (even a conditional `if mtx: mtx.unlock()`)
+            # is the guarded-resource idiom and credits every exit of
+            # THAT try (never an earlier return above it)
+            self._finally_trys.append(st.lineno)
+            self._walk_block(st.finalbody)
+            self._finally_trys.pop()
+            self._cleanup_depth -= 1
+            self._branch_depth -= 1
+            return
+        if isinstance(st, ast.Raise):
+            for value in (st.exc, st.cause):
+                if value is not None:
+                    self._scan_expr(value)
+            if st.exc is not None:
+                t = st.exc.func if isinstance(st.exc, ast.Call) else st.exc
+                d = _dotted(t)
+                if d and not d.startswith("?."):
+                    self.sum["raises"].append(
+                        {"type": d, "line": st.lineno}
+                    )
+            return
+        if isinstance(st, ast.Assign):
+            # lifetime escape: a local stored on self (or into any
+            # container/subscript slot) outlives this call — the owner
+            # releases it, not this function's exits
+            if isinstance(st.value, ast.Name) and any(
+                isinstance(t, (ast.Attribute, ast.Subscript))
+                for t in st.targets
+            ):
+                self.sum["escapes"].append(st.value.id)
+            if isinstance(st.value, ast.Call):
+                ref = _dotted(st.value.func)
+                if ref and len(st.targets) == 1 \
+                        and isinstance(st.targets[0], ast.Name):
+                    # light local type inference: v = ClassRef(...)
+                    seg = ref.split(".")[-1]
+                    if seg[:1].isupper() or seg == "new":
+                        self.sum["locals"][st.targets[0].id] = ref
+                if ref:
+                    kind = acquisition_kind(ref)
+                    if kind is None and ref in _FILE_CTORS:
+                        kind = "file"  # raw handle assigned outside with
+                    var = None
+                    if len(st.targets) == 1:
+                        t = st.targets[0]
+                        if isinstance(t, ast.Name):
+                            var = t.id
+                        elif isinstance(t, (ast.Tuple, ast.List)) \
+                                and t.elts \
+                                and isinstance(t.elts[0], ast.Name):
+                            var = t.elts[0].id  # fd, path = mkstemp()
+                        elif isinstance(t, (ast.Attribute, ast.Subscript)):
+                            var = "<stored>"  # acquired straight into
+                            # an attribute/container slot: escapes
+                    if kind is not None and var is not None:
+                        if var == "<stored>":
+                            self.sum["resources"].append({
+                                "kind": kind, "var": None,
+                                "line": st.lineno, "expr": ref,
+                                "cm": False, "loose": False,
+                                "escaped": True,
+                            })
+                        else:
+                            self.sum["resources"].append({
+                                "kind": kind, "var": var,
+                                "line": st.lineno, "expr": ref,
+                                "cm": False,
+                                "loose": bool(
+                                    self._loop_depth
+                                    or self._branch_depth
+                                    or self._cleanup_depth
+                                ),
+                            })
         # collect calls in this statement's own expressions
         for fieldname, value in ast.iter_fields(st):
             if fieldname in ("body", "orelse", "finalbody", "handlers"):
@@ -420,8 +673,33 @@ class _FunctionExtractor:
         for hdl in getattr(st, "handlers", []) or []:
             self._walk_block(hdl.body)
 
+    def _collect_try(self, st: ast.Try) -> None:
+        """Typed catches + broad-swallow handlers for the error-taint
+        pass. A swallow = a broad handler (bare / Exception /
+        BaseException) containing no raise at all — the error converts
+        into a normal return value. Handlers nested inside an outer
+        except/finally are cleanup during unwinding and exempt."""
+        from .rules_async import _is_broad
+
+        for h in st.handlers:
+            if h.type is not None:
+                for t in (h.type.elts if isinstance(h.type, ast.Tuple)
+                          else [h.type]):
+                    d = _dotted(t)
+                    if d:
+                        self.sum["catches"].append(d.split(".")[-1])
+            if _is_broad(h) and not _handler_raises(h) \
+                    and not _handler_captures(h):
+                self.sum["swallows"].append({
+                    "line": h.lineno,
+                    "cleanup": bool(self._cleanup_depth),
+                })
+
     @staticmethod
-    def _nslock_acquire_in(st: ast.stmt) -> int | None:
+    def _nslock_acquire_in(st: ast.stmt) -> tuple[int, str | None] | None:
+        """(line, bound handle name) of an ns-lock acquisition in this
+        statement, or None. The name feeds the resources pass: releases
+        are `mtx.unlock()`-shaped calls on the same local."""
         roots: list[ast.AST] = []
         if isinstance(st, (ast.Expr, ast.Assign)):
             roots.append(st.value)
@@ -433,11 +711,15 @@ class _FunctionExtractor:
                     continue
                 name = _dotted(n.func) or ""
                 if name == "_lock_dyn":
-                    return n.lineno
+                    var = None
+                    if n.args and isinstance(n.args[0], ast.Name):
+                        var = n.args[0].id
+                    return n.lineno, var
                 if name.endswith(".lock") or name.endswith(".rlock"):
                     base = name.rsplit(".", 1)[0]
                     if base.split(".")[-1] in ("mtx", "lk", "lock", "mutex"):
-                        return n.lineno
+                        var = base if "." not in base else None
+                        return n.lineno, var
         return None
 
     # -- broad try/except collection (cancellation-reachable) -------------
@@ -528,12 +810,32 @@ def _exit_paths(fn: ast.AST) -> list[dict]:
         for st in stmts:
             if isinstance(st, ast.Return):
                 tail = None
+                names: list[str] = []
                 if isinstance(st.value, ast.Call):
                     tail = _dotted(st.value.func)
                 if st.value is not None:
                     s |= calls_in(st.value)
+                    # local names returned as VALUES are transferred to
+                    # the caller — bare (`return mtx`), in a tuple, or
+                    # as a call argument (`return Handle(mutex=mtx)`).
+                    # A name that only RECEIVES a method call
+                    # (`return fh.read()`) is used, not transferred.
+                    recv_only: set[int] = set()
+                    for n in ast.walk(st.value):
+                        if isinstance(n, ast.Attribute):
+                            root = n.value
+                            while isinstance(root, ast.Attribute):
+                                root = root.value
+                            if isinstance(root, ast.Name):
+                                recv_only.add(id(root))
+                    names = sorted({
+                        n.id for n in ast.walk(st.value)
+                        if isinstance(n, ast.Name)
+                        and id(n) not in recv_only
+                    })
                 exits.append({"line": st.lineno, "kind": "return",
-                              "before": sorted(s), "tail": tail})
+                              "before": sorted(s), "tail": tail,
+                              "names": names})
                 return s, False
             if isinstance(st, ast.Raise):
                 return s, False
@@ -617,8 +919,83 @@ def _exit_paths(fn: ast.AST) -> list[dict]:
     if falls:
         end = max(getattr(fn, "end_lineno", fn.lineno) or fn.lineno, fn.lineno)
         exits.append({"line": end, "kind": "fallthrough",
-                      "before": sorted(s), "tail": None})
+                      "before": sorted(s), "tail": None, "names": []})
     return exits
+
+
+def _handler_raises(h: ast.ExceptHandler) -> bool:
+    """Does the handler body contain any raise of its own (bare re-raise
+    or a typed translation)? Either way the error propagates — only a
+    raise-free broad handler converts it into a normal return value."""
+    from .core import iter_nodes_outside_nested_functions
+
+    return any(
+        isinstance(n, ast.Raise)
+        for n in iter_nodes_outside_nested_functions(h.body)
+    )
+
+
+# handler calls that feed the bound exception into a data channel the
+# caller consumes: the quorum errs list, a future, a queue. Logging
+# calls are deliberately NOT here — a logged-and-dropped error is the
+# swallow the pass exists to find.
+_CAPTURE_METHODS = frozenset({"append", "add", "put", "set_exception"})
+
+
+def _handler_captures(h: ast.ExceptHandler) -> bool:
+    """Does the handler propagate the bound exception as a VALUE — store
+    it (`errs[i] = e`), collect it (`errs.append(e)`, the quorum error
+    channel), return it (`return None, e`, the per-drive result pair),
+    or complete a future with it (`fut.set_exception(e)`)? That is
+    typed propagation through a data channel, not a swallow. Merely
+    logging it is not."""
+    from .core import iter_nodes_outside_nested_functions
+
+    if not h.name:
+        return False
+    def is_the_exception(value: ast.AST | None) -> bool:
+        # the exception is the stored/returned VALUE itself: bare, or a
+        # direct element of a tuple/list
+        if isinstance(value, ast.Name):
+            return value.id == h.name
+        if isinstance(value, (ast.Tuple, ast.List)):
+            return any(
+                isinstance(el, ast.Name) and el.id == h.name
+                for el in value.elts
+            )
+        return False
+
+    def mentions_exception(value: ast.AST | None) -> bool:
+        return value is not None and any(
+            isinstance(sub, ast.Name) and sub.id == h.name
+            for sub in ast.walk(value)
+        )
+
+    for n in iter_nodes_outside_nested_functions(h.body):
+        if isinstance(n, ast.Assign):
+            # stored into a field/container slot, the error (even
+            # stringified: `st["error"] = str(e)`) outlives the handler
+            # as observable state; a derived LOCAL (`msg = str(e)`
+            # before a log call) is still a swallow
+            stored = any(
+                isinstance(t, (ast.Subscript, ast.Attribute))
+                for t in n.targets
+            )
+            if is_the_exception(n.value) or (
+                stored and mentions_exception(n.value)
+            ):
+                return True
+        elif isinstance(n, ast.Return):
+            if is_the_exception(n.value):
+                return True
+        elif isinstance(n, ast.Call):
+            fname = _dotted(n.func) or ""
+            if fname.split(".")[-1] in _CAPTURE_METHODS and any(
+                isinstance(a, ast.Name) and a.id == h.name
+                for a in n.args
+            ):
+                return True
+    return False
 
 
 def _loop_breaks(loop: ast.AST) -> bool:
@@ -644,7 +1021,9 @@ _LOCK_CTORS = ("threading.Lock", "threading.RLock", "threading.Condition",
 def extract_summary(tree: ast.AST, relpath: str) -> dict:
     """Reduce one parsed module to its serializable project summary."""
     module = _module_name(relpath)
-    want_exits = relpath.startswith("erasure/")
+    # exits everywhere: the resources pass proves per-exit release
+    # discipline in every subsystem, not just erasure/
+    want_exits = True
     summary: dict = {
         "module": module,
         "relpath": relpath,
@@ -653,7 +1032,33 @@ def extract_summary(tree: ast.AST, relpath: str) -> dict:
         "functions": {},  # qualname -> funcsum
         "locks": {},      # attr-or-name -> canonical lock id
         "globals": {},    # module-level var -> class-ref expr (singletons)
+        "knob_reads": [],        # exact MINIO_* literals in this file
+        "knob_prefix_reads": [], # literal f-string heads / *_ prefixes
     }
+    # MINIO_* literals anywhere in the file are knob reads for the
+    # dead-knob pass (conservative: a mention is a read). The registry
+    # itself is excluded — a declaration must not count as a read
+    # (other analysis files DO read knobs: the sanitizer's own switches).
+    if relpath != "analysis/knobs.py":
+        exact: set[str] = set()
+        prefixes: set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                if _KNOB_LIT_RE.match(node.value) and node.value != "MINIO_":
+                    exact.add(node.value)
+                    if node.value.endswith("_"):
+                        prefixes.add(node.value)
+            elif isinstance(node, ast.JoinedStr) and node.values:
+                head = node.values[0]
+                if (
+                    isinstance(head, ast.Constant)
+                    and isinstance(head.value, str)
+                    and _KNOB_LIT_RE.match(head.value)
+                    and len(node.values) > 1
+                ):
+                    prefixes.add(head.value)
+        summary["knob_reads"] = sorted(exact)
+        summary["knob_prefix_reads"] = sorted(prefixes)
 
     def resolve_import_target(modpath: str, level: int) -> str:
         if level == 0:
@@ -1048,6 +1453,7 @@ class ProjectResult:
     lock_order: list[str] = field(default_factory=list)
     lock_edges: dict[str, list[str]] = field(default_factory=dict)
     guard_table: list[dict] = field(default_factory=list)
+    resource_table: list[dict] = field(default_factory=list)
     stats: dict = field(default_factory=dict)
 
 
@@ -1148,13 +1554,20 @@ def analyze_project(
                 f"unknown rule id(s): {', '.join(sorted(unknown))}"
             )
     py_files: list[tuple[str, str]] = []   # (path, relpath)
+    native_files: list[str] = []
     findings: list[Finding] = []
     for path in iter_python_files(paths):
         if path.endswith(rules_native.NATIVE_EXTS):
+            native_files.append(path)
             if wanted is None or rules_native.RULE_ID in wanted:
                 findings.extend(rules_native.scan_native_file(path))
         else:
             py_files.append((path, _package_relpath(path)))
+    # getenv evidence from native sources for the dead-knob pass (the
+    # native plane reads knobs the Python AST walk can't see)
+    native_knob_reads: set[str] = set()
+    for path in sorted(native_files):
+        native_knob_reads |= rules_native.native_knob_reads(path)
 
     cache: dict = {}
     cache_dirty = False
@@ -1237,6 +1650,15 @@ def analyze_project(
         for rp in sorted(records):
             h.update(rp.encode())
             h.update(str(records[rp].get("sha", "")).encode())
+        # native sources feed the dead-knob pass: an edited .cpp must
+        # bust the cached interproc result too
+        for path in sorted(native_files):
+            try:
+                with open(path, "rb") as fh:
+                    h.update(path.encode())
+                    h.update(_sha1(fh.read()).encode())
+            except OSError:
+                pass
         ip_key = h.hexdigest()
 
     ip_used: dict[str, set[int]] = {}   # pragma lines interproc consumed
@@ -1268,6 +1690,7 @@ def analyze_project(
                 for k, v in ip_stored.get("lock_edges", {}).items()
             },
             guard_table=list(ip_stored.get("guard_table", ())),
+            resource_table=list(ip_stored.get("resource_table", ())),
         )
         for rp, lines in ip_stored.get("used", {}).items():
             used_by_file.setdefault(rp, set()).update(lines)
@@ -1281,6 +1704,7 @@ def analyze_project(
             passes=[p for p in INTERPROC_PASSES
                     if wanted is None or p in wanted],
             suppressed=_suppressed,
+            native_knob_reads=native_knob_reads,
         )
         ip_findings: list[list] = []
         for f in ip.findings:
@@ -1305,6 +1729,7 @@ def analyze_project(
                 "lock_order": ip.lock_order,
                 "lock_edges": ip.lock_edges,
                 "guard_table": ip.guard_table,
+                "resource_table": ip.resource_table,
             }
             cache_dirty = True
 
@@ -1352,6 +1777,7 @@ def analyze_project(
         lock_order=ip.lock_order,
         lock_edges=ip.lock_edges,
         guard_table=ip.guard_table,
+        resource_table=ip.resource_table,
         stats={
             "files": len(py_files),
             "parsed": parsed,

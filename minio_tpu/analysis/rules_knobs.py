@@ -20,6 +20,8 @@ from .knobs import KNOBS, PREFIX_KNOBS
 
 _KNOB_RE = re.compile(r"^MINIO_[A-Z0-9_]*$")
 
+_DECL_RE = re.compile(r'_k\(\s*"(MINIO_[A-Z0-9_]*)"')
+
 # call attrs that read from an env mapping; .get/.pop/.setdefault cover
 # os.environ and its local aliases/copies, startswith covers the
 # iterate-environ-and-match pattern in events/audit
@@ -60,6 +62,63 @@ def _default_literal(call: ast.Call, key_index: int) -> str | None:
         if isinstance(d, ast.Constant) and isinstance(d.value, str):
             return d.value
     return None
+
+
+def _declaration_lines() -> dict[str, int]:
+    """Registry knob name -> its declaration line in knobs.py (where a
+    dead-knob finding anchors, and where its pragma lives)."""
+    import os
+
+    path = os.path.join(os.path.dirname(__file__), "knobs.py")
+    out: dict[str, int] = {}
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            for i, line in enumerate(fh, 1):
+                m = _DECL_RE.search(line)
+                if m and m.group(1) not in out:
+                    out[m.group(1)] = i
+    except OSError:
+        pass
+    return out
+
+
+def dead_knob_findings(index, native_reads, suppressed) -> list[Finding]:
+    """``dead-knob`` interprocedural pass: a knob declared in the
+    registry that no Python or native source reads is dead config — the
+    docs advertise a switch wired to nothing. A read is any ``MINIO_*``
+    string literal in a non-analysis source file (exact name, or a
+    literal prefix ending in ``_`` that the name extends — the
+    f-string/concat family idiom). Only runs when the registry file AND
+    the serving code that reads knobs are both in the analyzed tree —
+    a fixture run must not inherit the registry as findings, and an
+    analysis-subpackage-only run must not flag every knob the unscanned
+    server/erasure sources actually read."""
+    from .knobs import KNOBS, PREFIX_KNOBS
+
+    if "analysis/knobs.py" not in index.summaries \
+            or "server/app.py" not in index.summaries:
+        return []
+    exact: set[str] = set(native_reads)
+    prefixes: set[str] = {n for n in native_reads if n.endswith("_")}
+    for s in index.summaries.values():
+        exact.update(s.get("knob_reads", ()))
+        prefixes.update(s.get("knob_prefix_reads", ()))
+    decl = _declaration_lines()
+    findings: list[Finding] = []
+    for name in sorted(set(KNOBS) | set(PREFIX_KNOBS)):
+        if name in exact or any(name.startswith(p) for p in prefixes):
+            continue
+        line = decl.get(name, 1)
+        if suppressed("analysis/knobs.py", line, "dead-knob"):
+            continue
+        findings.append(Finding(
+            "analysis/knobs.py", line, "dead-knob",
+            f"knob `{name}` is declared in the registry but no Python "
+            "or native source reads it — dead config advertised in "
+            "docs/CONFIG.md; delete the declaration (and regenerate "
+            "the docs) or wire the knob up",
+        ))
+    return findings
 
 
 @rule("knob")

@@ -63,6 +63,22 @@ def scan_native_file(path: str) -> list[Finding]:
         return scan_native_source(fh.read(), path)
 
 
+_KNOB_LIT_RE = re.compile(r'"(MINIO_[A-Z0-9_]*)"')
+
+
+def native_knob_reads(path: str) -> set[str]:
+    """Every quoted MINIO_* literal in a native source — conservative
+    read evidence for the dead-knob pass (a mention is a read)."""
+    try:
+        with open(path, "r", encoding="utf-8", errors="replace") as fh:
+            return {
+                m for m in _KNOB_LIT_RE.findall(fh.read())
+                if m != "MINIO_"
+            }
+    except OSError:
+        return set()
+
+
 def _noop_python_rule(tree, ctx):
     """Registered so --select/--list-rules know the id; the real scan
     runs over native sources in analyze_paths (no AST to walk here)."""

@@ -570,8 +570,13 @@ class RacesEngine:
         )
 
 
-def run(index: ProjectIndex, suppressed) -> tuple[list[Finding], list[dict]]:
-    eng = RacesEngine(index, suppressed)
+def run(index: ProjectIndex, suppressed,
+        engine: "RacesEngine | None" = None
+        ) -> tuple[list[Finding], list[dict]]:
+    """`engine` lets run_passes share ONE engine (and its execution-
+    context fixpoint) with the error-taint pass instead of computing
+    the whole-program context map twice per run."""
+    eng = engine if engine is not None else RacesEngine(index, suppressed)
     return eng.analyze()
 
 

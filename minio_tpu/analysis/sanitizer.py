@@ -42,6 +42,7 @@ import sys
 import threading
 import time
 import traceback
+import weakref
 from collections import deque
 
 _TRUTHY = ("1", "on", "true", "yes")
@@ -638,12 +639,175 @@ def status() -> dict:
         "lockWitnessInstalled": _installed,
         "staticLockRanks": len(_ranks),
         "witnessedAttrs": witnessed_attrs(),
+        "leakClasses": leak_classes(),
         "violations": counts,
         "stallEpisodes": sum(w.stalls for w in _watchdogs),
         "recent": [
             {k: v for k, v in r.items() if k != "stack"} for r in recent
         ],
     }
+
+
+# -- resource leak witness --------------------------------------------------
+#
+# The dynamic half of the static `resources` pass: the ownership table
+# (docs/RESOURCES.md) proves every acquisition releases/transfers on
+# every static exit; the leak witness cross-validates at runtime through
+# the one channel static analysis cannot see — garbage collection.
+# Acquisition wrappers register a weakref finalizer carrying the
+# acquisition stack; release methods mark the token released. A resource
+# collected with its token still live was dropped without release (a
+# leaked ns-lock handle, an unclosed spool) and reports one
+# ``resource.leak`` obs record with kind + acquisition stack.
+# Report-only, like every witness; interpreter shutdown is not a leak
+# (finalizers are detached from atexit).
+
+_LEAK_TRACKED: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+_LEAK_CLASSES: dict = {}  # "module.Class" -> (kind, saved originals)
+
+
+class _LeakToken:
+    __slots__ = ("kind", "stack", "released")
+
+    def __init__(self, kind: str, stack: str):
+        self.kind = kind
+        self.stack = stack
+        self.released = False
+
+
+def leaks_enabled() -> bool:
+    raw = os.environ.get("MINIO_TPU_SANITIZE_LEAKS", "1").lower()
+    return raw in _TRUTHY
+
+
+def _finalize_leak(token: _LeakToken) -> None:
+    if not token.released:
+        _report("resource.leak", kind=token.kind, stack=token.stack)
+
+
+def track_resource(obj, kind: str) -> None:
+    """Register `obj` with the leak witness: if it is garbage-collected
+    before ``mark_released(obj)``, a ``resource.leak`` record carrying
+    the acquisition stack is reported. No-op for objects that cannot be
+    weak-referenced or hashed."""
+    try:
+        if obj in _LEAK_TRACKED:
+            return
+        token = _LeakToken(
+            kind, "".join(traceback.format_stack(limit=12)[:-2])
+        )
+        _LEAK_TRACKED[obj] = token
+        fin = weakref.finalize(obj, _finalize_leak, token)
+        fin.atexit = False  # interpreter shutdown is not a leak
+    except TypeError:
+        pass
+
+
+def mark_released(obj) -> None:
+    try:
+        token = _LEAK_TRACKED.get(obj)
+    except TypeError:
+        return
+    if token is not None:
+        token.released = True
+
+
+def instrument_resource_class(cls, kind: str, release=("close",),
+                              holds: str | None = None) -> bool:
+    """Acquisition wrapper for one resource class: ``__init__`` registers
+    the leak finalizer, each method named in `release` marks the token
+    released. `holds` names an attribute whose falsy value after
+    construction means no resource is actually held (e.g.
+    ``ObjectHandle(mutex=None)`` on metadata-only paths). Idempotent."""
+    if _LEAK_CLASSES.get(f"{cls.__module__}.{cls.__qualname__}"):
+        return True
+    saved: dict = {"__init__": cls.__init__}
+    orig_init = cls.__init__
+
+    def __init__(self, *a, **kw):
+        orig_init(self, *a, **kw)
+        if holds is None or getattr(self, holds, None):
+            track_resource(self, kind)
+
+    __init__.__wrapped__ = orig_init
+    cls.__init__ = __init__
+    for name in release:
+        # resolve through the MRO: an INHERITED release method must be
+        # wrapped onto this class too, or every properly-released
+        # instance would report a false leak (finalizer registered,
+        # token never marked). The saved None sentinel means "delete
+        # from this class on disarm" (the base keeps its original).
+        orig = getattr(cls, name, None)
+        if orig is None:
+            continue
+        saved[name] = cls.__dict__.get(name)
+
+        def _rel(self, *a, _mv_orig=orig, **kw):
+            mark_released(self)
+            return _mv_orig(self, *a, **kw)
+
+        _rel.__wrapped__ = orig
+        setattr(cls, name, _rel)
+    _LEAK_CLASSES[f"{cls.__module__}.{cls.__qualname__}"] = (
+        kind, cls, saved
+    )
+    return True
+
+
+# resource classes the witness arms on a live server, mirroring the
+# static ownership table's kinds: (module, class, kind, release methods,
+# holds-attr). ObjectHandle is THE case the table exists for — a handle
+# collected unreleased stranded a namespace read lock until TTL.
+_LEAK_TABLE = (
+    ("minio_tpu.erasure.set", "ObjectHandle", "nslock-handle",
+     ("close",), "_mutex"),
+    ("minio_tpu.server.sftp", "_WriteHandle", "spool",
+     ("close",), "spool"),
+    ("minio_tpu.native", "DataplanePut", "native-put",
+     ("finish", "abort"), None),
+)
+
+
+def arm_leak_witness() -> int:
+    """Instrument every already-imported class in the leak table. Call
+    after the serving modules are imported (server startup, test setup);
+    classes imported later can be armed by calling again. Returns how
+    many classes are actively witnessed."""
+    if not leaks_enabled():
+        return 0
+    armed = 0
+    for mod_name, cls_name, kind, release, holds in _LEAK_TABLE:
+        mod = sys.modules.get(mod_name)
+        if mod is None:
+            continue
+        cls = getattr(mod, cls_name, None)
+        if not isinstance(cls, type):
+            continue
+        try:
+            if instrument_resource_class(cls, kind, release, holds):
+                armed += 1
+        except Exception:
+            continue  # witness must never break imports/serving
+    return armed
+
+
+def disarm_leak_witness() -> None:
+    """Restore every instrumented class (tests)."""
+    for key, (kind, cls, saved) in list(_LEAK_CLASSES.items()):
+        for name, orig in saved.items():
+            if orig is None:
+                # wrapper shadowed an inherited method: remove it
+                try:
+                    delattr(cls, name)
+                except AttributeError:
+                    pass
+            else:
+                setattr(cls, name, orig)
+        _LEAK_CLASSES.pop(key, None)
+
+
+def leak_classes() -> list[str]:
+    return sorted(_LEAK_CLASSES)
 
 
 # -- event-loop stall watchdog ---------------------------------------------

@@ -42,6 +42,7 @@ from collections import OrderedDict
 from concurrent.futures import Future
 
 from .. import obs
+from ..storage.errors import StorageError
 
 __all__ = [
     "SetCache",
@@ -382,8 +383,8 @@ class SetCache:
         for disk in es.disks:
             try:
                 m = disk.read_version(bucket, obj, vid, read_data=False)
-            except Exception:  # noqa: BLE001 — unreachable: try the next
-                continue
+            except (StorageError, OSError):
+                continue  # drive unreachable: try the next voucher
             if (m.mod_time, m.data_dir) != stamp or m.deleted:
                 return False  # authoritative: identity moved on
             seen += 1
@@ -892,6 +893,9 @@ def _transformed(fi) -> bool:
         from ..server import transforms
 
         return transforms.is_transformed(fi.metadata)
+    # miniovet: ignore[error-taint] -- fail-SAFE default: any failure
+    # (import cycle, malformed metadata) steers OFF the segment fast
+    # path onto the full erasure read, which serves correctly regardless
     except Exception:  # noqa: BLE001 — can't tell: stay off the fast path
         return True
 

@@ -179,6 +179,9 @@ def _prefetch(es_ref, bucket: str, obj: str, vid: str, offset: int,
                     h.close()
         with _mu:
             _stats["completed"] += 1
+    # miniovet: ignore[error-taint] -- prefetch is speculative background
+    # work on the QoS bg lane: a failed read-ahead must never surface to
+    # any request; failures are counted into the prefetch error series
     except Exception:  # noqa: BLE001 — read-ahead is best-effort
         with _mu:
             _stats["errors"] += 1

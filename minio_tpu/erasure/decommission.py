@@ -15,6 +15,9 @@ import threading
 import time
 from dataclasses import dataclass
 
+from ..storage.errors import StorageError
+from .quorum import ErasureError
+
 SYSTEM_BUCKET = ".minio.sys"
 
 
@@ -65,7 +68,14 @@ class PoolManager:
         try:
             _, it = self.pools.get_object(SYSTEM_BUCKET, self._ckpt_key(idx))
             return DecomStatus(**json.loads(b"".join(it)))
-        except (ObjectNotFound, Exception):  # noqa: BLE001
+        except ObjectNotFound:
+            return None  # no checkpoint yet: fresh start
+        except (ValueError, TypeError, KeyError):
+            # corrupt checkpoint doc: restarting the copy sweep is safe
+            # (copies are idempotent). Quorum/storage errors PROPAGATE —
+            # the old broad except silently discarded real progress and
+            # restarted the whole decommission whenever the system
+            # bucket was briefly unreadable.
             return None
 
     # -- decommission ------------------------------------------------------
@@ -163,8 +173,8 @@ class PoolManager:
                     di = d.disk_info()
                     total += di.total
                     free += di.free
-                except Exception:  # noqa: BLE001
-                    pass
+                except (StorageError, OSError):
+                    pass  # offline drive: skip its capacity, keep the rest
             out.append(
                 {"pool": i, "total": total, "free": free,
                  "usedPct": 0.0 if not total else round(100 * (1 - free / total), 2)}
@@ -253,6 +263,6 @@ class PoolManager:
                     )
                     src.delete_object(b.name, raw)
                     moved += 1
-                except Exception:  # noqa: BLE001
-                    pass
+                except (ErasureError, StorageError, OSError):
+                    pass  # this object stays put; the next pass retries
         return {"moved": moved, "from": src_i, "to": dst_i}

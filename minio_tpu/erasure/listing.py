@@ -19,8 +19,9 @@ import time
 import weakref
 from typing import Iterator
 
-from .quorum import ObjectNotFound, QuorumError, VersionNotFound
+from .quorum import ErasureError, ObjectNotFound, QuorumError, VersionNotFound
 from .types import ListObjectsResult, ObjectInfo
+from ..storage.errors import StorageError
 
 SYSTEM_BUCKET = ".minio.sys"
 
@@ -32,11 +33,14 @@ from ..storage.pathutil import (  # noqa: F401 — re-exported API
 
 
 def _safe_walk(disk, bucket: str, base: str) -> Iterator[str]:
-    """walk_dir with drive faults swallowed — the walk is a generator, so
-    errors must be caught inside it, not at construction time."""
+    """walk_dir with DRIVE faults swallowed — the walk is a generator, so
+    errors must be caught inside it, not at construction time. Only
+    storage/transport errors are dead-drive evidence; anything else
+    (a code bug in the walk) must propagate, not silently serve an
+    empty listing."""
     try:
         yield from disk.walk_dir(bucket, base)
-    except Exception:  # noqa: BLE001 — dead drives don't break listing
+    except (StorageError, OSError):
         return
 
 
@@ -218,8 +222,11 @@ def _metacache_keys(es, bucket: str, prefix: str) -> list[str] | None:
         # expired persisted cache: reclaim the space opportunistically
         try:
             es.delete_object(SYSTEM_BUCKET, obj_key)
-        except Exception:  # noqa: BLE001
-            pass
+        except (ErasureError, StorageError, OSError):
+            pass  # reclaim is best-effort; the TTL already expired it
+    # miniovet: ignore[error-taint] -- any failure here (absent object,
+    # corrupt doc, quorum loss) is recoverable by design: the walk below
+    # rebuilds the listing from the drives, which is the source of truth
     except Exception:  # noqa: BLE001 — absent/corrupt: rebuild
         pass
     keys: list[str] | None = []
@@ -237,8 +244,8 @@ def _metacache_keys(es, bucket: str, prefix: str) -> list[str] | None:
                 SYSTEM_BUCKET, obj_key,
                 json.dumps({"created": now, "keys": keys}).encode(),
             )
-        except Exception:  # noqa: BLE001 — persistence is an optimization
-            pass
+        except (ErasureError, StorageError, OSError):
+            pass  # persistence is an optimization; memory cache serves
     return keys
 
 

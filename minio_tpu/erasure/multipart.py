@@ -17,7 +17,12 @@ from dataclasses import dataclass
 from ..storage import errors
 from ..storage.datatypes import FileInfo, ObjectPartInfo, now_ns
 from ..utils.hashing import hash_order
-from .quorum import ObjectNotFound, QuorumError, reduce_quorum_errs
+from .quorum import (
+    ObjectNotFound,
+    QuorumError,
+    VersionNotFound,
+    reduce_quorum_errs,
+)
 from .set import ErasureSet, _lock_dyn
 from .types import ObjectInfo
 
@@ -332,8 +337,12 @@ class MultipartManager:
                     cur = None if cfi.deleted else self.es._to_object_info(
                         bucket, obj, cfi
                     )
-                except Exception:  # noqa: BLE001 — absent object
-                    cur = None
+                except (ObjectNotFound, VersionNotFound,
+                        errors.FileNotFound, errors.FileVersionNotFound):
+                    cur = None  # genuinely absent: precondition sees None
+                    # (quorum/storage failures PROPAGATE — a conditional
+                    # complete must not treat an unreadable object as
+                    # absent and overwrite it)
                 check_precond(cur)
             except BaseException:
                 mtx.unlock()
@@ -436,7 +445,9 @@ class MultipartRouter:
             # serving the stale copy from the earlier pool
             try:
                 pool_idx = pools.index(self.store._pool_holding(bucket, obj))
-            except Exception:  # noqa: BLE001 — new object: place by space
+            except (ObjectNotFound, ValueError):
+                # new object (or holder not in this router's pool list):
+                # place by free space
                 pool_idx = pools.index(self.store._pool_with_most_free())
         raw = self._mgr(obj, pool_idx).new_upload(bucket, obj, user_defined, parity)
         return f"{pool_idx}{POOL_SEP}{raw}"

@@ -11,8 +11,9 @@ from __future__ import annotations
 from typing import Iterator
 
 from ..storage.datatypes import FileInfo
+from ..storage.errors import StorageError
 from ..storage.interface import StorageAPI
-from .quorum import ObjectNotFound, VersionNotFound
+from .quorum import ErasureError, ObjectNotFound, VersionNotFound
 from .sets import ErasureSets
 from .types import BucketInfo, ObjectInfo
 
@@ -47,8 +48,8 @@ class ServerPools:
             for d in p.disks:
                 try:
                     free += d.disk_info().free
-                except Exception:  # noqa: BLE001
-                    pass
+                except (StorageError, OSError):
+                    pass  # offline drive contributes no free space
             if free > best_free:
                 best, best_free = p, free
         return best
@@ -133,8 +134,8 @@ class ServerPools:
         for p in self.pools:
             try:
                 out.extend(p.list_object_versions(bucket, obj))
-            except Exception:  # noqa: BLE001
-                pass
+            except (ErasureError, StorageError, OSError):
+                pass  # pool doesn't hold the object (or is offline)
         out.sort(key=lambda o: o.mod_time, reverse=True)
         return out
 

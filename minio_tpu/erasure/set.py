@@ -24,6 +24,7 @@ from .. import obs
 from ..fault import registry as fault_registry
 from ..ops.bitrot import DEFAULT_BITROT_ALGO, fast_hash256
 from ..storage import errors
+from ..storage.errors import StorageError
 from ..storage.datatypes import (
     ChecksumInfo,
     ErasureInfo,
@@ -481,6 +482,13 @@ class ErasureSet:
                 except Exception:  # noqa: BLE001 — best-effort cleanup
                     pass
             raise
+        # quorum passed, but a minority drive may have staged its shard
+        # and then failed before rename_data swept the staging dir — the
+        # staged bytes must not outlive the PUT (the streaming path
+        # sweeps the same way after its commit)
+        self._sweep_staging(
+            tmp_id, (d for d, e in zip(self.disks, errs) if e is not None)
+        )
         return self._to_object_info(bucket, obj, fi)
 
     def _put_object_streaming(
@@ -619,11 +627,7 @@ class ErasureSet:
                 except Exception:  # noqa: BLE001 — best-effort cleanup
                     pass
             raise
-        for disk in self.disks:
-            try:
-                disk.delete(TMP_VOLUME, tmp_id, recursive=True)
-            except Exception:  # noqa: BLE001
-                pass
+        self._sweep_staging(tmp_id, self.disks)
         return self._to_object_info(bucket, obj, fi)
 
     def _stream_native(
@@ -674,6 +678,18 @@ class ErasureSet:
         if sum(e is None for e in errs) < write_q:
             raise QuorumError("write quorum lost")
         return etag, size
+
+    def _sweep_staging(self, tmp_id: str, disks) -> None:
+        """Best-effort removal of a staging dir on drives whose
+        rename_data never ran or failed (rename sweeps its own dir):
+        staged shard bytes must not outlive the operation that wrote
+        them — a partially-failed drive would otherwise keep a full
+        shard copy under .minio.sys/tmp until manual cleanup."""
+        for disk in disks:
+            try:
+                disk.delete(TMP_VOLUME, tmp_id, recursive=True)
+            except (StorageError, OSError):
+                pass  # already gone / drive offline: nothing to sweep
 
     # -- get ---------------------------------------------------------------
 
@@ -835,6 +851,9 @@ class ErasureSet:
                 degraded_reported = True
                 try:
                     self.on_degraded(bucket, obj)
+                # miniovet: ignore[error-taint] -- observer callback
+                # isolation: a failing heal-enqueue hook must never fail
+                # the GET it was observing
                 except Exception:  # noqa: BLE001
                     pass
 
@@ -1329,8 +1348,8 @@ class ErasureSet:
                 for disk in self.disks:
                     try:
                         disk.delete(bucket, f"{obj}/{old_data_dir}", recursive=True)
-                    except Exception:  # noqa: BLE001 — already absent
-                        pass
+                    except (StorageError, OSError):
+                        pass  # already absent / drive offline
         finally:
             mtx.unlock()
         self.cache.invalidate_object(bucket, obj)
@@ -1380,6 +1399,13 @@ class ErasureSet:
                     errs.append(None)
                 except Exception as e:  # noqa: BLE001
                     errs.append(e)
+            # drives that staged but never finished rename_data keep a
+            # full restored shard under .minio.sys/tmp — sweep them
+            # whether or not quorum held (on failure every drive may)
+            self._sweep_staging(
+                tmp_id,
+                (d for d, e in zip(self.disks, errs) if e is not None),
+            )
             reduce_quorum_errs(errs, write_q)
         finally:
             mtx.unlock()
@@ -1472,8 +1498,8 @@ class ErasureSet:
                     try:
                         disk.write_metadata(bucket, obj, fi)
                         healed.append(disk.endpoint)
-                    except Exception:  # noqa: BLE001
-                        pass
+                    except (StorageError, OSError):
+                        pass  # heal is per-drive best-effort
             return {"healed": healed, "type": "delete-marker"}
 
         d, p = fi.erasure.data_blocks, fi.erasure.parity_blocks
@@ -1489,8 +1515,8 @@ class ErasureSet:
                 else:
                     disk.verify_file(bucket, obj, m)
                 good[idx] = (disk, m)
-            except Exception:  # noqa: BLE001
-                pass
+            except (StorageError, OSError, ValueError):
+                pass  # corrupt/unreadable shard: heal rebuilds it below
         if len(good) < d:
             raise QuorumError(f"not enough healthy shards to heal: {len(good)}/{d}")
 
@@ -1644,8 +1670,10 @@ class ErasureSet:
                         )
                     disk.rename_data(TMP_VOLUME, tmp_id, dfi, bucket, obj)
                 healed.append(disk.endpoint)
-            except Exception:  # noqa: BLE001
-                pass
+            except (StorageError, OSError):
+                # heal is per-drive best-effort, but staged parts on the
+                # failed drive must not outlive the attempt
+                self._sweep_staging(tmp_id, [disk])
         return {"healed": healed, "type": "object"}
 
     def _verify_inline(self, m: FileInfo, coder: ErasureCoder) -> None:

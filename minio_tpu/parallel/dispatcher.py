@@ -340,6 +340,9 @@ class TpuDispatcher:
                 fp.unpack_chunk_major(np.asarray(parity_cm)),
                 np.asarray(digests),
             )
+        # miniovet: ignore[error-taint] -- this IS the degradation ladder:
+        # a fused-rung failure falls to the XLA rung (byte-identical
+        # results), is counted in fused_failures, and backs off
         except Exception:  # noqa: BLE001 — lowering/device failure: XLA path
             # back off exponentially and re-probe: one transient device
             # hiccup must not degrade the server until restart
@@ -398,6 +401,8 @@ class TpuDispatcher:
             np.asarray(parity)
             np.asarray(digests)
             return True
+        # miniovet: ignore[error-taint] -- ladder probe: False means "stay
+        # demoted"; the synthetic batch exists to absorb this failure
         except Exception:  # noqa: BLE001 — device still gone
             return False
 
@@ -521,6 +526,10 @@ class TpuDispatcher:
                                 self.stats["backend_level"] = LEVEL_XLA
                             else:
                                 self.stats["backend_level"] = LEVEL_FUSED
+                    # miniovet: ignore[error-taint] -- error-as-value into
+                    # the ladder: _device_fault(e) records the fault,
+                    # demotes past the streak threshold, and the batch is
+                    # re-served byte-identically on the numpy rung below
                     except Exception as e:  # noqa: BLE001 — serve degraded
                         # the device rung failed mid-batch: waiters get
                         # numpy results instead of errors, the ladder

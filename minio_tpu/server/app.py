@@ -1303,6 +1303,15 @@ def main(argv: list[str] | None = None) -> None:
                     f"sanitizer: access witness armed on {armed} "
                     "attributes", flush=True,
                 )
+            # leak witness: resource classes from the static ownership
+            # table (docs/RESOURCES.md) get weakref finalizers — a
+            # handle collected unreleased reports `resource.leak`
+            leak_armed = sanitizer.arm_leak_witness()
+            if leak_armed:
+                print(
+                    f"sanitizer: leak witness armed on {leak_armed} "
+                    "resource classes", flush=True,
+                )
 
     async def on_stop(app):
         wd = app.get("sanitize_watchdog")

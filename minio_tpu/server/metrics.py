@@ -991,7 +991,7 @@ def _g_api_sanitizer(server) -> list[str]:
     _fmt(out, "minio_sanitizer_violations_total", "counter",
          [({"kind": k}, v) for k, v in sorted(st["violations"].items())],
          "Sanitizer violations by kind (lock.order, attr.race, "
-         "loop.stall, env.leak)")
+         "loop.stall, env.leak, resource.leak)")
     _fmt(out, "minio_sanitizer_witnessed_attributes", "gauge",
          [({}, len(st["witnessedAttrs"]))],
          "Cross-context attributes under the runtime access witness")

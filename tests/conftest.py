@@ -18,6 +18,20 @@ import pytest
 
 TPU_LANE = os.environ.get("MINIO_TPU_TEST_TPU") == "1"
 
+# The optional `cryptography` dependency gates SSE / admin-wire
+# encryption (minio_tpu/crypto/sse.py raises a typed error at use when
+# it is absent, as in this container). Test modules import this marker
+# for the affected tests so they SKIP visibly instead of failing red —
+# one definition, so the reason string cannot drift per file.
+import importlib.util  # noqa: E402
+
+HAS_CRYPTO = importlib.util.find_spec("cryptography") is not None
+requires_crypto = pytest.mark.skipif(
+    not HAS_CRYPTO,
+    reason="needs the optional 'cryptography' package (SSE / admin-wire "
+    "encryption)",
+)
+
 # Runtime sanitizer (analysis/sanitizer.py): on by default under pytest;
 # MINIO_TPU_SANITIZE=0 opts out. Installed before any minio_tpu module
 # creates locks so instance locks get the lock-order witness.

@@ -61,6 +61,40 @@ def test_concurrency_doc_in_sync(project_result):
     )
 
 
+def test_resources_doc_in_sync(project_result):
+    from minio_tpu.analysis.rules_resources import generate_resources_md
+
+    path = os.path.join(REPO_ROOT, "docs", "RESOURCES.md")
+    with open(path, "r", encoding="utf-8") as fh:
+        on_disk = fh.read()
+    expected = generate_resources_md(project_result.resource_table)
+    assert on_disk == expected, (
+        "docs/RESOURCES.md is stale; regenerate with "
+        "`python -m minio_tpu.analysis --gen-resources` (make docs)"
+    )
+
+
+def test_resource_table_covers_known_ownership(project_result):
+    # the facts the runtime leak witness relies on: open_object's
+    # ns-lock handle transfers into ObjectHandle (which close()
+    # releases), and every erasure mutation path releases its own lock
+    rows = {
+        (r["function"], r["kind"]): r
+        for r in project_result.resource_table
+    }
+    assert rows[("ErasureSet.open_object", "nslock")]["ownership"] \
+        == "transferred"
+    assert rows[("ErasureSet.put_object", "nslock")]["ownership"] \
+        == "released"
+    assert rows[("ErasureSet.delete_object", "nslock")]["ownership"] \
+        == "released"
+    # obs spans are context-manager balanced by construction
+    assert any(
+        r["kind"] == "span" and r["ownership"] == "balanced"
+        for r in project_result.resource_table
+    )
+
+
 def test_concurrency_table_covers_known_cross_context_state(project_result):
     # the facts the runtime access witness relies on: the grid client's
     # mux tables are cross-thread and guarded by the client lock

@@ -1,0 +1,897 @@
+"""`resources` + `error-taint` + `dead-knob` interprocedural passes
+(analysis/rules_resources.py, rules_errors.py), the generated ownership
+table, SARIF region/helpUri fidelity, the runtime resource-leak witness
+(analysis/sanitizer.py) — plus regressions for the real propagation bugs
+the triage sweep fixed in the tree."""
+
+import gc
+import os
+import threading
+
+import pytest
+
+from minio_tpu.analysis.project import analyze_project
+from minio_tpu.analysis.rules_resources import generate_resources_md
+from minio_tpu.analysis import sanitizer
+from minio_tpu import obs
+
+import minio_tpu
+
+PKG_DIR = os.path.dirname(minio_tpu.__file__)
+
+
+def _write_tree(base, files):
+    for rel, src in files.items():
+        p = base / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+    return str(base)
+
+
+def _rule(res, rule_id):
+    return [f for f in res.findings if f.rule == rule_id]
+
+
+# -- resources: seeded leak / release / transfer / escape fixtures ----------
+
+_NSLOCK_LEAK = """
+class Set:
+    def mutate(self, bucket, obj):
+        mtx = self.ns.new(bucket, obj)
+        if not _lock_dyn(mtx, write=True):
+            raise TimeoutError("lock")
+        meta = self.read_meta()
+        if meta is None:
+            return None  # <-- leaks the namespace lock
+        try:
+            return self.commit(meta)
+        finally:
+            mtx.unlock()
+"""
+
+
+def test_seeded_nslock_leak_is_found(tmp_path):
+    root = _write_tree(tmp_path, {"set1.py": _NSLOCK_LEAK})
+    hits = _rule(analyze_project([root]), "resources")
+    assert len(hits) == 1
+    assert "nslock `mtx`" in hits[0].message
+    assert "without being released" in hits[0].message
+
+
+_NSLOCK_RELEASED = """
+class Set:
+    def mutate(self, bucket, obj):
+        mtx = self.ns.new(bucket, obj)
+        if not _lock_dyn(mtx, write=True):
+            raise TimeoutError("lock")
+        try:
+            return self.commit()
+        finally:
+            mtx.unlock()
+"""
+
+
+def test_nslock_released_in_finally_is_clean(tmp_path):
+    root = _write_tree(tmp_path, {"set2.py": _NSLOCK_RELEASED})
+    res = analyze_project([root])
+    assert _rule(res, "resources") == []
+    rows = {r["function"]: r for r in res.resource_table}
+    assert rows["Set.mutate"]["ownership"] == "released"
+
+
+_NSLOCK_CONDITIONAL_FINALLY = """
+class KMS:
+    def create(self):
+        mtx = self.ns_mutex()
+        if mtx is not None and not mtx.lock(timeout=30.0):
+            raise TimeoutError("lock")
+        try:
+            return self.write_ring()
+        finally:
+            if mtx is not None:
+                mtx.unlock()
+"""
+
+
+def test_conditional_release_in_finally_credits_exits(tmp_path):
+    # `if mtx is not None: mtx.unlock()` in a finally is the
+    # guarded-resource idiom: not a definite call, but the finally runs
+    # on every exit — the KMS false-positive shape
+    root = _write_tree(tmp_path, {"kms.py": _NSLOCK_CONDITIONAL_FINALLY})
+    assert _rule(analyze_project([root]), "resources") == []
+
+
+_NSLOCK_TRANSFER = """
+class Handle:
+    def __init__(self, meta, mutex=None):
+        self._mutex = mutex
+
+    def close(self):
+        mtx, self._mutex = self._mutex, None
+        if mtx is not None:
+            mtx.runlock()
+
+class Set:
+    def open(self, bucket, obj):
+        mtx = self.ns.new(bucket, obj)
+        if not _lock_dyn(mtx, write=False):
+            raise TimeoutError("lock")
+        try:
+            meta = self.read_meta()
+            return Handle(meta, mutex=mtx)
+        except BaseException:
+            mtx.runlock()
+            raise
+"""
+
+
+def test_nslock_transfer_into_owning_handle(tmp_path):
+    # the open_object shape: the handle's __init__ stores the lock, so
+    # returning Handle(..., mutex=mtx) transfers ownership
+    root = _write_tree(tmp_path, {"set3.py": _NSLOCK_TRANSFER})
+    res = analyze_project([root])
+    assert _rule(res, "resources") == []
+    rows = {r["function"]: r for r in res.resource_table}
+    assert rows["Set.open"]["ownership"] == "transferred"
+
+
+_SPOOL = """
+import os
+import tempfile
+
+def leaky(data):
+    fd, path = tempfile.mkstemp()
+    n = os.write(fd, data)
+    return n  # <-- fd and file both leak
+
+def balanced(data):
+    fd, path = tempfile.mkstemp()
+    try:
+        return os.write(fd, data)
+    finally:
+        os.close(fd)
+        os.unlink(path)
+"""
+
+
+def test_spool_leak_found_and_balanced_clean(tmp_path):
+    root = _write_tree(tmp_path, {"sp.py": _SPOOL})
+    hits = _rule(analyze_project([root]), "resources")
+    assert len(hits) == 1
+    assert "spool `fd`" in hits[0].message
+    assert hits[0].line == 6  # the mkstemp line in the fixture
+
+
+_FUTURES = """
+def lost(pool, fn):
+    fut = pool.submit(fn)
+    return True  # <-- the future's exception is silently lost
+
+def waited(pool, fn):
+    fut = pool.submit(fn)
+    return fut.result()
+
+def anchored(pool, fn, futs):
+    fut = pool.submit(fn)
+    futs.append(fut)
+"""
+
+
+def test_future_lost_vs_waited_vs_anchored(tmp_path):
+    root = _write_tree(tmp_path, {"fut.py": _FUTURES})
+    res = analyze_project([root])
+    hits = _rule(res, "resources")
+    assert len(hits) == 1
+    assert "future `fut`" in hits[0].message and hits[0].line == 3
+    rows = {
+        (r["function"], r["line"]): r["ownership"]
+        for r in res.resource_table
+    }
+    # `return fut.result()` consumes the future (a receiver-only name
+    # is a use, not a transfer)
+    assert rows[("waited", 7)] == "released"
+    assert rows[("anchored", 11)] == "escapes"
+
+
+_TASKS = """
+import asyncio
+
+class Svc:
+    async def spawn_kept(self):
+        self.t = asyncio.create_task(self.run())
+
+    async def spawn_awaited(self):
+        t = asyncio.create_task(self.run())
+        await t
+
+    async def spawn_lost(self):
+        t = asyncio.create_task(self.run())
+        return None  # <-- task may be GC'd mid-flight
+"""
+
+
+def test_task_anchoring(tmp_path):
+    root = _write_tree(tmp_path, {"tk.py": _TASKS})
+    res = analyze_project([root])
+    hits = _rule(res, "resources")
+    assert len(hits) == 1
+    assert "task `t`" in hits[0].message
+    assert "spawn_lost" in hits[0].message
+
+
+_CM_AND_LOOP = """
+import tempfile
+
+def balanced_cm():
+    with tempfile.NamedTemporaryFile() as fh:
+        return fh.read()
+
+def loop_release(pool, jobs):
+    for j in jobs:
+        fut = pool.submit(j)
+        fut.result()
+"""
+
+
+def test_context_manager_balanced_and_loop_release(tmp_path):
+    root = _write_tree(tmp_path, {"cm.py": _CM_AND_LOOP})
+    res = analyze_project([root])
+    assert _rule(res, "resources") == []
+    rows = {r["function"]: r for r in res.resource_table}
+    assert rows["balanced_cm"]["ownership"] == "balanced"
+    assert rows["loop_release"]["ownership"] == "released"
+
+
+def test_resources_pragma_suppresses_and_is_consumed(tmp_path):
+    # the finding anchors on the _lock_dyn acquisition line: an inline
+    # pragma there suppresses it
+    src = _NSLOCK_LEAK.replace(
+        "if not _lock_dyn(mtx, write=True):",
+        "if not _lock_dyn(mtx, write=True):"
+        "  # miniovet: ignore[resources] -- fixture: deliberate",
+    )
+    root = _write_tree(tmp_path, {"sup.py": src})
+    res = analyze_project([root])
+    assert _rule(res, "resources") == []
+    # consumed: no unused-pragma finding either
+    assert _rule(res, "pragma") == []
+
+
+def test_generate_resources_md_shape():
+    table = [
+        {"kind": "nslock", "file": "erasure/set.py", "line": 7,
+         "function": "Set.mutate", "expr": "<nslock>",
+         "ownership": "released"},
+        {"kind": "span", "file": "obs/trace.py", "line": 3,
+         "function": "f", "expr": "obs.span", "ownership": "balanced"},
+    ]
+    md = generate_resources_md(table)
+    assert "| nslock | `Set.mutate` | erasure/set.py:7" in md
+    assert "| span | 1 |" in md  # balanced acquisitions aggregate
+    assert "do not edit by" in md
+
+
+_FILE_HANDLE = """
+def leaky(p):
+    fh = open(p)
+    return fh.read()  # the handle itself is dropped unclosed
+
+def closed(p):
+    fh = open(p)
+    try:
+        return fh.read()
+    finally:
+        fh.close()
+"""
+
+
+def test_raw_file_handle_outside_with(tmp_path):
+    root = _write_tree(tmp_path, {"fh.py": _FILE_HANDLE})
+    res = analyze_project([root])
+    hits = _rule(res, "resources")
+    assert len(hits) == 1
+    assert "file `fh`" in hits[0].message and "leaky" in hits[0].message
+    rows = {r["function"]: r for r in res.resource_table}
+    assert rows["closed"]["ownership"] == "released"
+
+
+# -- error-taint: swallows --------------------------------------------------
+
+_SWALLOW = """
+class Set:
+    def read_meta(self, bucket, obj):
+        try:
+            return self.fan_out(bucket, obj)
+        except Exception:
+            return None  # <-- storage error becomes a normal miss
+
+async def handler(s):
+    return s.read_meta("b", "o")
+"""
+
+
+def test_seeded_swallow_on_serving_path(tmp_path):
+    root = _write_tree(tmp_path, {"minio_tpu/erasure/fake.py": _SWALLOW})
+    hits = _rule(analyze_project([root]), "error-taint")
+    assert len(hits) == 1
+    assert "broad except" in hits[0].message
+    assert "Set.read_meta" in hits[0].message
+
+
+def test_swallow_outside_storage_dirs_not_flagged(tmp_path):
+    root = _write_tree(tmp_path, {"minio_tpu/events/fake.py": _SWALLOW})
+    assert _rule(analyze_project([root]), "error-taint") == []
+
+
+_DAEMON_ONLY = """
+import threading
+
+class Scanner:
+    def start(self):
+        threading.Thread(target=self._sweep, name="scanner").start()
+
+    def _sweep(self):
+        try:
+            self.walk()
+        except Exception:
+            return None
+"""
+
+
+def test_daemon_confined_swallow_exempt(tmp_path):
+    root = _write_tree(
+        tmp_path, {"minio_tpu/erasure/fakescan.py": _DAEMON_ONLY}
+    )
+    assert _rule(analyze_project([root]), "error-taint") == []
+
+
+_UNREACHED = """
+class Set:
+    def read_meta(self, bucket, obj):
+        try:
+            return self.fan_out(bucket, obj)
+        except Exception:
+            return None
+"""
+
+
+def test_unreached_function_defaults_to_serving(tmp_path):
+    # no caller at all: the context fixpoint never reaches read_meta —
+    # an UNPROVEN caller is not an exemption (only proven daemon
+    # confinement is), so the swallow is still a finding
+    root = _write_tree(
+        tmp_path, {"minio_tpu/erasure/fakeorphan.py": _UNREACHED}
+    )
+    hits = _rule(analyze_project([root]), "error-taint")
+    assert len(hits) == 1
+
+
+_APPEND_CHANNEL = """
+class Set:
+    def collect(self, errs):
+        try:
+            return self.fan_out()
+        except Exception as e:
+            errs.append(e)  # quorum collector shape
+
+    def pair(self, disk, fn):
+        try:
+            return fn(disk), None
+        except Exception as e:
+            return None, e  # per-drive result pair shape
+
+async def handler(s, errs, d, f):
+    s.collect(errs)
+    s.pair(d, f)
+"""
+
+
+def test_append_and_return_channels_are_exempt(tmp_path):
+    root = _write_tree(
+        tmp_path, {"minio_tpu/erasure/fakechan.py": _APPEND_CHANNEL}
+    )
+    assert _rule(analyze_project([root]), "error-taint") == []
+
+
+_LOGGED_AND_DROPPED = """
+class Set:
+    def read_meta(self, bucket, obj):
+        try:
+            return self.fan_out(bucket, obj)
+        except Exception as e:
+            msg = str(e)
+            self.log_warning(msg)
+            return None  # logged-and-dropped: STILL a swallow
+
+    def recorded(self, bucket, obj):
+        try:
+            return self.fan_out(bucket, obj)
+        except Exception as e:
+            self.state["error"] = str(e)  # stored as observable state
+            return None
+
+async def handler(s):
+    s.read_meta("b", "o")
+    s.recorded("b", "o")
+"""
+
+
+def test_logged_and_dropped_is_still_a_swallow(tmp_path):
+    # deriving a LOCAL from the exception (`msg = str(e)`) before a log
+    # call does not count as propagation; storing the error into a
+    # field/container (`self.state["error"] = str(e)`) does
+    root = _write_tree(
+        tmp_path, {"minio_tpu/erasure/fakelog.py": _LOGGED_AND_DROPPED}
+    )
+    hits = _rule(analyze_project([root]), "error-taint")
+    assert len(hits) == 1
+    assert "read_meta" in hits[0].message
+
+
+_PROPAGATING = """
+class Set:
+    def translate(self, bucket, obj):
+        try:
+            return self.fan_out(bucket, obj)
+        except Exception:
+            raise RuntimeError("typed translation")  # propagates
+
+    def channel(self, fut):
+        try:
+            return self.fan_out()
+        except Exception as e:
+            fut.set_exception(e)  # error-as-value channel
+
+    def collect(self, errs, i):
+        try:
+            return self.fan_out()
+        except Exception as e:
+            errs[i] = e  # quorum error channel
+
+    def close(self):
+        try:
+            self.release_all()
+        except Exception:
+            pass  # release-shaped method: best-effort by design
+
+    def careful(self):
+        try:
+            return self.fan_out()
+        except ValueError:
+            try:
+                self.undo()
+            except Exception:
+                pass  # cleanup during unwinding
+            raise
+
+async def handler(s, fut, errs):
+    s.translate("b", "o")
+    s.channel(fut)
+    s.collect(errs, 0)
+    s.close()
+    s.careful()
+"""
+
+
+def test_propagation_shapes_are_exempt(tmp_path):
+    root = _write_tree(
+        tmp_path, {"minio_tpu/erasure/fakeok.py": _PROPAGATING}
+    )
+    assert _rule(analyze_project([root]), "error-taint") == []
+
+
+# -- error-taint: unmapped exception types ----------------------------------
+
+_UNMAPPED = """
+class StripeTorn(Exception):
+    pass
+
+class Set:
+    def read(self):
+        raise StripeTorn("no typed handler anywhere")
+
+async def handler(s):
+    return s.read()
+"""
+
+
+def test_unmapped_exception_type_found(tmp_path):
+    root = _write_tree(
+        tmp_path, {"minio_tpu/erasure/fakeraise.py": _UNMAPPED}
+    )
+    hits = _rule(analyze_project([root]), "error-taint")
+    assert len(hits) == 1
+    assert "`StripeTorn`" in hits[0].message
+    assert "never caught by a typed handler" in hits[0].message
+
+
+_MAPPED = _UNMAPPED + """
+def boundary(s):
+    try:
+        return s.read()
+    except StripeTorn:
+        return None
+"""
+
+_MAPPED_ANCESTOR = """
+class Storageish(Exception):
+    pass
+
+class StripeTorn(Storageish):
+    pass
+
+class Set:
+    def read(self):
+        raise StripeTorn("caught via ancestor")
+
+def boundary(s, e):
+    if isinstance(e, Storageish):
+        return None
+    return s.read()
+
+async def handler(s):
+    return s.read()
+"""
+
+
+def test_mapped_exception_types_clean(tmp_path):
+    root = _write_tree(
+        tmp_path, {"minio_tpu/erasure/fakemapped.py": _MAPPED}
+    )
+    assert _rule(analyze_project([root]), "error-taint") == []
+    root2 = _write_tree(
+        tmp_path / "b",
+        {"minio_tpu/erasure/fakeanc.py": _MAPPED_ANCESTOR},
+    )
+    # isinstance dispatch on the ANCESTOR counts as typed handling
+    assert _rule(analyze_project([root2]), "error-taint") == []
+
+
+# -- dead-knob ---------------------------------------------------------------
+
+
+def test_dead_knob_detection_against_real_registry():
+    from minio_tpu.analysis.knobs import KNOBS, PREFIX_KNOBS
+    from minio_tpu.analysis.rules_knobs import dead_knob_findings
+
+    class FakeIx:
+        # the pass requires BOTH the registry and the serving code in
+        # the analyzed tree (an analysis-subpackage-only run must not
+        # flag every knob the unscanned server sources read)
+        summaries = {"analysis/knobs.py": {}, "server/app.py": {}}
+
+    all_names = set(KNOBS) | set(PREFIX_KNOBS)
+    # every name read -> clean
+    f = dead_knob_findings(FakeIx(), all_names, lambda *_: False)
+    assert f == []
+    # hide one read -> exactly that knob is flagged, anchored in the
+    # registry file at its declaration line
+    hidden = sorted(all_names - {"MINIO_TPU_FSYNC"})
+    f = dead_knob_findings(FakeIx(), set(hidden), lambda *_: False)
+    assert len(f) == 1
+    assert "MINIO_TPU_FSYNC" in f[0].message
+    assert f[0].file == "analysis/knobs.py" and f[0].line > 1
+    # a literal prefix read covers the whole family
+    fam = {n for n in all_names if n.startswith("MINIO_NOTIFY_")}
+    f = dead_knob_findings(
+        FakeIx(), (all_names - fam) | {"MINIO_NOTIFY_"},
+        lambda *_: False,
+    )
+    assert f == []
+
+    class SubtreeIx:
+        summaries = {"analysis/knobs.py": {}}  # no serving code in scope
+
+    assert dead_knob_findings(SubtreeIx(), set(), lambda *_: False) == []
+
+
+def test_dead_knob_inert_without_registry_in_tree(tmp_path):
+    # fixture trees don't contain analysis/knobs.py: the pass must not
+    # inherit the whole registry as findings there
+    root = _write_tree(tmp_path, {"plain.py": "x = 1\n"})
+    assert _rule(analyze_project([root]), "dead-knob") == []
+
+
+# -- SARIF fidelity ----------------------------------------------------------
+
+
+def test_sarif_regions_and_help_uris(tmp_path):
+    import json
+
+    from minio_tpu.analysis.core import Finding
+    from minio_tpu.analysis.output import findings_sarif
+
+    src = tmp_path / "bad.py"
+    src.write_text("import time\n\nasync def f():\n    time.sleep(1)\n")
+    doc = json.loads(findings_sarif([
+        Finding(str(src), 4, "blocking", "sleep in async"),
+    ]))
+    run = doc["runs"][0]
+    region = run["results"][0]["locations"][0]["physicalLocation"]["region"]
+    # full region: highlights `time.sleep(1)` (indent 4, line length 17)
+    assert region == {
+        "startLine": 4, "startColumn": 5, "endLine": 4, "endColumn": 18,
+    }
+    rules = {r["id"]: r for r in run["tool"]["driver"]["rules"]}
+    assert rules["blocking"]["helpUri"] == "docs/ANALYSIS.md#blocking"
+
+
+def test_sarif_unreadable_file_falls_back_to_start_line():
+    import json
+
+    from minio_tpu.analysis.core import Finding
+    from minio_tpu.analysis.output import findings_sarif
+
+    doc = json.loads(findings_sarif([
+        Finding("/nonexistent/x.py", 3, "resources", "m"),
+    ]))
+    region = (doc["runs"][0]["results"][0]["locations"][0]
+              ["physicalLocation"]["region"])
+    assert region == {"startLine": 3}
+    rules = doc["runs"][0]["tool"]["driver"]["rules"]
+    assert rules[0]["helpUri"] == "docs/ANALYSIS.md#resources"
+
+
+# -- runtime leak witness ----------------------------------------------------
+
+
+@pytest.fixture
+def leak_cleanup():
+    yield
+    sanitizer.disarm_leak_witness()
+    sanitizer.clear_events()
+
+
+class _FakeMutex:
+    def __init__(self):
+        self.released = 0
+
+    def runlock(self):
+        self.released += 1
+
+
+def test_leak_witness_reports_unreleased_resource(leak_cleanup):
+    """A tracked resource garbage-collected without release reports ONE
+    resource.leak record carrying kind + acquisition stack, streamed as
+    a `type=sanitizer` record through the same pubsub the admin trace
+    endpoint serves."""
+    from minio_tpu.obs import TraceFilter
+    from minio_tpu.server.metrics import TracePubSub
+
+    pub = TracePubSub()
+    prev = obs.publisher()
+    obs.set_publisher(pub)
+    sub = pub.subscribe(filter=TraceFilter(types={"sanitizer"}))
+    sanitizer.clear_events()
+    try:
+        class Handle:
+            def __init__(self, mutex=None):
+                self._mutex = mutex
+
+            def close(self):
+                mtx, self._mutex = self._mutex, None
+                if mtx is not None:
+                    mtx.runlock()
+
+        assert sanitizer.instrument_resource_class(
+            Handle, "nslock-handle", ("close",), holds="_mutex"
+        )
+        # released: quiet
+        h = Handle(mutex=_FakeMutex())
+        h.close()
+        del h
+        gc.collect()
+        assert sanitizer.events("resource.leak") == []
+        # leaked: one record with the acquisition stack
+        h2 = Handle(mutex=_FakeMutex())
+        del h2
+        gc.collect()
+        evs = sanitizer.events("resource.leak")
+        assert len(evs) == 1
+        assert evs[0]["kind"] == "nslock-handle"
+        assert "test_analysis_resources" in evs[0]["stack"]
+        rec = sub.q.get_nowait()
+        assert rec["type"] == "sanitizer"
+        assert rec["name"] == "resource.leak"
+        # holds-predicate: a handle constructed without a resource is
+        # never tracked
+        h3 = Handle(mutex=None)
+        del h3
+        gc.collect()
+        assert len(sanitizer.events("resource.leak")) == 1
+    finally:
+        pub.unsubscribe(sub)
+        obs.set_publisher(prev)
+
+
+def test_leak_witness_arms_real_object_handle(leak_cleanup):
+    # the table entry the static ownership table exists for: a dropped
+    # ObjectHandle = a stranded namespace read lock until TTL
+    import minio_tpu.erasure.set as set_mod
+
+    armed = sanitizer.arm_leak_witness()
+    assert armed >= 1
+    assert any("ObjectHandle" in c for c in sanitizer.leak_classes())
+    sanitizer.clear_events()
+    h = set_mod.ObjectHandle(
+        None, "bkt", "obj", None, [], mutex=_FakeMutex()
+    )
+    del h
+    gc.collect()
+    evs = sanitizer.events("resource.leak")
+    assert len(evs) == 1 and evs[0]["kind"] == "nslock-handle"
+    # a closed handle is quiet (close() marks the token released AND
+    # releases the real lock)
+    sanitizer.clear_events()
+    m = _FakeMutex()
+    h2 = set_mod.ObjectHandle(None, "bkt", "obj", None, [], mutex=m)
+    h2.close()
+    assert m.released == 1
+    del h2
+    gc.collect()
+    assert sanitizer.events("resource.leak") == []
+
+
+def test_leak_witness_surfaces_in_status_and_metrics(leak_cleanup):
+    class Box:
+        def __init__(self):
+            self.res = object()
+
+        def close(self):
+            self.res = None
+
+    sanitizer.instrument_resource_class(Box, "spool", ("close",), "res")
+    sanitizer.clear_events()
+    b = Box()
+    del b
+    gc.collect()
+    st = sanitizer.status()
+    assert st["violations"].get("resource.leak", 0) >= 1
+    assert any("Box" in c for c in st["leakClasses"])
+    # metrics-v3 /api/sanitizer exposition carries the counter
+    from minio_tpu.server import metrics as metrics_mod
+
+    out = "".join(metrics_mod._g_api_sanitizer(None))
+    assert 'minio_sanitizer_violations_total{kind="resource.leak"}' in out
+
+
+def test_leak_witness_wraps_inherited_release_methods(leak_cleanup):
+    # close() inherited from a base class must still mark the token
+    # released, or every correctly-closed instance would report a
+    # false leak on GC
+    class Base:
+        def __init__(self):
+            self.res = object()
+
+        def close(self):
+            self.res = None
+
+    class Derived(Base):
+        pass
+
+    sanitizer.instrument_resource_class(
+        Derived, "spool", ("close",), "res"
+    )
+    sanitizer.clear_events()
+    d = Derived()
+    d.close()
+    del d
+    gc.collect()
+    assert sanitizer.events("resource.leak") == []
+    d2 = Derived()
+    del d2
+    gc.collect()
+    assert len(sanitizer.events("resource.leak")) == 1
+    # disarm removes the shadowing wrapper; the base method is back
+    sanitizer.disarm_leak_witness()
+    assert "close" not in Derived.__dict__
+    d3 = Derived()
+    d3.close()
+    assert d3.res is None
+
+
+def test_leaks_knob_gates_arming(leak_cleanup, monkeypatch):
+    monkeypatch.setenv("MINIO_TPU_SANITIZE_LEAKS", "0")
+    assert sanitizer.arm_leak_witness() == 0
+    monkeypatch.setenv("MINIO_TPU_SANITIZE_LEAKS", "1")
+    assert sanitizer.arm_leak_witness() >= 1
+
+
+# -- triage regressions: the real propagation bugs the sweep fixed ----------
+
+
+def test_safe_walk_swallows_drive_faults_only():
+    """erasure/listing._safe_walk used to swallow EVERY exception: a
+    code bug in walk_dir silently served an empty listing. Only
+    storage/transport faults are dead-drive evidence now."""
+    from minio_tpu.erasure.listing import _safe_walk
+    from minio_tpu.storage.errors import DiskNotFound
+
+    class DeadDisk:
+        def walk_dir(self, bucket, base):
+            raise DiskNotFound("gone")
+            yield  # pragma: no cover
+
+    assert list(_safe_walk(DeadDisk(), "b", "")) == []
+
+    class BuggyDisk:
+        def walk_dir(self, bucket, base):
+            raise TypeError("bug in the walk")
+            yield  # pragma: no cover
+
+    with pytest.raises(TypeError):
+        list(_safe_walk(BuggyDisk(), "b", ""))
+
+
+def test_load_checkpoint_propagates_quorum_errors():
+    """decommission checkpoints: `except (ObjectNotFound, Exception)`
+    used to swallow quorum loss and silently restart the whole copy
+    sweep from object zero. Absent/corrupt still mean a fresh start;
+    infrastructure errors now propagate."""
+    from minio_tpu.erasure.decommission import PoolManager
+    from minio_tpu.erasure.quorum import ObjectNotFound, QuorumError
+
+    pm = PoolManager.__new__(PoolManager)
+
+    class Absent:
+        def get_object(self, *a):
+            raise ObjectNotFound("no checkpoint")
+
+    pm.pools = Absent()
+    assert pm.load_checkpoint(0) is None
+
+    class Corrupt:
+        def get_object(self, *a):
+            return None, [b"not json"]
+
+    pm.pools = Corrupt()
+    assert pm.load_checkpoint(0) is None
+
+    class Offline:
+        def get_object(self, *a):
+            raise QuorumError("drives offline")
+
+    pm.pools = Offline()
+    with pytest.raises(QuorumError):
+        pm.load_checkpoint(0)
+
+
+def test_pool_usage_skips_offline_drives_only():
+    from minio_tpu.erasure.decommission import PoolManager
+    from minio_tpu.storage.errors import DiskNotFound
+
+    class DeadDrive:
+        def disk_info(self):
+            raise DiskNotFound("offline")
+
+    class D:
+        def __init__(self, total, free):
+            self._t, self._f = total, free
+
+        def disk_info(self):
+            class I:
+                pass
+
+            i = I()
+            i.total, i.free = self._t, self._f
+            return i
+
+    class Pool:
+        def __init__(self, disks):
+            self.disks = disks
+
+    class Pools:
+        pools = [Pool([D(100, 50), DeadDrive()])]
+
+    pm = PoolManager.__new__(PoolManager)
+    pm.pools = Pools()
+    out = pm.pool_usage()
+    assert out[0]["total"] == 100 and out[0]["free"] == 50
+
+    class Buggy:
+        def disk_info(self):
+            raise TypeError("bug")
+
+    Pools.pools = [Pool([Buggy()])]
+    with pytest.raises(TypeError):
+        pm.pool_usage()

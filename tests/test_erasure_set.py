@@ -217,3 +217,81 @@ def test_open_object_failure_after_metadata_releases_lock(es, monkeypatch):
     mtx = es.ns.new("bkt", "locked-obj")
     assert mtx.lock(timeout=0.5)
     mtx.unlock()
+
+
+def _tmp_leftovers(tmp_path, drive):
+    tmpdir = tmp_path / drive / ".minio.sys" / "tmp"
+    if not tmpdir.exists():
+        return []
+    return sorted(p.name for p in tmpdir.iterdir())
+
+
+def test_buffered_put_sweeps_staging_on_partial_drive_failure(
+    es, tmp_path, monkeypatch
+):
+    """Regression (miniovet resources triage): a drive whose rename_data
+    fails AFTER create_file staged its shard used to keep a full shard
+    copy under .minio.sys/tmp forever when the PUT still made quorum —
+    the staged bytes must not outlive the operation."""
+    # force the pure-Python buffered path (native routes via streaming,
+    # which has always swept); "0" = never take the native plane
+    monkeypatch.setenv("MINIO_TPU_NATIVE_PLANE", "0")
+    bad = es.disks[0]
+    orig = bad.rename_data
+    bad.rename_data = lambda *a, **kw: (_ for _ in ()).throw(
+        OSError("injected rename failure")
+    )
+    try:
+        data = RNG.integers(0, 256, size=256 * 1024, dtype=np.uint8).tobytes()
+        oi = es.put_object("bkt", "sweep-me", data)  # quorum: 3 of 4
+        assert oi.size == len(data)
+    finally:
+        bad.rename_data = orig
+    assert _tmp_leftovers(tmp_path, "d0") == []
+    # the object still serves (decodes around the failed drive)
+    _, it = es.get_object("bkt", "sweep-me")
+    assert b"".join(it) == data
+
+
+def test_heal_commit_sweeps_staging_on_rename_failure(es, tmp_path):
+    """Same leak class on the heal plane: a stale drive that staged
+    rebuilt parts but failed its rename kept them under .minio.sys/tmp."""
+    import shutil
+
+    data = RNG.integers(0, 256, size=2 * 1024 * 1024, dtype=np.uint8).tobytes()
+    es.put_object("bkt", "heal-sweep", data)
+    shutil.rmtree(tmp_path / "d0" / "bkt")
+    bad = es.disks[0]
+    orig = bad.rename_data
+    bad.rename_data = lambda *a, **kw: (_ for _ in ()).throw(
+        OSError("injected rename failure")
+    )
+    try:
+        res = es.heal_object("bkt", "heal-sweep")
+        assert res["healed"] == []  # the one stale drive failed to commit
+    finally:
+        bad.rename_data = orig
+    assert _tmp_leftovers(tmp_path, "d0") == []
+
+
+def test_restore_sweeps_staging_on_partial_drive_failure(es, tmp_path):
+    """restore_object stages a full re-encoded object per drive; a drive
+    failing mid-commit (or a whole failed restore) used to leak every
+    staged shard."""
+    from minio_tpu.ilm.tier import TRANSITION_TIER_META
+
+    data = RNG.integers(0, 256, size=64 * 1024, dtype=np.uint8).tobytes()
+    es.put_object(
+        "bkt", "restore-sweep", data,
+        user_defined={TRANSITION_TIER_META: "WARMTIER"},
+    )
+    bad = es.disks[0]
+    orig = bad.rename_data
+    bad.rename_data = lambda *a, **kw: (_ for _ in ()).throw(
+        OSError("injected rename failure")
+    )
+    try:
+        es.restore_object("bkt", "restore-sweep", data, days=1)
+    finally:
+        bad.rename_data = orig
+    assert _tmp_leftovers(tmp_path, "d0") == []

@@ -13,6 +13,9 @@ import pytest
 from minio_tpu.client import S3Client
 from minio_tpu.iam.policy import CANNED_POLICIES, Policy
 from tests.test_s3_api import ServerThread
+from tests.conftest import requires_crypto
+
+
 
 
 # -- pure policy evaluation -------------------------------------------------
@@ -69,6 +72,7 @@ def admin(server):
     return c
 
 
+@requires_crypto
 def test_admin_user_lifecycle_and_enforcement(admin, server):
     # create a user with readonly policy
     r = admin.request(
@@ -130,6 +134,7 @@ def test_custom_policy_and_groups(admin, server):
     assert bob.put_object("priv", "x", b"no").status == 403
 
 
+@requires_crypto
 def test_service_account(admin, server):
     r = admin.admin("PUT", "add-service-account", body=b"{}", encrypt_body=True)
     assert r.status == 200
@@ -281,6 +286,7 @@ def test_service_account_escalation_blocked(admin, server):
     assert r.status == 403, r.body
 
 
+@requires_crypto
 def test_disabled_parent_cuts_off_derived_credentials(admin, server):
     # ADVICE r1: a disabled parent must disable its service accounts and
     # STS temp creds (reference rejects SA auth when parent is disabled)
@@ -345,6 +351,7 @@ def test_bucket_policy_not_policy_shaped_is_400(admin, server):
         assert r.status == 400, (bad, r.status, r.body)
 
 
+@requires_crypto
 def test_service_account_list_info_delete(admin, server):
     """SA lifecycle admin ops (reference cmd/admin-handlers-users.go
     ListServiceAccounts/InfoServiceAccount/DeleteServiceAccount)."""
@@ -380,6 +387,7 @@ def test_service_account_list_info_delete(admin, server):
     assert not any(a["accessKey"] == ak for a in json.loads(r.body)["accounts"])
 
 
+@requires_crypto
 def test_service_account_self_service(admin, server):
     """A plain user (no admin policies) manages their OWN service
     accounts — reference semantics (self-ops need no admin grant)."""

@@ -12,6 +12,9 @@ import pytest
 
 from minio_tpu.client import S3Client
 from tests.test_s3_api import ServerThread
+from tests.conftest import requires_crypto
+
+
 
 
 @pytest.fixture(scope="module")
@@ -42,6 +45,7 @@ def test_status_metrics_apis_version(cli):
     assert json.loads(_kms(cli, "GET", "version").body)["version"] == "v1"
 
 
+@requires_crypto
 def test_key_lifecycle(cli):
     assert _kms(cli, "POST", "key/create",
                 query={"key-id": "tenant-a"}).status == 200
@@ -62,6 +66,7 @@ def test_key_lifecycle(cli):
                 query={"key-id": "tenant-a"}).status == 404
 
 
+@requires_crypto
 def test_metrics_report_real_counters(cli):
     """The /v1/metrics endpoint reports the backend's actual request
     counters: a successful op bumps requestOK, a failed one requestErr."""
@@ -102,6 +107,7 @@ def test_typed_error_statuses():
         assert issubclass(cls, CryptoError)
 
 
+@requires_crypto
 def test_key_import(cli):
     material = os.urandom(32)
     r = _kms(cli, "POST", "key/import", query={"key-id": "imported"},
@@ -125,6 +131,7 @@ def test_default_key_protected(cli):
     assert r.status == 400
 
 
+@requires_crypto
 def test_sse_kms_seals_under_named_key(server, cli):
     """An object encrypted under a named key becomes unreadable once the
     key is deleted — proves data really is sealed under THAT key, not

@@ -13,6 +13,9 @@ from minio_tpu.client import S3Client
 from minio_tpu.server import madmin
 
 from test_s3_api import ServerThread
+from tests.conftest import requires_crypto
+
+
 
 
 @pytest.fixture(scope="module")
@@ -28,6 +31,7 @@ def cli(server):
     return S3Client(f"127.0.0.1:{server.port}")
 
 
+@requires_crypto
 def test_format_layout():
     blob = madmin.encrypt("pw", b"payload")
     # salt(32) | aead id(1) | nonce(8) | one sealed fragment (7 + 16 tag)
@@ -36,6 +40,7 @@ def test_format_layout():
     assert madmin.decrypt("pw", blob) == b"payload"
 
 
+@requires_crypto
 def test_fragmenting_and_empty():
     for n in (0, 1, madmin.FRAGMENT - 1, madmin.FRAGMENT, madmin.FRAGMENT + 1,
               3 * madmin.FRAGMENT):
@@ -43,6 +48,7 @@ def test_fragmenting_and_empty():
         assert madmin.decrypt("k", madmin.encrypt("k", data)) == data
 
 
+@requires_crypto
 def test_wrong_key_and_tamper_rejected():
     blob = bytearray(madmin.encrypt("right", b"x" * 100))
     with pytest.raises(madmin.MadminCryptError):
@@ -52,6 +58,7 @@ def test_wrong_key_and_tamper_rejected():
         madmin.decrypt("right", bytes(blob))
 
 
+@requires_crypto
 def test_truncation_rejected():
     blob = madmin.encrypt("k", os.urandom(2 * madmin.FRAGMENT))
     # cutting the stream at the first fragment boundary must not yield a
@@ -67,6 +74,7 @@ def test_plaintext_json_not_mistaken():
     assert madmin.maybe_decrypt("k", body) == body
 
 
+@requires_crypto
 def test_encrypted_request_body_accepted(cli):
     """add-user with a madmin-encrypted body, exactly as mc sends it."""
     body = madmin.encrypt(
@@ -81,6 +89,7 @@ def test_encrypted_request_body_accepted(cli):
     assert wired.request("GET", "/").status in (200, 403)  # creds valid
 
 
+@requires_crypto
 def test_list_users_response_encrypted(cli):
     raw = cli.request("GET", "/minio/admin/v3/list-users")
     assert raw.status == 200
@@ -92,12 +101,14 @@ def test_list_users_response_encrypted(cli):
     assert "wireuser" in users
 
 
+@requires_crypto
 def test_admin_helper_transparent_decrypt(cli):
     r = cli.admin("GET", "list-users")
     assert r.status == 200
     assert "wireuser" in json.loads(r.body)
 
 
+@requires_crypto
 def test_service_account_wire_roundtrip(cli):
     r = cli.admin(
         "PUT", "add-service-account", body={"targetUser": "minioadmin"},

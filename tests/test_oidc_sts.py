@@ -14,6 +14,13 @@ os.environ.setdefault("MINIO_TPU_SCAN_INTERVAL", "0")
 
 import pytest
 
+# every test here signs JWTs with an RSA key: the whole module rides the
+# optional `cryptography` dependency — skip visibly when it is absent
+pytest.importorskip(
+    "cryptography",
+    reason="needs the optional 'cryptography' package (OIDC JWT signing)",
+)
+
 from minio_tpu.client import S3Client
 from tests.test_s3_api import ServerThread
 

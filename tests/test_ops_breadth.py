@@ -14,6 +14,9 @@ import pytest
 
 from minio_tpu.client import S3Client
 from tests.test_s3_api import ServerThread
+from tests.conftest import requires_crypto
+
+
 
 
 @pytest.fixture(scope="module")
@@ -135,6 +138,7 @@ def test_batch_expire_job(cli_a):
     assert cli_a.get_object("srcb", "expireme/old").status == 404
 
 
+@requires_crypto
 def test_config_kv(cli_a):
     r = cli_a.admin("GET", "get-config")
     cfg = json.loads(r.body)
@@ -378,6 +382,7 @@ def test_replication_proxy_get(tmp_path):
         local.stop()
 
 
+@requires_crypto
 def test_batch_keyrotate_job(tmp_path):
     """Batch key rotation re-encrypts SSE objects under fresh keys
     (reference cmd/batch-rotate.go)."""

@@ -20,6 +20,9 @@ from minio_tpu.server.signature import (
 )
 
 from test_s3_api import ServerThread
+from tests.conftest import requires_crypto
+
+
 
 
 @pytest.fixture(scope="module")
@@ -242,6 +245,7 @@ def test_kes_factory_selection(kes, monkeypatch):
     assert k.unseal(sealed, "x") == plain
 
 
+@requires_crypto
 def test_sse_kms_through_kes_end_to_end(kes, tmp_path_factory, monkeypatch):
     """A server whose KMS is KES serves SSE-KMS objects; DEKs come from
     the external KMS (visible in the KES request log)."""
@@ -270,6 +274,7 @@ def test_sse_kms_through_kes_end_to_end(kes, tmp_path_factory, monkeypatch):
 # -- config breadth ----------------------------------------------------------
 
 
+@requires_crypto
 def test_config_subsystem_count(cli):
     cfg = json.loads(cli.admin("GET", "get-config").body)
     assert len(cfg) >= 30, len(cfg)
@@ -278,6 +283,7 @@ def test_config_subsystem_count(cli):
         assert sub in cfg, sub
 
 
+@requires_crypto
 def test_config_set_new_subsystems(cli):
     r = cli.request(
         "PUT", "/minio/admin/v3/set-config-kv",

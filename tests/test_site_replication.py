@@ -13,6 +13,9 @@ import pytest
 
 from minio_tpu.client import S3Client
 from tests.test_s3_api import ServerThread
+from tests.conftest import requires_crypto
+
+
 
 RNG = np.random.default_rng(21)
 
@@ -108,6 +111,7 @@ def test_bucket_metadata_sync(sites):
     )
 
 
+@requires_crypto
 def test_iam_sync(sites):
     s1, s2, c1, c2 = sites
     c1.request("PUT", "/minio/admin/v3/add-user", query={"accessKey": "syncuser"},

@@ -15,6 +15,9 @@ import pytest
 
 from minio_tpu.client import S3Client
 from tests.test_s3_api import ServerThread
+from tests.conftest import requires_crypto
+
+
 
 
 @pytest.fixture(scope="module", autouse=True)
@@ -58,6 +61,7 @@ def _ssec_headers(key: bytes) -> dict:
     }
 
 
+@requires_crypto
 def test_sse_s3_roundtrip(server, cli):
     body = os.urandom(200 * 1024)
     r = cli.put_object(
@@ -78,6 +82,7 @@ def test_sse_s3_roundtrip(server, cli):
         assert probe not in open(meta, "rb").read()
 
 
+@requires_crypto
 def test_sse_s3_range(cli):
     body = bytes(range(256)) * 2048  # 512 KiB, > several packets
     cli.put_object("secure", "rng.bin", body,
@@ -88,6 +93,7 @@ def test_sse_s3_range(cli):
     assert g.headers["content-range"] == f"bytes 70000-70099/{len(body)}"
 
 
+@requires_crypto
 def test_sse_c_roundtrip_and_wrong_key(cli):
     key = os.urandom(32)
     body = os.urandom(50 * 1024)
@@ -103,6 +109,7 @@ def test_sse_c_roundtrip_and_wrong_key(cli):
     assert g.body == body
 
 
+@requires_crypto
 def test_sse_kms_roundtrip(cli):
     body = b"kms-protected-data" * 1000
     r = cli.put_object("secure", "kmsenc.bin", body,
@@ -112,6 +119,7 @@ def test_sse_kms_roundtrip(cli):
     assert cli.get_object("secure", "kmsenc.bin").body == body
 
 
+@requires_crypto
 def test_bucket_default_encryption(cli):
     cfg = (
         "<ServerSideEncryptionConfiguration><Rule>"
@@ -127,6 +135,7 @@ def test_bucket_default_encryption(cli):
     cli.request("DELETE", "/secure", query={"encryption": ""})
 
 
+@requires_crypto
 def test_compression_roundtrip(server, cli):
     body = b"A" * (2 << 20)  # highly compressible 2 MiB
     cli.put_object("secure", "logs/huge.txt", body)
@@ -148,6 +157,7 @@ def test_compression_roundtrip(server, cli):
     assert g.status == 206 and g.body == body[100:200]
 
 
+@requires_crypto
 def test_compression_skips_incompressible(cli):
     body = os.urandom(64 * 1024)  # random: zlib won't shrink it
     cli.put_object("secure", "rand.bin", body)
@@ -160,6 +170,7 @@ def test_kms_status_api(cli):
     assert r.status == 200 and b"key-id" in r.body
 
 
+@requires_crypto
 def test_copy_of_encrypted_object_readable(cli):
     body = os.urandom(30 * 1024)
     cli.put_object("secure", "copy-src-enc", body,
@@ -171,6 +182,7 @@ def test_copy_of_encrypted_object_readable(cli):
     assert g.status == 200 and g.body == body
 
 
+@requires_crypto
 def test_multipart_sse_roundtrip(server, cli):
     """SSE-S3 multipart: parts encrypt as independent packet streams
     under one OEK (reference cmd/encryption-v1.go multipart path)."""
@@ -213,6 +225,7 @@ def test_multipart_sse_roundtrip(server, cli):
         assert probe not in open(part, "rb").read()
 
 
+@requires_crypto
 def test_multipart_ssec_roundtrip(server, cli):
     """SSE-C multipart: the customer key seals the OEK at initiation and
     must be re-presented on every part and on reads (reference
@@ -304,6 +317,7 @@ def test_kms_malformed_spec_raises():
         KMS(key_spec="name:" + base64.b64encode(b"short").decode())
 
 
+@requires_crypto
 def test_kms_ephemeral_key_is_random():
     from minio_tpu.crypto.sse import KMS
 
@@ -317,6 +331,7 @@ def test_kms_ephemeral_key_is_random():
     assert a.unseal(sealed, "ctx") == b"\x01" * 32
 
 
+@requires_crypto
 def test_kms_master_key_created_once_and_shared():
     from minio_tpu.crypto.sse import KMS
 
@@ -329,6 +344,7 @@ def test_kms_master_key_created_once_and_shared():
     assert k2.unseal(sealed, "ctx") == b"\x02" * 32
 
 
+@requires_crypto
 def test_kms_concurrent_first_boot_with_ns_lock():
     import threading
     import time as _t

@@ -16,6 +16,10 @@ import numpy as np
 from minio_tpu.erasure.coder import ErasureCoder
 from minio_tpu.erasure.set import ErasureSet
 from minio_tpu.storage.xlstorage import XLStorage
+from tests.conftest import requires_crypto
+
+
+
 
 RNG = np.random.default_rng(5)
 
@@ -231,6 +235,7 @@ def test_streaming_abort_preserves_existing_object(tmp_path):
     assert b"".join(it) == old
 
 
+@requires_crypto
 def test_streaming_sse_header_falls_back_to_encrypting(monkeypatch):
     """Request-level SSE on a large unsigned PUT must still encrypt."""
     from minio_tpu.client import S3Client

@@ -19,6 +19,9 @@ import numpy as np
 import pytest
 
 from minio_tpu.ilm.warm_backends import AzureWarmClient, GCSWarmClient
+from tests.conftest import requires_crypto
+
+
 
 RNG = np.random.default_rng(77)
 
@@ -269,6 +272,7 @@ def gcs_srv():
     srv.shutdown()
 
 
+@requires_crypto
 def test_gcs_roundtrip(gcs_srv):
     ep, creds = gcs_srv
     c = GCSWarmClient(ep, creds)
@@ -282,6 +286,7 @@ def test_gcs_roundtrip(gcs_srv):
     assert c.get_object("gbkt", "a/b/obj.bin").status == 404
 
 
+@requires_crypto
 def test_gcs_token_cached_across_requests(gcs_srv):
     ep, creds = gcs_srv
     before = _FakeGCS.token_grants
@@ -291,6 +296,7 @@ def test_gcs_token_cached_across_requests(gcs_srv):
     assert _FakeGCS.token_grants == before + 1  # one JWT exchange, then cached
 
 
+@requires_crypto
 def test_gcs_credentials_as_json_string(gcs_srv):
     ep, creds = gcs_srv
     c = GCSWarmClient(ep, json.dumps(creds))

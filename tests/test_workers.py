@@ -22,7 +22,9 @@ import os
 
 os.environ.setdefault("MINIO_TPU_BACKEND", "numpy")
 
+import random
 import signal
+import socket
 import subprocess
 import sys
 import threading
@@ -35,6 +37,30 @@ from tests.test_s3_api import _free_port
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BUCKET = "wpool"
+
+
+def _free_port_block(n: int, lo: int = 20000, hi: int = 29000) -> int:
+    """`n` consecutive free ports BELOW the kernel's ephemeral range
+    (/proc/sys/net/ipv4/ip_local_port_range starts at 32768):
+    `_free_port()`'s bind(0) picks hand back ephemeral ports that the
+    suite's own client-connection churn can reclaim between the probe
+    and the worker's bind — worker 1 then crash-loops on EADDRINUSE and
+    the pool never reports ready (the full-suite-only flake)."""
+    for _ in range(128):
+        base = random.randrange(lo, hi - n)
+        socks = []
+        try:
+            for i in range(n):
+                s = socket.socket()
+                s.bind(("127.0.0.1", base + i))
+                socks.append(s)
+            return base
+        except OSError:
+            continue
+        finally:
+            for s in socks:
+                s.close()
+    raise RuntimeError("no free port block found")
 
 
 def _wait_ready(clients, timeout: float = 60.0) -> None:
@@ -58,8 +84,11 @@ def _wait_ready(clients, timeout: float = 60.0) -> None:
 @pytest.fixture(scope="module")
 def pool(tmp_path_factory):
     base = tmp_path_factory.mktemp("wpool")
-    port = _free_port()
-    ctrl_base = _free_port()
+    # ONE block of three: shared S3 port + both control ports — two
+    # independent probes could overlap (each closes its probe sockets
+    # before the next one draws)
+    port = _free_port_block(3)
+    ctrl_base = port + 1
     env = dict(os.environ)
     env["MINIO_TPU_BACKEND"] = "numpy"
     env["MINIO_TPU_WORKERS"] = "2"
@@ -77,10 +106,16 @@ def pool(tmp_path_factory):
     env["MINIO_TPU_CACHE_DISK_DIR"] = str(base / "segspool")
     env["PYTHONPATH"] = REPO
     env.pop("JAX_PLATFORMS", None)
+    # pool output goes to a FILE, not a PIPE: nobody drains a pipe
+    # while the pool serves, so a chatty boot (jax warnings under a
+    # loaded host) could fill the 64KB buffer and wedge every worker
+    # on a blocked write — exactly a readiness timeout
+    log_path = base / "pool.log"
+    log_fh = open(log_path, "wb")
     proc = subprocess.Popen(
         [sys.executable, "-m", "minio_tpu.server", "--address",
          f"127.0.0.1:{port}", *[str(base / f"d{i}") for i in range(8)]],
-        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        env=env, stdout=log_fh, stderr=subprocess.STDOUT,
     )
     shared = S3Client(f"127.0.0.1:{port}")
     w0 = S3Client(f"127.0.0.1:{ctrl_base}")
@@ -89,7 +124,8 @@ def pool(tmp_path_factory):
         _wait_ready([w0, w1])
     except TimeoutError:
         proc.kill()
-        print(proc.stdout.read().decode()[-4000:])
+        log_fh.close()
+        print(log_path.read_bytes().decode(errors="replace")[-4000:])
         raise
     assert w0.make_bucket(BUCKET).status == 200
     yield {"proc": proc, "shared": shared, "w0": w0, "w1": w1,
@@ -100,6 +136,7 @@ def pool(tmp_path_factory):
             proc.wait(20)
         except subprocess.TimeoutExpired:
             proc.kill()
+    log_fh.close()
 
 
 def _info(cli) -> dict:
