@@ -93,6 +93,27 @@ _ALL: list[Knob] = [
     _k("MINIO_TPU_DEVICE_HEAL", "0", "erasure",
        "Route heal-plane reconstruct+hash through the fused device "
        "kernel (1) instead of the CPU path (0)."),
+    _k("MINIO_TPU_EC_FAMILY", "reedsolomon", "erasure",
+       "Default erasure code family for NEW writes: `reedsolomon` "
+       "(Vandermonde RS, native/mega-kernel planes) or `cauchy` (Cauchy "
+       "MDS with piggybacked sub-chunks — single-shard repair reads "
+       "~40% fewer survivor bytes at EC 8+8). Recorded per object in "
+       "xl.meta; reads/heals always dispatch on the stored family, so "
+       "flipping this never breaks existing objects. Malformed values "
+       "fall back to reedsolomon."),
+    _k("MINIO_TPU_EC_FAMILY_STANDARD", "", "erasure",
+       "Code-family override for x-amz-storage-class STANDARD (and "
+       "requests with no storage class); empty defers to "
+       "MINIO_TPU_EC_FAMILY."),
+    _k("MINIO_TPU_EC_FAMILY_RRS", "", "erasure",
+       "Code-family override for x-amz-storage-class "
+       "REDUCED_REDUNDANCY; empty defers to MINIO_TPU_EC_FAMILY."),
+    _k("MINIO_TPU_EC_REPAIR", "1", "erasure",
+       "Partial-repair reads for sub-packetized families: heal and "
+       "degraded GETs of a single lost data shard fetch only the "
+       "repair schedule's sub-chunk frames instead of full survivor "
+       "shards. 0 forces full-shard reads (correctness never depends "
+       "on this — it is purely the repair-bandwidth optimization)."),
     _k("MINIO_TPU_DISK_MONITOR_INTERVAL", "10", "erasure",
        "Seconds between background disk health probes (offline-disk "
        "detection and auto-heal triggering)."),

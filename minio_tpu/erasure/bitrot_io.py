@@ -13,6 +13,19 @@ over the whole file lives in the version metadata
 (ErasureInfo.checksums[part].hash). New writes always produce the
 streaming format, like the reference; whole-file is a read/verify/heal
 compatibility surface for imported legacy data.
+
+FAMILY FRAMING — the shard-block frame depends on the erasure code
+family recorded in xl.meta (ErasureInfo.algorithm):
+
+- ``reedsolomon``:  hash(block) || block            (one frame)
+- ``cauchy``:       hash(sub1) || sub1 || hash(sub2) || sub2
+
+The cauchy family (ops/cauchy.py) sub-packetizes every shard block into
+two sub-chunks so single-shard repair can fetch PARTIAL shards; each
+sub-chunk carries its own digest so a sub-chunk ranged read stays
+bitrot-verified without touching the other half (``sub_chunk_span`` +
+``verify_sub_chunk`` are that read path). Unknown family strings raise
+the typed ``errors.UnknownErasureFamily``.
 """
 
 from __future__ import annotations
@@ -24,34 +37,135 @@ from ..storage import errors
 
 DIGEST_SIZE = 32
 
+FAMILY_RS = "reedsolomon"
+FAMILY_CAUCHY = "cauchy"
+FAMILIES = (FAMILY_RS, FAMILY_CAUCHY)
 
-def block_offset(shard_size: int, block_index: int) -> int:
-    """Shard-file offset of block `block_index` (its digest included)."""
-    return block_index * (DIGEST_SIZE + shard_size)
+
+def check_family(family: str) -> str:
+    """Validate an xl.meta code-family string; single choke point for the
+    'unknown-family is a typed error, never a misread frame' contract."""
+    if family not in FAMILIES:
+        raise errors.UnknownErasureFamily(
+            f"unknown erasure code family {family!r} (known: {FAMILIES})"
+        )
+    return family
 
 
-def verify_block(
-    buf: bytes, expect_len: int, algo: BitrotAlgorithm = DEFAULT_BITROT_ALGO
-) -> bytes:
-    """Split one digest||block record and verify it; returns the block.
+def frames_per_block(family: str = FAMILY_RS) -> int:
+    """Bitrot frames (digests) per shard block for a code family."""
+    return 2 if check_family(family) == FAMILY_CAUCHY else 1
 
-    Raises FileCorrupt on short reads or digest mismatch — the bitrot
-    detection that triggers healing in the read path. Single source of
-    truth for the record layout (used by reads, inline verify, heal)."""
-    if len(buf) != DIGEST_SIZE + expect_len:
-        raise errors.FileCorrupt("short shard block")
-    digest, block = buf[:DIGEST_SIZE], buf[DIGEST_SIZE:]
+
+def sub_lens(shard_size: int) -> tuple[int, int]:
+    """(len(sub-chunk 1), len(sub-chunk 2)) of a sub-packetized shard
+    block. Single source: ops/cauchy.sub_lens (floor half first) —
+    duplicated arithmetic here would let the framing drift from the
+    codec."""
+    from ..ops.cauchy import sub_lens as _cs
+
+    return _cs(shard_size)
+
+
+_sub_lens = sub_lens
+
+
+def block_offset(shard_size: int, block_index: int, family: str = FAMILY_RS) -> int:
+    """Shard-file offset of block `block_index` (its digest(s) included)."""
+    return block_index * (
+        frames_per_block(family) * DIGEST_SIZE + shard_size
+    )
+
+
+def block_disk_size(shard_size: int, family: str = FAMILY_RS) -> int:
+    """On-disk bytes of one shard-block frame group."""
+    return frames_per_block(family) * DIGEST_SIZE + shard_size
+
+
+def sub_chunk_in_block(shard_size: int, which: int) -> tuple[int, int]:
+    """(offset within the block's frame group, data length) of one
+    sub-chunk frame — the single source for the cauchy frame layout
+    that the partial-repair readers (GET + heal) and ``sub_chunk_span``
+    all share. ``shard_size`` is THIS block's shard length (tail blocks
+    differ from full blocks)."""
+    h1, h2 = _sub_lens(shard_size)
+    if which == 0:
+        return 0, h1
+    if which == 1:
+        return DIGEST_SIZE + h1, h2
+    raise ValueError("sub-chunk index must be 0 or 1")
+
+
+def sub_chunk_span(
+    shard_size: int, block_index: int, which: int, family: str = FAMILY_CAUCHY
+) -> tuple[int, int, int]:
+    """(file offset, on-disk length, data length) of one sub-chunk frame
+    of a cauchy shard block in a uniform-geometry shard file."""
+    if check_family(family) != FAMILY_CAUCHY:
+        raise ValueError("sub-chunk reads exist only for sub-packetized families")
+    base = block_offset(shard_size, block_index, family)
+    rel, dlen = sub_chunk_in_block(shard_size, which)
+    return base + rel, DIGEST_SIZE + dlen, dlen
+
+
+def _digest(block: bytes, algo: BitrotAlgorithm) -> bytes:
     if algo in (BitrotAlgorithm.HIGHWAYHASH256, BitrotAlgorithm.HIGHWAYHASH256S):
         from ..ops.bitrot import fast_hash256
 
-        got = fast_hash256(block)
-    else:
-        h = algo.new()
-        h.update(block)
-        got = h.digest()
-    if got != digest:
+        return fast_hash256(block)
+    h = algo.new()
+    h.update(block)
+    return h.digest()
+
+
+def frame_block(
+    block: bytes, family: str = FAMILY_RS,
+    algo: BitrotAlgorithm = DEFAULT_BITROT_ALGO,
+) -> bytes:
+    """Digest-frame one shard block for its family's on-disk format."""
+    if check_family(family) == FAMILY_CAUCHY:
+        h1, _h2 = _sub_lens(len(block))
+        sub1, sub2 = block[:h1], block[h1:]
+        return _digest(sub1, algo) + sub1 + _digest(sub2, algo) + sub2
+    return _digest(block, algo) + block
+
+
+def verify_block(
+    buf: bytes, expect_len: int, algo: BitrotAlgorithm = DEFAULT_BITROT_ALGO,
+    family: str = FAMILY_RS,
+) -> bytes:
+    """Split one shard-block frame group and verify it; returns the block.
+
+    Raises FileCorrupt on short reads or digest mismatch — the bitrot
+    detection that triggers healing in the read path. Single source of
+    truth for the record layout (used by reads, inline verify, heal).
+    For the cauchy family the buffer holds TWO digest||sub-chunk frames;
+    both verify and the sub-chunks concatenate back into the block."""
+    if check_family(family) == FAMILY_CAUCHY:
+        h1, h2 = _sub_lens(expect_len)
+        if len(buf) != 2 * DIGEST_SIZE + expect_len:
+            raise errors.FileCorrupt("short shard block")
+        sub1 = verify_sub_chunk(buf[: DIGEST_SIZE + h1], h1, algo)
+        sub2 = verify_sub_chunk(buf[DIGEST_SIZE + h1 :], h2, algo)
+        return sub1 + sub2
+    if len(buf) != DIGEST_SIZE + expect_len:
+        raise errors.FileCorrupt("short shard block")
+    digest, block = buf[:DIGEST_SIZE], buf[DIGEST_SIZE:]
+    if _digest(block, algo) != digest:
         raise errors.FileCorrupt("bitrot detected")
     return block
+
+
+def verify_sub_chunk(
+    buf: bytes, expect_len: int, algo: BitrotAlgorithm = DEFAULT_BITROT_ALGO
+) -> bytes:
+    """Verify one digest||sub-chunk frame (the partial-repair read unit)."""
+    if len(buf) != DIGEST_SIZE + expect_len:
+        raise errors.FileCorrupt("short sub-chunk frame")
+    digest, sub = buf[:DIGEST_SIZE], buf[DIGEST_SIZE:]
+    if _digest(sub, algo) != digest:
+        raise errors.FileCorrupt("bitrot detected (sub-chunk)")
+    return sub
 
 
 def whole_file_digest(data: bytes, algo: BitrotAlgorithm = DEFAULT_BITROT_ALGO) -> bytes:
@@ -81,14 +195,17 @@ def bitrot_verify_file(
     want_file_size: int,
     shard_size: int,
     algo: BitrotAlgorithm = DEFAULT_BITROT_ALGO,
+    family: str = FAMILY_RS,
 ) -> None:
     """Whole-file streaming verification (heal/scanner path).
 
     want_file_size is the *data* size of the shard (without digests); the
-    on-disk file must be exactly want_file_size + n_blocks*32.
+    on-disk file must be exactly want_file_size plus the family's digest
+    overhead (one 32-byte digest per frame, frames_per_block per block).
     """
+    frames = frames_per_block(family)
     n_blocks = -(-want_file_size // shard_size) if want_file_size else 0
-    expect_disk = want_file_size + n_blocks * DIGEST_SIZE
+    expect_disk = want_file_size + n_blocks * frames * DIGEST_SIZE
     try:
         actual = os.path.getsize(path)
     except FileNotFoundError:
@@ -101,8 +218,8 @@ def bitrot_verify_file(
         left = want_file_size
         while left > 0:
             n = min(shard_size, left)
-            buf = f.read(DIGEST_SIZE + n)
-            if len(buf) != DIGEST_SIZE + n:
+            buf = f.read(frames * DIGEST_SIZE + n)
+            if len(buf) != frames * DIGEST_SIZE + n:
                 raise errors.FileCorrupt("short read during verify")
-            verify_block(buf, n, algo)
+            verify_block(buf, n, algo, family)
             left -= n

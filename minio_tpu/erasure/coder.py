@@ -7,6 +7,16 @@ recompilation). Only the object's final partial block has a variable shard
 size; it runs on the numpy codec (ops/rs.py + ops/highwayhash.py), which is
 byte-identical. GetObject/Heal reconstruction follows the same split.
 
+CODE FAMILIES: two TPU-batchable families share this interface —
+``reedsolomon`` (ops/rs.py / ops/rs_jax.py, the default) and ``cauchy``
+(ops/cauchy.py: Cauchy MDS with piggybacked sub-chunks for partial
+repair). The family is chosen per storage class at write time
+(MINIO_TPU_EC_FAMILY*), recorded in xl.meta (ErasureInfo.algorithm),
+and every decode/heal path dispatches on the STORED family, so objects
+of both families coexist on the same drives. Per-family counters
+(encode/decode blocks, heal/degraded ingress bytes) aggregate here for
+the metrics-v3 /api/tpu group.
+
 Backend forced with MINIO_TPU_BACKEND=numpy|jax (default: jax when any
 device is available).
 """
@@ -14,6 +24,7 @@ device is available).
 from __future__ import annotations
 
 import os
+import threading
 from dataclasses import dataclass
 from typing import Iterator
 
@@ -22,6 +33,7 @@ import numpy as np
 from ..ops import rs
 from ..ops.highwayhash import hash256_batch_numpy
 from . import bitrot_io
+from .bitrot_io import FAMILY_CAUCHY, FAMILY_RS
 
 # max shards per device dispatch (HBM headroom: the hash lane arrays
 # OOM above ~3072 shards of 128 KiB on a 16 GB chip)
@@ -36,6 +48,86 @@ def _use_jax() -> bool:
     return mode != "numpy"
 
 
+def default_ec_family() -> str:
+    """Write-time code family (MINIO_TPU_EC_FAMILY). Malformed values
+    fall back to reedsolomon — a tuning typo must not take down PUTs —
+    but reads always dispatch on the family RECORDED in xl.meta."""
+    fam = os.environ.get("MINIO_TPU_EC_FAMILY", FAMILY_RS)
+    return fam if fam in bitrot_io.FAMILIES else FAMILY_RS
+
+
+def repair_reads_enabled() -> bool:
+    """MINIO_TPU_EC_REPAIR gates the sub-chunk partial-repair read plans
+    (heal + degraded GET) of sub-packetized families; decode correctness
+    never depends on it — off means full-shard reads everywhere."""
+    return os.environ.get("MINIO_TPU_EC_REPAIR", "1") != "0"
+
+
+# -- per-family counters (metrics-v3 /api/tpu) ------------------------------
+
+_FSTATS_LOCK = threading.Lock()
+_FAMILY_STATS: dict[str, dict[str, int]] = {}
+_FSTAT_KEYS = (
+    "encode_blocks", "decode_blocks", "heal_ingress_bytes",
+    "degraded_ingress_bytes", "repair_partial_blocks",
+)
+
+
+def family_stats_add(family: str, key: str, n: int = 1) -> None:
+    with _FSTATS_LOCK:
+        st = _FAMILY_STATS.get(family)
+        if st is None:
+            st = _FAMILY_STATS[family] = {k: 0 for k in _FSTAT_KEYS}
+        st[key] = st.get(key, 0) + n
+
+
+def family_stats_snapshot() -> dict[str, dict[str, int]]:
+    """Copy of the per-family counter table; families that served no
+    traffic yet report zeroed rows so metrics series exist from boot."""
+    with _FSTATS_LOCK:
+        out = {f: dict(st) for f, st in _FAMILY_STATS.items()}
+    for fam in bitrot_io.FAMILIES:
+        out.setdefault(fam, {k: 0 for k in _FSTAT_KEYS})
+    return out
+
+
+def encode_blocks_numpy(
+    np_codec, blocks: np.ndarray, family: str = FAMILY_RS
+) -> tuple[np.ndarray, np.ndarray]:
+    """CPU full-block encode+hash, byte-identical to the device rungs.
+
+    [B, d, n] -> (shards [B, t, n], digests [B, t, 32] rs /
+    [B, t, 2, 32] cauchy). Shared by ErasureCoder's no-device path and
+    the dispatcher's numpy degradation rung, so the two can never
+    drift."""
+    from ..ops.bitrot import fast_hash256_batch
+
+    b, d, n = blocks.shape
+    t = np_codec.total_shards
+    shards = np.zeros((b, t, n), dtype=np.uint8)
+    shards[:, :d] = blocks
+    for i in range(b):
+        shards[i] = np_codec.encode(shards[i])
+    if family == FAMILY_CAUCHY:
+        h1 = n // 2
+        # per-sub-chunk digests: two bitrot frames per shard block. The
+        # halves hash as separate batches (unequal lengths when n is odd).
+        d1 = fast_hash256_batch(
+            np.ascontiguousarray(shards[:, :, :h1]).reshape(b * t, h1)
+        )
+        d2 = fast_hash256_batch(
+            np.ascontiguousarray(shards[:, :, h1:]).reshape(b * t, n - h1)
+        )
+        digests = np.stack(
+            [np.asarray(d1), np.asarray(d2)], axis=1
+        ).reshape(b, t, 2, 32)
+        return shards, digests
+    digests = np.asarray(
+        fast_hash256_batch(shards.reshape(b * t, -1))
+    ).reshape(b, t, 32)
+    return shards, digests
+
+
 @dataclass
 class EncodedPart:
     """One erasure-coded part: per-drive shard file bytes (bitrot
@@ -46,18 +138,35 @@ class EncodedPart:
 
 
 class ErasureCoder:
-    def __init__(self, data_blocks: int, parity_blocks: int, block_size: int = BLOCK_SIZE):
+    def __init__(
+        self, data_blocks: int, parity_blocks: int,
+        block_size: int = BLOCK_SIZE, family: str = FAMILY_RS,
+    ):
+        self.family = bitrot_io.check_family(family)
         self.d = data_blocks
         self.p = parity_blocks
         self.t = data_blocks + parity_blocks
         self.block_size = block_size
         self.shard_size = -(-block_size // data_blocks)
-        self._np = rs.get_codec(self.d, self.p)
+        # on-disk digest overhead per shard block (1 frame for rs, 2 for
+        # the sub-packetized cauchy family)
+        self.frame_digests = bitrot_io.frames_per_block(self.family)
+        if self.family == FAMILY_CAUCHY:
+            from ..ops import cauchy as cauchy_mod
+
+            self._np = cauchy_mod.get_codec(self.d, self.p)
+        else:
+            self._np = rs.get_codec(self.d, self.p)
         self._jax = None
         if _use_jax():
-            from ..ops import rs_jax  # deferred: jax import is heavy
+            if self.family == FAMILY_CAUCHY:
+                from ..ops import cauchy as cauchy_mod
 
-            self._jax = rs_jax.get_tpu_codec(self.d, self.p)
+                self._jax = cauchy_mod.get_tpu_codec(self.d, self.p)
+            else:
+                from ..ops import rs_jax  # deferred: jax import is heavy
+
+                self._jax = rs_jax.get_tpu_codec(self.d, self.p)
 
     @property
     def device_active(self) -> bool:
@@ -80,6 +189,9 @@ class ErasureCoder:
         from .. import native
         from ..ops.highwayhash import MINIO_KEY
 
+        # tail blocks count like full blocks so the per-family encode
+        # series stays comparable across families
+        family_stats_add(self.family, "encode_blocks", 1)
         if native.available():
             shards = self._np.split(block)
             parity, digests = native.gf_encode_hash(
@@ -92,29 +204,28 @@ class ErasureCoder:
         return shards, digests
 
     def _encode_full_blocks(self, blocks: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
-        """blocks: [B, d, shard_size] -> (shards [B, t, n], digests [B, t, 32]).
+        """blocks: [B, d, shard_size] -> (shards [B, t, n], digests).
 
-        The device path goes through the batching dispatcher: blocks from
-        concurrent requests coalesce into one fused dispatch."""
-        if self._jax is not None:
+        digests: [B, t, 32] for reedsolomon, [B, t, 2, 32] (per
+        sub-chunk) for cauchy. The device path goes through the batching
+        dispatcher: blocks from concurrent requests of BOTH families
+        coalesce into one stream (family tag per batch entry). The
+        cauchy composite matmul needs an even shard size; odd geometries
+        take the numpy path below."""
+        if self._jax is not None and (
+            self.family != FAMILY_CAUCHY or blocks.shape[2] % 2 == 0
+        ):
             from ..parallel.dispatcher import get_dispatcher
 
-            return get_dispatcher(self._jax, blocks.shape[2]).encode(blocks)
-        from ..ops.bitrot import fast_hash256_batch
-
-        b = blocks.shape[0]
-        shards = np.zeros((b, self.t, blocks.shape[2]), dtype=np.uint8)
-        shards[:, : self.d] = blocks
-        for i in range(b):
-            shards[i, self.d :] = self._np.encode(shards[i].copy())[self.d :]
-        digests = fast_hash256_batch(shards.reshape(b * self.t, -1)).reshape(
-            b, self.t, 32
-        )
-        return shards, digests
+            return get_dispatcher(self._jax, blocks.shape[2]).encode(
+                blocks, codec=self._jax
+            )
+        family_stats_add(self.family, "encode_blocks", blocks.shape[0])
+        return encode_blocks_numpy(self._np, blocks, self.family)
 
     def _encode_full_buffer(self, data: memoryview) -> list[bytearray]:
         """len(data) is a multiple of block_size -> per-shard file chunks
-        (digest || shard block interleave) for these stripe blocks."""
+        (family-framed digest || shard interleave) for these blocks."""
         full = len(data) // self.block_size
         per = self.shard_size
         padded_block = self.d * per  # >= block_size; zero padding at tail
@@ -129,17 +240,32 @@ class ErasureCoder:
                 a[: self.block_size] = blk
         files = [bytearray() for _ in range(self.t)]
         max_blocks = max(1, MAX_DEVICE_SHARDS // self.t)
+        cauchy = self.family == FAMILY_CAUCHY
+        h1 = per // 2
         for start in range(0, full, max_blocks):
             chunk = arr[start : start + max_blocks]
             shards, digests = self._encode_full_blocks(chunk)
             for b in range(chunk.shape[0]):
                 for i in range(self.t):
-                    files[i] += digests[b, i].tobytes()
-                    files[i] += shards[b, i].tobytes()
+                    if cauchy:
+                        files[i] += digests[b, i, 0].tobytes()
+                        files[i] += shards[b, i, :h1].tobytes()
+                        files[i] += digests[b, i, 1].tobytes()
+                        files[i] += shards[b, i, h1:].tobytes()
+                    else:
+                        files[i] += digests[b, i].tobytes()
+                        files[i] += shards[b, i].tobytes()
         return files
 
     def _encode_tail_buffer(self, data: bytes) -> list[bytearray]:
         """Partial final block (numpy codec, byte-identical)."""
+        if self.family == FAMILY_CAUCHY:
+            shards = self._np.encode_data(data)
+            family_stats_add(self.family, "encode_blocks", 1)
+            return [
+                bytearray(bitrot_io.frame_block(shards[i].tobytes(), self.family))
+                for i in range(self.t)
+            ]
         shards, digests = self._encode_block_np(data)
         files = [bytearray() for _ in range(self.t)]
         for i in range(self.t):
@@ -217,6 +343,7 @@ class ErasureCoder:
         for i in idxs:
             shards[i] = present[i]
         rec = self._np.reconstruct(shards)
+        family_stats_add(self.family, "decode_blocks", 1)
         return {i: rec[i] for i in range(self.t)}
 
     def reconstruct_data_flat(
@@ -236,6 +363,13 @@ class ErasureCoder:
         from .. import native
 
         d_, w, per = survivors.shape
+        family_stats_add(self.family, "decode_blocks", w)
+        if self.family == FAMILY_CAUCHY:
+            # cauchy decode runs on the numpy/native GF plane: the
+            # piggyback purify step chains two applies, and repair-path
+            # reads (the family's point) are bandwidth- not compute-
+            # bound. Device decode is a named PERF round-9 next lever.
+            return self._np.reconstruct_flat(survivors, present, missing)
         if (
             self._jax is not None
             and w * self.t >= int(os.environ.get("MINIO_TPU_DECODE_MIN_SHARDS", "64"))
@@ -304,6 +438,21 @@ class ErasureCoder:
                 if c:
                     acc ^= gf.MUL_TABLE[c][survivors[:, k]]
         return out
+
+    # -- partial repair (sub-packetized families) --------------------------
+
+    def repair_schedule(self, missing: int):
+        """Sub-chunk repair plan for ONE lost data shard, or None when
+        the family has no shortcut (reedsolomon, parity loss, p < 2, or
+        repair reads disabled via MINIO_TPU_EC_REPAIR=0)."""
+        if self.family != FAMILY_CAUCHY or not repair_reads_enabled():
+            return None
+        return self._np.repair_schedule(missing)
+
+    def repair_data_shard(self, sched, shard_size, sub2, pb_sub2, sub1):
+        """Execute a repair schedule (ops/cauchy.repair_data_shard)."""
+        family_stats_add(self.family, "repair_partial_blocks", 1)
+        return self._np.repair_data_shard(sched, shard_size, sub2, pb_sub2, sub1)
 
     # -- geometry ----------------------------------------------------------
 

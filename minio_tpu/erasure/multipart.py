@@ -70,6 +70,7 @@ class MultipartManager:
         obj: str,
         user_defined: dict[str, str] | None = None,
         parity: int | None = None,
+        family: str | None = None,
     ) -> str:
         if not self.es.bucket_exists(bucket):
             from .quorum import BucketNotFound
@@ -82,6 +83,12 @@ class MultipartManager:
         )
         if parity is not None:
             meta["__parity"] = str(parity)
+        # the upload's code family pins at initiation (like parity and
+        # distribution): every part must share the final object's shard
+        # format, even if MINIO_TPU_EC_FAMILY changes mid-upload
+        from .coder import default_ec_family
+
+        meta["__family"] = family or default_ec_family()
         self.es.put_object(
             MP_VOLUME,
             self._upload_key(bucket, obj, upload_id),
@@ -108,6 +115,12 @@ class MultipartManager:
         up = self._upload_meta(bucket, obj, upload_id)
         dist = [int(x) for x in up.user_defined["__distribution"].split(",")]
         parity = int(up.user_defined.get("__parity", self.es.default_parity))
+        # absent __family (upload initiated before the family field
+        # existed) can ONLY mean its earlier parts were framed
+        # reedsolomon — falling back to the CURRENT default here would
+        # mix shard formats inside one object if the knob flipped
+        # mid-upload across a restart
+        family = up.user_defined.get("__family") or "reedsolomon"
         part_meta: dict[str, str] | None = dict(extra_meta) if extra_meta else None
         plain_after = None  # streamed transforms know the size only at EOF
         if self.part_transform is not None:
@@ -129,6 +142,7 @@ class MultipartManager:
             parity=parity,
             distribution=dist,
             allow_inline=False,
+            family=family,
         )
         if plain_after is not None:
             size = str(plain_after())
@@ -436,7 +450,9 @@ class MultipartRouter:
                 pass
         return 0, upload_id
 
-    def new_upload(self, bucket, obj, user_defined=None, parity=None) -> str:
+    def new_upload(
+        self, bucket, obj, user_defined=None, parity=None, family=None
+    ) -> str:
         pools = self._pools()
         pool_idx = 0
         if len(pools) > 1:
@@ -449,7 +465,9 @@ class MultipartRouter:
                 # new object (or holder not in this router's pool list):
                 # place by free space
                 pool_idx = pools.index(self.store._pool_with_most_free())
-        raw = self._mgr(obj, pool_idx).new_upload(bucket, obj, user_defined, parity)
+        raw = self._mgr(obj, pool_idx).new_upload(
+            bucket, obj, user_defined, parity, family
+        )
         return f"{pool_idx}{POOL_SEP}{raw}"
 
     def put_part(self, bucket, obj, upload_id, part_number, data,

@@ -22,7 +22,7 @@ import numpy as np
 
 from .. import obs
 from ..fault import registry as fault_registry
-from ..ops.bitrot import DEFAULT_BITROT_ALGO, fast_hash256
+from ..ops.bitrot import DEFAULT_BITROT_ALGO
 from ..storage import errors
 from ..storage.errors import StorageError
 from ..storage.datatypes import (
@@ -36,7 +36,12 @@ from ..storage.format import INLINE_DATA_THRESHOLD
 from ..storage.interface import StorageAPI
 from ..utils.hashing import hash_order
 from . import bitrot_io
-from .coder import BLOCK_SIZE, ErasureCoder
+from .coder import (
+    BLOCK_SIZE,
+    ErasureCoder,
+    default_ec_family,
+    family_stats_add,
+)
 from .quorum import (
     BucketExists,
     BucketNotFound,
@@ -173,7 +178,7 @@ class ErasureSet:
         )
         self.ns = ns_lock if ns_lock is not None else NamespaceLock()
         self._pool = obs.ContextPool(max_workers=max(4, self.n))
-        self._coders: dict[tuple[int, int], ErasureCoder] = {}
+        self._coders: dict[tuple[int, int, str], ErasureCoder] = {}
         # read-path degradation hook (MRF heal-on-read, reference cmd/mrf.go)
         self.on_degraded = None
         self._bucket_cache: dict[str, float] = {}
@@ -185,11 +190,24 @@ class ErasureSet:
 
     # -- helpers -----------------------------------------------------------
 
-    def coder(self, d: int, p: int) -> ErasureCoder:
-        key = (d, p)
+    def coder(self, d: int, p: int, family: str = "reedsolomon") -> ErasureCoder:
+        key = (d, p, family)
         if key not in self._coders:
-            self._coders[key] = ErasureCoder(d, p)
+            self._coders[key] = ErasureCoder(d, p, family=family)
         return self._coders[key]
+
+    def coder_for(self, fi: FileInfo) -> ErasureCoder:
+        """Codec for a STORED object: every decode/heal path dispatches
+        on the family recorded in its xl.meta, so objects written under
+        different MINIO_TPU_EC_FAMILY settings coexist on one set. An
+        unknown family string raises the typed UnknownErasureFamily
+        (never a misread frame)."""
+        family = bitrot_io.check_family(
+            fi.erasure.algorithm or bitrot_io.FAMILY_RS
+        )
+        return self.coder(
+            fi.erasure.data_blocks, fi.erasure.parity_blocks, family
+        )
 
     def _hedge_budget_s(self) -> float | None:
         """Straggler budget for hedged shard reads, or None when hedging
@@ -332,13 +350,16 @@ class ErasureSet:
         distribution: list[int] | None = None,
         allow_inline: bool = True,
         check_precond=None,
+        family: str | None = None,
     ) -> ObjectInfo:
         """distribution/allow_inline overrides serve the multipart plane:
         all parts of an upload must share the final object's shard layout
         and be rename-able files (never inline). check_precond(current
         ObjectInfo | None) runs UNDER the namespace write lock — the
         conditional-write hook (PUT If-Match / If-None-Match, reference
-        checkPreconditionsPUT) with no TOCTOU window."""
+        checkPreconditionsPUT) with no TOCTOU window. ``family`` picks
+        the erasure code family (per-storage-class mapping in the S3
+        layer); None uses MINIO_TPU_EC_FAMILY."""
         if not self.bucket_exists(bucket) and not bucket.startswith(".minio.sys"):
             raise BucketNotFound(bucket)
         with obs.span(
@@ -372,6 +393,7 @@ class ErasureSet:
                 oi = self._put_object_locked(
                     bucket, obj, data, user_defined, version_id, versioned,
                     parity, distribution, allow_inline, lock=mtx,
+                    family=family,
                 )
             finally:
                 mtx.unlock()
@@ -397,24 +419,29 @@ class ErasureSet:
         distribution: list[int] | None,
         allow_inline: bool,
         lock=None,
+        family: str | None = None,
     ) -> ObjectInfo:
+        family = family or default_ec_family()
         if not isinstance(data, (bytes, bytearray, memoryview)):
             return self._put_object_streaming(
                 bucket, obj, data, user_defined, version_id, versioned,
-                parity, distribution, lock=lock,
+                parity, distribution, lock=lock, family=family,
             )
         p = self.default_parity if parity is None else parity
         d = self.n - p
         if (
             len(data) > INLINE_DATA_THRESHOLD
+            and family == bitrot_io.FAMILY_RS
             and _native_plane_enabled(self.coder(d, p).device_active)
             and all(dk.local_path(TMP_VOLUME, "x") is not None for dk in self.disks)
         ):
             # large buffered bodies (signed-payload PUTs) also take the
-            # native C++ pass; small ones keep the inline fast path
+            # native C++ pass; small ones keep the inline fast path.
+            # (The native plane speaks the single-frame reedsolomon
+            # format only; other families stream through the coder.)
             return self._put_object_streaming(
                 bucket, obj, iter([data]), user_defined, version_id, versioned,
-                parity, distribution, lock=lock,
+                parity, distribution, lock=lock, family=family,
             )
         write_q = d + 1 if d == p else d
 
@@ -428,7 +455,7 @@ class ErasureSet:
         etag = hashlib.md5(data).hexdigest()
         fi.metadata.setdefault("etag", etag)
         fi.erasure = ErasureInfo(
-            algorithm="reedsolomon",
+            algorithm=family,
             data_blocks=d,
             parity_blocks=p,
             block_size=BLOCK_SIZE,
@@ -437,7 +464,7 @@ class ErasureSet:
         )
         fi.parts = [ObjectPartInfo(1, len(data), len(data), fi.mod_time, etag)]
 
-        encoded = self.coder(d, p).encode_part(data)
+        encoded = self.coder(d, p, family).encode_part(data)
         if lock is not None and lock.lost:
             raise QuorumError(f"write lock on {bucket}/{obj} lost; aborting")
         inline = allow_inline and len(data) <= INLINE_DATA_THRESHOLD
@@ -502,6 +529,7 @@ class ErasureSet:
         parity: int | None,
         distribution: list[int] | None,
         lock=None,
+        family: str | None = None,
     ) -> ObjectInfo:
         """Bounded-memory PUT: encode batches of stripe blocks as they
         arrive and append shard-file chunks to each drive's staged part
@@ -509,6 +537,7 @@ class ErasureSet:
         block-by-block through a ring buffer,
         /root/reference/cmd/bitrot-streaming.go:108-133). Never inlines.
         """
+        family = family or default_ec_family()
         p = self.default_parity if parity is None else parity
         d = self.n - p
         write_q = d + 1 if d == p else d
@@ -520,7 +549,7 @@ class ErasureSet:
         fi.mod_time = now_ns()
         fi.metadata = dict(user_defined or {})
         fi.erasure = ErasureInfo(
-            algorithm="reedsolomon",
+            algorithm=family,
             data_blocks=d,
             parity_blocks=p,
             block_size=BLOCK_SIZE,
@@ -530,7 +559,7 @@ class ErasureSet:
         fi.data_dir = str(uuid.uuid4())
         tmp_id = str(uuid.uuid4())
         stage = f"{tmp_id}/{fi.data_dir}/part.1"
-        coder = self.coder(d, p)
+        coder = self.coder(d, p, family)
         md5 = hashlib.md5()
         size = 0
         # a drive that fails once stops receiving appends (its staged file
@@ -553,8 +582,12 @@ class ErasureSet:
         renamed = False  # whether any rename_data may have landed
         stream_cap = int(os.environ.get("MINIO_TPU_STREAM_BATCH_MB", "64")) << 20
         # native C++ single-pass plane when every drive is local + healthy
+        # (reedsolomon framing only — sub-packetized families stream
+        # through the coder's python/device path)
         native_paths: list[str] | None = None
-        if _native_plane_enabled(coder.device_active) and all(
+        if family == bitrot_io.FAMILY_RS and _native_plane_enabled(
+            coder.device_active
+        ) and all(
             e is None for e in errs
         ):
             native_paths = [""] * self.n
@@ -677,6 +710,14 @@ class ErasureSet:
                 errs[i] = OSError("native shard write failed")
         if sum(e is None for e in errs) < write_q:
             raise QuorumError("write quorum lost")
+        if size:
+            # the native plane bypasses the coder, so count its stripe
+            # blocks here — the per-family encode series must reflect
+            # RS traffic served by C++ too
+            family_stats_add(
+                bitrot_io.FAMILY_RS, "encode_blocks",
+                -(-size // coder.block_size),
+            )
         return etag, size
 
     def _sweep_staging(self, tmp_id: str, disks) -> None:
@@ -808,6 +849,7 @@ class ErasureSet:
         with obs.span(
             obs.TYPE_TPU, "stripe.read-verify",
             bucket=bucket, object=obj, offset=offset, bytes=length,
+            family=fi.erasure.algorithm or "reedsolomon",
         ):
             yield from self._read_range_inner(
                 bucket, obj, fi, metas, offset, length, seg_sink
@@ -840,7 +882,9 @@ class ErasureSet:
         if length == 0:
             return
         d = fi.erasure.data_blocks
-        coder = self.coder(d, fi.erasure.parity_blocks)
+        coder = self.coder_for(fi)  # typed rejection of unknown families
+        family = coder.family
+        fdig = coder.frame_digests * DIGEST  # digest bytes per block frame group
         sources = self._shard_sources(fi, metas)
         bad: set[int] = set()
         degraded_reported = False
@@ -893,19 +937,122 @@ class ErasureSet:
             disk, m = sources[idx]
             wf = _whole_file_hash(m, part_num)
             if wf is not None:
-                block_i = f_off // (DIGEST + coder.shard_size)
+                block_i = f_off // (fdig + coder.shard_size)
                 data = read_whole_shard(idx, part_num, *wf)
                 blk = data[block_i * coder.shard_size:][:per]
                 if len(blk) != per:
                     raise errors.FileCorrupt("short whole-file shard")
                 return blk
             if m.inline_data:
-                buf = m.inline_data[f_off : f_off + DIGEST + per]
+                buf = m.inline_data[f_off : f_off + fdig + per]
             else:
                 buf = disk.read_file(
-                    bucket, f"{obj}/{fi.data_dir}/part.{part_num}", f_off, DIGEST + per
+                    bucket, f"{obj}/{fi.data_dir}/part.{part_num}", f_off, fdig + per
                 )
-            return bitrot_io.verify_block(buf, per)
+            return bitrot_io.verify_block(buf, per, family=family)
+
+        def read_sub_chunk(
+            part_num: int, idx: int, per: int, f_off: int, which: int
+        ) -> np.ndarray:
+            """Partial-repair read unit: ONE digest||sub-chunk frame of a
+            sub-packetized shard block (the other half never moves)."""
+            disk, m = sources[idx]
+            rel, dlen = bitrot_io.sub_chunk_in_block(per, which)
+            off = f_off + rel
+            if m.inline_data:
+                buf = m.inline_data[off : off + DIGEST + dlen]
+            else:
+                buf = disk.read_file(
+                    bucket, f"{obj}/{fi.data_dir}/part.{part_num}",
+                    off, DIGEST + dlen,
+                )
+            return np.frombuffer(
+                bitrot_io.verify_sub_chunk(bytes(buf), dlen), dtype=np.uint8
+            )
+
+        # ---- partial-repair plan: sub-packetized family, exactly one ----
+        # data shard gone, every helper present — degraded reads fetch
+        # the repair fraction instead of d full shards (ops/cauchy.py
+        # schedule; any failure inside the plan falls back to the
+        # generic full-gather path below, correctness never rides it)
+        repair_sched = None
+        if family == bitrot_io.FAMILY_CAUCHY and not any(
+            c.hash for c in fi.erasure.checksums
+        ):
+            missing_data = [i for i in range(d) if i not in sources]
+            if len(missing_data) == 1:
+                sched = coder.repair_schedule(missing_data[0])
+                if sched is not None and all(
+                    h in sources for h in sched.helpers
+                ):
+                    repair_sched = sched
+
+        def repair_read_block(
+            pnum: int, per: int, f_off: int, lo: int, hi: int
+        ) -> bytes:
+            """Serve [lo, hi) of one stripe block under the repair plan:
+            full frames only for the data shards the range needs, the
+            schedule's sub-chunk frames to rebuild the lost one."""
+            i_m = repair_sched.missing
+            lo_sh, hi_sh = lo // per, (hi - 1) // per
+            needed = list(range(lo_sh, min(hi_sh, d - 1) + 1))
+            ingress = 0
+            full_idx = set(idx for idx in needed if idx != i_m)
+            if i_m in needed:
+                # every group mate is also a b_helper, so it needs BOTH
+                # sub-chunks — one contiguous frame-group read moves the
+                # same bytes as two sub-chunk reads with half the
+                # round-trips
+                full_idx.update(repair_sched.mates)
+            full_futs = {
+                idx: pool.submit(read_shard_block, pnum, idx, per, f_off)
+                for idx in full_idx
+            }
+            sub_futs = {}
+            if i_m in needed:
+                for r in repair_sched.b_helpers:
+                    if r not in full_futs:
+                        sub_futs[(r, 1)] = pool.submit(
+                            read_sub_chunk, pnum, r, per, f_off, 1
+                        )
+                sub_futs[(repair_sched.pb_parity, 1)] = pool.submit(
+                    read_sub_chunk, pnum, repair_sched.pb_parity, per, f_off, 1
+                )
+            try:
+                got_full = {
+                    idx: np.frombuffer(f.result(), dtype=np.uint8)
+                    for idx, f in full_futs.items()
+                }
+            except BaseException:
+                # a failed full read fails the plan (caller falls back to
+                # the generic gather): don't leave sub-chunk reads queued
+                for f in sub_futs.values():
+                    f.cancel()
+                raise
+            if i_m in needed:
+                # same semantics as the generic path's counter: EVERY
+                # frame fetched for a block that needs reconstruction —
+                # full frames the range needed anyway included — so the
+                # per-family comparison stays apples-to-apples
+                ingress += len(got_full) * (fdig + per)
+                h1, h2 = bitrot_io.sub_lens(per)
+                sub2 = {}
+                for r in repair_sched.b_helpers:
+                    sub2[r] = (
+                        got_full[r][h1:] if r in got_full
+                        else sub_futs[(r, 1)].result()
+                    )
+                    ingress += DIGEST + h2 if r not in got_full else 0
+                pb = sub_futs[(repair_sched.pb_parity, 1)].result()
+                ingress += DIGEST + h2
+                # mates were fetched as full frame groups above
+                sub1 = {r: got_full[r][:h1] for r in repair_sched.mates}
+                got_full[i_m] = coder.repair_data_shard(
+                    repair_sched, per, sub2, pb, sub1
+                )
+                family_stats_add(family, "degraded_ingress_bytes", ingress)
+            out = b"".join(got_full[idx].tobytes() for idx in needed)
+            return out[lo - lo_sh * per : hi - lo_sh * per]
 
         # ---- plan: every stripe block overlapping [offset, offset+length) ----
         plan: list[tuple[int, int, int, int, int]] = []  # (part#, per, f_off, lo, hi)
@@ -927,7 +1074,9 @@ class ErasureSet:
                 lo = max(offset - bpos, 0)
                 hi = min(lo + remaining, data_len)
                 if hi > lo:
-                    f_off = bitrot_io.block_offset(coder.shard_size, block_i)
+                    f_off = bitrot_io.block_offset(
+                        coder.shard_size, block_i, family
+                    )
                     plan.append((part.number, per, f_off, lo, hi))
                     remaining -= hi - lo
                 bpos += data_len
@@ -937,7 +1086,8 @@ class ErasureSet:
         # One C++ pass per span does pread + bitrot verify + window assembly
         # (native/dataplane.cpp dp_get_span); any failure falls back to the
         # reconstructing windowed path below for the remaining plan.
-        if plan and _native_plane_enabled() and all(
+        # reedsolomon framing only: dp_get_span walks digest||block frames.
+        if plan and family == bitrot_io.FAMILY_RS and _native_plane_enabled() and all(
             i in sources and not sources[i][1].inline_data
             and not any(c.hash for c in sources[i][1].erasure.checksums)
             for i in range(d)
@@ -997,7 +1147,7 @@ class ErasureSet:
                     # rejected there); bytes are post-verify, same as the
                     # reconstructing path's fills
                     o = 0
-                    frame = DIGEST + coder.shard_size
+                    frame = fdig + coder.shard_size
                     for pnum_s, _per_s, f_off_s, lo_s, hi_s in span:
                         if lo_s == 0:
                             seg_sink(
@@ -1153,6 +1303,13 @@ class ErasureSet:
                 if present == tuple(range(d)):
                     out[bi] = b"".join(got[bi][i] for i in range(d))
                 else:
+                    # survivor ingress: every frame fetched for a block
+                    # that needs reconstruction (the full-shard cost the
+                    # repair plan above avoids)
+                    family_stats_add(
+                        family, "degraded_ingress_bytes",
+                        len(got[bi]) * (fdig + win[bi][1]),
+                    )
                     # group by (pattern, shard size): the tail block's per
                     # differs from full blocks and cannot share a stack
                     groups.setdefault((present, win[bi][1]), []).append(bi)
@@ -1172,6 +1329,27 @@ class ErasureSet:
                     out[bi] = b"".join(shards[i] for i in range(d))
             return out  # type: ignore[return-value]
 
+        # ---- repair-plan execution: sub-chunk reads, block by block ----
+        if repair_sched is not None:
+            rest = None
+            for k, (pnum, per, f_off, lo, hi) in enumerate(plan):
+                try:
+                    piece = repair_read_block(pnum, per, f_off, lo, hi)
+                except (errors.FileCorrupt, errors.FileNotFound,
+                        errors.DiskNotFound, errors.DiskFull,
+                        errors.VolumeNotFound, OSError):
+                    # a helper failed mid-plan (second fault, bitrot):
+                    # the rest of the range takes the generic gather
+                    # path, which discovers and spills around failures
+                    # itself — partial repair is an optimization, never
+                    # a correctness dependency
+                    rest = plan[k:]
+                    break
+                yield piece
+            if rest is None:
+                return
+            plan = rest
+
         # ---- pipelined execution: window k+1 reads under window k decode ----
         windows = [plan[i : i + window] for i in range(0, len(plan), window)]
         futs = start_window(windows[0]) if windows else {}
@@ -1189,7 +1367,7 @@ class ErasureSet:
                         # so even a partial-range request fills whole
                         # verified segments
                         seg_sink(
-                            pnum, f_off // (DIGEST + coder.shard_size),
+                            pnum, f_off // (fdig + coder.shard_size),
                             block,
                         )
                     yield block[lo:hi]
@@ -1374,8 +1552,9 @@ class ErasureSet:
             )
             if fi.deleted or not fi.metadata.get(TRANSITION_TIER_META):
                 raise ObjectNotFound(f"{bucket}/{obj} is not transitioned")
-            d, p = fi.erasure.data_blocks, fi.erasure.parity_blocks
-            encoded = self.coder(d, p).encode_part(data)
+            # restored shards keep the object's STORED family: its
+            # xl.meta algorithm field survives the restore round-trip
+            encoded = self.coder_for(fi).encode_part(data)
             nfi = FileInfo.from_dict(fi.to_dict())
             nfi.data_dir = str(uuid.uuid4())
             nfi.parts = [
@@ -1467,7 +1646,11 @@ class ErasureSet:
                 raise QuorumError(f"namespace lock timeout healing {bucket}/{obj}")
             try:
                 res = self._heal_object_locked(bucket, obj, version_id, lock=mtx)
-                hsp.set(healed=len(res.get("healed", [])))
+                hsp.set(
+                    healed=len(res.get("healed", [])),
+                    family=res.get("family", ""),
+                    ingressBytes=res.get("ingressBytes", 0),
+                )
             finally:
                 mtx.unlock()
             if res.get("healed"):
@@ -1503,7 +1686,9 @@ class ErasureSet:
             return {"healed": healed, "type": "delete-marker"}
 
         d, p = fi.erasure.data_blocks, fi.erasure.parity_blocks
-        coder = self.coder(d, p)
+        coder = self.coder_for(fi)  # stored family; unknown -> typed error
+        family = coder.family
+        fdig = coder.frame_digests * DIGEST
         sources = self._shard_sources(fi, metas)
 
         # verify the shards we think are good; drop any that fail bitrot
@@ -1538,8 +1723,12 @@ class ErasureSet:
         missing_idx = tuple(sorted(idx for idx, _ in stale))
 
         heal_whole_cache: dict[tuple[int, int], bytes] = {}
+        # survivor bytes moved into this heal (the repair-bandwidth
+        # number: metrics minio_heal_ingress_bytes_total, heal span)
+        ingress = 0
 
         def read_block(part, idx, f_off, per):
+            nonlocal ingress
             disk, m = good[idx]
             wf = _whole_file_hash(m, part.number)
             if wf is not None:  # legacy whole-file survivor
@@ -1548,27 +1737,62 @@ class ErasureSet:
                     raw = m.inline_data if m.inline_data else disk.read_file(
                         bucket, f"{obj}/{fi.data_dir}/part.{part.number}", 0, -1
                     )
+                    ingress += len(raw)
                     heal_whole_cache[k] = bitrot_io.verify_whole_file(
                         bytes(raw), *wf
                     )
-                block_i = f_off // (DIGEST + coder.shard_size)
+                block_i = f_off // (fdig + coder.shard_size)
                 blk = heal_whole_cache[k][block_i * coder.shard_size:][:per]
                 if len(blk) != per:
                     raise errors.FileCorrupt("short whole-file shard")
                 return blk
             if m.inline_data:
-                buf = m.inline_data[f_off : f_off + DIGEST + per]
+                buf = m.inline_data[f_off : f_off + fdig + per]
             else:
                 buf = disk.read_file(
                     bucket, f"{obj}/{fi.data_dir}/part.{part.number}",
-                    f_off, DIGEST + per,
+                    f_off, fdig + per,
                 )
-            return bitrot_io.verify_block(buf, per)
+            ingress += len(buf)
+            return bitrot_io.verify_block(buf, per, family=family)
+
+        def read_sub(part, idx, f_off, per, which):
+            """Sub-chunk frame read from a survivor (partial repair)."""
+            nonlocal ingress
+            disk, m = good[idx]
+            rel, dlen = bitrot_io.sub_chunk_in_block(per, which)
+            off = f_off + rel
+            if m.inline_data:
+                buf = m.inline_data[off : off + DIGEST + dlen]
+            else:
+                buf = disk.read_file(
+                    bucket, f"{obj}/{fi.data_dir}/part.{part.number}",
+                    off, DIGEST + dlen,
+                )
+            ingress += len(buf)
+            return np.frombuffer(
+                bitrot_io.verify_sub_chunk(bytes(buf), dlen), dtype=np.uint8
+            )
 
         # healed shards keep the OBJECT's format: streaming objects get
-        # digest||block frames, legacy whole-file objects raw bytes plus a
-        # fresh metadata digest (the reference heals legacy in kind too)
+        # family-framed digest||block records, legacy whole-file objects
+        # raw bytes plus a fresh metadata digest (healed in kind)
         whole = any(c.hash for c in fi.erasure.checksums)
+
+        # partial-repair plan: ONE stale data shard of a sub-packetized
+        # family rebuilds from the schedule's sub-chunk reads — the
+        # direct lever on survivor bytes moved (ROADMAP item 2). Any
+        # read failure falls back to the generic full-read rebuild.
+        repair_sched = None
+        if (
+            family == bitrot_io.FAMILY_CAUCHY
+            and not whole
+            and len(stale) == 1
+            and stale[0][0] < d
+        ):
+            sched = coder.repair_schedule(stale[0][0])
+            if sched is not None and all(h in good for h in sched.helpers):
+                repair_sched = sched
 
         for part in fi.parts:
             geometry = coder.shard_sizes_for(part.size)
@@ -1581,12 +1805,56 @@ class ErasureSet:
 
             use_device = (
                 coder._jax is not None
+                and family == bitrot_io.FAMILY_RS
                 and full_n >= 4
                 and not fi.inline_data
                 and not whole  # device path emits streaming frames only
                 and _os.environ.get("MINIO_TPU_DEVICE_HEAL", "0") == "1"
             )
             batched_done = 0
+            if repair_sched is not None:
+                s_idx = repair_sched.missing
+                try:
+                    for block_i, (data_len, per) in enumerate(geometry):
+                        f_off = bitrot_io.block_offset(
+                            coder.shard_size, block_i, family
+                        )
+                        # group mates need BOTH sub-chunks (every mate is
+                        # a b_helper): one full frame-group read each —
+                        # same bytes as two sub-chunk reads, half the ops
+                        h1m, _h2m = bitrot_io.sub_lens(per)
+                        mate_full = {
+                            r: np.frombuffer(
+                                read_block(part, r, f_off, per),
+                                dtype=np.uint8,
+                            )
+                            for r in repair_sched.mates
+                        }
+                        sub2 = {
+                            r: (
+                                mate_full[r][h1m:] if r in mate_full
+                                else read_sub(part, r, f_off, per, 1)
+                            )
+                            for r in repair_sched.b_helpers
+                        }
+                        pb = read_sub(
+                            part, repair_sched.pb_parity, f_off, per, 1
+                        )
+                        sub1 = {r: v[:h1m] for r, v in mate_full.items()}
+                        blk = coder.repair_data_shard(
+                            repair_sched, per, sub2, pb, sub1
+                        )
+                        rebuilt[s_idx] += bitrot_io.frame_block(
+                            blk.tobytes(), family
+                        )
+                    per_part_rebuilt[part.number] = rebuilt
+                    continue
+                except (StorageError, OSError):
+                    # helper failed mid-repair: rebuild THIS part the
+                    # generic way (and stop trying the shortcut — the
+                    # helper set just proved unreliable)
+                    repair_sched = None
+                    rebuilt = {idx: bytearray() for idx, _ in stale}
             if use_device:
                 from ..ops.bitrot_jax import reconstruct_and_hash
 
@@ -1620,7 +1888,7 @@ class ErasureSet:
             for block_i, (data_len, per) in enumerate(geometry):
                 if block_i < batched_done:
                     continue
-                f_off = bitrot_io.block_offset(coder.shard_size, block_i)
+                f_off = bitrot_io.block_offset(coder.shard_size, block_i, family)
                 got: dict[int, np.ndarray] = {}
                 for idx in survivors_idx:
                     got[idx] = np.frombuffer(
@@ -1630,11 +1898,13 @@ class ErasureSet:
                 for idx, _ in stale:
                     blk = rec[idx].tobytes()
                     if not whole:
-                        rebuilt[idx] += fast_hash256(blk)
-                    rebuilt[idx] += blk
+                        rebuilt[idx] += bitrot_io.frame_block(blk, family)
+                    else:
+                        rebuilt[idx] += blk
             per_part_rebuilt[part.number] = rebuilt
         if lock is not None and lock.lost:
             raise QuorumError(f"heal lock on {bucket}/{obj} lost; aborting commit")
+        family_stats_add(family, "heal_ingress_bytes", ingress)
         healed = []
         tmp_id = str(uuid.uuid4())
         for shard_idx, disk in stale:
@@ -1674,14 +1944,21 @@ class ErasureSet:
                 # heal is per-drive best-effort, but staged parts on the
                 # failed drive must not outlive the attempt
                 self._sweep_staging(tmp_id, [disk])
-        return {"healed": healed, "type": "object"}
+        return {
+            "healed": healed, "type": "object", "family": family,
+            "ingressBytes": ingress,
+            "partialRepair": repair_sched is not None,
+        }
 
     def _verify_inline(self, m: FileInfo, coder: ErasureCoder) -> None:
         data = m.inline_data or b""
+        fdig = coder.frame_digests * DIGEST
         off = 0
         for _, per in coder.shard_sizes_for(m.size):
-            bitrot_io.verify_block(data[off : off + DIGEST + per], per)
-            off += DIGEST + per
+            bitrot_io.verify_block(
+                data[off : off + fdig + per], per, family=coder.family
+            )
+            off += fdig + per
 
     # -- misc --------------------------------------------------------------
 

@@ -133,7 +133,8 @@ class TpuDispatcher:
         self._device_fault_streak = 0
         self._probe_countdown = self._probe_after
         self._shape = f"{codec.data_shards}+{codec.parity_shards}"
-        self._np_codec = None  # lazy: numpy rung only pays when reached
+        # lazy per-family numpy codecs: the rung only pays when reached
+        self._np_codec: dict[str, object] = {}
         self._cv = threading.Condition()
         # lanes hold (blocks, fut, priority, t_enqueue); unconsumed items
         # stay at the head, so no separate carry slot is needed
@@ -183,28 +184,39 @@ class TpuDispatcher:
                 for k, v in self.stats.items()
             }
 
-    def submit(self, blocks: np.ndarray, priority: int | None = None) -> Future:
-        """blocks: [k, d, n] -> Future of (shards [k, t, n], digests [k, t, 32]).
+    def submit(
+        self, blocks: np.ndarray, priority: int | None = None, codec=None
+    ) -> Future:
+        """blocks: [k, d, n] -> Future of (shards [k, t, n], digests).
 
         priority: PRI_FOREGROUND / PRI_BACKGROUND; None resolves from the
         qos context (background planes run under ``background_context()``).
+
+        codec: the family codec encoding this entry (defaults to the
+        dispatcher's founding reedsolomon codec). Both code families ride
+        ONE queue stream — each batch entry carries its family tag, and
+        the dispatch loop groups same-family entries into shared device
+        calls. Digest shape is the family's: [k, t, 32] for reedsolomon,
+        [k, t, 2, 32] (per sub-chunk) for cauchy.
         """
         if priority is None:
             priority = current_priority()
+        if codec is None:
+            codec = self.codec
         fut: Future = Future()
         # request id captured at submit time (contextvar — costs one read
         # only while someone is tracing) so the batch record can name the
         # requests it served
         req_id = obs.current_request_id() if obs.active() else ""
         item = (blocks, fut, priority, _monotonic(), req_id,
-                priority == PRI_BACKGROUND and in_prefetch())
+                priority == PRI_BACKGROUND and in_prefetch(), codec)
         with self._cv:
             (self._bg if priority == PRI_BACKGROUND else self._fg).append(item)
             self._cv.notify()
         return fut
 
-    def encode(self, blocks: np.ndarray, priority: int | None = None):
-        return self.submit(blocks, priority).result()
+    def encode(self, blocks: np.ndarray, priority: int | None = None, codec=None):
+        return self.submit(blocks, priority, codec).result()
 
     # -- worker ------------------------------------------------------------
 
@@ -406,200 +418,222 @@ class TpuDispatcher:
         except Exception:  # noqa: BLE001 — device still gone
             return False
 
-    def _encode_numpy(self, blocks: np.ndarray):
+    def _encode_numpy(self, blocks: np.ndarray, family: str = "reedsolomon"):
         """Pure-CPU rung: numpy GF parity + numpy HighwayHash digests,
         byte-identical to the device rungs (golden tests pin all three).
-        [k, d, n] -> (parity [k, p, n], digests [k, d+p, 32])."""
-        if self._np_codec is None:
-            from ..ops.rs import get_codec
-
-            self._np_codec = get_codec(
+        [k, d, n] -> (shards [k, t, n], family-shaped digests)."""
+        ref = self._np_codec.get(family)
+        if ref is None:
+            if family == "cauchy":
+                from ..ops.cauchy import get_codec
+            else:
+                from ..ops.rs import get_codec
+            ref = self._np_codec[family] = get_codec(
                 self.codec.data_shards, self.codec.parity_shards
             )
-        from ..ops import gf
-        from ..ops.highwayhash import hash256_batch_numpy
+        from ..erasure.coder import encode_blocks_numpy
 
-        ref = self._np_codec
-        k, d, n = blocks.shape
-        p = self.codec.parity_shards
-        parity = np.empty((k, p, n), dtype=np.uint8)
-        digests = np.empty((k, d + p, 32), dtype=np.uint8)
-        for i in range(k):
-            parity[i] = gf.gf_matvec_blocks(ref.parity_matrix, blocks[i])
-            digests[i] = hash256_batch_numpy(
-                np.concatenate([blocks[i], parity[i]], axis=0)
-            )
-        return parity, digests
+        return encode_blocks_numpy(ref, blocks, family)
 
     def _loop(self) -> None:
         while True:
             batch = self._collect()
             t_start = _monotonic()
-            # per-item queue wait: submit -> dispatch start
-            max_wait = 0.0
+            # per-item queue wait: submit -> dispatch start (each family
+            # group recomputes its own max for the obs record)
             with self._cv:
                 for it in batch:
                     wait = max(t_start - it[3], 0.0)
-                    max_wait = max(max_wait, wait)
                     self.stats["queue_wait_s"] += wait
                     _hist_add(
                         self.stats["queue_wait_hist"], QUEUE_WAIT_BUCKETS,
                         wait,
                     )
-            try:
-                all_blocks = np.concatenate([it[0] for it in batch], axis=0)
-                # malformed input is a CALLER error: it must propagate to
-                # the waiters, never count as a device fault or get
-                # "served degraded" by the numpy rung
-                if all_blocks.shape[1] != self.codec.data_shards:
-                    raise ValueError(
-                        f"blocks have d={all_blocks.shape[1]}, codec "
-                        f"expects {self.codec.data_shards}"
-                    )
-                k = all_blocks.shape[0]
-                bucket = self._bucket(k)
-                if bucket < 16 and self._fused_enabled and self._fused_cooldown == 0:
-                    from ..ops import fused_pallas as fp
+            # ONE stream, two families: entries carry their family tag;
+            # same-family entries fuse into shared device calls, and a
+            # mixed batch dispatches as consecutive per-family groups
+            # (matrix weights differ — they cannot share one matmul).
+            groups: dict[str, list[tuple]] = {}
+            for it in batch:
+                groups.setdefault(
+                    getattr(it[6], "family", "reedsolomon"), []
+                ).append(it)
+            for family, items in groups.items():
+                self._dispatch_group(items, family)
 
-                    # low-concurrency batches pad up to the mega-kernel's
-                    # floor rather than losing the fused path (VERDICT r2)
-                    if fp.supports(
-                        all_blocks.shape[1], self.codec.parity_shards, 16,
-                        all_blocks.shape[2],
-                    ):
-                        bucket = 16
-                if bucket != k:
-                    pad = np.zeros(
-                        (bucket - k, *all_blocks.shape[1:]), dtype=np.uint8
-                    )
-                    all_blocks = np.concatenate([all_blocks, pad], axis=0)
-                level = self.stats["backend_level"]
-                if level == LEVEL_NUMPY:
-                    # degraded: traffic serves on CPU; every probe_after
-                    # dispatches a synthetic batch probes the device and
-                    # re-promotes on success
-                    self._probe_countdown -= 1
-                    if self._probe_countdown <= 0:
-                        if self._probe_device():
-                            level = LEVEL_XLA
-                            with self._cv:
-                                self.stats["backend_level"] = level
-                                self.stats["promotions"] += 1
-                            self._device_fault_streak = 0
-                            fault_registry.emit(
-                                "backend.promote", shape=self._shape
-                            )
-                        else:
-                            self._probe_countdown = self._probe_after
-                was_fused = False
-                parity = digests = None
-                # device_s covers ONLY time spent against the device
-                # (successful or faulted attempts) — the numpy rung and
-                # the probe are host work and land in host_s, so the
-                # host-vs-device split stays honest in degraded mode
-                device_s = 0.0
-                if level != LEVEL_NUMPY:
-                    t_dev = _monotonic()
-                    try:
-                        self._tpu_fault_hook()
-                        fused = self._fused_cm(all_blocks)
-                        was_fused = fused is not None
-                        if fused is None:
-                            # don't pay mega-kernel padding (16) on the XLA
-                            # path: trim back to the power-of-two bucket
-                            nb = self._bucket(k)
-                            if nb < all_blocks.shape[0]:
-                                all_blocks = all_blocks[:nb]
-                            fused = self._encode_and_hash(self.codec, all_blocks)
-                        parity, digests = fused
-                        # np.asarray is the device sync point: execute + D2H
-                        # land inside the device window, fan-out is host time
-                        parity = np.asarray(parity)[:k]
-                        digests = np.asarray(digests)[:k]
-                        self._device_fault_streak = 0
-                        # gauge semantics: XLA is a DEGRADATION signal only
-                        # when the fused rung is faulted out (cooldown); a
-                        # benign fused skip (unsupported shape, big bucket,
-                        # MINIO_TPU_FUSED_CM=0) reads healthy
+    def _dispatch_group(self, batch: list[tuple], family: str) -> None:
+        t_start = _monotonic()
+        try:
+            codec = batch[0][6]
+            max_wait = max(
+                (max(t_start - it[3], 0.0) for it in batch), default=0.0
+            )
+            all_blocks = np.concatenate([it[0] for it in batch], axis=0)
+            # malformed input is a CALLER error: it must propagate to
+            # the waiters, never count as a device fault or get
+            # "served degraded" by the numpy rung
+            if all_blocks.shape[1] != self.codec.data_shards:
+                raise ValueError(
+                    f"blocks have d={all_blocks.shape[1]}, codec "
+                    f"expects {self.codec.data_shards}"
+                )
+            k = all_blocks.shape[0]
+            bucket = self._bucket(k)
+            fusable = family == "reedsolomon"  # mega-kernel weights are RS
+            if (
+                bucket < 16 and fusable and self._fused_enabled
+                and self._fused_cooldown == 0
+            ):
+                from ..ops import fused_pallas as fp
+
+                # low-concurrency batches pad up to the mega-kernel's
+                # floor rather than losing the fused path (VERDICT r2)
+                if fp.supports(
+                    all_blocks.shape[1], self.codec.parity_shards, 16,
+                    all_blocks.shape[2],
+                ):
+                    bucket = 16
+            if bucket != k:
+                pad = np.zeros(
+                    (bucket - k, *all_blocks.shape[1:]), dtype=np.uint8
+                )
+                all_blocks = np.concatenate([all_blocks, pad], axis=0)
+            level = self.stats["backend_level"]
+            if level == LEVEL_NUMPY:
+                # degraded: traffic serves on CPU; every probe_after
+                # dispatches a synthetic batch probes the device and
+                # re-promotes on success
+                self._probe_countdown -= 1
+                if self._probe_countdown <= 0:
+                    if self._probe_device():
+                        level = LEVEL_XLA
                         with self._cv:
-                            if self._fused_cooldown > 0:
-                                self.stats["backend_level"] = LEVEL_XLA
-                            else:
-                                self.stats["backend_level"] = LEVEL_FUSED
-                    # miniovet: ignore[error-taint] -- error-as-value into
-                    # the ladder: _device_fault(e) records the fault,
-                    # demotes past the streak threshold, and the batch is
-                    # re-served byte-identically on the numpy rung below
-                    except Exception as e:  # noqa: BLE001 — serve degraded
-                        # the device rung failed mid-batch: waiters get
-                        # numpy results instead of errors, the ladder
-                        # counts the fault and demotes past the threshold
-                        self._device_fault(e)
-                        was_fused = False
-                        parity = None
-                    device_s = _monotonic() - t_dev
-                if parity is None:
-                    parity, digests = self._encode_numpy(all_blocks[:k])
-                    with self._cv:
-                        self.stats["numpy_blocks"] += k
-                shards = np.concatenate(
-                    [all_blocks[:k], parity], axis=1
-                )  # [B, t, n]
-                occupancy = 100.0 * k / max(all_blocks.shape[0], 1)
-                with self._cv:
-                    self.stats["dispatches"] += 1
-                    self.stats["blocks"] += k
-                    self.stats["max_batch"] = max(self.stats["max_batch"], k)
-                    self.stats["occupancy_pct_sum"] += occupancy
-                    self.stats["device_s"] += device_s
-                    _hist_add(
-                        self.stats["device_time_hist"], DEVICE_TIME_BUCKETS,
-                        device_s,
-                    )
-                    for it in batch:
-                        kk = it[0].shape[0]
-                        if it[2] == PRI_BACKGROUND:
-                            self.stats["bg_blocks"] += kk
-                            if it[5]:
-                                self.stats["prefetch_blocks"] += kk
+                            self.stats["backend_level"] = level
+                            self.stats["promotions"] += 1
+                        self._device_fault_streak = 0
+                        fault_registry.emit(
+                            "backend.promote", shape=self._shape
+                        )
+                    else:
+                        self._probe_countdown = self._probe_after
+            was_fused = False
+            shards = digests = None
+            # device_s covers ONLY time spent against the device
+            # (successful or faulted attempts) — the numpy rung and
+            # the probe are host work and land in host_s, so the
+            # host-vs-device split stays honest in degraded mode
+            device_s = 0.0
+            if level != LEVEL_NUMPY:
+                t_dev = _monotonic()
+                try:
+                    self._tpu_fault_hook()
+                    fused = self._fused_cm(all_blocks) if fusable else None
+                    was_fused = fused is not None
+                    if fused is None:
+                        # don't pay mega-kernel padding (16) on the XLA
+                        # path: trim back to the power-of-two bucket
+                        nb = self._bucket(k)
+                        if nb < all_blocks.shape[0]:
+                            all_blocks = all_blocks[:nb]
+                        if family == "cauchy":
+                            from ..ops.cauchy import encode_and_hash_cauchy
+
+                            fused = encode_and_hash_cauchy(codec, all_blocks)
                         else:
-                            self.stats["fg_blocks"] += kk
-                off = 0
-                for it in batch:
-                    blocks, fut = it[0], it[1]
-                    kk = blocks.shape[0]
-                    fut.set_result(
-                        (shards[off : off + kk], digests[off : off + kk])
-                    )
-                    off += kk
-                host_s = _monotonic() - t_start - device_s
+                            fused = self._encode_and_hash(codec, all_blocks)
+                    parity, digests = fused
+                    # np.asarray is the device sync point: execute + D2H
+                    # land inside the device window, fan-out is host time
+                    parity = np.asarray(parity)[:k]
+                    digests = np.asarray(digests)[:k]
+                    shards = np.concatenate(
+                        [all_blocks[:k], parity], axis=1
+                    )  # [B, t, n]
+                    self._device_fault_streak = 0
+                    # gauge semantics: XLA is a DEGRADATION signal only
+                    # when the fused rung is faulted out (cooldown); a
+                    # benign fused skip (unsupported shape, big bucket,
+                    # MINIO_TPU_FUSED_CM=0, cauchy family) reads healthy
+                    with self._cv:
+                        if self._fused_cooldown > 0:
+                            self.stats["backend_level"] = LEVEL_XLA
+                        else:
+                            self.stats["backend_level"] = LEVEL_FUSED
+                # miniovet: ignore[error-taint] -- error-as-value into
+                # the ladder: _device_fault(e) records the fault,
+                # demotes past the streak threshold, and the batch is
+                # re-served byte-identically on the numpy rung below
+                except Exception as e:  # noqa: BLE001 — serve degraded
+                    # the device rung failed mid-batch: waiters get
+                    # numpy results instead of errors, the ladder
+                    # counts the fault and demotes past the threshold
+                    self._device_fault(e)
+                    was_fused = False
+                    shards = None
+                device_s = _monotonic() - t_dev
+            if shards is None:
+                shards, digests = self._encode_numpy(all_blocks[:k], family)
                 with self._cv:
-                    self.stats["host_s"] += host_s
-                if obs.active():
-                    req_ids = sorted({it[4] for it in batch if it[4]})
-                    obs.publish({
-                        "time": time.time(),
-                        "type": obs.TYPE_TPU,
-                        "name": "dispatch.batch",
-                        "reqId": req_ids[0] if len(req_ids) == 1 else "",
-                        "reqIds": req_ids,
-                        "node": obs.trace.NODE,
-                        "durationNs": int((host_s + device_s) * 1e9),
-                        "deviceNs": int(device_s * 1e9),
-                        "hostNs": int(host_s * 1e9),
-                        "queueWaitMaxNs": int(max_wait * 1e9),
-                        "blocks": k,
-                        "bucket": int(all_blocks.shape[0]),
-                        "occupancyPct": round(occupancy, 1),
-                        "fused": was_fused,
-                        "shape": f"{self.codec.data_shards}+"
-                                 f"{self.codec.parity_shards}",
-                        "error": "",
-                    })
-            except Exception as e:  # noqa: BLE001 — fail all waiters
+                    self.stats["numpy_blocks"] += k
+            from ..erasure.coder import family_stats_add
+
+            family_stats_add(family, "encode_blocks", k)
+            occupancy = 100.0 * k / max(all_blocks.shape[0], 1)
+            with self._cv:
+                self.stats["dispatches"] += 1
+                self.stats["blocks"] += k
+                self.stats["max_batch"] = max(self.stats["max_batch"], k)
+                self.stats["occupancy_pct_sum"] += occupancy
+                self.stats["device_s"] += device_s
+                _hist_add(
+                    self.stats["device_time_hist"], DEVICE_TIME_BUCKETS,
+                    device_s,
+                )
                 for it in batch:
-                    if not it[1].done():
-                        it[1].set_exception(e)
+                    kk = it[0].shape[0]
+                    if it[2] == PRI_BACKGROUND:
+                        self.stats["bg_blocks"] += kk
+                        if it[5]:
+                            self.stats["prefetch_blocks"] += kk
+                    else:
+                        self.stats["fg_blocks"] += kk
+            off = 0
+            for it in batch:
+                blocks, fut = it[0], it[1]
+                kk = blocks.shape[0]
+                fut.set_result(
+                    (shards[off : off + kk], digests[off : off + kk])
+                )
+                off += kk
+            host_s = _monotonic() - t_start - device_s
+            with self._cv:
+                self.stats["host_s"] += host_s
+            if obs.active():
+                req_ids = sorted({it[4] for it in batch if it[4]})
+                obs.publish({
+                    "time": time.time(),
+                    "type": obs.TYPE_TPU,
+                    "name": "dispatch.batch",
+                    "reqId": req_ids[0] if len(req_ids) == 1 else "",
+                    "reqIds": req_ids,
+                    "node": obs.trace.NODE,
+                    "durationNs": int((host_s + device_s) * 1e9),
+                    "deviceNs": int(device_s * 1e9),
+                    "hostNs": int(host_s * 1e9),
+                    "queueWaitMaxNs": int(max_wait * 1e9),
+                    "blocks": k,
+                    "bucket": int(all_blocks.shape[0]),
+                    "occupancyPct": round(occupancy, 1),
+                    "fused": was_fused,
+                    "family": family,
+                    "shape": f"{self.codec.data_shards}+"
+                             f"{self.codec.parity_shards}",
+                    "error": "",
+                })
+        except Exception as e:  # noqa: BLE001 — fail all waiters
+            for it in batch:
+                if not it[1].done():
+                    it[1].set_exception(e)
 
 
 def _monotonic() -> float:

@@ -814,6 +814,30 @@ def _g_api_tpu(server) -> list[str]:
          [({}, ds.get("bg_forced", 0))])
     _fmt(out, "minio_tpu_dispatch_fg_deferred_behind_bg_total", "counter",
          [({}, ds.get("fg_deferred_behind_bg", 0))])
+    # per-code-family plane (erasure/coder.py): encode/decode volume per
+    # family plus the repair-bandwidth counters — heal ingress is THE
+    # number the cauchy family exists to shrink (BENCH_r09 gate)
+    from ..erasure.coder import family_stats_snapshot
+
+    fs = family_stats_snapshot()
+    fams = sorted(fs)
+    _fmt(out, "minio_tpu_encode_blocks_total", "counter",
+         [({"family": f}, fs[f].get("encode_blocks", 0)) for f in fams],
+         "Stripe blocks erasure-encoded per code family")
+    _fmt(out, "minio_tpu_decode_blocks_total", "counter",
+         [({"family": f}, fs[f].get("decode_blocks", 0)) for f in fams],
+         "Stripe blocks reconstructed per code family")
+    _fmt(out, "minio_heal_ingress_bytes_total", "counter",
+         [({"family": f}, fs[f].get("heal_ingress_bytes", 0)) for f in fams],
+         "Survivor bytes read into heal reconstructions per family")
+    _fmt(out, "minio_tpu_degraded_ingress_bytes_total", "counter",
+         [({"family": f}, fs[f].get("degraded_ingress_bytes", 0))
+          for f in fams],
+         "Survivor bytes fetched for degraded-GET reconstruction")
+    _fmt(out, "minio_tpu_repair_partial_blocks_total", "counter",
+         [({"family": f}, fs[f].get("repair_partial_blocks", 0))
+          for f in fams],
+         "Stripe blocks rebuilt via sub-chunk partial repair")
     return out
 
 

@@ -50,7 +50,8 @@ class MultipartHandlersMixin:
             user_defined.update(sse_meta)
         upload_id = await self._run(
             self.mp.new_upload, bucket, key, user_defined,
-            self._parity_for_storage_class(request)
+            self._parity_for_storage_class(request),
+            self._family_for_storage_class(request),
         )
         xml = (
             '<?xml version="1.0" encoding="UTF-8"?>'

@@ -53,6 +53,24 @@ class ObjectHandlersMixin:
             return None
         return max(1, min(p, n // 2))
 
+    def _family_for_storage_class(self, request) -> str | None:
+        """Per-request erasure code family from x-amz-storage-class:
+        MINIO_TPU_EC_FAMILY_STANDARD / MINIO_TPU_EC_FAMILY_RRS override
+        the node-wide MINIO_TPU_EC_FAMILY for their class; the family is
+        recorded in xl.meta so reads/heals of existing objects never
+        depend on these knobs. None defers to the erasure layer default
+        (which reads MINIO_TPU_EC_FAMILY itself)."""
+        from ..erasure.bitrot_io import FAMILIES
+
+        sc = request.headers.get("x-amz-storage-class", "")
+        if not sc or sc == "STANDARD":
+            fam = os.environ.get("MINIO_TPU_EC_FAMILY_STANDARD", "")
+        elif sc == "REDUCED_REDUNDANCY":
+            fam = os.environ.get("MINIO_TPU_EC_FAMILY_RRS", "")
+        else:
+            fam = ""
+        return fam if fam in FAMILIES else None
+
     async def _proxy_get_remote(self, request, bucket, key, vid=""):
         """Serve a not-yet-replicated object from a replication target.
 
@@ -427,11 +445,13 @@ class ObjectHandlersMixin:
             # streaming path: body flows HTTP -> erasure encode -> drives
             user_defined.update(checksum_meta)
             sc_parity = self._parity_for_storage_class(request)
+            sc_family = self._family_for_storage_class(request)
             oi = await self._run_streaming_put(
                 request,
                 lambda rd: self.store.put_object(
                     bucket, key, rd, user_defined, None, bm.versioning,
                     parity=sc_parity, check_precond=precond,
+                    family=sc_family,
                 ),
             )
             headers = {"ETag": f'"{oi.etag}"'}
@@ -478,6 +498,7 @@ class ObjectHandlersMixin:
                 bucket, key, body, user_defined, None, bm.versioning,
                 parity=self._parity_for_storage_class(request),
                 check_precond=precond,
+                family=self._family_for_storage_class(request),
             )
         )
         headers = {"ETag": f'"{oi.etag}"'}

@@ -55,3 +55,10 @@ class DoneForNow(StorageError):
 
 class MethodNotAllowed(StorageError):
     pass
+
+
+class UnknownErasureFamily(StorageError):
+    """xl.meta names an erasure code family this build cannot decode
+    (ErasureInfo.algorithm outside the registered set). Typed so decode/
+    heal paths fail loudly instead of misinterpreting shard frames."""
+

@@ -510,6 +510,7 @@ class XLStorage(StorageAPI):
                 part_path,
                 fi.erasure.shard_file_size(part.size),
                 shard_size,
+                family=fi.erasure.algorithm or "reedsolomon",
             )
 
     # -- trash -------------------------------------------------------------

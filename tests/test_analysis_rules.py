@@ -258,6 +258,50 @@ def test_gf_dtype_blockspec_tiling():
     assert run(good, "ops/rs_pallas.py", rules=["gf-dtype"]) == []
 
 
+def test_gf_dtype_covers_cauchy_module():
+    """ISSUE-14 satellite: the second code family's kernels sit under
+    the same static gate — ops/cauchy.py is in gf-dtype scope, its
+    family-specific buffer names (cauchy matrices, sub-chunks,
+    piggybacks, heal 'rebuilt' frames) match the naming net, and a
+    BlockSpec off the (8, 128) tile is flagged there too."""
+    bad_alloc = """
+        import numpy as np
+
+        def make(d, p, n):
+            cauchy_matrix = np.zeros((p, d))
+            sub_chunk = np.zeros(n)
+            piggyback = np.empty((p, n))
+            rebuilt = np.zeros(n, dtype=np.float64)
+            return cauchy_matrix, sub_chunk, piggyback, rebuilt
+    """
+    fs = run(bad_alloc, relpath="ops/cauchy.py", rules=["gf-dtype"])
+    assert len(fs) == 4, [f.message for f in fs]
+    good_alloc = """
+        import numpy as np
+
+        def make(d, p, n):
+            cauchy_matrix = np.zeros((p, d), dtype=np.uint8)
+            sub_chunk = np.zeros(n, dtype=np.uint8)
+            return cauchy_matrix, sub_chunk
+    """
+    assert run(good_alloc, relpath="ops/cauchy.py", rules=["gf-dtype"]) == []
+    bad_tile = """
+        import jax.experimental.pallas as pl
+        spec = pl.BlockSpec((7, 128), lambda i: (0, 0))
+    """
+    assert rules_hit(bad_tile, "ops/cauchy.py", ["gf-dtype"]) == {"gf-dtype"}
+    # the REAL module passes its own gate
+    import os as _os
+
+    real = open(_os.path.join(
+        _os.path.dirname(__file__), "..", "minio_tpu", "ops", "cauchy.py"
+    )).read()
+    assert analyze_source(
+        real, path="minio_tpu/ops/cauchy.py", relpath="ops/cauchy.py",
+        rules=["gf-dtype"],
+    ) == []
+
+
 def test_gf_dtype_int_weight_tables_allowed():
     # bit-plane weights are int8 into the MXU by design: name doesn't
     # match the byte-domain patterns

@@ -705,6 +705,106 @@ def test_cache_coherence_schedule(tmp_path, monkeypatch):
     assert inv >= 2  # heal + overwrite both flowed through the choke point
 
 
+def test_drive_failure_storm_family_ingress(tmp_path, monkeypatch):
+    """ISSUE-14 chaos schedule: TWO drives lost mid-traffic at EC 8+8,
+    for each code family. Phase 1 loses both drives at once — degraded
+    GETs under double failure must stay byte-identical (etag-checked)
+    and the 2-stale heal recovers both. Phase 2 loses one drive alone —
+    the cauchy family's heal must read measurably fewer survivor bytes
+    than reedsolomon (>= 25%, the partial-repair schedule) with zero
+    wrong bytes. Readers hammer the object the whole time."""
+    import hashlib
+    import shutil
+    import threading
+
+    from minio_tpu.erasure.coder import family_stats_snapshot
+
+    monkeypatch.setenv("MINIO_TPU_NATIVE_PLANE", "0")
+    body = os.urandom(3 << 20)
+    etag = hashlib.md5(body).hexdigest()
+    heal_ingress = {}
+    for fam in ("reedsolomon", "cauchy"):
+        monkeypatch.setenv("MINIO_TPU_EC_FAMILY", fam)
+        root = tmp_path / fam
+        disks = [
+            HealthCheckedDisk(FaultInjectedDisk(XLStorage(str(root / f"d{i}"))))
+            for i in range(16)
+        ]
+        es = ErasureSet(disks, default_parity=8)  # EC 8+8
+        es.make_bucket("storm")
+        es.put_object("storm", "obj", body)
+        fi, _ = es._cached_fileinfo("storm", "obj", "")
+        assert fi.erasure.algorithm == fam
+        dist = fi.erasure.distribution
+
+        problems: list[str] = []
+        stop = threading.Event()
+        mu = threading.Lock()
+
+        def reader():
+            while not stop.is_set():
+                try:
+                    oi, it = es.get_object("storm", "obj")
+                    got = b"".join(bytes(c) for c in it)
+                except Exception as e:  # noqa: BLE001 — storm witness
+                    with mu:
+                        problems.append(f"read failed: {e!r}")
+                    return
+                if hashlib.md5(got).hexdigest() != oi.etag or oi.etag != etag:
+                    with mu:
+                        problems.append("wrong bytes served")
+                    return
+
+        threads = [threading.Thread(target=reader) for _ in range(4)]
+        for t in threads:
+            t.start()
+        try:
+            # phase 1: two drives lose the object at once (data shard 0
+            # + a parity shard) — traffic keeps flowing over 14 shards
+            lost_a = dist.index(1)       # data shard 0
+            lost_b = dist.index(16)      # parity shard 15
+            shutil.rmtree(root / f"d{lost_a}" / "storm" / "obj")
+            shutil.rmtree(root / f"d{lost_b}" / "storm" / "obj")
+            es.cache.clear()
+            time.sleep(0.2)
+            res = es.heal_object("storm", "obj")
+            assert sorted(res["healed"]) == sorted(
+                [disks[lost_a].endpoint, disks[lost_b].endpoint]
+            ), res
+            assert not res["partialRepair"]  # 2 stale -> generic rebuild
+            time.sleep(0.1)
+            # phase 2: a single data drive dies — the repair-bandwidth
+            # case the second family exists for
+            before = family_stats_snapshot()[fam]["heal_ingress_bytes"]
+            lost_c = dist.index(2)       # data shard 1
+            shutil.rmtree(root / f"d{lost_c}" / "storm" / "obj")
+            es.cache.clear()
+            time.sleep(0.2)
+            res = es.heal_object("storm", "obj")
+            assert res["healed"] == [disks[lost_c].endpoint], res
+            assert res["partialRepair"] == (fam == "cauchy")
+            heal_ingress[fam] = (
+                family_stats_snapshot()[fam]["heal_ingress_bytes"] - before
+            )
+        finally:
+            stop.set()
+            for t in threads:
+                t.join()
+        assert not problems, (fam, problems)
+        # post-storm: byte identity + every healed shard re-verifies
+        es.cache.clear()
+        oi, it = es.get_object("storm", "obj")
+        got = b"".join(bytes(c) for c in it)
+        assert got == body and oi.etag == etag
+        fi2, metas, _, _ = es._quorum_fileinfo("storm", "obj", "", read_data=True)
+        for dk, m in zip(es.disks, metas):
+            assert m is not None
+            dk.verify_file("storm", "obj", m)
+    assert heal_ingress["cauchy"] <= 0.75 * heal_ingress["reedsolomon"], (
+        heal_ingress
+    )
+
+
 def test_cluster_cache_cross_invalidation(cluster2):
     """2-node coherence: node 2 serves an object from its cache; node 1
     overwrites it. The write returns only after the grid invalidation

@@ -511,7 +511,7 @@ def test_dispatch_background_starvation_protection():
         # aged far past MINIO_TPU_QOS_BG_MAX_AGE_MS (default 50 ms)
         disp._bg.append(
             (blocks, aged_fut, PRI_BACKGROUND, time.monotonic() - 10.0,
-             "", False)
+             "", False, disp.codec)
         )
         disp._cv.notify()
     shards, digests = aged_fut.result(timeout=10)
