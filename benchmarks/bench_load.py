@@ -1,5 +1,5 @@
 """Closed-loop production load harness (round 7: many-core data plane;
-round 8: ranged-GET segment-cache phases).
+round 8: ranged-GET segment-cache phases; round 10: elastic topology).
 
 Drives a REAL server process (optionally an SO_REUSEPORT worker pool,
 ``MINIO_TPU_WORKERS``) with production-shaped traffic and emits the
@@ -26,6 +26,15 @@ numbers PERF.md and BENCH_r07/r08.json track:
   servers, median-of-N warm passes) vs a prefetched sequential pass;
   the mixed phase additionally carries an RGET request class so the
   segment path is exercised under production load.
+- **Topology phase (round 10)**: live pool expansion -> continuous
+  placement-aware rebalance with a SEEDED partition injected mid-drain
+  (topology fault boundary) -> decommission -> pool removal, all under
+  verifying zipf traffic: every GET is checked byte-for-byte against a
+  per-key generation ledger and its ETag against the served bytes.
+  Gates: zero stale bytes/etags across the set-membership changes,
+  ``fg_deferred_behind_bg`` flat, the pinned hot prefix never drained,
+  the partition provably bit, and ``rebalance_throughput_mibps``
+  recorded (BENCH_r10.json).
 
 Worker count and nproc are recorded in the JSON so cross-host numbers
 are never compared blindly.
@@ -156,6 +165,16 @@ class AsyncS3:
     async def request(self, method: str, path: str, query: str = "",
                       body: bytes = b"", read: bool = True,
                       headers: dict | None = None):
+        st, data, _ = await self.request_full(
+            method, path, query, body, read, headers
+        )
+        return st, data
+
+    async def request_full(self, method: str, path: str, query: str = "",
+                           body: bytes = b"", read: bool = True,
+                           headers: dict | None = None):
+        """Like request() but also returns the response headers (the
+        topology phase cross-checks ETag against the served bytes)."""
         hdrs = self._signed(method, path, query)
         if headers:
             hdrs.update(headers)  # unsigned extras (Range) are S3-legal
@@ -164,7 +183,7 @@ class AsyncS3:
             method, url, data=body if body else None, headers=hdrs
         ) as resp:
             data = await resp.read() if read else b""
-            return resp.status, data
+            return resp.status, data, dict(resp.headers)
 
 
 ZIPF_ALPHA = 1.1
@@ -509,6 +528,326 @@ def bench_ranged(cfg: argparse.Namespace) -> dict:
     return out
 
 
+# ------------------------------------------------------ topology (round 10)
+
+
+def _admin(port: int, method: str, path: str, body: bytes = b"",
+           query: dict | None = None, timeout: float = 60):
+    cli = S3Client(f"127.0.0.1:{port}")
+    return cli.request(method, f"/minio/admin/v3/{path}", body=body,
+                       query=query or {}, timeout=timeout)
+
+
+def _tbody(key: str, gen: int, size: int) -> bytes:
+    """Deterministic content for (key, generation): a reader can verify
+    every byte of every response it ever gets."""
+    import hashlib as _hl
+
+    seed = _hl.md5(f"{key}#{gen}".encode()).digest()
+    return (seed * (size // len(seed) + 1))[:size]
+
+
+class TopologyLoad:
+    """Verifying zipf mixed load for the topology phase. Every GET is
+    checked byte-for-byte against the generation ledger (and its ETag
+    against the served bytes), so a single stale cache entry or lost
+    update anywhere across the set-membership changes is a counted
+    failure, not a silent wrong answer."""
+
+    def __init__(self, cli: "AsyncS3", bucket: str, static_keys: list[str],
+                 hot_keys: list[str], size: int, clients: int):
+        self.cli = cli
+        self.bucket = bucket
+        self.static_keys = static_keys
+        self.hot_keys = hot_keys
+        self.size = size
+        self.clients = clients
+        self.committed = {k: 0 for k in hot_keys}  # gen ledger
+        self.stop = asyncio.Event()
+        self.stats = {"reads": 0, "writes": 0, "stale": 0, "etag_bad": 0,
+                      "errors": 0, "slowdowns": 0}
+        self.examples: list[str] = []
+
+    def _flag(self, kind: str, msg: str) -> None:
+        self.stats[kind] += 1
+        if len(self.examples) < 10:
+            self.examples.append(f"{kind}: {msg}")
+
+    async def _verify_get(self, key: str, expect_gen=None) -> None:
+        import hashlib as _hl
+
+        c0 = self.committed.get(key, 0) if expect_gen is None else expect_gen
+        st, data, hdrs = await self.cli.request_full(
+            "GET", f"/{self.bucket}/{key}"
+        )
+        if st == 503:
+            self.stats["slowdowns"] += 1
+            await asyncio.sleep(0.5)
+            return
+        if st != 200:
+            self._flag("errors", f"GET {key} -> HTTP {st}")
+            return
+        self.stats["reads"] += 1
+        if key in self.committed:
+            # accept the floor generation or anything newer (a racing
+            # writer may land mid-GET); OLDER than the floor = stale
+            for g in range(c0, self.committed[key] + 2):
+                if data == _tbody(key, g, self.size):
+                    break
+            else:
+                self._flag("stale", f"{key}: bytes match no gen >= {c0}")
+                return
+        else:
+            if data != _tbody(key, 0, self.size):
+                self._flag("stale", f"{key}: static bytes mismatch")
+                return
+        etag = (hdrs.get("ETag") or "").strip('"')
+        if etag and "-" not in etag and etag != _hl.md5(data).hexdigest():
+            self._flag("etag_bad", f"{key}: etag {etag} != md5(bytes)")
+
+    async def _reader(self, rid: int) -> None:
+        rng = random.Random(1000 + rid)
+        cdf = zipf_cdf(len(self.static_keys))
+        while not self.stop.is_set():
+            try:
+                if rng.random() < 0.3 and self.hot_keys:
+                    key = rng.choice(self.hot_keys)
+                else:
+                    key = self.static_keys[
+                        bisect.bisect_left(cdf, rng.random())
+                    ]
+                await self._verify_get(key)
+            except Exception as e:  # noqa: BLE001 — count, keep looping
+                self._flag("errors", f"reader: {type(e).__name__}: {e}")
+
+    async def _writer(self, wid: int) -> None:
+        """Overwrites its OWN slice of hot keys (one writer per key:
+        the generation ledger stays a total order per key)."""
+        rng = random.Random(2000 + wid)
+        mine = self.hot_keys[wid::4]
+        while not self.stop.is_set() and mine:
+            key = rng.choice(mine)
+            gen = self.committed[key] + 1
+            try:
+                st, _ = await self.cli.request(
+                    "PUT", f"/{self.bucket}/{key}",
+                    body=_tbody(key, gen, self.size), read=False,
+                )
+                if st == 200:
+                    self.committed[key] = gen
+                    self.stats["writes"] += 1
+                elif st == 503:
+                    self.stats["slowdowns"] += 1
+                    await asyncio.sleep(0.5)
+                else:
+                    self._flag("errors", f"PUT {key} -> HTTP {st}")
+            except Exception as e:  # noqa: BLE001
+                self._flag("errors", f"writer: {type(e).__name__}: {e}")
+            await asyncio.sleep(0.02)
+
+    async def run(self) -> None:
+        tasks = [
+            asyncio.create_task(self._reader(i)) for i in range(self.clients)
+        ] + [asyncio.create_task(self._writer(w)) for w in range(4)]
+        await self.stop.wait()
+        for t in tasks:
+            t.cancel()
+        await asyncio.gather(*tasks, return_exceptions=True)
+
+
+def _poll_admin(port: int, path: str, done, query: dict | None = None,
+                timeout: float = 120.0, every: float = 0.3) -> dict:
+    deadline = time.time() + timeout
+    last: dict = {}
+    while time.time() < deadline:
+        r = _admin(port, "GET", path, query=query)
+        if r.status == 200:
+            last = json.loads(r.body)
+            if done(last):
+                return last
+        time.sleep(every)
+    raise AssertionError(f"{path} did not converge in {timeout}s: {last}")
+
+
+async def run_topology_phase(port: int, base: str, cfg) -> dict:
+    """The elastic-topology proof: pool expansion -> continuous rebalance
+    with a seeded partition injected mid-drain -> decommission -> pool
+    removal, ALL under live verified zipf traffic. Gates: zero stale
+    bytes / bad etags, fg_deferred_behind_bg flat, pinned prefix never
+    drained, and a positive rebalance throughput recorded for the BENCH
+    json."""
+    import aiohttp
+
+    conn = aiohttp.TCPConnector(limit=0)
+    timeout = aiohttp.ClientTimeout(total=300)
+    async with aiohttp.ClientSession(
+        connector=conn, timeout=timeout, auto_decompress=False
+    ) as session:
+        cli = AsyncS3(session, "127.0.0.1", port)
+        size = cfg.topo_object_kb * 1024
+        static_keys = [f"stat-{i:04d}" for i in range(cfg.topo_keyspace)]
+        hot_keys = [f"hot/{i:03d}" for i in range(cfg.topo_hot_keys)]
+
+        # pin the hot prefix to pool 0 BEFORE any data lands
+        r = await asyncio.to_thread(
+            _admin, port, "POST", "placement/set", body=json.dumps(
+            {"bucket": BUCKET, "prefix": "hot/", "mode": "pin",
+             "pools": [0]}).encode())
+        assert r.status == 200, f"placement/set: {r.status} {r.body[:200]}"
+
+        sem = asyncio.Semaphore(16)
+
+        async def put_one(key: str, gen: int) -> None:
+            async with sem:
+                st, _ = await cli.request(
+                    "PUT", f"/{BUCKET}/{key}",
+                    body=_tbody(key, gen, size), read=False,
+                )
+                assert st == 200, f"preload {key}: HTTP {st}"
+
+        await asyncio.gather(*(put_one(k, 0) for k in static_keys))
+        # hot keys start at gen 1 (committed ledger starts there)
+        await asyncio.gather(*(put_one(k, 1) for k in hot_keys))
+
+        fg_deferred_before = await asyncio.to_thread(
+            scrape_counter, port,
+            "minio_tpu_dispatch_fg_deferred_behind_bg_total"
+        )
+
+        load = TopologyLoad(cli, BUCKET, static_keys, hot_keys, size,
+                            cfg.topo_clients)
+        for k in hot_keys:
+            load.committed[k] = 1
+        load_task = asyncio.create_task(load.run())
+        await asyncio.sleep(1.0)  # traffic flowing before any topology op
+
+        # -- expansion: second pool attaches to the RUNNING server ------
+        t0 = time.monotonic()
+        r = await asyncio.to_thread(
+            _admin, port, "POST", "pool/expand", json.dumps(
+            {"spec": os.path.join(base, "x2-d{1...%d}" % cfg.topo_drives)}
+        ).encode())
+        assert r.status == 200, f"pool/expand: {r.status} {r.body[:300]}"
+        expand = json.loads(r.body)
+
+        # -- continuous rebalance, chaos partition mid-drain ------------
+        # seeded partition armed BEFORE the mover starts: the drain's
+        # first pass provably runs through it (partition-during-drain),
+        # fails those moves, and must still converge once it clears
+        r = await asyncio.to_thread(
+            _admin, port, "POST", "fault/inject", json.dumps(
+                {"boundary": "topology", "mode": "partition",
+                 "target": "pool-0", "op": "move", "prob": 0.7,
+                 "count": 15, "seed": 42}).encode())
+        assert r.status == 200, r.body[:200]
+        fault_id = json.loads(r.body)["id"]
+        r = await asyncio.to_thread(
+            _admin, port, "POST", "pools/rebalance", b"",
+            {"threshold": str(cfg.topo_threshold_pct)})
+        assert r.status == 200, r.body[:200]
+        await asyncio.sleep(cfg.topo_chaos_s)  # let the partition bite
+        await asyncio.to_thread(
+            _admin, port, "POST", "fault/clear", b"",
+            {"id": str(fault_id), "local": "true"})
+        reb = await asyncio.to_thread(
+            _poll_admin, port, "pools/rebalance/status",
+            lambda s: s.get("state") != "running")
+        rebalance_wall = time.monotonic() - t0
+
+        # -- decommission the expanded pool, live, then detach it -------
+        r = await asyncio.to_thread(
+            _admin, port, "POST", "pools/decommission", b"", {"pool": "1"})
+        assert r.status == 200, r.body[:200]
+        decom = await asyncio.to_thread(
+            _poll_admin, port, "pools/decommission/status",
+            lambda s: s.get("state") in ("complete", "failed"),
+            {"pool": "1"},
+        )
+        r = await asyncio.to_thread(
+            _admin, port, "POST", "pool/remove", b"", {"pool": "1"})
+        removed = r.status == 200
+        # keep verified traffic running across the membership change —
+        # a stale cache entry from the dead sets would be caught here
+        await asyncio.sleep(cfg.topo_cooldown_s)
+
+        load.stop.set()
+        await load_task
+
+        fg_deferred_after = await asyncio.to_thread(
+            scrape_counter, port,
+            "minio_tpu_dispatch_fg_deferred_behind_bg_total"
+        )
+        topo_metrics = await asyncio.to_thread(
+            lambda: S3Client(f"127.0.0.1:{port}").request(
+                "GET", "/minio/metrics/v3/api/topology"
+            )
+        )
+        assert topo_metrics.status == 200
+
+    out = {
+        "expand": expand,
+        "rebalance": {k: reb.get(k) for k in (
+            "state", "moved", "moved_bytes", "failed", "skipped_pinned",
+            "passes", "spread_pct", "throughput_mibps", "eta_s")},
+        "rebalance_wall_s": round(rebalance_wall, 2),
+        "decommission": {k: decom.get(k) for k in (
+            "state", "objectsMoved", "bytesMoved", "failedObjects")},
+        "pool_removed": removed,
+        "load": dict(load.stats),
+        "fg_deferred_behind_bg_before": fg_deferred_before,
+        "fg_deferred_behind_bg_after": fg_deferred_after,
+        "examples": load.examples,
+    }
+    # -- the gates ---------------------------------------------------------
+    failures = []
+    if load.stats["stale"]:
+        failures.append(f"stale bytes served: {load.stats['stale']}")
+    if load.stats["etag_bad"]:
+        failures.append(f"etag/bytes mismatches: {load.stats['etag_bad']}")
+    if fg_deferred_after != fg_deferred_before:
+        failures.append(
+            "fg_deferred_behind_bg moved "
+            f"{fg_deferred_before} -> {fg_deferred_after}"
+        )
+    if reb.get("state") != "done":
+        failures.append(f"rebalance ended {reb.get('state')}")
+    if not reb.get("moved"):
+        failures.append("rebalance moved nothing")
+    if not reb.get("failed"):
+        failures.append(
+            "the mid-drain partition never bit a move (chaos misfire)"
+        )
+    if decom.get("state") != "complete":
+        failures.append(f"decommission ended {decom.get('state')}")
+    if not removed:
+        failures.append("pool/remove refused")
+    if load.stats["reads"] < 50:
+        failures.append(f"too few verified reads: {load.stats['reads']}")
+    out["gates_passed"] = not failures
+    out["gate_failures"] = failures
+    return out
+
+
+def bench_topology(cfg: argparse.Namespace) -> dict:
+    """Fresh single-process server (online topology changes refuse worker
+    pools), expansion + chaos rebalance + decommission under verified
+    live load."""
+    base = tempfile.mkdtemp(prefix="bench-topo-")
+    srv = Server(base, cfg.port, cfg.topo_drives, 1,
+                 scan_interval=cfg.scan_interval)
+    try:
+        cli = S3Client(f"127.0.0.1:{cfg.port}")
+        assert cli.make_bucket(BUCKET).status == 200
+        out = asyncio.run(run_topology_phase(cfg.port, base, cfg))
+        if out["gate_failures"]:
+            print(f"TOPOLOGY GATES FAILED: {out['gate_failures']}",
+                  file=sys.stderr, flush=True)
+        return out
+    finally:
+        srv.stop()
+        shutil.rmtree(base, ignore_errors=True)
+
+
 # ----------------------------------------------------------- qos plumbing
 
 
@@ -691,6 +1030,20 @@ def main() -> int:
     ap.add_argument("--ranged-repeats", type=int, default=5,
                     help="warm ranged passes (median reported)")
     ap.add_argument("--port", type=int, default=19801)
+    ap.add_argument("--topo-drives", type=int, default=8,
+                    help="drives per pool in the topology phase")
+    ap.add_argument("--topo-keyspace", type=int, default=192,
+                    help="static verified keys in the topology phase")
+    ap.add_argument("--topo-hot-keys", type=int, default=24,
+                    help="pinned hot (overwritten) keys")
+    ap.add_argument("--topo-object-kb", type=int, default=128)
+    ap.add_argument("--topo-clients", type=int, default=24,
+                    help="verifying reader coroutines")
+    ap.add_argument("--topo-threshold-pct", type=float, default=5.0)
+    ap.add_argument("--topo-chaos-s", type=float, default=2.0,
+                    help="seconds the mid-rebalance partition stays armed")
+    ap.add_argument("--topo-cooldown-s", type=float, default=2.0,
+                    help="verified traffic kept running after pool removal")
     ap.add_argument("--out", default="",
                     help="write the JSON here too (stdout always)")
     ap.add_argument("--quick", action="store_true",
@@ -712,6 +1065,13 @@ def main() -> int:
         args.scan_interval = 5.0
         args.ranged_object_mib = 8
         args.ranged_repeats = 2
+        args.topo_drives = 4
+        args.topo_keyspace = 40
+        args.topo_hot_keys = 8
+        args.topo_object_kb = 32
+        args.topo_clients = 8
+        args.topo_chaos_s = 1.0
+        args.topo_cooldown_s = 1.0
     worker_counts = [
         int(w) for w in (
             args.workers.split(",") if args.workers
@@ -732,6 +1092,10 @@ def main() -> int:
           flush=True)
     ranged = bench_ranged(args)
 
+    print("=== round: topology (expand/rebalance/decom under load) ===",
+          file=sys.stderr, flush=True)
+    topology = bench_topology(args)
+
     result = {
         "metric": "load_harness_closed_loop",
         "nproc": os.cpu_count(),
@@ -740,7 +1104,18 @@ def main() -> int:
         "quick": bool(args.quick),
         "runs": runs,
         "ranged": ranged,
+        "topology": topology,
+        # the round-10 headline: mover throughput under live verified
+        # traffic with a chaos partition mid-drain
+        "rebalance_throughput_mibps": topology["rebalance"].get(
+            "throughput_mibps", 0.0
+        ),
     }
+    if not topology.get("gates_passed", False):
+        print(f"TOPOLOGY GATES FAILED: {topology.get('gate_failures')}",
+              file=sys.stderr, flush=True)
+        print(json.dumps(result))
+        return 1
     by_w = {r["workers"]: r["put_throughput_mibs"] for r in runs}
     if 1 in by_w and len(by_w) > 1:
         best_w = max(w for w in by_w if w != 1)

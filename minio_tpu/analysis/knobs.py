@@ -302,6 +302,27 @@ _ALL: list[Knob] = [
        "records the accessing thread + held-lock witness; a live "
        "lockset inconsistency reports an `attr.race` sanitizer event. "
        "0 disables just this witness."),
+    # -- placement / topology (placement/) --------------------------------
+    _k("MINIO_TPU_PLACEMENT", "1", "placement",
+       "Placement-aware pool routing: per-bucket/per-prefix rules (pin "
+       "to a pool, spread across pools) persisted under .minio.sys, "
+       "with a weight-by-free-space default for unruled keys. 0 falls "
+       "back to the bare most-free-pool heuristic and ignores rules."),
+    _k("MINIO_TPU_PLACEMENT_REFRESH_S", "5", "placement",
+       "Seconds a process trusts its in-memory copy of the persisted "
+       "placement rules and its cached per-pool free-space snapshot "
+       "before re-reading; admin placement mutations refresh peers "
+       "immediately via fan-out."),
+    _k("MINIO_TPU_REBALANCE_THRESHOLD_PCT", "5", "placement",
+       "Continuous rebalance converges when the max-min pool fill "
+       "spread (percent of capacity used) drops below this."),
+    _k("MINIO_TPU_REBALANCE_BATCH", "200", "placement",
+       "Objects one rebalance pass moves before re-measuring pool "
+       "usage (smaller = tighter convergence checks, more passes)."),
+    _k("MINIO_TPU_REBALANCE_PAUSE_S", "0", "placement",
+       "Pause between continuous-rebalance passes; gives foreground "
+       "traffic breathing room beyond the QoS background lane's own "
+       "throttling."),
     # -- qos --------------------------------------------------------------
     _k("MINIO_TPU_API_ADMIN_REQUESTS_MAX", None, "qos",
        "Admin-API inflight cap (helper default 64)."),

@@ -463,8 +463,11 @@ class MultipartRouter:
                 pool_idx = pools.index(self.store._pool_holding(bucket, obj))
             except (ObjectNotFound, ValueError):
                 # new object (or holder not in this router's pool list):
-                # place by free space
-                pool_idx = pools.index(self.store._pool_with_most_free())
+                # the placement engine decides (pin/spread rules,
+                # weight-by-free-space default)
+                pool_idx = pools.index(
+                    self.store._placement_pool(bucket, obj)
+                )
         raw = self._mgr(obj, pool_idx).new_upload(
             bucket, obj, user_defined, parity, family
         )

@@ -20,9 +20,18 @@ from .types import BucketInfo, ObjectInfo
 
 class ServerPools:
     def __init__(self, pools: list[ErasureSets]):
+        from ..placement import PlacementPolicy
+
         if not pools:
             raise ValueError("need at least one pool")
         self.pools = pools
+        # pool indexes currently decommissioning (set by PoolManager):
+        # NEW objects never land there, or the drain would chase live
+        # writes forever. Indexes are re-stamped by topology.remove_pool.
+        self.draining: set[int] = set()
+        # placement policy engine (placement/policy.py): consulted for
+        # every NEW object's pool; rules persist through this store
+        self.placement = PlacementPolicy(self)
 
     # facade plumbing for listing/multipart
     @property
@@ -42,8 +51,11 @@ class ServerPools:
     def _pool_with_most_free(self) -> ErasureSets:
         if len(self.pools) == 1:
             return self.pools[0]
+        draining = self.draining if len(self.draining) < len(self.pools) else set()
         best, best_free = self.pools[0], -1
-        for p in self.pools:
+        for i, p in enumerate(self.pools):
+            if i in draining:
+                continue  # a decommissioning pool takes no new objects
             free = 0
             for d in p.disks:
                 try:
@@ -53,6 +65,30 @@ class ServerPools:
             if free > best_free:
                 best, best_free = p, free
         return best
+
+    def _placement_pool(self, bucket: str, obj: str) -> ErasureSets:
+        """Pool for a NEW object: the placement engine's decision
+        (pin/spread rules, weight-by-free-space default), falling back to
+        the legacy most-free heuristic when placement is off or the key
+        is in the system namespace (whose writes include the engine's own
+        rule persistence — they must never re-enter it)."""
+        from ..placement import placement_enabled
+
+        if len(self.pools) == 1:
+            return self.pools[0]
+        if bucket.startswith(".minio.sys"):
+            # system namespace anchors on pool 0: IAM docs, placement
+            # rules, and decommission checkpoints must never land on a
+            # pool that can be decommissioned and detached (remove_pool
+            # refuses pool 0); also breaks the recursion the placement
+            # engine's own rule persistence would otherwise cause
+            return self.pools[0]
+        if not placement_enabled():
+            return self._pool_with_most_free()
+        idx = self.placement.pool_index_for(bucket, obj)
+        if 0 <= idx < len(self.pools):
+            return self.pools[idx]
+        return self._pool_with_most_free()
 
     def _pool_holding(self, bucket: str, obj: str, version_id: str = "") -> ErasureSets:
         """Pool that already has the object (parallel lookup in the
@@ -88,12 +124,13 @@ class ServerPools:
     # -- objects -----------------------------------------------------------
 
     def put_object(self, bucket: str, obj: str, data: bytes, *a, **kw) -> ObjectInfo:
-        # overwrite in place if some pool already holds the object
+        # overwrite in place if some pool already holds the object; new
+        # objects land where the placement engine says
         if len(self.pools) > 1:
             try:
                 pool = self._pool_holding(bucket, obj)
             except (ObjectNotFound, VersionNotFound):
-                pool = self._pool_with_most_free()
+                pool = self._placement_pool(bucket, obj)
         else:
             pool = self.pools[0]
         return pool.put_object(bucket, obj, data, *a, **kw)

@@ -1,7 +1,7 @@
 """Runtime fault-injection registry — the chaos-engineering control plane.
 
 Admin-togglable fault rules with deterministic seeded schedules, injected
-at three boundaries:
+at four boundaries:
 
 - ``storage``  per-drive, per-op faults applied by ``fault.storage.
   FaultInjectedDisk`` (error / latency / bitrot / torn-write / enospc),
@@ -11,7 +11,10 @@ at three boundaries:
   partition);
 - ``tpu``      device faults applied by ``parallel/dispatcher.py``
   (kernel-fail / slow-batch / device-lost) that drive the
-  TPU→XLA→numpy backend degradation ladder.
+  TPU→XLA→numpy backend degradation ladder;
+- ``topology`` rebalance/decommission mover faults applied by
+  ``erasure/decommission.py`` (fail-move / partition / latency) that
+  prove drains survive mover crashes and mid-drain partitions.
 
 The registry is the single source of truth: rules are added via the
 admin API (``fault/inject``), matched per call site through ``check()``,
@@ -32,11 +35,17 @@ import random
 import threading
 import time
 
-BOUNDARIES = ("storage", "network", "tpu")
+BOUNDARIES = ("storage", "network", "tpu", "topology")
 MODES = {
     "storage": frozenset({"error", "latency", "bitrot", "torn-write", "enospc"}),
     "network": frozenset({"delay", "drop", "disconnect", "partition"}),
     "tpu": frozenset({"kernel-fail", "slow-batch", "device-lost"}),
+    # topology: the rebalance/decommission mover's per-object move
+    # (fail-move = the move errors and is retried next pass; partition =
+    # the source pool becomes unreachable mid-drain, like a network
+    # partition isolating the pool being drained; latency applies
+    # latency_ms per move via sleep_latency)
+    "topology": frozenset({"fail-move", "partition", "latency"}),
 }
 
 # fast-path flag: check() returns immediately while no rules exist; only
@@ -50,7 +59,7 @@ _ids = itertools.count(1)
 # robustness-plane counters (metrics v3 /api/fault): injection hits per
 # boundary plus the hedged-read outcome counters fed by erasure/set.py
 COUNTERS = {
-    "storage": 0, "network": 0, "tpu": 0,
+    "storage": 0, "network": 0, "tpu": 0, "topology": 0,
     "hedge_reads": 0, "hedge_wins": 0, "hedge_losses": 0,
     "latency_trips": 0,
 }

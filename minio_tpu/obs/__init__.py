@@ -25,6 +25,8 @@ from .trace import (  # noqa: F401
     TYPE_FAULT,
     TYPE_HEAL,
     TYPE_INTERNAL,
+    TYPE_PLACEMENT,
+    TYPE_REBALANCE,
     TYPE_S3,
     TYPE_SANITIZER,
     TYPE_SCANNER,

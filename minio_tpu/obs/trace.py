@@ -29,9 +29,12 @@ TYPE_HEAL = "heal"
 TYPE_SCANNER = "scanner"
 TYPE_FAULT = "fault"
 TYPE_SANITIZER = "sanitizer"
+TYPE_PLACEMENT = "placement"
+TYPE_REBALANCE = "rebalance"
 TRACE_TYPES = frozenset(
     {TYPE_S3, TYPE_INTERNAL, TYPE_STORAGE, TYPE_TPU, TYPE_HEAL,
-     TYPE_SCANNER, TYPE_FAULT, TYPE_SANITIZER}
+     TYPE_SCANNER, TYPE_FAULT, TYPE_SANITIZER, TYPE_PLACEMENT,
+     TYPE_REBALANCE}
 )
 
 # (request_id, parent_span_id); spans nest by swapping the second slot

@@ -691,6 +691,109 @@ async def handle_admin(server, request: web.Request, access_key: str, subpath: s
         ok = server.batch.cancel(q.get("jobId", ""))
         return web.Response(status=204 if ok else 404)
 
+    # -- placement + live topology (placement/) ----------------------------
+    if op.startswith("placement/"):
+        pl = getattr(server.store, "placement", None)
+        if pl is None:
+            return _json({"error": "store has no placement engine"}, 400)
+        if op == "placement/set" and m == "POST":
+            authz("admin:ServerUpdate")
+            try:
+                d = json.loads(body) if body else {}
+                rule = await server._run(pl.set_rule, d)
+            except (ValueError, TypeError, KeyError) as e:
+                return _json({"error": str(e)}, 400)
+            out = {"rule": rule}
+            if q.get("local") != "true":
+                # rules persist through the shared object layer; peers
+                # (cluster nodes AND pool workers) just re-read them
+                out["peers"] = await server._run(
+                    _admin_fanout, server, "placement/reload", b"", {}
+                )
+            return _json(out)
+        if op == "placement/delete" and m == "POST":
+            authz("admin:ServerUpdate")
+            try:
+                d = json.loads(body) if body else {}
+                removed = await server._run(
+                    pl.delete_rule, d.get("bucket", ""), d.get("prefix", "")
+                )
+            except (ValueError, TypeError) as e:
+                return _json({"error": str(e)}, 400)
+            out = {"removed": removed}
+            if q.get("local") != "true":
+                out["peers"] = await server._run(
+                    _admin_fanout, server, "placement/reload", b"", {}
+                )
+            return _json(out)
+        if op == "placement/reload" and m == "POST":
+            authz("admin:ServerUpdate")
+            return _json({"rules": await server._run(pl.reload)})
+        if op == "placement/get" and m == "GET":
+            authz("admin:ServerInfo")
+            return _json(await server._run(pl.rules))
+        if op == "placement/status" and m == "GET":
+            authz("admin:ServerInfo")
+            st = await server._run(pl.status)
+            if server.pool_mgr is not None:
+                st["pools"] = await server._run(server.pool_mgr.pool_usage)
+            return _json(st)
+
+    if op in ("pool/expand", "pool/remove") and m == "POST":
+        authz("admin:ServerUpdate")
+        if server.pool_mgr is None:
+            return _json({"error": "store has no pool topology"}, 400)
+        if getattr(server, "worker_count", 1) > 1 or (
+            getattr(server, "peers", None) or []
+        ):
+            # every process must see a pool the instant it exists —
+            # worker pools / clusters take the coordinated-restart path
+            return _json(
+                {"error": "online pool topology changes need a "
+                          "single-process deployment; add/remove the "
+                          "pool spec in the server args and restart"},
+                400,
+            )
+        from ..placement import topology as topomod
+        from ..storage.errors import StorageError
+
+        if op == "pool/expand":
+            try:
+                d = json.loads(body) if body else {}
+                spec = str(d["spec"])
+                set_size = int(d.get("setSize", 0) or 0)
+            except (ValueError, KeyError, TypeError):
+                raise s3err.InvalidArgument from None
+            bg = server.background
+            try:
+                out = await server._run(
+                    topomod.expand_pool, server.store, spec, set_size,
+                    bg.mrf.add if bg is not None else None,
+                )
+            except (ValueError, StorageError, OSError) as e:
+                return _json({"error": str(e)}, 400)
+            return _json(out)
+        # pool/remove: only a pool decommissioned to completion detaches
+        idx = _int_q(q, "pool", -1)
+        st = server.pool_mgr.status(idx)
+        if st is None or st.state != "complete":
+            return _json(
+                {"error": "pool must be decommissioned to completion "
+                          "before removal"},
+                400,
+            )
+        try:
+            out = await server._run(
+                topomod.remove_pool, server.store, idx
+            )
+        except ValueError as e:
+            return _json({"error": str(e)}, 400)
+        # decommission records key pool INDEXES: re-key them (and drop
+        # the removed pool's, incl. persisted checkpoints) so a stale
+        # 'complete' can never vouch for a later pool at this index
+        await server._run(server.pool_mgr.reindex_after_remove, idx)
+        return _json(out)
+
     # -- pools: decommission / rebalance ----------------------------------
     if op.startswith("pools/") and server.pool_mgr is not None:
         pm = server.pool_mgr
@@ -716,8 +819,14 @@ async def handle_admin(server, request: web.Request, access_key: str, subpath: s
             return web.Response(status=200)
         if op == "pools/rebalance" and m == "POST":
             authz("admin:RebalancePool")
+            thr = None
+            if q.get("threshold"):
+                try:
+                    thr = float(q["threshold"])
+                except ValueError:
+                    raise s3err.InvalidArgument from None
             try:
-                out = await server._run(pm.start_rebalance_continuous)
+                out = await server._run(pm.start_rebalance_continuous, thr)
             except ValueError as e:
                 return _json({"error": str(e)}, 400)
             return _json(out)

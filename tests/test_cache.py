@@ -426,3 +426,132 @@ def test_data_fill_rejected_if_invalidated_mid_stream(tmp_path, monkeypatch):
     got.extend(it)
     assert b"".join(bytes(c) for c in got) == body  # served fine
     assert cache_core.data_cache().get(es, "cb", "stream", "") is None
+
+
+# -- dead-set reclaim (elastic topology: decommissioned/removed sets) -------
+
+
+def test_dead_set_entries_reclaim_first_under_pressure(tmp_path, monkeypatch):
+    """ROADMAP item 4's "already exists — prove it": entries owned by a
+    set that no longer exists (pool decommissioned + detached) can never
+    be invalidated by anyone, so budget pressure must reclaim THEM
+    before any live entry — even a live entry that is older in LRU
+    order."""
+    import gc
+
+    import math
+
+    monkeypatch.setenv("MINIO_TPU_CACHE_ADMIT_TOUCHES", "1")
+    monkeypatch.setenv("MINIO_TPU_CACHE_OBJECT_MAX", str(4 << 20))
+    dc = cache_core.data_cache()
+    dc.drop_where(lambda k: True)  # earlier tests' entries skew the budget
+    # the byte budget is shared with other tiers' leftovers (inline
+    # fileinfo bytes, segments): size it RELATIVE to the baseline so
+    # three 2 MiB fills overflow it by construction and reclaiming the
+    # dead entry alone relieves it
+    base_mb = math.ceil(cache_core._bytes_total() / (1 << 20))
+    monkeypatch.setenv("MINIO_TPU_CACHE_MEM_MB", str(base_mb + 4))
+
+    live_es, _ = _rig(tmp_path / "live")
+    dead_es = ErasureSet(
+        [XLStorage(str(tmp_path / "dead" / f"d{i}")) for i in range(4)]
+    )
+    dead_es.make_bucket("cb")
+
+    def fill(es, key, body):
+        es.put_object("cb", key, body)
+        _, it = es.get_object("cb", key)
+        b"".join(bytes(c) for c in it)
+        assert dc.get(es, "cb", key, "") is not None, key
+
+    # LRU order: live1 (oldest), then the doomed set's entry, then the
+    # fill that overflows the budget
+    fill(live_es, "live1", os.urandom(2 << 20))
+    fill(dead_es, "doomed", os.urandom(2 << 20))
+    dead_key = dc._key(dead_es, "cb", "doomed", "")
+    del dead_es  # pool detached: nothing references the set anymore
+    gc.collect()
+    assert dc._lru[dead_key].ref() is None  # entry is now dead-owned
+
+    fill(live_es, "live2", os.urandom(2 << 20))  # pressure: over budget
+
+    assert dead_key not in dc._lru, "dead-set entry must reclaim first"
+    # pure LRU would have evicted live1 (older than the dead entry)
+    assert dc.get(live_es, "cb", "live1", "") is not None
+    assert dc.get(live_es, "cb", "live2", "") is not None
+
+
+def test_id_reuse_guard_blocks_dead_set_serve(tmp_path, monkeypatch):
+    """A dead set's bytes must NEVER serve another set, even when CPython
+    recycles id() so the cache keys collide — the per-entry owning-set
+    weakref is the guard. Forced collision via a constant key."""
+    monkeypatch.setenv("MINIO_TPU_CACHE_ADMIT_TOUCHES", "1")
+    monkeypatch.setattr(
+        cache_core.DataCache, "_key",
+        lambda self, es, b, o, v: ("forced-id", b, o, v),
+    )
+    dc = cache_core.data_cache()
+    es1, _ = _rig(tmp_path / "a")
+    body = os.urandom(100_000)
+    es1.put_object("cb", "hot", body)
+    _, it = es1.get_object("cb", "hot")
+    b"".join(bytes(c) for c in it)
+    assert dc.get(es1, "cb", "hot", "") is not None
+
+    es2, _ = _rig(tmp_path / "b")  # different set, SAME (forced) key
+    assert dc.get(es2, "cb", "hot", "") is None, (
+        "another set's entry must never serve across an id collision"
+    )
+
+
+def test_removed_pool_reads_stay_fresh(tmp_path):
+    """End-to-end set-membership change: objects cached while pool 1
+    held them, then pool 1 is decommissioned and DETACHED; reads through
+    the store must serve the moved copies byte-identical, and the dead
+    sets' cache entries become unreclaimable-by-invalidation dead
+    entries (weakref cleared) rather than stale-serve hazards."""
+    import gc
+    import time as _time
+
+    from minio_tpu.erasure.decommission import PoolManager
+    from minio_tpu.placement import expand_pool, remove_pool
+    from minio_tpu.server.app import make_object_layer
+
+    store = make_object_layer([str(tmp_path / "p1-d{1...4}")])
+    store.make_bucket("mb1")
+    expand_pool(store, str(tmp_path / "p2-d{1...4}"))
+    # pin everything to pool 1 so the cached copies live in its sets
+    store.placement.set_rule(
+        {"bucket": "mb1", "prefix": "", "mode": "pin", "pools": [1]}
+    )
+    bodies = {f"k{i}": bytes([i]) * 50_000 for i in range(4)}
+    for k, v in bodies.items():
+        store.put_object("mb1", k, v)
+        for _ in range(2):  # two-touch admission into the data cache
+            _, it = store.get_object("mb1", k)
+            assert b"".join(bytes(c) for c in it) == v
+    p1_sets = list(store.pools[1].sets)
+    assert any(
+        cache_core.data_cache().get(s, "mb1", k, "") is not None
+        for s in p1_sets for k in bodies
+    ), "test rig must actually have cached pool-1 entries"
+    # the pin must not block the drain: decommission overrides pins
+    store.placement.delete_rule("mb1", "")
+
+    pm = PoolManager(store)
+    pm.start_decommission(1)
+    deadline = _time.time() + 30
+    while _time.time() < deadline and pm.status(1).state == "draining":
+        _time.sleep(0.1)
+    assert pm.status(1).state == "complete"
+    remove_pool(store, 1)
+    del p1_sets
+    gc.collect()
+
+    # zero stale bytes/etags across the membership change
+    for k, v in bodies.items():
+        oi, it = store.get_object("mb1", k)
+        assert b"".join(bytes(c) for c in it) == v
+        import hashlib as _hl
+
+        assert oi.etag == _hl.md5(v).hexdigest()

@@ -414,3 +414,194 @@ def test_audit_to_kafka(monkeypatch):
             break
         time.sleep(0.1)
     assert log.stats["sent"] == 1
+
+
+# ---- Kafka partition-leader discovery (4th VERDICT round) -----------------
+
+
+def _k_read_req(conn):
+    """One size-prefixed Kafka request -> (api_key, correlation, raw)."""
+    import struct
+
+    hdr = b""
+    while len(hdr) < 4:
+        chunk = conn.recv(4 - len(hdr))
+        if not chunk:
+            return None, None, None
+        hdr += chunk
+    size = struct.unpack(">i", hdr)[0]
+    req = b""
+    while len(req) < size:
+        req += conn.recv(size - len(req))
+    api = struct.unpack(">h", req[:2])[0]
+    corr = struct.unpack(">i", req[4:8])[0]
+    return api, corr, req
+
+
+def _k_produce_resp(corr, topic, err):
+    import struct
+
+    t = topic.encode()
+    return (
+        struct.pack(">i", corr) + struct.pack(">i", 1)
+        + struct.pack(">h", len(t)) + t
+        + struct.pack(">i", 1) + struct.pack(">i", 0)
+        + struct.pack(">h", err) + struct.pack(">q", 0)
+        + struct.pack(">q", -1) + struct.pack(">i", 0)
+    )
+
+
+def _k_metadata_resp(corr, topic, brokers, leader_node):
+    """Metadata v0 response: broker list + one topic with partition 0."""
+    import struct
+
+    out = struct.pack(">i", corr)
+    out += struct.pack(">i", len(brokers))
+    for node, (host, port) in sorted(brokers.items()):
+        h = host.encode()
+        out += (struct.pack(">i", node) + struct.pack(">h", len(h)) + h
+                + struct.pack(">i", port))
+    t = topic.encode()
+    out += struct.pack(">i", 1)                       # topics
+    out += struct.pack(">h", 0)                       # topic error
+    out += struct.pack(">h", len(t)) + t
+    out += struct.pack(">i", 1)                       # partitions
+    out += (struct.pack(">h", 0) + struct.pack(">i", 0)   # err, pid 0
+            + struct.pack(">i", leader_node)
+            + struct.pack(">i", 0) + struct.pack(">i", 0))  # replicas, isr
+    return out
+
+
+def _k_send(conn, resp):
+    import struct
+
+    conn.sendall(struct.pack(">i", len(resp)) + resp)
+
+
+class _ScriptedBroker:
+    """A broker thread serving one connection at a time from a script of
+    per-request handlers (api-key dispatched)."""
+
+    def __init__(self, name):
+        self.sock = socket.socket()
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(8)
+        self.port = self.sock.getsockname()[1]
+        self.name = name
+        self.produces = []          # record batches this broker accepted
+        self.produce_errs = []      # error codes to answer first (FIFO)
+        self.metadata = None        # (brokers dict, leader_node) | None
+        self.conns = []             # accepted conns (killed on close)
+        self.stop = threading.Event()
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        while not self.stop.is_set():
+            try:
+                self.sock.settimeout(0.2)
+                conn, _ = self.sock.accept()
+            except OSError:
+                continue
+            self.conns.append(conn)
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            ).start()
+
+    def _serve_conn(self, conn):
+        topic = "bucket-events"
+        try:
+            while True:
+                api, corr, req = _k_read_req(conn)
+                if api is None:
+                    return
+                if api == 0:      # Produce
+                    err = self.produce_errs.pop(0) if self.produce_errs else 0
+                    if err == 0:
+                        self.produces.append(req)
+                    _k_send(conn, _k_produce_resp(corr, topic, err))
+                elif api == 3:    # Metadata
+                    assert self.metadata is not None, \
+                        f"{self.name}: unexpected metadata request"
+                    brokers, leader = self.metadata
+                    _k_send(conn, _k_metadata_resp(corr, topic, brokers, leader))
+        except OSError:
+            pass
+        finally:
+            conn.close()
+
+    def close(self):
+        self.stop.set()
+        self.sock.close()
+        for c in self.conns:  # a "dead" broker kills live conns too
+            try:
+                c.close()
+            except OSError:
+                pass
+
+
+def test_kafka_not_leader_rediscovers_and_delivers():
+    """Bootstrap broker answers NOT_LEADER_FOR_PARTITION; the client must
+    refresh metadata, dial the real leader, and deliver — not error into
+    the notifier retry queue (internal/event/target/kafka.go semantics
+    via sarama's leader refresh)."""
+    from minio_tpu.events.kafka import ERR_NOT_LEADER_FOR_PARTITION, KafkaTarget
+
+    boot = _ScriptedBroker("boot")
+    leader = _ScriptedBroker("leader")
+    try:
+        boot.produce_errs = [ERR_NOT_LEADER_FOR_PARTITION]
+        boot.metadata = (
+            {0: ("127.0.0.1", boot.port), 1: ("127.0.0.1", leader.port)}, 1
+        )
+        t = KafkaTarget("t1", f"127.0.0.1:{boot.port}", "bucket-events")
+        t.send(RECORD)
+        assert len(leader.produces) == 1, "event must land on the leader"
+        assert not boot.produces, "bootstrap must not have accepted it"
+        assert b"s3:ObjectCreated:Put" in leader.produces[0]
+        # subsequent sends stay on the discovered leader, no rediscovery
+        t.send(RECORD)
+        assert len(leader.produces) == 2
+    finally:
+        boot.close()
+        leader.close()
+
+
+def test_kafka_connection_failure_rediscovers():
+    """The discovered leader dies; reconnect attempts against it fail and
+    the client re-resolves the leader from the bootstrap broker."""
+    from minio_tpu.events.kafka import KafkaTarget
+
+    boot = _ScriptedBroker("boot")
+    old_leader = _ScriptedBroker("old-leader")
+    new_leader = _ScriptedBroker("new-leader")
+    try:
+        t = KafkaTarget("t1", f"127.0.0.1:{boot.port}", "bucket-events")
+        # steer the client onto old_leader via an initial NOT_LEADER
+        from minio_tpu.events.kafka import ERR_NOT_LEADER_FOR_PARTITION
+
+        boot.produce_errs = [ERR_NOT_LEADER_FOR_PARTITION]
+        boot.metadata = (
+            {0: ("127.0.0.1", boot.port), 1: ("127.0.0.1", old_leader.port)}, 1
+        )
+        t.send(RECORD)
+        assert len(old_leader.produces) == 1
+        # old leader dies; metadata now names the new leader
+        old_leader.close()
+        boot.metadata = (
+            {0: ("127.0.0.1", boot.port), 2: ("127.0.0.1", new_leader.port)}, 2
+        )
+        t.send(RECORD)
+        assert len(new_leader.produces) == 1, "must re-resolve and deliver"
+    finally:
+        boot.close()
+        new_leader.close()
+
+
+def test_kafka_metadata_parser():
+    from minio_tpu.events.kafka import _parse_metadata_leader
+
+    resp = _k_metadata_resp(
+        7, "tp", {3: ("h1", 9092), 9: ("h2", 19092)}, 9
+    )
+    assert _parse_metadata_leader(resp, "tp") == ("h2", 19092)
+    assert _parse_metadata_leader(resp, "other") is None

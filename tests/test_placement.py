@@ -1,0 +1,776 @@
+"""Elastic topology subsystem (minio_tpu/placement/): placement policy
+engine (pin/spread/weight-by-free rules, persistence, hit counters),
+live pool expansion/removal, placement-aware rebalance with status
+breadth, the topology fault boundary, and the admin + metrics surface."""
+
+import json
+import os
+import time
+
+os.environ.setdefault("MINIO_TPU_BACKEND", "numpy")
+os.environ.setdefault("MINIO_TPU_SCAN_INTERVAL", "0")
+
+import pytest
+
+from minio_tpu.erasure.decommission import PoolManager
+from minio_tpu.placement import (
+    PlacementPolicy,
+    PlacementRule,
+    expand_pool,
+    remove_pool,
+)
+from minio_tpu.server.app import make_object_layer
+
+
+def _holder(store, bucket, obj):
+    for i, p in enumerate(store.pools):
+        try:
+            p.get_object_info(bucket, obj)
+            return i
+        except Exception:  # noqa: BLE001 — not in this pool
+            pass
+    return None
+
+
+@pytest.fixture
+def store2(tmp_path):
+    """Two-pool store over tempdir drives."""
+    return make_object_layer(
+        [str(tmp_path / "p1-d{1...4}"), str(tmp_path / "p2-d{1...4}")]
+    )
+
+
+# -- rule model -------------------------------------------------------------
+
+
+def test_rule_validation():
+    with pytest.raises(ValueError):
+        PlacementRule("", "x/", "pin", [0])          # no bucket
+    with pytest.raises(ValueError):
+        PlacementRule(".minio.sys", "", "pin", [0])  # system namespace
+    with pytest.raises(ValueError):
+        PlacementRule("b", "", "nope", [0])          # unknown mode
+    with pytest.raises(ValueError):
+        PlacementRule("b", "", "pin", [0, 1])        # pin takes ONE pool
+    with pytest.raises(ValueError):
+        PlacementRule("b", "", "spread", [])         # empty pool list
+    with pytest.raises(ValueError):
+        PlacementRule("b", "", "spread", [-1])       # negative index
+    r = PlacementRule("b", "hot/", "pin", [1])
+    assert r.matches("b", "hot/x") and not r.matches("b", "cold/x")
+    assert not r.matches("other", "hot/x")
+
+
+def test_set_rule_rejects_unknown_pool(store2):
+    with pytest.raises(ValueError):
+        store2.placement.set_rule(
+            {"bucket": "bkt", "prefix": "", "mode": "pin", "pools": [7]}
+        )
+
+
+# -- placement decisions ----------------------------------------------------
+
+
+def test_pin_and_spread_routing(store2):
+    store2.make_bucket("bkt")
+    store2.placement.set_rule(
+        {"bucket": "bkt", "prefix": "hot/", "mode": "pin", "pools": [1]}
+    )
+    store2.placement.set_rule(
+        {"bucket": "bkt", "prefix": "sp/", "mode": "spread", "pools": [0, 1]}
+    )
+    for i in range(8):
+        store2.put_object("bkt", f"hot/k{i}", b"h" * 256)
+        store2.put_object("bkt", f"sp/k{i}", b"s" * 256)
+        store2.put_object("bkt", f"free/k{i}", b"f" * 256)
+    assert all(_holder(store2, "bkt", f"hot/k{i}") == 1 for i in range(8))
+    sp = [_holder(store2, "bkt", f"sp/k{i}") for i in range(8)]
+    assert set(sp) == {0, 1}, "spread must actually use both pools"
+    dec = store2.placement.status()["decisions"]
+    assert dec["pin"] == 8 and dec["spread"] == 8 and dec["free"] >= 8
+    hits = {r["bucket"] + "/" + r["prefix"]: r["hits"]
+            for r in store2.placement.rules()}
+    assert hits["bkt/hot/"] == 8 and hits["bkt/sp/"] == 8
+
+
+def test_longest_prefix_wins(store2):
+    store2.make_bucket("bkt")
+    store2.placement.set_rule(
+        {"bucket": "bkt", "prefix": "", "mode": "pin", "pools": [0]}
+    )
+    store2.placement.set_rule(
+        {"bucket": "bkt", "prefix": "deep/", "mode": "pin", "pools": [1]}
+    )
+    store2.put_object("bkt", "deep/x", b"d")
+    store2.put_object("bkt", "top", b"t")
+    assert _holder(store2, "bkt", "deep/x") == 1
+    assert _holder(store2, "bkt", "top") == 0
+
+
+def test_overwrite_stays_in_place_despite_pin(store2):
+    """Overwrite-in-place beats placement: two live copies of one key in
+    two pools would make reads ambiguous."""
+    store2.make_bucket("bkt")
+    store2.put_object("bkt", "pre", b"v1")
+    before = _holder(store2, "bkt", "pre")
+    other = 1 - before
+    store2.placement.set_rule(
+        {"bucket": "bkt", "prefix": "pre", "mode": "pin", "pools": [other]}
+    )
+    store2.put_object("bkt", "pre", b"v2")
+    assert _holder(store2, "bkt", "pre") == before
+    _, it = store2.get_object("bkt", "pre")
+    assert b"".join(it) == b"v2"
+
+
+def test_system_namespace_anchors_pool0(store2):
+    store2.put_object(".minio.sys", "anchor/test.json", b"{}")
+    try:
+        store2.pools[0].get_object_info(".minio.sys", "anchor/test.json")
+    except Exception:  # noqa: BLE001
+        raise AssertionError("system object must land on pool 0") from None
+
+
+def test_placement_disabled_falls_back(store2, monkeypatch):
+    store2.make_bucket("bkt")
+    store2.placement.set_rule(
+        {"bucket": "bkt", "prefix": "", "mode": "pin", "pools": [1]}
+    )
+    monkeypatch.setenv("MINIO_TPU_PLACEMENT", "0")
+    # rules ignored; the most-free heuristic decides (either pool is
+    # legal — assert only that the pin was NOT consulted)
+    store2.put_object("bkt", "off", b"x")
+    assert store2.placement.status()["decisions"]["pin"] == 0
+
+
+def test_rules_persist_and_reload(store2):
+    store2.placement.set_rule(
+        {"bucket": "bkt", "prefix": "a/", "mode": "pin", "pools": [0]}
+    )
+    fresh = PlacementPolicy(store2)
+    got = fresh.rules()
+    assert [(r["bucket"], r["prefix"], r["mode"], r["pools"])
+            for r in got] == [("bkt", "a/", "pin", [0])]
+    assert store2.placement.delete_rule("bkt", "a/")
+    assert not store2.placement.delete_rule("bkt", "a/")  # already gone
+    assert PlacementPolicy(store2).rules() == []
+
+
+def test_multipart_new_upload_honors_pin(store2):
+    from minio_tpu.erasure.multipart import MultipartRouter
+
+    store2.make_bucket("bkt")
+    store2.placement.set_rule(
+        {"bucket": "bkt", "prefix": "mp/", "mode": "pin", "pools": [1]}
+    )
+    router = MultipartRouter(store2)
+    upload_id = router.new_upload("bkt", "mp/obj")
+    assert upload_id.startswith("1~"), "upload must pin to pool 1"
+    etag = router.put_part("bkt", "mp/obj", upload_id, 1, b"P" * (5 << 20))
+    router.complete("bkt", "mp/obj", upload_id, [(1, etag)])
+    assert _holder(store2, "bkt", "mp/obj") == 1
+
+
+# -- live expansion / removal ----------------------------------------------
+
+
+def test_expand_pool_live(tmp_path):
+    store = make_object_layer([str(tmp_path / "p1-d{1...4}")])
+    store.make_bucket("ebk")
+    for i in range(6):
+        store.put_object("ebk", f"pre{i}", bytes([i]) * 512)
+    out = expand_pool(store, str(tmp_path / "p2-d{1...4}"))
+    assert out["pool"] == 1 and len(store.pools) == 2
+    # the new pool already has the bucket (buckets exist on every pool)
+    assert store.pools[1].bucket_exists("ebk")
+    # old objects still read; a pin can land new writes on the new pool
+    for i in range(6):
+        _, it = store.get_object("ebk", f"pre{i}")
+        assert b"".join(it) == bytes([i]) * 512
+    store.placement.set_rule(
+        {"bucket": "ebk", "prefix": "new/", "mode": "pin", "pools": [1]}
+    )
+    store.put_object("ebk", "new/x", b"NEW")
+    assert _holder(store, "ebk", "new/x") == 1
+
+
+def test_expand_rejects_remote_spec(store2):
+    with pytest.raises(ValueError):
+        expand_pool(store2, "http://other:9000/d{1...4}")
+
+
+def test_remove_pool_guards(store2):
+    with pytest.raises(ValueError):
+        remove_pool(store2, 0)  # pool 0 anchors the system namespace
+    with pytest.raises(ValueError):
+        remove_pool(store2, 5)  # out of range
+
+
+# -- placement-aware rebalance + status breadth -----------------------------
+
+
+def _drain_rebalance(pm, threshold=5.0, timeout=30.0):
+    pm.start_rebalance_continuous(threshold_pct=threshold)
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        st = pm.rebalance_status()
+        if st["state"] != "running":
+            return st
+        time.sleep(0.1)
+    raise AssertionError(f"rebalance did not finish: {pm.rebalance_status()}")
+
+
+def test_rebalance_moves_and_reports_breadth(tmp_path):
+    store = make_object_layer([str(tmp_path / "p1-d{1...4}")])
+    store.make_bucket("rbk")
+    for i in range(24):
+        store.put_object("rbk", f"k{i:03d}", bytes([i]) * 4096)
+    expand_pool(store, str(tmp_path / "p2-d{1...4}"))
+    pm = PoolManager(store)
+    assert pm.data_spread_pct(pm.pool_data_usage()) == 100.0
+    st = _drain_rebalance(pm, threshold=10.0)
+    assert st["state"] == "done"
+    assert st["moved"] > 0 and st["moved_bytes"] > 0
+    assert st["failed"] == 0
+    assert st["started"] > 0 and st["updated"] >= st["started"]
+    assert st["throughput_mibps"] > 0
+    assert st["spread_pct"] <= 10.0
+    data = pm.pool_data_usage()
+    assert all(u["objects"] > 0 for u in data), "both pools hold objects"
+    for i in range(24):
+        _, it = store.get_object("rbk", f"k{i:03d}")
+        assert b"".join(it) == bytes([i]) * 4096
+
+
+def test_rebalance_never_drains_pinned_prefix(tmp_path):
+    store = make_object_layer([str(tmp_path / "p1-d{1...4}")])
+    store.make_bucket("rbk")
+    store.placement.set_rule(
+        {"bucket": "rbk", "prefix": "pin/", "mode": "pin", "pools": [0]}
+    )
+    for i in range(10):
+        store.put_object("rbk", f"pin/k{i}", b"P" * 4096)
+    expand_pool(store, str(tmp_path / "p2-d{1...4}"))
+    pm = PoolManager(store)
+    out = pm.start_rebalance(max_objects=100)
+    assert out["moved"] == 0
+    assert out["skipped_pinned"] == 10, "every pinned key must be skipped"
+    assert all(_holder(store, "rbk", f"pin/k{i}") == 0 for i in range(10))
+
+
+def test_rebalance_moves_mispinned_keys_home(tmp_path):
+    """A key pinned AFTER it landed elsewhere: rebalance moves it to its
+    pinned pool, not the emptiest."""
+    store = make_object_layer([str(tmp_path / "p1-d{1...4}")])
+    store.make_bucket("rbk")
+    for i in range(8):
+        store.put_object("rbk", f"late/k{i}", b"L" * 4096)
+    expand_pool(store, str(tmp_path / "p2-d{1...4}"))
+    store.placement.set_rule(
+        {"bucket": "rbk", "prefix": "late/", "mode": "pin", "pools": [1]}
+    )
+    pm = PoolManager(store)
+    out = pm.start_rebalance(max_objects=100)
+    assert out["moved"] > 0
+    moved_home = [_holder(store, "rbk", f"late/k{i}") for i in range(8)]
+    assert 1 in moved_home, "mis-pinned keys must move toward their pool"
+    assert all(h in (0, 1) for h in moved_home)
+
+
+def test_decom_status_breadth(tmp_path):
+    store = make_object_layer(
+        [str(tmp_path / "p1-d{1...4}"), str(tmp_path / "p2-d{1...4}")]
+    )
+    store.make_bucket("dbk")
+    for i in range(8):
+        store.put_object("dbk", f"o{i}", b"D" * 2048)
+    pm = PoolManager(store)
+    src = _holder(store, "dbk", "o0")
+    pm.start_decommission(src)
+    deadline = time.time() + 30
+    while time.time() < deadline and pm.status(src).state == "draining":
+        time.sleep(0.1)
+    st = pm.status(src)
+    assert st.state == "complete"
+    d = st.to_dict()
+    # breadth fields + aliases
+    assert d["objectsMoved"] == d["objects_moved"] > 0
+    assert d["bytesMoved"] == d["bytes_moved"] > 0
+    assert d["failedObjects"] == 0
+    assert d["started"] > 0 and d["updated"] >= d["started"]
+    assert d["finished"] >= d["updated"] - 1e-6
+    # checkpoint round-trips the new fields
+    st2 = PoolManager(store).load_checkpoint(src)
+    assert st2 is not None and st2.updated == st.updated
+
+
+# -- topology fault boundary ------------------------------------------------
+
+
+def test_topology_fault_fail_move_and_recovery(tmp_path):
+    from minio_tpu import fault
+
+    store = make_object_layer([str(tmp_path / "p1-d{1...4}")])
+    store.make_bucket("fbk")
+    for i in range(6):
+        store.put_object("fbk", f"k{i}", b"F" * 2048)
+    expand_pool(store, str(tmp_path / "p2-d{1...4}"))
+    pm = PoolManager(store)
+    rid = fault.inject({"boundary": "topology", "mode": "fail-move",
+                        "target": "pool-0", "op": "move"})
+    try:
+        out = pm.start_rebalance(max_objects=100)
+        assert out["moved"] == 0 and out["failed"] > 0, (
+            "every move must fail under the armed rule"
+        )
+        # nothing lost: all objects still served
+        for i in range(6):
+            _, it = store.get_object("fbk", f"k{i}")
+            assert b"".join(it) == b"F" * 2048
+    finally:
+        fault.clear(rid)
+    out = pm.start_rebalance(max_objects=100)
+    assert out["moved"] > 0 and out["failed"] == 0, "retry pass recovers"
+
+
+def test_topology_fault_partition_counts(tmp_path):
+    from minio_tpu import fault
+
+    store = make_object_layer([str(tmp_path / "p1-d{1...4}")])
+    store.make_bucket("fbk")
+    store.put_object("fbk", "one", b"x" * 1024)
+    expand_pool(store, str(tmp_path / "p2-d{1...4}"))
+    pm = PoolManager(store)
+    rid = fault.inject({"boundary": "topology", "mode": "partition",
+                        "count": 1})
+    try:
+        out = pm.start_rebalance(max_objects=10)
+        assert out["failed"] == 1  # the one armed hit
+        assert fault.status()["counters"]["topology"] >= 1
+    finally:
+        fault.clear(rid)
+
+
+# -- admin + metrics surface (live server) ----------------------------------
+
+
+from tests.test_s3_api import ServerThread  # noqa: E402
+from minio_tpu.client import S3Client  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def topo_server(tmp_path_factory):
+    base = tmp_path_factory.mktemp("topo")
+    st = ServerThread([str(base / f"p1-d{i}") for i in range(4)])
+    st._base = base
+    yield st
+    st.stop()
+
+
+@pytest.fixture(scope="module")
+def topo_cli(topo_server):
+    return S3Client(f"127.0.0.1:{topo_server.port}")
+
+
+def test_admin_placement_roundtrip(topo_server, topo_cli):
+    cli = topo_cli
+    assert cli.make_bucket("abk").status == 200
+    r = cli.request("POST", "/minio/admin/v3/placement/set",
+                    body=json.dumps({"bucket": "abk", "prefix": "h/",
+                                     "mode": "pin", "pools": [0]}).encode())
+    assert r.status == 200, r.body
+    rules = json.loads(cli.request(
+        "GET", "/minio/admin/v3/placement/get").body)
+    assert [(x["bucket"], x["prefix"]) for x in rules] == [("abk", "h/")]
+    st = json.loads(cli.request(
+        "GET", "/minio/admin/v3/placement/status").body)
+    assert st["enabled"] and "decisions" in st and "pools" in st
+    # malformed rule -> 400
+    r = cli.request("POST", "/minio/admin/v3/placement/set",
+                    body=json.dumps({"bucket": "abk", "prefix": "",
+                                     "mode": "bogus", "pools": [0]}).encode())
+    assert r.status == 400
+    r = cli.request("POST", "/minio/admin/v3/placement/delete",
+                    body=json.dumps({"bucket": "abk",
+                                     "prefix": "h/"}).encode())
+    assert r.status == 200 and json.loads(r.body)["removed"] is True
+
+
+def test_admin_expand_rebalance_metrics_remove(topo_server, topo_cli):
+    cli = topo_cli
+    assert cli.make_bucket("tbk2").status == 200
+    for i in range(12):
+        assert cli.put_object("tbk2", f"o{i:02d}", b"M" * 4096).status == 200
+
+    # premature remove refused (nothing decommissioned)
+    r = cli.request("POST", "/minio/admin/v3/pool/remove",
+                    query={"pool": "1"})
+    assert r.status == 400
+
+    r = cli.request(
+        "POST", "/minio/admin/v3/pool/expand",
+        body=json.dumps(
+            {"spec": str(topo_server._base / "p2-d{1...4}")}
+        ).encode(),
+    )
+    assert r.status == 200, r.body
+    assert json.loads(r.body)["pool"] == 1
+
+    r = cli.request("POST", "/minio/admin/v3/pools/rebalance",
+                    query={"threshold": "15"})
+    assert r.status == 200, r.body
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        s = json.loads(cli.request(
+            "GET", "/minio/admin/v3/pools/rebalance/status").body)
+        if s.get("state") != "running":
+            break
+        time.sleep(0.1)
+    assert s["state"] == "done", s
+    assert s["moved"] > 0 and s["throughput_mibps"] > 0
+
+    text = cli.request("GET", "/minio/metrics/v3/api/topology").body.decode()
+    for series in (
+        "minio_topology_pools 2",
+        "minio_topology_pool_data_bytes",
+        "minio_topology_pool_objects",
+        "minio_topology_data_skew_pct",
+        "minio_rebalance_moved_bytes_total",
+        "minio_rebalance_throughput_mibps",
+        "minio_placement_decisions_total",
+        "minio_decommission_state",
+    ):
+        assert series in text, f"missing {series}"
+
+    # decommission pool 1, then remove it; all reads stay intact
+    r = cli.request("POST", "/minio/admin/v3/pools/decommission",
+                    query={"pool": "1"})
+    assert r.status == 200, r.body
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        s = json.loads(cli.request(
+            "GET", "/minio/admin/v3/pools/decommission/status",
+            query={"pool": "1"}).body)
+        if s["state"] in ("complete", "failed"):
+            break
+        time.sleep(0.1)
+    assert s["state"] == "complete", s
+    assert s["objectsMoved"] > 0 and s["bytesMoved"] > 0
+    r = cli.request("POST", "/minio/admin/v3/pool/remove",
+                    query={"pool": "1"})
+    assert r.status == 200, r.body
+    for i in range(12):
+        assert cli.get_object("tbk2", f"o{i:02d}").body == b"M" * 4096
+
+
+def test_obs_rebalance_records(topo_server, topo_cli):
+    """rebalance obs records stream over the admin trace endpoint with
+    the new type filter."""
+    import queue as _queue
+
+    srv = topo_server.srv
+    sub = srv.trace.subscribe(label="test-topo")
+    try:
+        pm = srv.pool_mgr
+        # two pools again for a mover pass
+        r = topo_cli.request(
+            "POST", "/minio/admin/v3/pool/expand",
+            body=json.dumps(
+                {"spec": str(topo_server._base / "p3-d{1...4}")}
+            ).encode(),
+        )
+        assert r.status == 200, r.body
+        pm.start_rebalance(max_objects=4)
+        st = pm.start_rebalance_continuous(threshold_pct=99.0)
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            if pm.rebalance_status()["state"] != "running":
+                break
+            time.sleep(0.1)
+        types = set()
+        while True:
+            try:
+                rec = sub.q.get_nowait()
+            except _queue.Empty:
+                break
+            types.add((rec.get("type"), rec.get("name")))
+        assert ("placement", "topology.expand") in types
+        assert any(t == "rebalance" for t, _ in types), types
+    finally:
+        srv.trace.unsubscribe(sub)
+
+
+def test_mover_withdraws_copy_when_overwritten_mid_move(tmp_path):
+    """Lost-update regression: a writer overwrites the object between
+    the mover's read and its delete. The unguarded get->put->delete
+    deleted the NEW version and kept serving the stale copy from the
+    destination pool; the mover must instead withdraw its stale staged
+    copy and leave the fresh version in place."""
+    store = make_object_layer(
+        [str(tmp_path / "p1-d{1...4}"), str(tmp_path / "p2-d{1...4}")]
+    )
+    store.make_bucket("mbk")
+    store.put_object("mbk", "contested", b"v1" * 100)
+    src_i = _holder(store, "mbk", "contested")
+    src, dst = store.pools[src_i], store.pools[1 - src_i]
+
+    class RacingSrc:
+        """Proxy: the overwrite lands right after the mover's read."""
+
+        def __init__(self, pool):
+            self._pool = pool
+            self.raced = False
+
+        def get_object(self, b, o, *a, **kw):
+            oi, it = self._pool.get_object(b, o, *a, **kw)
+            data = b"".join(it)
+            if not self.raced:
+                self.raced = True
+                self._pool.put_object(b, o, b"v2-fresh" * 100)
+            return oi, iter([data])
+
+        def __getattr__(self, name):
+            return getattr(self._pool, name)
+
+    n = PoolManager._move_object(RacingSrc(src), dst, "mbk", "contested")
+    assert n == 0, "a raced move must not count as moved"
+    # the fresh version survives in src; no stale copy lurks in dst
+    assert b"".join(src.get_object("mbk", "contested")[1]) == b"v2-fresh" * 100
+    from minio_tpu.erasure.quorum import ObjectNotFound
+
+    with pytest.raises(ObjectNotFound):
+        dst.get_object_info("mbk", "contested")
+    _, it = store.get_object("mbk", "contested")
+    assert b"".join(it) == b"v2-fresh" * 100
+
+
+def test_draining_pool_takes_no_new_objects(tmp_path):
+    """Decommission under live writes: NEW objects must avoid the
+    draining pool (or the drain chases the write stream forever); a
+    canceled decommission opens it back up."""
+    store = make_object_layer(
+        [str(tmp_path / "p1-d{1...4}"), str(tmp_path / "p2-d{1...4}")]
+    )
+    store.make_bucket("dbk")
+    pm = PoolManager(store)
+    # mark pool 1 draining without racing a real drain thread
+    store.draining.add(1)
+    try:
+        for i in range(12):
+            store.put_object("dbk", f"nw{i}", b"N" * 256)
+        assert all(
+            _holder(store, "dbk", f"nw{i}") == 0 for i in range(12)
+        ), "new objects must not land in the draining pool"
+        # pins naming only the draining pool fall through too
+        store.placement.set_rule(
+            {"bucket": "dbk", "prefix": "pinned/", "mode": "pin",
+             "pools": [1]}
+        )
+        store.put_object("dbk", "pinned/x", b"P")
+        assert _holder(store, "dbk", "pinned/x") == 0
+    finally:
+        store.draining.discard(1)
+    pm.start_decommission(1)
+    assert 1 in store.draining
+    pm.cancel_decommission(1)
+    deadline = time.time() + 10
+    while time.time() < deadline and 1 in store.draining:
+        time.sleep(0.05)
+    assert 1 not in store.draining
+
+
+def test_rebalance_never_fills_draining_pool(tmp_path):
+    """Review regression: rebalance must not pick a decommissioning pool
+    as its destination — objects landing behind the drain's cursor
+    would be detached with the pool."""
+    store = make_object_layer([str(tmp_path / "p1-d{1...4}")])
+    store.make_bucket("rdk")
+    for i in range(12):
+        store.put_object("rdk", f"k{i:02d}", b"R" * 4096)
+    expand_pool(store, str(tmp_path / "p2-d{1...4}"))
+    expand_pool(store, str(tmp_path / "p3-d{1...4}"))
+    pm = PoolManager(store)
+    store.draining.add(1)  # pool 1 mid-decommission (emptiest)
+    try:
+        out = pm.start_rebalance(max_objects=100)
+        assert out["moved"] > 0
+        assert out["to"] == 2, f"must target the live pool, got {out}"
+        d = pm.pool_data_usage()
+        assert d[1]["objects"] == 0, "draining pool must stay empty"
+        # a pin naming the draining pool is ignored by the mover too
+        store.placement.set_rule(
+            {"bucket": "rdk", "prefix": "k", "mode": "pin", "pools": [1]}
+        )
+        out = pm.start_rebalance(max_objects=100)
+        d = pm.pool_data_usage()
+        assert d[1]["objects"] == 0, "pinned moves must not fill it either"
+    finally:
+        store.draining.discard(1)
+
+
+def test_cancel_then_restart_decommission(tmp_path):
+    """Review regression: a canceled decommission could never be
+    restarted — the stale cancel flag instantly killed the new drain
+    and left the pool stuck refusing new objects."""
+    store = make_object_layer(
+        [str(tmp_path / "p1-d{1...4}"), str(tmp_path / "p2-d{1...4}")]
+    )
+    store.make_bucket("cbk")
+    for i in range(8):
+        store.put_object("cbk", f"o{i}", b"C" * 2048)
+    pm = PoolManager(store)
+    src = _holder(store, "cbk", "o0")
+    pm.cancel_decommission(src)  # stale cancel from an earlier attempt
+    pm.start_decommission(src)
+    deadline = time.time() + 30
+    while time.time() < deadline and pm.status(src).state == "draining":
+        time.sleep(0.1)
+    st = pm.status(src)
+    assert st.state == "complete", (
+        f"restart must actually drain, got {st.state}"
+    )
+    assert st.objects_moved > 0
+
+
+def test_pool_remove_clears_decom_state(topo_server, topo_cli):
+    """Review regression (data-loss path): after pool/remove, the
+    detached pool's 'complete' decommission record must not vouch for a
+    LATER pool attached at the same index — pool/remove of the new pool
+    must be refused until IT is drained."""
+    cli = topo_cli
+    assert cli.make_bucket("rmk").status == 200
+    # the module fixture has been through expand/remove cycles; attach a
+    # fresh pool, drain + remove it, then attach another at that index
+    r = cli.request(
+        "POST", "/minio/admin/v3/pool/expand",
+        body=json.dumps(
+            {"spec": str(topo_server._base / "p9-d{1...4}")}
+        ).encode(),
+    )
+    assert r.status == 200, r.body
+    idx = json.loads(r.body)["pool"]
+    r = cli.request("POST", "/minio/admin/v3/pools/decommission",
+                    query={"pool": str(idx)})
+    assert r.status == 200, r.body
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        s = json.loads(cli.request(
+            "GET", "/minio/admin/v3/pools/decommission/status",
+            query={"pool": str(idx)}).body)
+        if s["state"] in ("complete", "failed"):
+            break
+        time.sleep(0.1)
+    assert s["state"] == "complete", s
+    assert cli.request("POST", "/minio/admin/v3/pool/remove",
+                       query={"pool": str(idx)}).status == 200
+    # a NEW pool at the same index: removing it undrained must be 400
+    r = cli.request(
+        "POST", "/minio/admin/v3/pool/expand",
+        body=json.dumps(
+            {"spec": str(topo_server._base / "p10-d{1...4}")}
+        ).encode(),
+    )
+    assert r.status == 200, r.body
+    assert json.loads(r.body)["pool"] == idx
+    assert cli.put_object("rmk", "live-on-new-pool", b"x").status == 200
+    r = cli.request("POST", "/minio/admin/v3/pool/remove",
+                    query={"pool": str(idx)})
+    assert r.status == 400, (
+        "stale decom state must not authorize detaching an undrained pool"
+    )
+
+
+def test_remove_pool_reindexes_placement_rules(tmp_path):
+    """Review regression: rules address pools by INDEX — after a pool
+    removal they must re-key (and rules naming only the removed pool
+    drop), or every pin silently aims at the wrong physical pool."""
+    store = make_object_layer([str(tmp_path / "p1-d{1...4}")])
+    store.make_bucket("rpk")
+    expand_pool(store, str(tmp_path / "p2-d{1...4}"))
+    expand_pool(store, str(tmp_path / "p3-d{1...4}"))
+    p2_drives = {d.endpoint for d in store.pools[2].disks}
+    store.placement.set_rule(
+        {"bucket": "rpk", "prefix": "keep/", "mode": "pin", "pools": [2]}
+    )
+    store.placement.set_rule(
+        {"bucket": "rpk", "prefix": "gone/", "mode": "pin", "pools": [1]}
+    )
+    # drain pool 1 so it can be removed
+    pm = PoolManager(store)
+    pm.start_decommission(1)
+    deadline = time.time() + 30
+    while time.time() < deadline and pm.status(1).state == "draining":
+        time.sleep(0.1)
+    assert pm.status(1).state == "complete"
+    remove_pool(store, 1)
+
+    rules = {r["prefix"]: r for r in store.placement.rules()}
+    assert "gone/" not in rules, "rule naming only the removed pool drops"
+    assert rules["keep/"]["pools"] == [1], "index must shift down"
+    # and the shifted pin still lands on the SAME physical pool
+    store.put_object("rpk", "keep/x", b"K")
+    holder = _holder(store, "rpk", "keep/x")
+    assert {d.endpoint for d in store.pools[holder].disks} == p2_drives
+
+
+def test_decom_drain_avoids_draining_destination(tmp_path):
+    """Review regression: a drain must not hand objects to a pool that
+    is ITSELF being decommissioned (its cursor may already have passed
+    them — they would detach with that pool), even when a pin points
+    there."""
+    store = make_object_layer([str(tmp_path / "p1-d{1...4}")])
+    store.make_bucket("ddk")
+    expand_pool(store, str(tmp_path / "p2-d{1...4}"))
+    expand_pool(store, str(tmp_path / "p3-d{1...4}"))
+    store.placement.set_rule(
+        {"bucket": "ddk", "prefix": "", "mode": "pin", "pools": [1]}
+    )
+    for i in range(8):
+        store.put_object("ddk", f"o{i}", b"D" * 2048)
+    assert all(_holder(store, "ddk", f"o{i}") == 1 for i in range(8))
+    store.placement.set_rule(  # re-pin to pool 2, which is ALSO draining
+        {"bucket": "ddk", "prefix": "", "mode": "pin", "pools": [2]}
+    )
+    store.draining.add(2)
+    try:
+        pm = PoolManager(store)
+        pm.start_decommission(1)
+        deadline = time.time() + 30
+        while time.time() < deadline and pm.status(1).state == "draining":
+            time.sleep(0.1)
+        assert pm.status(1).state == "complete"
+        for i in range(8):
+            assert _holder(store, "ddk", f"o{i}") == 0, (
+                "drained objects must land on the live pool, not the "
+                "draining pin target"
+            )
+    finally:
+        store.draining.discard(2)
+
+
+def test_continuous_rebalance_stops_on_persistent_failures(tmp_path):
+    """Review regression: a pass whose every move fails must not
+    busy-loop the mover forever — after 3 no-progress passes the run
+    ends 'failed' with an explanatory error."""
+    from minio_tpu import fault
+
+    store = make_object_layer([str(tmp_path / "p1-d{1...4}")])
+    store.make_bucket("wbk")
+    for i in range(6):
+        store.put_object("wbk", f"k{i}", b"W" * 2048)
+    expand_pool(store, str(tmp_path / "p2-d{1...4}"))
+    pm = PoolManager(store)
+    rid = fault.inject({"boundary": "topology", "mode": "fail-move",
+                        "target": "pool-0", "op": "move"})  # unbounded
+    try:
+        pm.start_rebalance_continuous(threshold_pct=5.0)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            st = pm.rebalance_status()
+            if st["state"] != "running":
+                break
+            time.sleep(0.1)
+        assert st["state"] == "failed", st
+        assert "no progress" in st.get("error", ""), st
+    finally:
+        fault.clear(rid)

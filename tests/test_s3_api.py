@@ -26,8 +26,10 @@ def _free_port() -> int:
 
 
 class ServerThread:
-    def __init__(self, drives):
-        self.port = _free_port()
+    def __init__(self, drives, port=None):
+        # explicit port: failover tests restart a "returned" peer on the
+        # address its replication partners already hold
+        self.port = port or _free_port()
         self.loop = asyncio.new_event_loop()
         self.srv = make_server(drives)
         self.started = threading.Event()
@@ -43,10 +45,14 @@ class ServerThread:
         self.loop.run_until_complete(site.start())
         self.started.set()
         self.loop.run_forever()
+        # post-stop: release the listener so a failover test can rebind
+        # the same port for the "peer returns" half of the scenario
+        self.loop.run_until_complete(runner.cleanup())
 
     def stop(self):
         self.srv.close()  # IAM refresh/watch + scanner threads
         self.loop.call_soon_threadsafe(self.loop.stop)
+        self.thread.join(timeout=15)
 
 
 @pytest.fixture(scope="module")

@@ -129,3 +129,73 @@ def test_iam_sync(sites):
     assert _wait(lambda: c2.bucket_exists("iam-bkt"))
     ub = S3Client(f"127.0.0.1:{s2.port}", "syncuser", "syncsecret1")
     assert _wait(lambda: ub.put_object("iam-bkt", "by-sync-user", b"hi").status == 200)
+
+
+def test_replication_failover_resync_converges(tmp_path_factory):
+    """VERDICT parity tail: a site peer dies mid-stream. Writes landed
+    during the outage fail their replication attempts; when the peer
+    returns (same address, same drives), an admin resync drains the
+    backlog and the object set converges byte-identical."""
+    base = tmp_path_factory.mktemp("failover")
+    a_drives = [str(base / f"a{i}") for i in range(4)]
+    b_drives = [str(base / f"b{i}") for i in range(4)]
+    s1 = ServerThread(a_drives)
+    s2 = ServerThread(b_drives)
+    c1 = S3Client(f"127.0.0.1:{s1.port}")
+    c2 = S3Client(f"127.0.0.1:{s2.port}")
+    s2_port = s2.port
+    try:
+        body = json.dumps([
+            {"name": "siteA", "endpoint": f"http://127.0.0.1:{s1.port}",
+             "accessKey": "minioadmin", "secretKey": "minioadmin"},
+            {"name": "siteB", "endpoint": f"http://127.0.0.1:{s2_port}",
+             "accessKey": "minioadmin", "secretKey": "minioadmin"},
+        ]).encode()
+        r = c1.request("POST", "/minio/admin/v3/site-replication/add", body=body)
+        assert r.status == 200, r.body
+
+        assert c1.make_bucket("fob").status == 200
+        assert _wait(lambda: c2.bucket_exists("fob"))
+
+        wave1 = {f"w1/k{i}": bytes([i]) * (1000 + i) for i in range(6)}
+        for k, v in wave1.items():
+            assert c1.put_object("fob", k, v).status == 200
+        assert _wait(
+            lambda: all(c2.get_object("fob", k).body == v
+                        for k, v in wave1.items())
+        )
+
+        # peer dies mid-stream
+        s2.stop()
+        time.sleep(0.5)
+        wave2 = {f"w2/k{i}": bytes([64 + i]) * (2000 + i) for i in range(6)}
+        for k, v in wave2.items():
+            assert c1.put_object("fob", k, v).status == 200
+        # the replication attempts against the dead peer fail/queue; the
+        # source keeps serving its own reads
+        assert c1.get_object("fob", "w2/k0").body == wave2["w2/k0"]
+
+        # peer returns on the SAME address with the same drives
+        s2b = ServerThread(b_drives, port=s2_port)
+        try:
+            c2b = S3Client(f"127.0.0.1:{s2_port}")
+            # resync replays the bucket to the returned peer (the drain)
+            r = c1.request("POST", "/minio/admin/v3/replication/resync",
+                           query={"bucket": "fob"})
+            assert r.status == 200, r.body
+            assert json.loads(r.body)["queued"] >= len(wave1) + len(wave2)
+
+            everything = {**wave1, **wave2}
+            assert _wait(
+                lambda: all(c2b.get_object("fob", k).body == v
+                            for k, v in everything.items())
+            ), "object set must converge after the peer returns"
+            # byte-identical INCLUDING etags (full-object md5 both sides)
+            for k in everything:
+                ra = c1.request("HEAD", f"/fob/{k}")
+                rb = c2b.request("HEAD", f"/fob/{k}")
+                assert ra.headers.get("ETag") == rb.headers.get("ETag"), k
+        finally:
+            s2b.stop()
+    finally:
+        s1.stop()
