@@ -27,6 +27,9 @@ docs:
 
 # harness-stays-runnable gate: the closed-loop load harness end to end
 # (worker pool, mixed zipf traffic, heal flood, QoS guard metrics) in
-# seconds — full runs write BENCH json, this just proves it still works
+# seconds — full runs write BENCH json, this just proves it still works.
+# Then every named workload profile at toy scale, each with its real
+# gates armed (a missing gate series fails the run, never passes it).
 bench-smoke:
 	MINIO_TPU_BACKEND=numpy $(PY) benchmarks/bench_load.py --quick
+	MINIO_TPU_BACKEND=numpy $(PY) -m benchmarks.scenarios --all --quick

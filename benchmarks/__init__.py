@@ -1,0 +1,5 @@
+"""Benchmark harnesses (closed-loop load, scenario zoo, micro-benches).
+
+Run from the repo root: ``python benchmarks/bench_load.py --quick`` or
+``python -m benchmarks.scenarios --all --quick``.
+"""

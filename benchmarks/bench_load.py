@@ -1,1134 +1,56 @@
-"""Closed-loop production load harness (round 7: many-core data plane;
-round 8: ranged-GET segment-cache phases; round 10: elastic topology).
+"""Compatibility entry point for the closed-loop load harness.
 
-Drives a REAL server process (optionally an SO_REUSEPORT worker pool,
-``MINIO_TPU_WORKERS``) with production-shaped traffic and emits the
-numbers PERF.md and BENCH_r07/r08.json track:
+The harness itself now lives in the scenario zoo: the shared engine in
+``benchmarks/scenarios/engine.py`` and the BENCH_r07/r10 phases (mixed
+closed loop, large-PUT, QoS guard, ranged segment-cache, elastic
+topology) in ``benchmarks/scenarios/legacy.py``. This wrapper keeps the
+historical invocation — and, critically, the exact JSON series names —
+so BENCH_r07.json / BENCH_r10.json runs stay comparable release over
+release:
 
-- **Mixed closed-loop phase**: N virtual clients, each a coroutine that
-  issues its next request only after the previous one completes (closed
-  loop — offered load adapts to service rate instead of queueing without
-  bound). Op mix GET/PUT/HEAD/LIST over a zipf-hot keyspace, with the
-  background scanner/ILM running and induced heal work pending, so QoS
-  admission, the cache tiers, hedged reads, and the heal plane are
-  exercised TOGETHER. Reports per-class p50/p99 latency, IOPS, and
-  aggregate throughput.
-- **Large-PUT segment**: few concurrent 64 MiB streaming PUTs at EC 8+8
-  over 16 drives — the VERDICT r5 top-gap metric (target >= 350 MiB/s
-  multi-core; the single-core wall was ~200-240 MiB/s).
-- **QoS guard phase**: foreground GET p99 with a background heal flood
-  off vs on, at high connection counts (>= 5k full mode), plus the
-  ``fg_deferred_behind_bg`` invariant read from the pool-aggregated
-  metrics — the "bg must ride leftover capacity only" proof under real
-  HTTP load rather than the dispatcher microbench in bench.py.
-- **Ranged (segment cache) phases**: 1 MiB ranged GETs over a 64 MiB
-  object — cold vs warm (memory tier and NVMe tier on separate fresh
-  servers, median-of-N warm passes) vs a prefetched sequential pass;
-  the mixed phase additionally carries an RGET request class so the
-  segment path is exercised under production load.
-- **Topology phase (round 10)**: live pool expansion -> continuous
-  placement-aware rebalance with a SEEDED partition injected mid-drain
-  (topology fault boundary) -> decommission -> pool removal, all under
-  verifying zipf traffic: every GET is checked byte-for-byte against a
-  per-key generation ledger and its ETag against the served bytes.
-  Gates: zero stale bytes/etags across the set-membership changes,
-  ``fg_deferred_behind_bg`` flat, the pinned hot prefix never drained,
-  the partition provably bit, and ``rebalance_throughput_mibps``
-  recorded (BENCH_r10.json).
-
-Worker count and nproc are recorded in the JSON so cross-host numbers
-are never compared blindly.
-
-Usage:
     python benchmarks/bench_load.py                    # full run
     python benchmarks/bench_load.py --quick            # seconds (CI gate)
     python benchmarks/bench_load.py --workers 1,2      # compare pool sizes
     python benchmarks/bench_load.py --out BENCH_r07.json
+
+Named workload profiles (small-object-storm, ml-dataloader-shuffle,
+backup-restore, multi-tenant-burst) run through the zoo instead:
+
+    python -m benchmarks.scenarios --all --quick
 """
 
 from __future__ import annotations
 
-import argparse
-import asyncio
-import bisect
-import json
 import os
-import random
-import shutil
-import signal
-import subprocess
 import sys
-import tempfile
-import threading
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 os.environ.setdefault("MINIO_TPU_BACKEND", "numpy")
 
-from minio_tpu.client import S3Client  # noqa: E402
-from minio_tpu.server.signature import sign_request  # noqa: E402
-
-MIB = 1 << 20
-BUCKET = "loadbkt"
-UNSIGNED = "UNSIGNED-PAYLOAD"
-
-
-# ---------------------------------------------------------------- server
-
-
-class Server:
-    """One server process (pool supervisor when workers > 1) over fresh
-    local drives, EC 8+8 when 16 drives."""
-
-    def __init__(self, base: str, port: int, drives: int, workers: int,
-                 scan_interval: float, extra_env: dict | None = None):
-        self.port = port
-        self.drives = [os.path.join(base, f"d{i}") for i in range(drives)]
-        env = dict(
-            os.environ,
-            MINIO_TPU_WORKERS=str(workers),
-            MINIO_TPU_SCAN_INTERVAL=str(scan_interval),
-            MINIO_COMPRESSION_ENABLE="off",
-        )
-        env.update(extra_env or {})
-        # the readiness probes below assume the default control-port
-        # layout (port+1000+i); scrub inherited pool identity/overrides
-        # so an operator env can't silently shift the workers elsewhere
-        for k in ("MINIO_TPU_WORKER_INDEX", "MINIO_TPU_WORKER_COUNT",
-                  "MINIO_TPU_WORKER_PORT_BASE"):
-            env.pop(k, None)
-        if drives >= 16:
-            # the default storage class at 16 drives is EC:4; the target
-            # config is EC 8+8
-            env["MINIO_STORAGE_CLASS_STANDARD"] = "EC:8"
-        self.proc = subprocess.Popen(
-            [sys.executable, "-m", "minio_tpu.server",
-             "--address", f"127.0.0.1:{port}", *self.drives],
-            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT,
-        )
-        # readiness must cover EVERY worker: the shared SO_REUSEPORT port
-        # answers as soon as ONE worker is up, and a request landing on a
-        # still-booting sibling would 503
-        probes = (
-            [S3Client(f"127.0.0.1:{port + 1000 + i}") for i in range(workers)]
-            if workers > 1
-            else [S3Client(f"127.0.0.1:{port}")]
-        )
-        deadline = time.time() + 120
-        pending = list(probes)
-        while pending and time.time() < deadline:
-            still = []
-            for cli in pending:
-                try:
-                    if cli.request("GET", "/", timeout=5).status != 200:
-                        still.append(cli)
-                except Exception:  # noqa: BLE001 — still booting
-                    still.append(cli)
-            pending = still
-            if pending:
-                time.sleep(0.3)
-        if pending:
-            self.stop()
-            raise RuntimeError("server did not become ready")
-
-    def stop(self) -> None:
-        if self.proc.poll() is None:
-            self.proc.send_signal(signal.SIGTERM)
-            try:
-                self.proc.wait(20)
-            except subprocess.TimeoutExpired:
-                self.proc.kill()
-                self.proc.wait()
-
-
-# ------------------------------------------------------------- async client
-
-
-class AsyncS3:
-    """Minimal SigV4 asyncio client: one aiohttp session shared by every
-    virtual client (connection pool unbounded — concurrency is set by the
-    closed-loop client count, not by the connector)."""
-
-    def __init__(self, session, host: str, port: int):
-        self.session = session
-        self.base = f"http://{host}:{port}"
-        self.host = host
-        self.port = port
-
-    def _signed(self, method: str, path: str, query: str) -> dict:
-        url = f"{self.base}{path}" + (f"?{query}" if query else "")
-        return sign_request(
-            method, url, {"x-amz-content-sha256": UNSIGNED}, UNSIGNED,
-            "minioadmin", "minioadmin", "us-east-1",
-        )
-
-    async def request(self, method: str, path: str, query: str = "",
-                      body: bytes = b"", read: bool = True,
-                      headers: dict | None = None):
-        st, data, _ = await self.request_full(
-            method, path, query, body, read, headers
-        )
-        return st, data
-
-    async def request_full(self, method: str, path: str, query: str = "",
-                           body: bytes = b"", read: bool = True,
-                           headers: dict | None = None):
-        """Like request() but also returns the response headers (the
-        topology phase cross-checks ETag against the served bytes)."""
-        hdrs = self._signed(method, path, query)
-        if headers:
-            hdrs.update(headers)  # unsigned extras (Range) are S3-legal
-        url = f"{self.base}{path}" + (f"?{query}" if query else "")
-        async with self.session.request(
-            method, url, data=body if body else None, headers=hdrs
-        ) as resp:
-            data = await resp.read() if read else b""
-            return resp.status, data, dict(resp.headers)
-
-
-ZIPF_ALPHA = 1.1
-
-
-def zipf_cdf(n: int, alpha: float = ZIPF_ALPHA) -> list[float]:
-    w = [1.0 / (i + 1) ** alpha for i in range(n)]
-    total = sum(w)
-    acc, out = 0.0, []
-    for x in w:
-        acc += x / total
-        out.append(acc)
-    return out
-
-
-class Stats:
-    """Per-class latency/bytes accounting for one phase. 503 SlowDown is
-    the admission plane doing its job (bounded latency instead of
-    unbounded queueing) — counted separately from errors, excluded from
-    the latency percentiles, and answered by the virtual client with the
-    Retry-After backoff a real SDK would apply."""
-
-    def __init__(self):
-        self.lat: dict[str, list[float]] = {}
-        self.bytes = 0
-        self.errors = 0
-        self.slowdowns = 0
-        self.ops = 0
-
-    def add(self, cls: str, dt: float, nbytes: int, status: int) -> None:
-        if status == 503:
-            self.slowdowns += 1
-            return
-        self.lat.setdefault(cls, []).append(dt)
-        self.ops += 1
-        self.bytes += nbytes
-        if status not in (200, 206):  # 206: ranged GET partial content
-            self.errors += 1
-
-    def summary(self, wall: float) -> dict:
-        def pct(xs: list[float], q: float) -> float:
-            xs = sorted(xs)
-            return xs[min(len(xs) - 1, int(len(xs) * q))]
-
-        per_class = {
-            cls: {
-                "count": len(xs),
-                "p50_ms": round(pct(xs, 0.50) * 1e3, 3),
-                "p99_ms": round(pct(xs, 0.99) * 1e3, 3),
-            }
-            for cls, xs in sorted(self.lat.items())
-        }
-        return {
-            "wall_s": round(wall, 2),
-            "iops": round(self.ops / max(wall, 1e-9), 1),
-            "throughput_mibs": round(self.bytes / MIB / max(wall, 1e-9), 1),
-            "errors": self.errors,
-            "slowdowns_503": self.slowdowns,
-            "per_class": per_class,
-        }
-
-
-async def run_mixed(cli: AsyncS3, clients: int, duration: float,
-                    keyspace: int, obj_kb: int, put_frac: float,
-                    ranged_key: str = "", ranged_mib: int = 0) -> Stats:
-    """Closed-loop mixed GET/PUT/HEAD/LIST phase over a zipf-hot keyspace,
-    plus an RGET class (Range header over a large object) when
-    ``ranged_key`` is set — the segment-cache path exercised under mixed
-    production load, with its own p50/p99/IOPS row."""
-    stats = Stats()
-    cdf = zipf_cdf(keyspace)
-    stop_at = time.monotonic() + duration
-    body = os.urandom(obj_kb * 1024)
-    rget_frac = 0.05 if ranged_key else 0.0
-    ranged_blocks = max(ranged_mib, 1)
-
-    async def one_client(cid: int) -> None:
-        rng = random.Random(cid)
-        while time.monotonic() < stop_at:
-            r = rng.random()
-            key = f"o{bisect.bisect_left(cdf, rng.random()):06d}"
-            t0 = time.perf_counter()
-            try:
-                if r < put_frac:  # overwrite a hot key: invalidation churn
-                    st, _ = await cli.request(
-                        "PUT", f"/{BUCKET}/{key}", body=body, read=False
-                    )
-                    stats.add("PUT", time.perf_counter() - t0, len(body), st)
-                elif r < put_frac + 0.60 - rget_frac:
-                    st, data = await cli.request("GET", f"/{BUCKET}/{key}")
-                    stats.add("GET", time.perf_counter() - t0, len(data), st)
-                elif r < put_frac + 0.60:
-                    off = rng.randrange(ranged_blocks) * MIB
-                    st, data = await cli.request(
-                        "GET", f"/{BUCKET}/{ranged_key}",
-                        headers={"Range": f"bytes={off}-{off + MIB - 1}"},
-                    )
-                    stats.add("RGET", time.perf_counter() - t0, len(data), st)
-                elif r < put_frac + 0.75:
-                    st, _ = await cli.request("HEAD", f"/{BUCKET}/{key}")
-                    stats.add("HEAD", time.perf_counter() - t0, 0, st)
-                else:
-                    st, data = await cli.request(
-                        "GET", f"/{BUCKET}",
-                        query="list-type=2&max-keys=50&prefix=o0",
-                    )
-                    stats.add("LIST", time.perf_counter() - t0, len(data), st)
-                if st == 503:  # SlowDown: back off like a real SDK
-                    await asyncio.sleep(1.0)
-            except Exception:  # noqa: BLE001 — count, keep looping
-                stats.add("ERR", time.perf_counter() - t0, 0, 599)
-
-    t0 = time.monotonic()
-    await asyncio.gather(*(one_client(i) for i in range(clients)))
-    stats.wall = time.monotonic() - t0
-    return stats
-
-
-async def run_get_loop(cli: AsyncS3, clients: int, duration: float,
-                       keyspace: int) -> Stats:
-    """Hot-GET closed loop (QoS guard phase): latency under connection
-    pressure, no writes."""
-    stats = Stats()
-    cdf = zipf_cdf(keyspace)
-    stop_at = time.monotonic() + duration
-
-    async def one_client(cid: int) -> None:
-        rng = random.Random(cid * 7919)
-        while time.monotonic() < stop_at:
-            key = f"o{bisect.bisect_left(cdf, rng.random()):06d}"
-            t0 = time.perf_counter()
-            try:
-                st, data = await cli.request("GET", f"/{BUCKET}/{key}")
-                stats.add("GET", time.perf_counter() - t0, len(data), st)
-                if st == 503:  # SlowDown: back off like a real SDK
-                    await asyncio.sleep(1.0)
-            except Exception:  # noqa: BLE001
-                stats.add("ERR", time.perf_counter() - t0, 0, 599)
-
-    t0 = time.monotonic()
-    await asyncio.gather(*(one_client(i) for i in range(clients)))
-    stats.wall = time.monotonic() - t0
-    return stats
-
-
-async def run_put_throughput(cli: AsyncS3, streams: int, obj_mib: int,
-                             repeats: int) -> float:
-    """Aggregate streaming-PUT MiB/s: `streams` concurrent large PUTs,
-    `repeats` rounds each."""
-    body = os.urandom(obj_mib * MIB)
-
-    async def one(i: int) -> None:
-        for r in range(repeats):
-            st, _ = await cli.request(
-                "PUT", f"/{BUCKET}/big-{i}-{r}", body=body, read=False
-            )
-            assert st == 200, f"big PUT failed: HTTP {st}"
-
-    t0 = time.perf_counter()
-    await asyncio.gather(*(one(i) for i in range(streams)))
-    wall = time.perf_counter() - t0
-    return streams * repeats * obj_mib / wall
-
-
-# ------------------------------------------------------------ ranged GETs
-
-
-async def run_ranged_pass(cli: AsyncS3, key: str, size_mib: int,
-                          order: list[int], concurrency: int) -> Stats:
-    """One pass of 1 MiB ranged GETs over `key` at the given offsets
-    (MiB units), `concurrency` closed-loop workers draining the list."""
-    stats = Stats()
-    queue: list[int] = list(order)
-
-    async def worker() -> None:
-        while queue:
-            off = queue.pop() * MIB
-            t0 = time.perf_counter()
-            try:
-                st, data = await cli.request(
-                    "GET", f"/{BUCKET}/{key}",
-                    headers={"Range": f"bytes={off}-{off + MIB - 1}"},
-                )
-                stats.add("RGET", time.perf_counter() - t0, len(data), st)
-                if st == 206 and len(data) != MIB:
-                    stats.errors += 1
-            except Exception:  # noqa: BLE001
-                stats.add("ERR", time.perf_counter() - t0, 0, 599)
-
-    t0 = time.monotonic()
-    await asyncio.gather(*(worker() for _ in range(concurrency)))
-    stats.wall = time.monotonic() - t0
-    return stats
-
-
-def _median(xs: list[float]) -> float:
-    xs = sorted(xs)
-    return xs[len(xs) // 2]
-
-
-async def ranged_round(port: int, size_mib: int, repeats: int,
-                       concurrency: int = 8) -> dict:
-    """The segment-path benchmark: 1 MiB ranged GETs over one
-    `size_mib` object — cold (first pass, shuffled so no sequential run
-    forms), warm (repeat passes served from the segment tiers,
-    median-of-`repeats`), and prefetched (a fresh sequential pass with
-    read-ahead running ahead of the client; warm-up requests excluded).
-    The caller picks the tier the warm passes land in via the server's
-    cache env (big memory budget -> memory tier; tiny memory budget +
-    disk budget -> NVMe tier)."""
-    import aiohttp
-
-    conn = aiohttp.TCPConnector(limit=0)
-    timeout = aiohttp.ClientTimeout(total=300)
-    async with aiohttp.ClientSession(
-        connector=conn, timeout=timeout, auto_decompress=False
-    ) as session:
-        cli = AsyncS3(session, "127.0.0.1", port)
-        body = os.urandom(size_mib * MIB)
-        st, _ = await cli.request(
-            "PUT", f"/{BUCKET}/r-main", body=body, read=False
-        )
-        assert st == 200, f"ranged preload PUT: HTTP {st}"
-
-        order = list(range(size_mib))
-        random.Random(4242).shuffle(order)  # no run -> no prefetch
-        cold = await run_ranged_pass(cli, "r-main", size_mib, order, concurrency)
-
-        warm_iops, warm_p50, warm_p99 = [], [], []
-        for i in range(repeats):
-            random.Random(100 + i).shuffle(order)
-            w = await run_ranged_pass(
-                cli, "r-main", size_mib, order, concurrency
-            )
-            s = w.summary(w.wall)
-            warm_iops.append(s["iops"])
-            warm_p50.append(s["per_class"]["RGET"]["p50_ms"])
-            warm_p99.append(s["per_class"]["RGET"]["p99_ms"])
-
-        # prefetched: fresh object, strictly sequential, single client so
-        # the read-ahead (not concurrency) is what hides the misses
-        st, _ = await cli.request(
-            "PUT", f"/{BUCKET}/r-seq", body=body, read=False
-        )
-        assert st == 200
-        warmup = 4
-        seq = await run_ranged_pass(
-            cli, "r-seq", size_mib, list(range(size_mib))[::-1], 1
-        )  # reversed because workers pop() from the tail -> ascending
-        seq_lat = sorted(seq.lat.get("RGET", [0.0])[warmup:])
-
-        cold_s = cold.summary(cold.wall)
-        return {
-            "object_mib": size_mib,
-            "concurrency": concurrency,
-            "repeats": repeats,
-            "cold": {
-                "iops": cold_s["iops"],
-                "p50_ms": cold_s["per_class"]["RGET"]["p50_ms"],
-                "p99_ms": cold_s["per_class"]["RGET"]["p99_ms"],
-                "errors": cold_s["errors"],
-            },
-            "warm": {
-                "iops": _median(warm_iops),
-                "p50_ms": _median(warm_p50),
-                "p99_ms": _median(warm_p99),
-            },
-            "prefetched_seq": {
-                "iops": round(
-                    len(seq_lat) / max(sum(seq_lat), 1e-9), 1
-                ),
-                "p50_ms": round(seq_lat[len(seq_lat) // 2] * 1e3, 3),
-                "p99_ms": round(
-                    seq_lat[min(len(seq_lat) - 1,
-                                int(len(seq_lat) * 0.99))] * 1e3, 3),
-                "warmup_excluded": warmup,
-            },
-        }
-
-
-def scrape_cache_series(port: int) -> dict:
-    """Segment/prefetch counters from metrics v3 (pool-aggregated)."""
-    cli = S3Client(f"127.0.0.1:{port}")
-    r = cli.request("GET", "/minio/metrics/v3/api/cache")
-    assert r.status == 200, f"cache metrics scrape failed: HTTP {r.status}"
-    out: dict[str, float] = {}
-    for line in r.body.decode().splitlines():
-        if line.startswith("#") or " " not in line:
-            continue
-        name, val = line.rsplit(" ", 1)
-        try:
-            out[name] = out.get(name, 0) + float(val)
-        except ValueError:
-            pass
-    return {
-        k: v for k, v in out.items()
-        if "segment" in k or "prefetch" in k
-    }
-
-
-def bench_ranged(cfg: argparse.Namespace) -> dict:
-    """Run the ranged benchmark twice: once against a memory-budget
-    server (warm passes hit the memory tier) and once against a
-    tiny-memory + NVMe-budget server (warm passes promote from the disk
-    tier). Each server is fresh — the two tiers are measured in
-    isolation."""
-    out: dict = {}
-    tiers = {
-        "memory": {
-            "MINIO_TPU_CACHE_DISK_MB": "0",
-        },
-        "disk": {
-            # memory can hold only a fraction of the object: warm passes
-            # must come off the NVMe tier (promote-on-hit)
-            "MINIO_TPU_CACHE_MEM_MB": str(max(cfg.ranged_object_mib // 4, 8)),
-            "MINIO_TPU_CACHE_DISK_MB": str(cfg.ranged_object_mib * 8),
-        },
-    }
-    for tier, env in tiers.items():
-        base = tempfile.mkdtemp(prefix=f"bench-ranged-{tier}-")
-        srv = Server(base, cfg.port, cfg.drives, 1,
-                     scan_interval=300.0, extra_env=env)
-        try:
-            cli = S3Client(f"127.0.0.1:{cfg.port}")
-            assert cli.make_bucket(BUCKET).status == 200
-            res = asyncio.run(ranged_round(
-                cfg.port, cfg.ranged_object_mib, cfg.ranged_repeats
-            ))
-            res["cache_env"] = env
-            res["segment_series"] = scrape_cache_series(cfg.port)
-            res["fg_deferred_behind_bg"] = scrape_counter(
-                cfg.port, "minio_tpu_dispatch_fg_deferred_behind_bg_total"
-            )
-            out[tier] = res
-        finally:
-            srv.stop()
-            shutil.rmtree(base, ignore_errors=True)
-    if out["memory"]["cold"]["iops"]:
-        out["speedup_warm_memory_vs_cold_iops"] = round(
-            out["memory"]["warm"]["iops"] / out["memory"]["cold"]["iops"], 1
-        )
-    return out
-
-
-# ------------------------------------------------------ topology (round 10)
-
-
-def _admin(port: int, method: str, path: str, body: bytes = b"",
-           query: dict | None = None, timeout: float = 60):
-    cli = S3Client(f"127.0.0.1:{port}")
-    return cli.request(method, f"/minio/admin/v3/{path}", body=body,
-                       query=query or {}, timeout=timeout)
-
-
-def _tbody(key: str, gen: int, size: int) -> bytes:
-    """Deterministic content for (key, generation): a reader can verify
-    every byte of every response it ever gets."""
-    import hashlib as _hl
-
-    seed = _hl.md5(f"{key}#{gen}".encode()).digest()
-    return (seed * (size // len(seed) + 1))[:size]
-
-
-class TopologyLoad:
-    """Verifying zipf mixed load for the topology phase. Every GET is
-    checked byte-for-byte against the generation ledger (and its ETag
-    against the served bytes), so a single stale cache entry or lost
-    update anywhere across the set-membership changes is a counted
-    failure, not a silent wrong answer."""
-
-    def __init__(self, cli: "AsyncS3", bucket: str, static_keys: list[str],
-                 hot_keys: list[str], size: int, clients: int):
-        self.cli = cli
-        self.bucket = bucket
-        self.static_keys = static_keys
-        self.hot_keys = hot_keys
-        self.size = size
-        self.clients = clients
-        self.committed = {k: 0 for k in hot_keys}  # gen ledger
-        self.stop = asyncio.Event()
-        self.stats = {"reads": 0, "writes": 0, "stale": 0, "etag_bad": 0,
-                      "errors": 0, "slowdowns": 0}
-        self.examples: list[str] = []
-
-    def _flag(self, kind: str, msg: str) -> None:
-        self.stats[kind] += 1
-        if len(self.examples) < 10:
-            self.examples.append(f"{kind}: {msg}")
-
-    async def _verify_get(self, key: str, expect_gen=None) -> None:
-        import hashlib as _hl
-
-        c0 = self.committed.get(key, 0) if expect_gen is None else expect_gen
-        st, data, hdrs = await self.cli.request_full(
-            "GET", f"/{self.bucket}/{key}"
-        )
-        if st == 503:
-            self.stats["slowdowns"] += 1
-            await asyncio.sleep(0.5)
-            return
-        if st != 200:
-            self._flag("errors", f"GET {key} -> HTTP {st}")
-            return
-        self.stats["reads"] += 1
-        if key in self.committed:
-            # accept the floor generation or anything newer (a racing
-            # writer may land mid-GET); OLDER than the floor = stale
-            for g in range(c0, self.committed[key] + 2):
-                if data == _tbody(key, g, self.size):
-                    break
-            else:
-                self._flag("stale", f"{key}: bytes match no gen >= {c0}")
-                return
-        else:
-            if data != _tbody(key, 0, self.size):
-                self._flag("stale", f"{key}: static bytes mismatch")
-                return
-        etag = (hdrs.get("ETag") or "").strip('"')
-        if etag and "-" not in etag and etag != _hl.md5(data).hexdigest():
-            self._flag("etag_bad", f"{key}: etag {etag} != md5(bytes)")
-
-    async def _reader(self, rid: int) -> None:
-        rng = random.Random(1000 + rid)
-        cdf = zipf_cdf(len(self.static_keys))
-        while not self.stop.is_set():
-            try:
-                if rng.random() < 0.3 and self.hot_keys:
-                    key = rng.choice(self.hot_keys)
-                else:
-                    key = self.static_keys[
-                        bisect.bisect_left(cdf, rng.random())
-                    ]
-                await self._verify_get(key)
-            except Exception as e:  # noqa: BLE001 — count, keep looping
-                self._flag("errors", f"reader: {type(e).__name__}: {e}")
-
-    async def _writer(self, wid: int) -> None:
-        """Overwrites its OWN slice of hot keys (one writer per key:
-        the generation ledger stays a total order per key)."""
-        rng = random.Random(2000 + wid)
-        mine = self.hot_keys[wid::4]
-        while not self.stop.is_set() and mine:
-            key = rng.choice(mine)
-            gen = self.committed[key] + 1
-            try:
-                st, _ = await self.cli.request(
-                    "PUT", f"/{self.bucket}/{key}",
-                    body=_tbody(key, gen, self.size), read=False,
-                )
-                if st == 200:
-                    self.committed[key] = gen
-                    self.stats["writes"] += 1
-                elif st == 503:
-                    self.stats["slowdowns"] += 1
-                    await asyncio.sleep(0.5)
-                else:
-                    self._flag("errors", f"PUT {key} -> HTTP {st}")
-            except Exception as e:  # noqa: BLE001
-                self._flag("errors", f"writer: {type(e).__name__}: {e}")
-            await asyncio.sleep(0.02)
-
-    async def run(self) -> None:
-        tasks = [
-            asyncio.create_task(self._reader(i)) for i in range(self.clients)
-        ] + [asyncio.create_task(self._writer(w)) for w in range(4)]
-        await self.stop.wait()
-        for t in tasks:
-            t.cancel()
-        await asyncio.gather(*tasks, return_exceptions=True)
-
-
-def _poll_admin(port: int, path: str, done, query: dict | None = None,
-                timeout: float = 120.0, every: float = 0.3) -> dict:
-    deadline = time.time() + timeout
-    last: dict = {}
-    while time.time() < deadline:
-        r = _admin(port, "GET", path, query=query)
-        if r.status == 200:
-            last = json.loads(r.body)
-            if done(last):
-                return last
-        time.sleep(every)
-    raise AssertionError(f"{path} did not converge in {timeout}s: {last}")
-
-
-async def run_topology_phase(port: int, base: str, cfg) -> dict:
-    """The elastic-topology proof: pool expansion -> continuous rebalance
-    with a seeded partition injected mid-drain -> decommission -> pool
-    removal, ALL under live verified zipf traffic. Gates: zero stale
-    bytes / bad etags, fg_deferred_behind_bg flat, pinned prefix never
-    drained, and a positive rebalance throughput recorded for the BENCH
-    json."""
-    import aiohttp
-
-    conn = aiohttp.TCPConnector(limit=0)
-    timeout = aiohttp.ClientTimeout(total=300)
-    async with aiohttp.ClientSession(
-        connector=conn, timeout=timeout, auto_decompress=False
-    ) as session:
-        cli = AsyncS3(session, "127.0.0.1", port)
-        size = cfg.topo_object_kb * 1024
-        static_keys = [f"stat-{i:04d}" for i in range(cfg.topo_keyspace)]
-        hot_keys = [f"hot/{i:03d}" for i in range(cfg.topo_hot_keys)]
-
-        # pin the hot prefix to pool 0 BEFORE any data lands
-        r = await asyncio.to_thread(
-            _admin, port, "POST", "placement/set", body=json.dumps(
-            {"bucket": BUCKET, "prefix": "hot/", "mode": "pin",
-             "pools": [0]}).encode())
-        assert r.status == 200, f"placement/set: {r.status} {r.body[:200]}"
-
-        sem = asyncio.Semaphore(16)
-
-        async def put_one(key: str, gen: int) -> None:
-            async with sem:
-                st, _ = await cli.request(
-                    "PUT", f"/{BUCKET}/{key}",
-                    body=_tbody(key, gen, size), read=False,
-                )
-                assert st == 200, f"preload {key}: HTTP {st}"
-
-        await asyncio.gather(*(put_one(k, 0) for k in static_keys))
-        # hot keys start at gen 1 (committed ledger starts there)
-        await asyncio.gather(*(put_one(k, 1) for k in hot_keys))
-
-        fg_deferred_before = await asyncio.to_thread(
-            scrape_counter, port,
-            "minio_tpu_dispatch_fg_deferred_behind_bg_total"
-        )
-
-        load = TopologyLoad(cli, BUCKET, static_keys, hot_keys, size,
-                            cfg.topo_clients)
-        for k in hot_keys:
-            load.committed[k] = 1
-        load_task = asyncio.create_task(load.run())
-        await asyncio.sleep(1.0)  # traffic flowing before any topology op
-
-        # -- expansion: second pool attaches to the RUNNING server ------
-        t0 = time.monotonic()
-        r = await asyncio.to_thread(
-            _admin, port, "POST", "pool/expand", json.dumps(
-            {"spec": os.path.join(base, "x2-d{1...%d}" % cfg.topo_drives)}
-        ).encode())
-        assert r.status == 200, f"pool/expand: {r.status} {r.body[:300]}"
-        expand = json.loads(r.body)
-
-        # -- continuous rebalance, chaos partition mid-drain ------------
-        # seeded partition armed BEFORE the mover starts: the drain's
-        # first pass provably runs through it (partition-during-drain),
-        # fails those moves, and must still converge once it clears
-        r = await asyncio.to_thread(
-            _admin, port, "POST", "fault/inject", json.dumps(
-                {"boundary": "topology", "mode": "partition",
-                 "target": "pool-0", "op": "move", "prob": 0.7,
-                 "count": 15, "seed": 42}).encode())
-        assert r.status == 200, r.body[:200]
-        fault_id = json.loads(r.body)["id"]
-        r = await asyncio.to_thread(
-            _admin, port, "POST", "pools/rebalance", b"",
-            {"threshold": str(cfg.topo_threshold_pct)})
-        assert r.status == 200, r.body[:200]
-        await asyncio.sleep(cfg.topo_chaos_s)  # let the partition bite
-        await asyncio.to_thread(
-            _admin, port, "POST", "fault/clear", b"",
-            {"id": str(fault_id), "local": "true"})
-        reb = await asyncio.to_thread(
-            _poll_admin, port, "pools/rebalance/status",
-            lambda s: s.get("state") != "running")
-        rebalance_wall = time.monotonic() - t0
-
-        # -- decommission the expanded pool, live, then detach it -------
-        r = await asyncio.to_thread(
-            _admin, port, "POST", "pools/decommission", b"", {"pool": "1"})
-        assert r.status == 200, r.body[:200]
-        decom = await asyncio.to_thread(
-            _poll_admin, port, "pools/decommission/status",
-            lambda s: s.get("state") in ("complete", "failed"),
-            {"pool": "1"},
-        )
-        r = await asyncio.to_thread(
-            _admin, port, "POST", "pool/remove", b"", {"pool": "1"})
-        removed = r.status == 200
-        # keep verified traffic running across the membership change —
-        # a stale cache entry from the dead sets would be caught here
-        await asyncio.sleep(cfg.topo_cooldown_s)
-
-        load.stop.set()
-        await load_task
-
-        fg_deferred_after = await asyncio.to_thread(
-            scrape_counter, port,
-            "minio_tpu_dispatch_fg_deferred_behind_bg_total"
-        )
-        topo_metrics = await asyncio.to_thread(
-            lambda: S3Client(f"127.0.0.1:{port}").request(
-                "GET", "/minio/metrics/v3/api/topology"
-            )
-        )
-        assert topo_metrics.status == 200
-
-    out = {
-        "expand": expand,
-        "rebalance": {k: reb.get(k) for k in (
-            "state", "moved", "moved_bytes", "failed", "skipped_pinned",
-            "passes", "spread_pct", "throughput_mibps", "eta_s")},
-        "rebalance_wall_s": round(rebalance_wall, 2),
-        "decommission": {k: decom.get(k) for k in (
-            "state", "objectsMoved", "bytesMoved", "failedObjects")},
-        "pool_removed": removed,
-        "load": dict(load.stats),
-        "fg_deferred_behind_bg_before": fg_deferred_before,
-        "fg_deferred_behind_bg_after": fg_deferred_after,
-        "examples": load.examples,
-    }
-    # -- the gates ---------------------------------------------------------
-    failures = []
-    if load.stats["stale"]:
-        failures.append(f"stale bytes served: {load.stats['stale']}")
-    if load.stats["etag_bad"]:
-        failures.append(f"etag/bytes mismatches: {load.stats['etag_bad']}")
-    if fg_deferred_after != fg_deferred_before:
-        failures.append(
-            "fg_deferred_behind_bg moved "
-            f"{fg_deferred_before} -> {fg_deferred_after}"
-        )
-    if reb.get("state") != "done":
-        failures.append(f"rebalance ended {reb.get('state')}")
-    if not reb.get("moved"):
-        failures.append("rebalance moved nothing")
-    if not reb.get("failed"):
-        failures.append(
-            "the mid-drain partition never bit a move (chaos misfire)"
-        )
-    if decom.get("state") != "complete":
-        failures.append(f"decommission ended {decom.get('state')}")
-    if not removed:
-        failures.append("pool/remove refused")
-    if load.stats["reads"] < 50:
-        failures.append(f"too few verified reads: {load.stats['reads']}")
-    out["gates_passed"] = not failures
-    out["gate_failures"] = failures
-    return out
-
-
-def bench_topology(cfg: argparse.Namespace) -> dict:
-    """Fresh single-process server (online topology changes refuse worker
-    pools), expansion + chaos rebalance + decommission under verified
-    live load."""
-    base = tempfile.mkdtemp(prefix="bench-topo-")
-    srv = Server(base, cfg.port, cfg.topo_drives, 1,
-                 scan_interval=cfg.scan_interval)
-    try:
-        cli = S3Client(f"127.0.0.1:{cfg.port}")
-        assert cli.make_bucket(BUCKET).status == 200
-        out = asyncio.run(run_topology_phase(cfg.port, base, cfg))
-        if out["gate_failures"]:
-            print(f"TOPOLOGY GATES FAILED: {out['gate_failures']}",
-                  file=sys.stderr, flush=True)
-        return out
-    finally:
-        srv.stop()
-        shutil.rmtree(base, ignore_errors=True)
-
-
-# ----------------------------------------------------------- qos plumbing
-
-
-def scrape_counter(port: int, series: str, path: str = "/api/qos") -> int:
-    """Sum a counter across workers from the pool-aggregated metrics v3
-    exposition (worker labels sum away). A failed scrape or a missing
-    series raises — the guard invariant must never 'pass' because the
-    measurement silently returned nothing."""
-    cli = S3Client(f"127.0.0.1:{port}")
-    r = cli.request("GET", f"/minio/metrics/v3{path}")
-    assert r.status == 200, f"metrics scrape failed: HTTP {r.status}"
-    total = 0
-    seen = False
-    for line in r.body.decode().splitlines():
-        if line.startswith(series) and not line.startswith("#"):
-            try:
-                total += int(float(line.rsplit(" ", 1)[1]))
-                seen = True
-            except ValueError:
-                pass
-    assert seen, f"series {series} absent from {path} exposition"
-    return total
-
-
-class HealFlood:
-    """Background heal/ILM flood: a thread looping admin heal sweeps
-    (walks + per-object heal over the whole keyspace) while the scanner
-    keeps its own cycle going — the bg pressure the QoS guard phase
-    measures fg p99 against."""
-
-    def __init__(self, port: int):
-        self.cli = S3Client(f"127.0.0.1:{port}")
-        self.stop = threading.Event()
-        self.sweeps = 0
-        self.thread = threading.Thread(target=self._loop, daemon=True)
-
-    def _loop(self) -> None:
-        while not self.stop.is_set():
-            try:
-                self.cli.request(
-                    "POST", f"/minio/admin/v3/heal/{BUCKET}", timeout=120
-                )
-                self.sweeps += 1
-            except Exception:  # noqa: BLE001 — flood keeps flooding
-                time.sleep(0.2)
-
-    def __enter__(self) -> "HealFlood":
-        self.thread.start()
-        return self
-
-    def __exit__(self, *exc) -> None:
-        self.stop.set()
-        self.thread.join(timeout=150)
-
-
-# ----------------------------------------------------------------- phases
-
-
-async def run_round(port: int, cfg: argparse.Namespace) -> dict:
-    import aiohttp
-
-    conn = aiohttp.TCPConnector(limit=0)
-    timeout = aiohttp.ClientTimeout(total=300)
-    async with aiohttp.ClientSession(
-        connector=conn, timeout=timeout, auto_decompress=False
-    ) as session:
-        cli = AsyncS3(session, "127.0.0.1", port)
-
-        # preload the keyspace (also the heal flood's object population)
-        body = os.urandom(cfg.object_kb * 1024)
-        sem = asyncio.Semaphore(32)
-
-        async def put_one(i: int) -> None:
-            async with sem:
-                st, _ = await cli.request(
-                    "PUT", f"/{BUCKET}/o{i:06d}", body=body, read=False
-                )
-                assert st == 200, f"preload PUT {i}: HTTP {st}"
-
-        t0 = time.monotonic()
-        await asyncio.gather(*(put_one(i) for i in range(cfg.keyspace)))
-        # one large object for the mixed phase's RGET class (the segment
-        # path exercised under production load, not just in isolation)
-        st, _ = await cli.request(
-            "PUT", f"/{BUCKET}/rmix",
-            body=os.urandom(cfg.ranged_object_mib * MIB), read=False,
-        )
-        assert st == 200, f"ranged preload PUT: HTTP {st}"
-        preload_s = time.monotonic() - t0
-
-        # mixed closed loop with scanner/ILM live
-        mixed = await run_mixed(
-            cli, cfg.clients, cfg.duration, cfg.keyspace, cfg.object_kb,
-            put_frac=0.20, ranged_key="rmix",
-            ranged_mib=cfg.ranged_object_mib,
-        )
-
-        # large-PUT aggregate throughput (the EC 8+8 target metric)
-        put_mibs = await run_put_throughput(
-            cli, cfg.put_streams, cfg.put_object_mib, cfg.put_repeats
-        )
-
-        # QoS guard: fg GET p99 with bg heal flood off vs on, at high
-        # connection count; fg_deferred_behind_bg read AFTER, aggregated
-        # over workers
-        qos_off = await run_get_loop(
-            cli, cfg.connections, cfg.qos_duration, cfg.keyspace
-        )
-        with HealFlood(port) as flood:
-            qos_on = await run_get_loop(
-                cli, cfg.connections, cfg.qos_duration, cfg.keyspace
-            )
-            sweeps = flood.sweeps
-        deferred = scrape_counter(
-            port, "minio_tpu_dispatch_fg_deferred_behind_bg_total"
-        )
-
-    off, on = qos_off.summary(qos_off.wall), qos_on.summary(qos_on.wall)
-    return {
-        "preload_s": round(preload_s, 1),
-        "mixed": mixed.summary(mixed.wall),
-        "put_streams": cfg.put_streams,
-        "put_object_mib": cfg.put_object_mib,
-        "put_throughput_mibs": round(put_mibs, 1),
-        "qos": {
-            "connections": cfg.connections,
-            "fg_get_p50_ms_bg_off": off["per_class"].get("GET", {}).get("p50_ms"),
-            "fg_get_p99_ms_bg_off": off["per_class"].get("GET", {}).get("p99_ms"),
-            "fg_get_p50_ms_bg_on": on["per_class"].get("GET", {}).get("p50_ms"),
-            "fg_get_p99_ms_bg_on": on["per_class"].get("GET", {}).get("p99_ms"),
-            "fg_iops_bg_off": off["iops"],
-            "fg_iops_bg_on": on["iops"],
-            "errors_bg_off": off["errors"],
-            "errors_bg_on": on["errors"],
-            "slowdowns_bg_off": off["slowdowns_503"],
-            "slowdowns_bg_on": on["slowdowns_503"],
-            "heal_sweeps_during_flood": sweeps,
-            "fg_deferred_behind_bg": deferred,
-        },
-    }
-
-
-def bench_one_worker_count(workers: int, cfg: argparse.Namespace) -> dict:
-    base = tempfile.mkdtemp(prefix=f"bench-load-w{workers}-")
-    srv = Server(base, cfg.port, cfg.drives, workers,
-                 scan_interval=cfg.scan_interval)
-    try:
-        cli = S3Client(f"127.0.0.1:{cfg.port}")
-        assert cli.make_bucket(BUCKET).status == 200
-        out = asyncio.run(run_round(cfg.port, cfg))
-        out["workers"] = workers
-        return out
-    finally:
-        srv.stop()
-        shutil.rmtree(base, ignore_errors=True)
-
-
-def main() -> int:
-    ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--workers", default="",
-                    help="comma-separated pool sizes to compare "
-                         "(default: 1,<nproc>; quick: 2)")
-    ap.add_argument("--drives", type=int, default=16)
-    ap.add_argument("--clients", type=int, default=512,
-                    help="closed-loop clients in the mixed phase")
-    ap.add_argument("--connections", type=int, default=5000,
-                    help="closed-loop clients in the QoS guard phase")
-    ap.add_argument("--duration", type=float, default=15.0)
-    ap.add_argument("--qos-duration", type=float, default=12.0)
-    ap.add_argument("--keyspace", type=int, default=512)
-    ap.add_argument("--object-kb", type=int, default=256,
-                    help="mixed-phase object size")
-    ap.add_argument("--put-streams", type=int, default=4)
-    ap.add_argument("--put-object-mib", type=int, default=64)
-    ap.add_argument("--put-repeats", type=int, default=3)
-    ap.add_argument("--scan-interval", type=float, default=30.0)
-    ap.add_argument("--ranged-object-mib", type=int, default=64,
-                    help="object size for the ranged-GET (segment cache) "
-                         "phases")
-    ap.add_argument("--ranged-repeats", type=int, default=5,
-                    help="warm ranged passes (median reported)")
-    ap.add_argument("--port", type=int, default=19801)
-    ap.add_argument("--topo-drives", type=int, default=8,
-                    help="drives per pool in the topology phase")
-    ap.add_argument("--topo-keyspace", type=int, default=192,
-                    help="static verified keys in the topology phase")
-    ap.add_argument("--topo-hot-keys", type=int, default=24,
-                    help="pinned hot (overwritten) keys")
-    ap.add_argument("--topo-object-kb", type=int, default=128)
-    ap.add_argument("--topo-clients", type=int, default=24,
-                    help="verifying reader coroutines")
-    ap.add_argument("--topo-threshold-pct", type=float, default=5.0)
-    ap.add_argument("--topo-chaos-s", type=float, default=2.0,
-                    help="seconds the mid-rebalance partition stays armed")
-    ap.add_argument("--topo-cooldown-s", type=float, default=2.0,
-                    help="verified traffic kept running after pool removal")
-    ap.add_argument("--out", default="",
-                    help="write the JSON here too (stdout always)")
-    ap.add_argument("--quick", action="store_true",
-                    help="seconds-long smoke (CI harness-stays-runnable "
-                         "gate): tiny keyspace, short phases, one pool size")
-    args = ap.parse_args()
-
-    if args.quick:
-        args.drives = min(args.drives, 8)
-        args.clients = 48
-        args.connections = 128
-        args.duration = 3.0
-        args.qos_duration = 2.5
-        args.keyspace = 48
-        args.object_kb = 64
-        args.put_streams = 2
-        args.put_object_mib = 4
-        args.put_repeats = 2
-        args.scan_interval = 5.0
-        args.ranged_object_mib = 8
-        args.ranged_repeats = 2
-        args.topo_drives = 4
-        args.topo_keyspace = 40
-        args.topo_hot_keys = 8
-        args.topo_object_kb = 32
-        args.topo_clients = 8
-        args.topo_chaos_s = 1.0
-        args.topo_cooldown_s = 1.0
-    worker_counts = [
-        int(w) for w in (
-            args.workers.split(",") if args.workers
-            else (["2"] if args.quick
-                  else ["1", str(os.cpu_count() or 1)])
-        )
-        if w.strip()
-    ]
-    # dedupe preserving order (nproc may be 1)
-    worker_counts = list(dict.fromkeys(worker_counts))
-
-    runs = []
-    for w in worker_counts:
-        print(f"=== round: {w} worker(s) ===", file=sys.stderr, flush=True)
-        runs.append(bench_one_worker_count(w, args))
-
-    print("=== round: ranged (segment cache) ===", file=sys.stderr,
-          flush=True)
-    ranged = bench_ranged(args)
-
-    print("=== round: topology (expand/rebalance/decom under load) ===",
-          file=sys.stderr, flush=True)
-    topology = bench_topology(args)
-
-    result = {
-        "metric": "load_harness_closed_loop",
-        "nproc": os.cpu_count(),
-        "drives": args.drives,
-        "ec": "8+8" if args.drives >= 16 else "default",
-        "quick": bool(args.quick),
-        "runs": runs,
-        "ranged": ranged,
-        "topology": topology,
-        # the round-10 headline: mover throughput under live verified
-        # traffic with a chaos partition mid-drain
-        "rebalance_throughput_mibps": topology["rebalance"].get(
-            "throughput_mibps", 0.0
-        ),
-    }
-    if not topology.get("gates_passed", False):
-        print(f"TOPOLOGY GATES FAILED: {topology.get('gate_failures')}",
-              file=sys.stderr, flush=True)
-        print(json.dumps(result))
-        return 1
-    by_w = {r["workers"]: r["put_throughput_mibs"] for r in runs}
-    if 1 in by_w and len(by_w) > 1:
-        best_w = max(w for w in by_w if w != 1)
-        result["put_scaling_vs_1_worker"] = round(
-            by_w[best_w] / max(by_w[1], 1e-9), 2
-        )
-    line = json.dumps(result)
-    print(line)
-    if args.out:
-        with open(args.out, "w", encoding="utf-8") as fh:
-            fh.write(line + "\n")
-    return 0
-
+from benchmarks.scenarios.legacy import (  # noqa: E402,F401 — re-exports
+    BUCKET,
+    MIB,
+    AsyncS3,
+    HealFlood,
+    Server,
+    Stats,
+    TopologyLoad,
+    _admin,
+    _poll_admin,
+    _tbody,
+    bench_one_worker_count,
+    bench_ranged,
+    bench_topology,
+    main,
+    ranged_round,
+    run_get_loop,
+    run_mixed,
+    run_put_throughput,
+    run_round,
+    run_topology_phase,
+    scrape_cache_series,
+    scrape_counter,
+)
 
 if __name__ == "__main__":
     sys.exit(main())

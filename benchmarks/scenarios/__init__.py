@@ -1,0 +1,11 @@
+"""Scenario zoo: named workload profiles with per-profile gates.
+
+Each profile is a declarative spec (client mix, object-size
+distribution, key-space shape, background pressure, gates and BENCH
+series) executed by the shared closed-loop engine in ``engine.py`` —
+the same primitives ``bench_load.py`` is built from, factored out so a
+new workload is a spec plus a phase function, not a fork of the
+harness. See docs/WORKLOADS.md for the schema and how to add one.
+"""
+
+from .profiles import PROFILES  # noqa: F401

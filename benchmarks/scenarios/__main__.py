@@ -1,0 +1,80 @@
+"""Scenario zoo runner.
+
+Usage:
+    python -m benchmarks.scenarios --list
+    python -m benchmarks.scenarios --all --quick          # CI smoke: every
+                                                          # profile, toy scale
+    python -m benchmarks.scenarios --profile small-object-storm \
+        --out BENCH_r11.json                              # full-scale run
+
+Exit status is non-zero if any selected profile's gates fail — and a
+profile fails BEFORE running if any series its gates are computed from
+is missing from the metrics scrape (no vacuous passes).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+os.environ.setdefault("MINIO_TPU_BACKEND", "numpy")
+
+from benchmarks.scenarios.profiles import PROFILES, run_profile  # noqa: E402
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--profile", action="append", default=[],
+                    choices=sorted(PROFILES),
+                    help="profile to run (repeatable)")
+    ap.add_argument("--all", action="store_true",
+                    help="run every named profile")
+    ap.add_argument("--list", action="store_true",
+                    help="list profiles and exit")
+    ap.add_argument("--quick", action="store_true",
+                    help="toy-scale specs (CI smoke)")
+    ap.add_argument("--port", type=int, default=19821)
+    ap.add_argument("--out", default="",
+                    help="write the JSON here too (stdout always)")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for name, p in sorted(PROFILES.items()):
+            print(f"{name:24s} {p.summary}")
+        return 0
+
+    names = sorted(PROFILES) if args.all else args.profile
+    if not names:
+        ap.error("pick --all or at least one --profile")
+
+    results: dict[str, dict] = {}
+    ok = True
+    for name in names:
+        print(f"=== profile: {name} "
+              f"({'quick' if args.quick else 'full'}) ===",
+              file=sys.stderr, flush=True)
+        res = run_profile(name, args.quick, args.port)
+        results[name] = res
+        ok = ok and res.get("gates_passed", False)
+
+    result = {
+        "metric": "scenario_zoo",
+        "quick": bool(args.quick),
+        "nproc": os.cpu_count(),
+        "profiles": results,
+        "gates_passed": ok,
+    }
+    line = json.dumps(result)
+    print(line)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as fh:
+            fh.write(line + "\n")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,803 @@
+"""Shared closed-loop load engine.
+
+The primitives every benchmark phase is built from, factored out of
+``bench_load.py`` so a workload profile (scenarios/profiles.py) and the
+legacy BENCH_r07/r10 rounds (scenarios/legacy.py) drive the SAME server
+bring-up, SigV4 client, closed-loop client shapes, latency accounting,
+and metrics scraping. A new workload is a declarative spec plus a phase
+coroutine — not a fork of the harness.
+
+Everything here talks to a REAL server process over HTTP; nothing
+reaches into in-process state (the one exception profiles may take is
+an explicitly-synthetic in-process measurement, labelled as such in
+their output).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import bisect
+import contextlib
+import json
+import os
+import random
+import re
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+os.environ.setdefault("MINIO_TPU_BACKEND", "numpy")
+
+from minio_tpu.client import S3Client  # noqa: E402
+from minio_tpu.server.signature import sign_request  # noqa: E402
+
+MIB = 1 << 20
+BUCKET = "loadbkt"
+UNSIGNED = "UNSIGNED-PAYLOAD"
+
+
+# ---------------------------------------------------------------- server
+
+
+class Server:
+    """One server process (pool supervisor when workers > 1) over fresh
+    local drives, EC 8+8 when 16 drives."""
+
+    def __init__(self, base: str, port: int, drives: int, workers: int,
+                 scan_interval: float, extra_env: dict | None = None):
+        self.port = port
+        self.drives = [os.path.join(base, f"d{i}") for i in range(drives)]
+        env = dict(
+            os.environ,
+            MINIO_TPU_WORKERS=str(workers),
+            MINIO_TPU_SCAN_INTERVAL=str(scan_interval),
+            MINIO_COMPRESSION_ENABLE="off",
+        )
+        env.update(extra_env or {})
+        # the readiness probes below assume the default control-port
+        # layout (port+1000+i); scrub inherited pool identity/overrides
+        # so an operator env can't silently shift the workers elsewhere
+        for k in ("MINIO_TPU_WORKER_INDEX", "MINIO_TPU_WORKER_COUNT",
+                  "MINIO_TPU_WORKER_PORT_BASE"):
+            env.pop(k, None)
+        if drives >= 16:
+            # the default storage class at 16 drives is EC:4; the target
+            # config is EC 8+8
+            env["MINIO_STORAGE_CLASS_STANDARD"] = "EC:8"
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "minio_tpu.server",
+             "--address", f"127.0.0.1:{port}", *self.drives],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT,
+        )
+        # readiness must cover EVERY worker: the shared SO_REUSEPORT port
+        # answers as soon as ONE worker is up, and a request landing on a
+        # still-booting sibling would 503
+        probes = (
+            [S3Client(f"127.0.0.1:{port + 1000 + i}") for i in range(workers)]
+            if workers > 1
+            else [S3Client(f"127.0.0.1:{port}")]
+        )
+        deadline = time.time() + 120
+        pending = list(probes)
+        while pending and time.time() < deadline:
+            still = []
+            for cli in pending:
+                try:
+                    if cli.request("GET", "/", timeout=5).status != 200:
+                        still.append(cli)
+                except Exception:  # noqa: BLE001 — still booting
+                    still.append(cli)
+            pending = still
+            if pending:
+                time.sleep(0.3)
+        if pending:
+            self.stop()
+            raise RuntimeError("server did not become ready")
+
+    def stop(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.send_signal(signal.SIGTERM)
+            try:
+                self.proc.wait(20)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait()
+
+
+def rss_tree_kb(root_pid: int) -> int:
+    """Resident set of a process TREE (the pool supervisor plus every
+    worker), summed from /proc — the backup-restore profile's
+    bounded-memory gate. Returns 0 on non-Linux."""
+    try:
+        ppid_of: dict[int, int] = {}
+        for ent in os.listdir("/proc"):
+            if not ent.isdigit():
+                continue
+            try:
+                with open(f"/proc/{ent}/stat", "rb") as fh:
+                    stat = fh.read().decode("ascii", "replace")
+                # field 4 (ppid) sits after the parenthesised comm,
+                # which may itself contain spaces
+                ppid_of[int(ent)] = int(stat.rsplit(")", 1)[1].split()[1])
+            except (OSError, ValueError, IndexError):
+                continue
+        tree = {root_pid}
+        grew = True
+        while grew:
+            grew = False
+            for pid, ppid in ppid_of.items():
+                if ppid in tree and pid not in tree:
+                    tree.add(pid)
+                    grew = True
+        total = 0
+        for pid in tree:
+            try:
+                with open(f"/proc/{pid}/status", "rb") as fh:
+                    m = re.search(rb"VmRSS:\s+(\d+) kB", fh.read())
+                if m:
+                    total += int(m.group(1))
+            except OSError:
+                continue
+        return total
+    except OSError:
+        return 0
+
+
+class RssSampler:
+    """Background max-RSS-of-tree watermark while a phase runs."""
+
+    def __init__(self, root_pid: int, every: float = 0.5):
+        self.root_pid = root_pid
+        self.every = every
+        self.max_kb = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self.max_kb = max(self.max_kb, rss_tree_kb(self.root_pid))
+            self._stop.wait(self.every)
+
+    def __enter__(self) -> "RssSampler":
+        self.max_kb = rss_tree_kb(self.root_pid)
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+        self.max_kb = max(self.max_kb, rss_tree_kb(self.root_pid))
+
+
+# ------------------------------------------------------------- async client
+
+
+class AsyncS3:
+    """Minimal SigV4 asyncio client: one aiohttp session shared by every
+    virtual client (connection pool unbounded — concurrency is set by the
+    closed-loop client count, not by the connector)."""
+
+    def __init__(self, session, host: str, port: int):
+        self.session = session
+        self.base = f"http://{host}:{port}"
+        self.host = host
+        self.port = port
+
+    def _signed(self, method: str, path: str, query: str) -> dict:
+        url = f"{self.base}{path}" + (f"?{query}" if query else "")
+        return sign_request(
+            method, url, {"x-amz-content-sha256": UNSIGNED}, UNSIGNED,
+            "minioadmin", "minioadmin", "us-east-1",
+        )
+
+    async def request(self, method: str, path: str, query: str = "",
+                      body: bytes = b"", read: bool = True,
+                      headers: dict | None = None):
+        st, data, _ = await self.request_full(
+            method, path, query, body, read, headers
+        )
+        return st, data
+
+    async def request_full(self, method: str, path: str, query: str = "",
+                           body: bytes = b"", read: bool = True,
+                           headers: dict | None = None):
+        """Like request() but also returns the response headers (the
+        topology phase cross-checks ETag against the served bytes)."""
+        hdrs = self._signed(method, path, query)
+        if headers:
+            hdrs.update(headers)  # unsigned extras (Range) are S3-legal
+        url = f"{self.base}{path}" + (f"?{query}" if query else "")
+        async with self.session.request(
+            method, url, data=body if body else None, headers=hdrs
+        ) as resp:
+            data = await resp.read() if read else b""
+            return resp.status, data, dict(resp.headers)
+
+
+def header_get(hdrs: dict, name: str) -> str:
+    """Case-insensitive response-header lookup (aiohttp title-cases
+    names: the server's ETag arrives as Etag)."""
+    for k, v in hdrs.items():
+        if k.lower() == name.lower():
+            return v
+    return ""
+
+
+@contextlib.asynccontextmanager
+async def s3_session(port: int, host: str = "127.0.0.1"):
+    """One unbounded-connector aiohttp session wrapped as AsyncS3 — the
+    bring-up every async phase shares."""
+    import aiohttp
+
+    conn = aiohttp.TCPConnector(limit=0)
+    timeout = aiohttp.ClientTimeout(total=300)
+    async with aiohttp.ClientSession(
+        connector=conn, timeout=timeout, auto_decompress=False
+    ) as session:
+        yield AsyncS3(session, host, port)
+
+
+async def multipart_put(cli: AsyncS3, bucket: str, key: str,
+                        parts: list[bytes]) -> str:
+    """S3 multipart upload over the raw wire: initiate, upload each part
+    (collecting ETags), complete. Returns the completed object's ETag.
+    Raises AssertionError on any non-200 leg — a backup stream that
+    silently drops a part must fail the phase, not shrink the object."""
+    st, data = await cli.request("POST", f"/{bucket}/{key}", query="uploads")
+    assert st == 200, f"initiate multipart {key}: HTTP {st}"
+    m = re.search(rb"<UploadId>([^<]+)</UploadId>", data)
+    assert m, f"no UploadId in initiate response: {data[:200]!r}"
+    upload_id = m.group(1).decode()
+
+    etags: list[str] = []
+    for n, body in enumerate(parts, start=1):
+        st, _, hdrs = await cli.request_full(
+            "PUT", f"/{bucket}/{key}",
+            query=f"partNumber={n}&uploadId={upload_id}", body=body,
+        )
+        assert st == 200, f"part {n} of {key}: HTTP {st}"
+        etag = header_get(hdrs, "ETag").strip('"')
+        assert etag, f"part {n} of {key}: no ETag header"
+        etags.append(etag)
+
+    xml = "<CompleteMultipartUpload>" + "".join(
+        f"<Part><PartNumber>{n}</PartNumber><ETag>{e}</ETag></Part>"
+        for n, e in enumerate(etags, start=1)
+    ) + "</CompleteMultipartUpload>"
+    st, data = await cli.request(
+        "POST", f"/{bucket}/{key}", query=f"uploadId={upload_id}",
+        body=xml.encode(),
+    )
+    assert st == 200 and b"<Error>" not in data, (
+        f"complete multipart {key}: HTTP {st} {data[:200]!r}")
+    m = re.search(rb"<ETag>&quot;([^&]+)&quot;</ETag>", data) or re.search(
+        rb'<ETag>"?([^<"]+)"?</ETag>', data)
+    return m.group(1).decode() if m else ""
+
+
+# ------------------------------------------------------------- workload law
+
+
+ZIPF_ALPHA = 1.1
+
+
+def zipf_cdf(n: int, alpha: float = ZIPF_ALPHA) -> list[float]:
+    w = [1.0 / (i + 1) ** alpha for i in range(n)]
+    total = sum(w)
+    acc, out = 0.0, []
+    for x in w:
+        acc += x / total
+        out.append(acc)
+    return out
+
+
+def median(xs: list[float]) -> float:
+    xs = sorted(xs)
+    return xs[len(xs) // 2]
+
+
+class Stats:
+    """Per-class latency/bytes accounting for one phase. 503 SlowDown is
+    the admission plane doing its job (bounded latency instead of
+    unbounded queueing) — counted separately from errors, excluded from
+    the latency percentiles, and answered by the virtual client with the
+    Retry-After backoff a real SDK would apply."""
+
+    def __init__(self):
+        self.lat: dict[str, list[float]] = {}
+        self.bytes = 0
+        self.errors = 0
+        self.slowdowns = 0
+        self.ops = 0
+
+    def add(self, cls: str, dt: float, nbytes: int, status: int) -> None:
+        if status == 503:
+            self.slowdowns += 1
+            return
+        self.lat.setdefault(cls, []).append(dt)
+        self.ops += 1
+        self.bytes += nbytes
+        if status not in (200, 206):  # 206: ranged GET partial content
+            self.errors += 1
+
+    def summary(self, wall: float) -> dict:
+        def pct(xs: list[float], q: float) -> float:
+            xs = sorted(xs)
+            return xs[min(len(xs) - 1, int(len(xs) * q))]
+
+        per_class = {
+            cls: {
+                "count": len(xs),
+                "p50_ms": round(pct(xs, 0.50) * 1e3, 3),
+                "p99_ms": round(pct(xs, 0.99) * 1e3, 3),
+            }
+            for cls, xs in sorted(self.lat.items())
+        }
+        return {
+            "wall_s": round(wall, 2),
+            "iops": round(self.ops / max(wall, 1e-9), 1),
+            "throughput_mibs": round(self.bytes / MIB / max(wall, 1e-9), 1),
+            "errors": self.errors,
+            "slowdowns_503": self.slowdowns,
+            "per_class": per_class,
+        }
+
+
+# ------------------------------------------------------- closed-loop phases
+
+
+async def run_mixed(cli: AsyncS3, clients: int, duration: float,
+                    keyspace: int, obj_kb: int, put_frac: float,
+                    ranged_key: str = "", ranged_mib: int = 0) -> Stats:
+    """Closed-loop mixed GET/PUT/HEAD/LIST phase over a zipf-hot keyspace,
+    plus an RGET class (Range header over a large object) when
+    ``ranged_key`` is set — the segment-cache path exercised under mixed
+    production load, with its own p50/p99/IOPS row."""
+    stats = Stats()
+    cdf = zipf_cdf(keyspace)
+    stop_at = time.monotonic() + duration
+    body = os.urandom(obj_kb * 1024)
+    rget_frac = 0.05 if ranged_key else 0.0
+    ranged_blocks = max(ranged_mib, 1)
+
+    async def one_client(cid: int) -> None:
+        rng = random.Random(cid)
+        while time.monotonic() < stop_at:
+            r = rng.random()
+            key = f"o{bisect.bisect_left(cdf, rng.random()):06d}"
+            t0 = time.perf_counter()
+            try:
+                if r < put_frac:  # overwrite a hot key: invalidation churn
+                    st, _ = await cli.request(
+                        "PUT", f"/{BUCKET}/{key}", body=body, read=False
+                    )
+                    stats.add("PUT", time.perf_counter() - t0, len(body), st)
+                elif r < put_frac + 0.60 - rget_frac:
+                    st, data = await cli.request("GET", f"/{BUCKET}/{key}")
+                    stats.add("GET", time.perf_counter() - t0, len(data), st)
+                elif r < put_frac + 0.60:
+                    off = rng.randrange(ranged_blocks) * MIB
+                    st, data = await cli.request(
+                        "GET", f"/{BUCKET}/{ranged_key}",
+                        headers={"Range": f"bytes={off}-{off + MIB - 1}"},
+                    )
+                    stats.add("RGET", time.perf_counter() - t0, len(data), st)
+                elif r < put_frac + 0.75:
+                    st, _ = await cli.request("HEAD", f"/{BUCKET}/{key}")
+                    stats.add("HEAD", time.perf_counter() - t0, 0, st)
+                else:
+                    st, data = await cli.request(
+                        "GET", f"/{BUCKET}",
+                        query="list-type=2&max-keys=50&prefix=o0",
+                    )
+                    stats.add("LIST", time.perf_counter() - t0, len(data), st)
+                if st == 503:  # SlowDown: back off like a real SDK
+                    await asyncio.sleep(1.0)
+            except Exception:  # noqa: BLE001 — count, keep looping
+                stats.add("ERR", time.perf_counter() - t0, 0, 599)
+
+    t0 = time.monotonic()
+    await asyncio.gather(*(one_client(i) for i in range(clients)))
+    stats.wall = time.monotonic() - t0
+    return stats
+
+
+async def run_get_loop(cli: AsyncS3, clients: int, duration: float,
+                       keyspace: int, key_fmt: str = "o{:06d}",
+                       cls: str = "GET") -> Stats:
+    """Hot-GET closed loop (QoS guard phase and the tenant probes):
+    latency under connection pressure, no writes."""
+    stats = Stats()
+    cdf = zipf_cdf(keyspace)
+    stop_at = time.monotonic() + duration
+
+    async def one_client(cid: int) -> None:
+        rng = random.Random(cid * 7919)
+        while time.monotonic() < stop_at:
+            key = key_fmt.format(bisect.bisect_left(cdf, rng.random()))
+            t0 = time.perf_counter()
+            try:
+                st, data = await cli.request("GET", f"/{BUCKET}/{key}")
+                stats.add(cls, time.perf_counter() - t0, len(data), st)
+                if st == 503:  # SlowDown: back off like a real SDK
+                    await asyncio.sleep(1.0)
+            except Exception:  # noqa: BLE001
+                stats.add("ERR", time.perf_counter() - t0, 0, 599)
+
+    t0 = time.monotonic()
+    await asyncio.gather(*(one_client(i) for i in range(clients)))
+    stats.wall = time.monotonic() - t0
+    return stats
+
+
+async def run_put_throughput(cli: AsyncS3, streams: int, obj_mib: int,
+                             repeats: int) -> float:
+    """Aggregate streaming-PUT MiB/s: `streams` concurrent large PUTs,
+    `repeats` rounds each."""
+    body = os.urandom(obj_mib * MIB)
+
+    async def one(i: int) -> None:
+        for r in range(repeats):
+            st, _ = await cli.request(
+                "PUT", f"/{BUCKET}/big-{i}-{r}", body=body, read=False
+            )
+            assert st == 200, f"big PUT failed: HTTP {st}"
+
+    t0 = time.perf_counter()
+    await asyncio.gather(*(one(i) for i in range(streams)))
+    wall = time.perf_counter() - t0
+    return streams * repeats * obj_mib / wall
+
+
+async def run_ranged_pass(cli: AsyncS3, key: str, size_mib: int,
+                          order: list[int], concurrency: int) -> Stats:
+    """One pass of 1 MiB ranged GETs over `key` at the given offsets
+    (MiB units), `concurrency` closed-loop workers draining the list."""
+    stats = Stats()
+    queue: list[int] = list(order)
+
+    async def worker() -> None:
+        while queue:
+            off = queue.pop() * MIB
+            t0 = time.perf_counter()
+            try:
+                st, data = await cli.request(
+                    "GET", f"/{BUCKET}/{key}",
+                    headers={"Range": f"bytes={off}-{off + MIB - 1}"},
+                )
+                stats.add("RGET", time.perf_counter() - t0, len(data), st)
+                if st == 206 and len(data) != MIB:
+                    stats.errors += 1
+            except Exception:  # noqa: BLE001
+                stats.add("ERR", time.perf_counter() - t0, 0, 599)
+
+    t0 = time.monotonic()
+    await asyncio.gather(*(worker() for _ in range(concurrency)))
+    stats.wall = time.monotonic() - t0
+    return stats
+
+
+async def ranged_round(port: int, size_mib: int, repeats: int,
+                       concurrency: int = 8) -> dict:
+    """The segment-path benchmark: 1 MiB ranged GETs over one
+    `size_mib` object — cold (first pass, shuffled so no sequential run
+    forms), warm (repeat passes served from the segment tiers,
+    median-of-`repeats`), and prefetched (a fresh sequential pass with
+    read-ahead running ahead of the client; warm-up requests excluded).
+    The caller picks the tier the warm passes land in via the server's
+    cache env (big memory budget -> memory tier; tiny memory budget +
+    disk budget -> NVMe tier)."""
+    async with s3_session(port) as cli:
+        body = os.urandom(size_mib * MIB)
+        st, _ = await cli.request(
+            "PUT", f"/{BUCKET}/r-main", body=body, read=False
+        )
+        assert st == 200, f"ranged preload PUT: HTTP {st}"
+
+        order = list(range(size_mib))
+        random.Random(4242).shuffle(order)  # no run -> no prefetch
+        cold = await run_ranged_pass(cli, "r-main", size_mib, order, concurrency)
+
+        warm_iops, warm_p50, warm_p99 = [], [], []
+        for i in range(repeats):
+            random.Random(100 + i).shuffle(order)
+            w = await run_ranged_pass(
+                cli, "r-main", size_mib, order, concurrency
+            )
+            s = w.summary(w.wall)
+            warm_iops.append(s["iops"])
+            warm_p50.append(s["per_class"]["RGET"]["p50_ms"])
+            warm_p99.append(s["per_class"]["RGET"]["p99_ms"])
+
+        # prefetched: fresh object, strictly sequential, single client so
+        # the read-ahead (not concurrency) is what hides the misses
+        st, _ = await cli.request(
+            "PUT", f"/{BUCKET}/r-seq", body=body, read=False
+        )
+        assert st == 200
+        warmup = 4
+        seq = await run_ranged_pass(
+            cli, "r-seq", size_mib, list(range(size_mib))[::-1], 1
+        )  # reversed because workers pop() from the tail -> ascending
+        seq_lat = sorted(seq.lat.get("RGET", [0.0])[warmup:])
+
+        cold_s = cold.summary(cold.wall)
+        return {
+            "object_mib": size_mib,
+            "concurrency": concurrency,
+            "repeats": repeats,
+            "cold": {
+                "iops": cold_s["iops"],
+                "p50_ms": cold_s["per_class"]["RGET"]["p50_ms"],
+                "p99_ms": cold_s["per_class"]["RGET"]["p99_ms"],
+                "errors": cold_s["errors"],
+            },
+            "warm": {
+                "iops": median(warm_iops),
+                "p50_ms": median(warm_p50),
+                "p99_ms": median(warm_p99),
+            },
+            "prefetched_seq": {
+                "iops": round(
+                    len(seq_lat) / max(sum(seq_lat), 1e-9), 1
+                ),
+                "p50_ms": round(seq_lat[len(seq_lat) // 2] * 1e3, 3),
+                "p99_ms": round(
+                    seq_lat[min(len(seq_lat) - 1,
+                                int(len(seq_lat) * 0.99))] * 1e3, 3),
+                "warmup_excluded": warmup,
+            },
+        }
+
+
+# ------------------------------------------------------- metrics plumbing
+
+
+def scrape_counter(port: int, series: str, path: str = "/api/qos") -> int:
+    """Sum a counter across workers from the pool-aggregated metrics v3
+    exposition (worker labels sum away). A failed scrape or a missing
+    series raises — the guard invariant must never 'pass' because the
+    measurement silently returned nothing."""
+    cli = S3Client(f"127.0.0.1:{port}")
+    r = cli.request("GET", f"/minio/metrics/v3{path}")
+    assert r.status == 200, f"metrics scrape failed: HTTP {r.status}"
+    total = 0
+    seen = False
+    for line in r.body.decode().splitlines():
+        if line.startswith(series) and not line.startswith("#"):
+            try:
+                total += int(float(line.rsplit(" ", 1)[1]))
+                seen = True
+            except ValueError:
+                pass
+    assert seen, f"series {series} absent from {path} exposition"
+    return total
+
+
+def scrape_series(port: int, path: str, prefix: str) -> dict[str, float]:
+    """Every series line under `path` whose name starts with `prefix`,
+    as {full-labelled-name: summed value}. Raises if NOTHING matches —
+    a gate computed over an empty scrape is a vacuous pass."""
+    cli = S3Client(f"127.0.0.1:{port}")
+    r = cli.request("GET", f"/minio/metrics/v3{path}")
+    assert r.status == 200, f"metrics scrape failed: HTTP {r.status}"
+    out: dict[str, float] = {}
+    for line in r.body.decode().splitlines():
+        if line.startswith("#") or " " not in line:
+            continue
+        name, val = line.rsplit(" ", 1)
+        if not name.startswith(prefix):
+            continue
+        try:
+            out[name] = out.get(name, 0.0) + float(val)
+        except ValueError:
+            pass
+    assert out, f"no series matching {prefix} on {path}"
+    return out
+
+
+def scrape_cache_series(port: int) -> dict:
+    """Segment/prefetch counters from metrics v3 (pool-aggregated)."""
+    cli = S3Client(f"127.0.0.1:{port}")
+    r = cli.request("GET", "/minio/metrics/v3/api/cache")
+    assert r.status == 200, f"cache metrics scrape failed: HTTP {r.status}"
+    out: dict[str, float] = {}
+    for line in r.body.decode().splitlines():
+        if line.startswith("#") or " " not in line:
+            continue
+        name, val = line.rsplit(" ", 1)
+        try:
+            out[name] = out.get(name, 0) + float(val)
+        except ValueError:
+            pass
+    return {
+        k: v for k, v in out.items()
+        if "segment" in k or "prefetch" in k
+    }
+
+
+def require_gate_series(port: int, wanted: list[tuple[str, str]]) -> dict:
+    """The no-vacuous-pass primitive: every (metrics path, series name)
+    a profile's gates are computed from must be PRESENT in the scrape,
+    or the run fails loudly before any gate is evaluated. Returns the
+    current summed values keyed by series name."""
+    return {series: scrape_counter(port, series, path)
+            for path, series in wanted}
+
+
+# ----------------------------------------------------------- admin plumbing
+
+
+def admin(port: int, method: str, path: str, body: bytes = b"",
+          query: dict | None = None, timeout: float = 60):
+    cli = S3Client(f"127.0.0.1:{port}")
+    return cli.request(method, f"/minio/admin/v3/{path}", body=body,
+                       query=query or {}, timeout=timeout)
+
+
+def poll_admin(port: int, path: str, done, query: dict | None = None,
+               timeout: float = 120.0, every: float = 0.3) -> dict:
+    deadline = time.time() + timeout
+    last: dict = {}
+    while time.time() < deadline:
+        r = admin(port, "GET", path, query=query)
+        if r.status == 200:
+            last = json.loads(r.body)
+            if done(last):
+                return last
+        time.sleep(every)
+    raise AssertionError(f"{path} did not converge in {timeout}s: {last}")
+
+
+def tbody(key: str, gen: int, size: int) -> bytes:
+    """Deterministic content for (key, generation): a reader can verify
+    every byte of every response it ever gets."""
+    import hashlib as _hl
+
+    seed = _hl.md5(f"{key}#{gen}".encode()).digest()
+    return (seed * (size // len(seed) + 1))[:size]
+
+
+class HealFlood:
+    """Background heal/ILM flood: a thread looping admin heal sweeps
+    (walks + per-object heal over the whole keyspace) while the scanner
+    keeps its own cycle going — the bg pressure the QoS guard phase
+    measures fg p99 against."""
+
+    def __init__(self, port: int, bucket: str = BUCKET):
+        self.cli = S3Client(f"127.0.0.1:{port}")
+        self.bucket = bucket
+        self.stop = threading.Event()
+        self.sweeps = 0
+        self.thread = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self) -> None:
+        while not self.stop.is_set():
+            try:
+                self.cli.request(
+                    "POST", f"/minio/admin/v3/heal/{self.bucket}",
+                    timeout=120,
+                )
+                self.sweeps += 1
+            except Exception:  # noqa: BLE001 — flood keeps flooding
+                time.sleep(0.2)
+
+    def __enter__(self) -> "HealFlood":
+        self.thread.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop.set()
+        self.thread.join(timeout=150)
+
+
+class TopologyLoad:
+    """Verifying zipf mixed load for the topology phase. Every GET is
+    checked byte-for-byte against the generation ledger (and its ETag
+    against the served bytes), so a single stale cache entry or lost
+    update anywhere across the set-membership changes is a counted
+    failure, not a silent wrong answer."""
+
+    def __init__(self, cli: "AsyncS3", bucket: str, static_keys: list[str],
+                 hot_keys: list[str], size: int, clients: int):
+        self.cli = cli
+        self.bucket = bucket
+        self.static_keys = static_keys
+        self.hot_keys = hot_keys
+        self.size = size
+        self.clients = clients
+        self.committed = {k: 0 for k in hot_keys}  # gen ledger
+        self.stop = asyncio.Event()
+        self.stats = {"reads": 0, "writes": 0, "stale": 0, "etag_bad": 0,
+                      "errors": 0, "slowdowns": 0}
+        self.examples: list[str] = []
+
+    def _flag(self, kind: str, msg: str) -> None:
+        self.stats[kind] += 1
+        if len(self.examples) < 10:
+            self.examples.append(f"{kind}: {msg}")
+
+    async def _verify_get(self, key: str, expect_gen=None) -> None:
+        import hashlib as _hl
+
+        c0 = self.committed.get(key, 0) if expect_gen is None else expect_gen
+        st, data, hdrs = await self.cli.request_full(
+            "GET", f"/{self.bucket}/{key}"
+        )
+        if st == 503:
+            self.stats["slowdowns"] += 1
+            await asyncio.sleep(0.5)
+            return
+        if st != 200:
+            self._flag("errors", f"GET {key} -> HTTP {st}")
+            return
+        self.stats["reads"] += 1
+        if key in self.committed:
+            # accept the floor generation or anything newer (a racing
+            # writer may land mid-GET); OLDER than the floor = stale
+            for g in range(c0, self.committed[key] + 2):
+                if data == tbody(key, g, self.size):
+                    break
+            else:
+                self._flag("stale", f"{key}: bytes match no gen >= {c0}")
+                return
+        else:
+            if data != tbody(key, 0, self.size):
+                self._flag("stale", f"{key}: static bytes mismatch")
+                return
+        etag = header_get(hdrs, "ETag").strip('"')
+        if etag and "-" not in etag and etag != _hl.md5(data).hexdigest():
+            self._flag("etag_bad", f"{key}: etag {etag} != md5(bytes)")
+
+    async def _reader(self, rid: int) -> None:
+        rng = random.Random(1000 + rid)
+        cdf = zipf_cdf(len(self.static_keys))
+        while not self.stop.is_set():
+            try:
+                if rng.random() < 0.3 and self.hot_keys:
+                    key = rng.choice(self.hot_keys)
+                else:
+                    key = self.static_keys[
+                        bisect.bisect_left(cdf, rng.random())
+                    ]
+                await self._verify_get(key)
+            except Exception as e:  # noqa: BLE001 — count, keep looping
+                self._flag("errors", f"reader: {type(e).__name__}: {e}")
+
+    async def _writer(self, wid: int) -> None:
+        """Overwrites its OWN slice of hot keys (one writer per key:
+        the generation ledger stays a total order per key)."""
+        rng = random.Random(2000 + wid)
+        mine = self.hot_keys[wid::4]
+        while not self.stop.is_set() and mine:
+            key = rng.choice(mine)
+            gen = self.committed[key] + 1
+            try:
+                st, _ = await self.cli.request(
+                    "PUT", f"/{self.bucket}/{key}",
+                    body=tbody(key, gen, self.size), read=False,
+                )
+                if st == 200:
+                    self.committed[key] = gen
+                    self.stats["writes"] += 1
+                elif st == 503:
+                    self.stats["slowdowns"] += 1
+                    await asyncio.sleep(0.5)
+                else:
+                    self._flag("errors", f"PUT {key} -> HTTP {st}")
+            except Exception as e:  # noqa: BLE001
+                self._flag("errors", f"writer: {type(e).__name__}: {e}")
+            await asyncio.sleep(0.02)
+
+    async def run(self) -> None:
+        tasks = [
+            asyncio.create_task(self._reader(i)) for i in range(self.clients)
+        ] + [asyncio.create_task(self._writer(w)) for w in range(4)]
+        await self.stop.wait()
+        for t in tasks:
+            t.cancel()
+        await asyncio.gather(*tasks, return_exceptions=True)

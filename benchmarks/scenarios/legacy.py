@@ -1,0 +1,522 @@
+"""Closed-loop production load harness (round 7: many-core data plane;
+round 8: ranged-GET segment-cache phases; round 10: elastic topology).
+
+Drives a REAL server process (optionally an SO_REUSEPORT worker pool,
+``MINIO_TPU_WORKERS``) with production-shaped traffic and emits the
+numbers PERF.md and BENCH_r07/r08.json track:
+
+- **Mixed closed-loop phase**: N virtual clients, each a coroutine that
+  issues its next request only after the previous one completes (closed
+  loop — offered load adapts to service rate instead of queueing without
+  bound). Op mix GET/PUT/HEAD/LIST over a zipf-hot keyspace, with the
+  background scanner/ILM running and induced heal work pending, so QoS
+  admission, the cache tiers, hedged reads, and the heal plane are
+  exercised TOGETHER. Reports per-class p50/p99 latency, IOPS, and
+  aggregate throughput.
+- **Large-PUT segment**: few concurrent 64 MiB streaming PUTs at EC 8+8
+  over 16 drives — the VERDICT r5 top-gap metric (target >= 350 MiB/s
+  multi-core; the single-core wall was ~200-240 MiB/s).
+- **QoS guard phase**: foreground GET p99 with a background heal flood
+  off vs on, at high connection counts (>= 5k full mode), plus the
+  ``fg_deferred_behind_bg`` invariant read from the pool-aggregated
+  metrics — the "bg must ride leftover capacity only" proof under real
+  HTTP load rather than the dispatcher microbench in bench.py.
+- **Ranged (segment cache) phases**: 1 MiB ranged GETs over a 64 MiB
+  object — cold vs warm (memory tier and NVMe tier on separate fresh
+  servers, median-of-N warm passes) vs a prefetched sequential pass;
+  the mixed phase additionally carries an RGET request class so the
+  segment path is exercised under production load.
+- **Topology phase (round 10)**: live pool expansion -> continuous
+  placement-aware rebalance with a SEEDED partition injected mid-drain
+  (topology fault boundary) -> decommission -> pool removal, all under
+  verifying zipf traffic: every GET is checked byte-for-byte against a
+  per-key generation ledger and its ETag against the served bytes.
+  Gates: zero stale bytes/etags across the set-membership changes,
+  ``fg_deferred_behind_bg`` flat, the pinned hot prefix never drained,
+  the partition provably bit, and ``rebalance_throughput_mibps``
+  recorded (BENCH_r10.json).
+
+Worker count and nproc are recorded in the JSON so cross-host numbers
+are never compared blindly.
+
+These phases predate the scenario zoo (scenarios/profiles.py) and keep
+their exact series names so BENCH_r07/r10 stay comparable release over
+release; ``bench_load.py`` is the thin compatibility entry point.
+
+Usage:
+    python benchmarks/bench_load.py                    # full run
+    python benchmarks/bench_load.py --quick            # seconds (CI gate)
+    python benchmarks/bench_load.py --workers 1,2      # compare pool sizes
+    python benchmarks/bench_load.py --out BENCH_r07.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+from .engine import (  # noqa: F401 — re-exported for compatibility
+    BUCKET,
+    MIB,
+    AsyncS3,
+    HealFlood,
+    Server,
+    Stats,
+    TopologyLoad,
+    admin as _admin,
+    poll_admin as _poll_admin,
+    ranged_round,
+    run_get_loop,
+    run_mixed,
+    run_put_throughput,
+    s3_session,
+    scrape_cache_series,
+    scrape_counter,
+    tbody as _tbody,
+)
+
+from minio_tpu.client import S3Client
+
+
+def bench_ranged(cfg: argparse.Namespace) -> dict:
+    """Run the ranged benchmark twice: once against a memory-budget
+    server (warm passes hit the memory tier) and once against a
+    tiny-memory + NVMe-budget server (warm passes promote from the disk
+    tier). Each server is fresh — the two tiers are measured in
+    isolation."""
+    out: dict = {}
+    tiers = {
+        "memory": {
+            "MINIO_TPU_CACHE_DISK_MB": "0",
+        },
+        "disk": {
+            # memory can hold only a fraction of the object: warm passes
+            # must come off the NVMe tier (promote-on-hit)
+            "MINIO_TPU_CACHE_MEM_MB": str(max(cfg.ranged_object_mib // 4, 8)),
+            "MINIO_TPU_CACHE_DISK_MB": str(cfg.ranged_object_mib * 8),
+        },
+    }
+    for tier, env in tiers.items():
+        base = tempfile.mkdtemp(prefix=f"bench-ranged-{tier}-")
+        srv = Server(base, cfg.port, cfg.drives, 1,
+                     scan_interval=300.0, extra_env=env)
+        try:
+            cli = S3Client(f"127.0.0.1:{cfg.port}")
+            assert cli.make_bucket(BUCKET).status == 200
+            res = asyncio.run(ranged_round(
+                cfg.port, cfg.ranged_object_mib, cfg.ranged_repeats
+            ))
+            res["cache_env"] = env
+            res["segment_series"] = scrape_cache_series(cfg.port)
+            res["fg_deferred_behind_bg"] = scrape_counter(
+                cfg.port, "minio_tpu_dispatch_fg_deferred_behind_bg_total"
+            )
+            out[tier] = res
+        finally:
+            srv.stop()
+            shutil.rmtree(base, ignore_errors=True)
+    if out["memory"]["cold"]["iops"]:
+        out["speedup_warm_memory_vs_cold_iops"] = round(
+            out["memory"]["warm"]["iops"] / out["memory"]["cold"]["iops"], 1
+        )
+    return out
+
+
+# ------------------------------------------------------ topology (round 10)
+
+
+async def run_topology_phase(port: int, base: str, cfg) -> dict:
+    """The elastic-topology proof: pool expansion -> continuous rebalance
+    with a seeded partition injected mid-drain -> decommission -> pool
+    removal, ALL under live verified zipf traffic. Gates: zero stale
+    bytes / bad etags, fg_deferred_behind_bg flat, pinned prefix never
+    drained, and a positive rebalance throughput recorded for the BENCH
+    json."""
+    async with s3_session(port) as cli:
+        size = cfg.topo_object_kb * 1024
+        static_keys = [f"stat-{i:04d}" for i in range(cfg.topo_keyspace)]
+        hot_keys = [f"hot/{i:03d}" for i in range(cfg.topo_hot_keys)]
+
+        # pin the hot prefix to pool 0 BEFORE any data lands
+        r = await asyncio.to_thread(
+            _admin, port, "POST", "placement/set", body=json.dumps(
+            {"bucket": BUCKET, "prefix": "hot/", "mode": "pin",
+             "pools": [0]}).encode())
+        assert r.status == 200, f"placement/set: {r.status} {r.body[:200]}"
+
+        sem = asyncio.Semaphore(16)
+
+        async def put_one(key: str, gen: int) -> None:
+            async with sem:
+                st, _ = await cli.request(
+                    "PUT", f"/{BUCKET}/{key}",
+                    body=_tbody(key, gen, size), read=False,
+                )
+                assert st == 200, f"preload {key}: HTTP {st}"
+
+        await asyncio.gather(*(put_one(k, 0) for k in static_keys))
+        # hot keys start at gen 1 (committed ledger starts there)
+        await asyncio.gather(*(put_one(k, 1) for k in hot_keys))
+
+        fg_deferred_before = await asyncio.to_thread(
+            scrape_counter, port,
+            "minio_tpu_dispatch_fg_deferred_behind_bg_total"
+        )
+
+        load = TopologyLoad(cli, BUCKET, static_keys, hot_keys, size,
+                            cfg.topo_clients)
+        for k in hot_keys:
+            load.committed[k] = 1
+        load_task = asyncio.create_task(load.run())
+        await asyncio.sleep(1.0)  # traffic flowing before any topology op
+
+        # -- expansion: second pool attaches to the RUNNING server ------
+        t0 = time.monotonic()
+        r = await asyncio.to_thread(
+            _admin, port, "POST", "pool/expand", json.dumps(
+            {"spec": os.path.join(base, "x2-d{1...%d}" % cfg.topo_drives)}
+        ).encode())
+        assert r.status == 200, f"pool/expand: {r.status} {r.body[:300]}"
+        expand = json.loads(r.body)
+
+        # -- continuous rebalance, chaos partition mid-drain ------------
+        # seeded partition armed BEFORE the mover starts: the drain's
+        # first pass provably runs through it (partition-during-drain),
+        # fails those moves, and must still converge once it clears
+        r = await asyncio.to_thread(
+            _admin, port, "POST", "fault/inject", json.dumps(
+                {"boundary": "topology", "mode": "partition",
+                 "target": "pool-0", "op": "move", "prob": 0.7,
+                 "count": 15, "seed": 42}).encode())
+        assert r.status == 200, r.body[:200]
+        fault_id = json.loads(r.body)["id"]
+        r = await asyncio.to_thread(
+            _admin, port, "POST", "pools/rebalance", b"",
+            {"threshold": str(cfg.topo_threshold_pct)})
+        assert r.status == 200, r.body[:200]
+        await asyncio.sleep(cfg.topo_chaos_s)  # let the partition bite
+        await asyncio.to_thread(
+            _admin, port, "POST", "fault/clear", b"",
+            {"id": str(fault_id), "local": "true"})
+        reb = await asyncio.to_thread(
+            _poll_admin, port, "pools/rebalance/status",
+            lambda s: s.get("state") != "running")
+        rebalance_wall = time.monotonic() - t0
+
+        # -- decommission the expanded pool, live, then detach it -------
+        r = await asyncio.to_thread(
+            _admin, port, "POST", "pools/decommission", b"", {"pool": "1"})
+        assert r.status == 200, r.body[:200]
+        decom = await asyncio.to_thread(
+            _poll_admin, port, "pools/decommission/status",
+            lambda s: s.get("state") in ("complete", "failed"),
+            {"pool": "1"},
+        )
+        r = await asyncio.to_thread(
+            _admin, port, "POST", "pool/remove", b"", {"pool": "1"})
+        removed = r.status == 200
+        # keep verified traffic running across the membership change —
+        # a stale cache entry from the dead sets would be caught here
+        await asyncio.sleep(cfg.topo_cooldown_s)
+
+        load.stop.set()
+        await load_task
+
+        fg_deferred_after = await asyncio.to_thread(
+            scrape_counter, port,
+            "minio_tpu_dispatch_fg_deferred_behind_bg_total"
+        )
+        topo_metrics = await asyncio.to_thread(
+            lambda: S3Client(f"127.0.0.1:{port}").request(
+                "GET", "/minio/metrics/v3/api/topology"
+            )
+        )
+        assert topo_metrics.status == 200
+
+    out = {
+        "expand": expand,
+        "rebalance": {k: reb.get(k) for k in (
+            "state", "moved", "moved_bytes", "failed", "skipped_pinned",
+            "passes", "spread_pct", "throughput_mibps", "eta_s")},
+        "rebalance_wall_s": round(rebalance_wall, 2),
+        "decommission": {k: decom.get(k) for k in (
+            "state", "objectsMoved", "bytesMoved", "failedObjects")},
+        "pool_removed": removed,
+        "load": dict(load.stats),
+        "fg_deferred_behind_bg_before": fg_deferred_before,
+        "fg_deferred_behind_bg_after": fg_deferred_after,
+        "examples": load.examples,
+    }
+    # -- the gates ---------------------------------------------------------
+    failures = []
+    if load.stats["stale"]:
+        failures.append(f"stale bytes served: {load.stats['stale']}")
+    if load.stats["etag_bad"]:
+        failures.append(f"etag/bytes mismatches: {load.stats['etag_bad']}")
+    if fg_deferred_after != fg_deferred_before:
+        failures.append(
+            "fg_deferred_behind_bg moved "
+            f"{fg_deferred_before} -> {fg_deferred_after}"
+        )
+    if reb.get("state") != "done":
+        failures.append(f"rebalance ended {reb.get('state')}")
+    if not reb.get("moved"):
+        failures.append("rebalance moved nothing")
+    if not reb.get("failed"):
+        failures.append(
+            "the mid-drain partition never bit a move (chaos misfire)"
+        )
+    if decom.get("state") != "complete":
+        failures.append(f"decommission ended {decom.get('state')}")
+    if not removed:
+        failures.append("pool/remove refused")
+    if load.stats["reads"] < 50:
+        failures.append(f"too few verified reads: {load.stats['reads']}")
+    out["gates_passed"] = not failures
+    out["gate_failures"] = failures
+    return out
+
+
+def bench_topology(cfg: argparse.Namespace) -> dict:
+    """Fresh single-process server (online topology changes refuse worker
+    pools), expansion + chaos rebalance + decommission under verified
+    live load."""
+    base = tempfile.mkdtemp(prefix="bench-topo-")
+    srv = Server(base, cfg.port, cfg.topo_drives, 1,
+                 scan_interval=cfg.scan_interval)
+    try:
+        cli = S3Client(f"127.0.0.1:{cfg.port}")
+        assert cli.make_bucket(BUCKET).status == 200
+        out = asyncio.run(run_topology_phase(cfg.port, base, cfg))
+        if out["gate_failures"]:
+            print(f"TOPOLOGY GATES FAILED: {out['gate_failures']}",
+                  file=sys.stderr, flush=True)
+        return out
+    finally:
+        srv.stop()
+        shutil.rmtree(base, ignore_errors=True)
+
+
+# ----------------------------------------------------------------- phases
+
+
+async def run_round(port: int, cfg: argparse.Namespace) -> dict:
+    async with s3_session(port) as cli:
+        # preload the keyspace (also the heal flood's object population)
+        body = os.urandom(cfg.object_kb * 1024)
+        sem = asyncio.Semaphore(32)
+
+        async def put_one(i: int) -> None:
+            async with sem:
+                st, _ = await cli.request(
+                    "PUT", f"/{BUCKET}/o{i:06d}", body=body, read=False
+                )
+                assert st == 200, f"preload PUT {i}: HTTP {st}"
+
+        t0 = time.monotonic()
+        await asyncio.gather(*(put_one(i) for i in range(cfg.keyspace)))
+        # one large object for the mixed phase's RGET class (the segment
+        # path exercised under production load, not just in isolation)
+        st, _ = await cli.request(
+            "PUT", f"/{BUCKET}/rmix",
+            body=os.urandom(cfg.ranged_object_mib * MIB), read=False,
+        )
+        assert st == 200, f"ranged preload PUT: HTTP {st}"
+        preload_s = time.monotonic() - t0
+
+        # mixed closed loop with scanner/ILM live
+        mixed = await run_mixed(
+            cli, cfg.clients, cfg.duration, cfg.keyspace, cfg.object_kb,
+            put_frac=0.20, ranged_key="rmix",
+            ranged_mib=cfg.ranged_object_mib,
+        )
+
+        # large-PUT aggregate throughput (the EC 8+8 target metric)
+        put_mibs = await run_put_throughput(
+            cli, cfg.put_streams, cfg.put_object_mib, cfg.put_repeats
+        )
+
+        # QoS guard: fg GET p99 with bg heal flood off vs on, at high
+        # connection count; fg_deferred_behind_bg read AFTER, aggregated
+        # over workers
+        qos_off = await run_get_loop(
+            cli, cfg.connections, cfg.qos_duration, cfg.keyspace
+        )
+        with HealFlood(port) as flood:
+            qos_on = await run_get_loop(
+                cli, cfg.connections, cfg.qos_duration, cfg.keyspace
+            )
+            sweeps = flood.sweeps
+        deferred = scrape_counter(
+            port, "minio_tpu_dispatch_fg_deferred_behind_bg_total"
+        )
+
+    off, on = qos_off.summary(qos_off.wall), qos_on.summary(qos_on.wall)
+    return {
+        "preload_s": round(preload_s, 1),
+        "mixed": mixed.summary(mixed.wall),
+        "put_streams": cfg.put_streams,
+        "put_object_mib": cfg.put_object_mib,
+        "put_throughput_mibs": round(put_mibs, 1),
+        "qos": {
+            "connections": cfg.connections,
+            "fg_get_p50_ms_bg_off": off["per_class"].get("GET", {}).get("p50_ms"),
+            "fg_get_p99_ms_bg_off": off["per_class"].get("GET", {}).get("p99_ms"),
+            "fg_get_p50_ms_bg_on": on["per_class"].get("GET", {}).get("p50_ms"),
+            "fg_get_p99_ms_bg_on": on["per_class"].get("GET", {}).get("p99_ms"),
+            "fg_iops_bg_off": off["iops"],
+            "fg_iops_bg_on": on["iops"],
+            "errors_bg_off": off["errors"],
+            "errors_bg_on": on["errors"],
+            "slowdowns_bg_off": off["slowdowns_503"],
+            "slowdowns_bg_on": on["slowdowns_503"],
+            "heal_sweeps_during_flood": sweeps,
+            "fg_deferred_behind_bg": deferred,
+        },
+    }
+
+
+def bench_one_worker_count(workers: int, cfg: argparse.Namespace) -> dict:
+    base = tempfile.mkdtemp(prefix=f"bench-load-w{workers}-")
+    srv = Server(base, cfg.port, cfg.drives, workers,
+                 scan_interval=cfg.scan_interval)
+    try:
+        cli = S3Client(f"127.0.0.1:{cfg.port}")
+        assert cli.make_bucket(BUCKET).status == 200
+        out = asyncio.run(run_round(cfg.port, cfg))
+        out["workers"] = workers
+        return out
+    finally:
+        srv.stop()
+        shutil.rmtree(base, ignore_errors=True)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--workers", default="",
+                    help="comma-separated pool sizes to compare "
+                         "(default: 1,<nproc>; quick: 2)")
+    ap.add_argument("--drives", type=int, default=16)
+    ap.add_argument("--clients", type=int, default=512,
+                    help="closed-loop clients in the mixed phase")
+    ap.add_argument("--connections", type=int, default=5000,
+                    help="closed-loop clients in the QoS guard phase")
+    ap.add_argument("--duration", type=float, default=15.0)
+    ap.add_argument("--qos-duration", type=float, default=12.0)
+    ap.add_argument("--keyspace", type=int, default=512)
+    ap.add_argument("--object-kb", type=int, default=256,
+                    help="mixed-phase object size")
+    ap.add_argument("--put-streams", type=int, default=4)
+    ap.add_argument("--put-object-mib", type=int, default=64)
+    ap.add_argument("--put-repeats", type=int, default=3)
+    ap.add_argument("--scan-interval", type=float, default=30.0)
+    ap.add_argument("--ranged-object-mib", type=int, default=64,
+                    help="object size for the ranged-GET (segment cache) "
+                         "phases")
+    ap.add_argument("--ranged-repeats", type=int, default=5,
+                    help="warm ranged passes (median reported)")
+    ap.add_argument("--port", type=int, default=19801)
+    ap.add_argument("--topo-drives", type=int, default=8,
+                    help="drives per pool in the topology phase")
+    ap.add_argument("--topo-keyspace", type=int, default=192,
+                    help="static verified keys in the topology phase")
+    ap.add_argument("--topo-hot-keys", type=int, default=24,
+                    help="pinned hot (overwritten) keys")
+    ap.add_argument("--topo-object-kb", type=int, default=128)
+    ap.add_argument("--topo-clients", type=int, default=24,
+                    help="verifying reader coroutines")
+    ap.add_argument("--topo-threshold-pct", type=float, default=5.0)
+    ap.add_argument("--topo-chaos-s", type=float, default=2.0,
+                    help="seconds the mid-rebalance partition stays armed")
+    ap.add_argument("--topo-cooldown-s", type=float, default=2.0,
+                    help="verified traffic kept running after pool removal")
+    ap.add_argument("--out", default="",
+                    help="write the JSON here too (stdout always)")
+    ap.add_argument("--quick", action="store_true",
+                    help="seconds-long smoke (CI harness-stays-runnable "
+                         "gate): tiny keyspace, short phases, one pool size")
+    args = ap.parse_args(argv)
+
+    if args.quick:
+        args.drives = min(args.drives, 8)
+        args.clients = 48
+        args.connections = 128
+        args.duration = 3.0
+        args.qos_duration = 2.5
+        args.keyspace = 48
+        args.object_kb = 64
+        args.put_streams = 2
+        args.put_object_mib = 4
+        args.put_repeats = 2
+        args.scan_interval = 5.0
+        args.ranged_object_mib = 8
+        args.ranged_repeats = 2
+        args.topo_drives = 4
+        args.topo_keyspace = 40
+        args.topo_hot_keys = 8
+        args.topo_object_kb = 32
+        args.topo_clients = 8
+        args.topo_chaos_s = 1.0
+        args.topo_cooldown_s = 1.0
+    worker_counts = [
+        int(w) for w in (
+            args.workers.split(",") if args.workers
+            else (["2"] if args.quick
+                  else ["1", str(os.cpu_count() or 1)])
+        )
+        if w.strip()
+    ]
+    # dedupe preserving order (nproc may be 1)
+    worker_counts = list(dict.fromkeys(worker_counts))
+
+    runs = []
+    for w in worker_counts:
+        print(f"=== round: {w} worker(s) ===", file=sys.stderr, flush=True)
+        runs.append(bench_one_worker_count(w, args))
+
+    print("=== round: ranged (segment cache) ===", file=sys.stderr,
+          flush=True)
+    ranged = bench_ranged(args)
+
+    print("=== round: topology (expand/rebalance/decom under load) ===",
+          file=sys.stderr, flush=True)
+    topology = bench_topology(args)
+
+    result = {
+        "metric": "load_harness_closed_loop",
+        "nproc": os.cpu_count(),
+        "drives": args.drives,
+        "ec": "8+8" if args.drives >= 16 else "default",
+        "quick": bool(args.quick),
+        "runs": runs,
+        "ranged": ranged,
+        "topology": topology,
+        # the round-10 headline: mover throughput under live verified
+        # traffic with a chaos partition mid-drain
+        "rebalance_throughput_mibps": topology["rebalance"].get(
+            "throughput_mibps", 0.0
+        ),
+    }
+    if not topology.get("gates_passed", False):
+        print(f"TOPOLOGY GATES FAILED: {topology.get('gate_failures')}",
+              file=sys.stderr, flush=True)
+        print(json.dumps(result))
+        return 1
+    by_w = {r["workers"]: r["put_throughput_mibs"] for r in runs}
+    if 1 in by_w and len(by_w) > 1:
+        best_w = max(w for w in by_w if w != 1)
+        result["put_scaling_vs_1_worker"] = round(
+            by_w[best_w] / max(by_w[1], 1e-9), 2
+        )
+    line = json.dumps(result)
+    print(line)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as fh:
+            fh.write(line + "\n")
+    return 0

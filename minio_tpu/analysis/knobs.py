@@ -119,6 +119,16 @@ _ALL: list[Knob] = [
        "detection and auto-heal triggering)."),
     _k("MINIO_TPU_METACACHE_MAX_KEYS", "200000", "erasure",
        "Cap on cached listing entries per metacache bucket scan."),
+    _k("MINIO_TPU_METACACHE_PERSIST", "1", "erasure",
+       "Persist metacache shard/index docs under .minio.sys so a "
+       "restarted node or a cluster peer adopts a TTL-fresh listing "
+       "(faulting in only the shards its pages touch) instead of "
+       "re-walking every drive. 0 keeps the metacache memory-only."),
+    _k("MINIO_TPU_METACACHE_SHARD_KEYS", "8192", "erasure",
+       "Keys per metacache key-range shard. A continuation token "
+       "bisects into its shard, so page-resume work is O(log shards + "
+       "page) regardless of total keyspace; smaller shards mean finer "
+       "lazy loads from the persisted tier, more docs."),
     _k("MINIO_TPU_METACACHE_TTL", "15", "erasure",
        "Seconds a bucket-listing metacache stays valid before a "
        "rescan."),

@@ -10,6 +10,7 @@ grouping and marker pagination mirror ListObjectsV2 semantics.
 
 from __future__ import annotations
 
+import bisect
 import hashlib
 import heapq
 import json
@@ -17,7 +18,7 @@ import os
 import threading
 import time
 import weakref
-from typing import Iterator
+from typing import Callable, Iterator
 
 from .quorum import ErasureError, ObjectNotFound, QuorumError, VersionNotFound
 from .types import ListObjectsResult, ObjectInfo
@@ -46,6 +47,8 @@ def _safe_walk(disk, bucket: str, base: str) -> Iterator[str]:
 
 def _merged_keys(es, bucket: str, prefix: str) -> Iterator[str]:
     """Sorted union of object keys across all drives under a prefix."""
+    with _MC_LOCK:
+        _MC_STATS["walks"] += 1
     # walk from the parent of the last prefix segment so dir-marker
     # siblings ("photos/" stored as "photos__XLDIR__") are visited too
     trimmed = prefix[:-1] if prefix.endswith("/") else prefix
@@ -71,26 +74,131 @@ def _merged_keys(es, bucket: str, prefix: str) -> Iterator[str]:
 # as objects under .minio.sys and resumes them by continuation token,
 # /root/reference/cmd/metacache-set.go:319, metacache-server-pool.go:60),
 # and REPEATED first-page scans of the same (bucket, prefix) — training
-# manifests, dashboards — reuse the previous walk outright. Coherence:
-# every object mutation invalidates its bucket's entries through the
-# cache choke point (cache/core.SetCache.invalidate_object), so a
-# same-node put -> list round-trip always sees the new key; cross-node
-# the TTL plus the coherence broadcast bound staleness.
+# manifests, dashboards — reuse the previous walk outright.
+#
+# The key stream is SHARDED by key range (ShardedKeys): the sorted walk
+# splits into ~MINIO_TPU_METACACHE_SHARD_KEYS-entry shards with a small
+# decoded-boundary index, so resuming a continuation token is a bisect
+# into one shard (O(log shards + page) per page) instead of an O(total
+# keys) scan — at 10^6 keys that is the difference between flat and
+# linear page latency. Shards persist individually under .minio.sys
+# (index doc + one doc per shard), so a restarted node or a cluster
+# peer adopts the index and faults in only the shards its pages touch.
+#
+# Coherence: every object mutation invalidates its bucket's entries
+# through the cache choke point (cache/core.SetCache.invalidate_object),
+# so a same-node put -> list round-trip always sees the new key. The
+# persisted index is stamped with the bucket's invalidation sequence at
+# walk start; an adopter accepts it only while its own in-memory
+# sequence still matches (or is 0 — fresh boot, where the TTL alone
+# bounds staleness, same trust as a cross-node adoption).
 
 _MC_LOCK = threading.Lock()
-# (store-id, bucket, prefix) -> (created, keys | None, store-weakref);
-# keys=None is the memoized "too big to cache" verdict so huge prefixes
+# (store-id, bucket, prefix) -> (created, ShardedKeys | None, store-weakref);
+# None is the memoized "too big to cache" verdict so huge prefixes
 # don't double-walk. The weakref guards against CPython id() reuse after
 # a store is garbage-collected.
-_MC_MEM: dict[tuple[int, str, str], tuple[float, list[str] | None, object]] = {}
+_MC_MEM: dict[tuple[int, str, str], tuple[float, object, object]] = {}
 _MC_MAX_ENTRIES = 256
-_MC_STATS = {"hits": 0, "misses": 0, "invalidations": 0, "stores": 0}
-# per-bucket invalidation sequence: a first-page walk captured across a
-# concurrent mutation must not be memoized (the walk may predate the new
-# key but would be stamped fresh) — snapshot at walk start, compare at
-# store time
+_MC_STATS = {
+    "hits": 0,
+    "misses": 0,
+    "invalidations": 0,
+    "stores": 0,
+    "evictions": 0,
+    "walks": 0,           # full merged drive walks started
+    "persisted": 0,       # shard + index docs written to .minio.sys
+    "persist_adopts": 0,  # persisted indexes adopted (restart / peer)
+    "shard_loads": 0,     # individual shard docs faulted in on demand
+    "build_waits": 0,     # misses that waited on a sibling's build
+}
+# build singleflight: concurrent paginated misses on one (store, bucket,
+# prefix) would each walk every drive — at 10^5+ keys that thundering
+# herd is minutes of redundant I/O. The first miss claims the key and
+# walks; the rest wait on its event, then re-check the memory cache.
+_MC_BUILDING: dict[tuple[int, str, str], threading.Event] = {}
+# per-bucket invalidation sequence: a walk captured across a concurrent
+# mutation must not be memoized (the walk may predate the new key but
+# would be stamped fresh) — snapshot at walk start, compare at store time
 _MC_SEQ = 0
 _MC_BSEQ: dict[str, int] = {}
+
+
+class MetacacheGone(Exception):
+    """A lazily-persisted shard could not be faulted in (deleted,
+    corrupt, or torn overwrite): the cached stream is unusable and the
+    caller must fall back to a fresh drive walk."""
+
+
+class ShardedKeys:
+    """Key-range-sharded sorted key stream for one (bucket, prefix).
+
+    ``shards`` holds ENCODED keys (sorted by decoded form, exactly as
+    the merged walk yields them); ``bounds`` holds the DECODED first key
+    of each shard so a continuation marker bisects straight to its
+    shard. A shard slot may be None when the object was adopted from
+    the persisted tier — ``loader(i)`` faults it in on first touch."""
+
+    __slots__ = ("shards", "bounds", "total", "_loader", "_lock")
+
+    def __init__(
+        self,
+        shards: list[list[str] | None],
+        bounds: list[str],
+        total: int,
+        loader: Callable[[int], list[str]] | None = None,
+    ):
+        self.shards = shards
+        self.bounds = bounds
+        self.total = total
+        self._loader = loader
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def build(keys: list[str], shard_keys: int) -> "ShardedKeys":
+        n = max(1, shard_keys)
+        shards: list[list[str] | None] = [
+            keys[i : i + n] for i in range(0, len(keys), n)
+        ]
+        bounds = [decode_dir_object(s[0]) for s in shards]
+        return ShardedKeys(shards, bounds, len(keys))
+
+    def loaded_shards(self) -> int:
+        return sum(1 for s in self.shards if s is not None)
+
+    def _shard(self, i: int) -> list[str]:
+        s = self.shards[i]
+        if s is None:
+            with self._lock:
+                s = self.shards[i]
+                if s is None:
+                    if self._loader is None:
+                        raise MetacacheGone(f"shard {i} missing")
+                    s = self._loader(i)
+                    self.shards[i] = s
+        return s
+
+    def iter_from(self, marker: str = "") -> Iterator[str]:
+        """Yield encoded keys whose DECODED form is >= marker (versions
+        pagination resumes ON the marker key). O(log shards) to find the
+        resume point; only shards at/after it are touched."""
+        if not self.shards:
+            return
+        si = 0
+        if marker:
+            si = max(bisect.bisect_right(self.bounds, marker) - 1, 0)
+        first = self._shard(si)
+        start = (
+            bisect.bisect_left(first, marker, key=decode_dir_object)
+            if marker
+            else 0
+        )
+        yield from first[start:]
+        for i in range(si + 1, len(self.shards)):
+            yield from self._shard(i)
+
+    def __iter__(self) -> Iterator[str]:
+        return self.iter_from("")
 
 
 def _mc_bucket_seq(bucket: str) -> int:
@@ -104,6 +212,14 @@ def _mc_ttl() -> float:
 
 def _mc_max_keys() -> int:
     return int(os.environ.get("MINIO_TPU_METACACHE_MAX_KEYS", "200000"))
+
+
+def _mc_shard_keys() -> int:
+    return int(os.environ.get("MINIO_TPU_METACACHE_SHARD_KEYS", "8192"))
+
+
+def _mc_persist_enabled() -> bool:
+    return os.environ.get("MINIO_TPU_METACACHE_PERSIST", "1") != "0"
 
 
 def invalidate_bucket(bucket: str) -> None:
@@ -133,13 +249,20 @@ def clear_metacache() -> int:
 
 def metacache_stats() -> dict:
     with _MC_LOCK:
-        return dict(_MC_STATS, entries=len(_MC_MEM))
+        shards = sum(
+            entry[1].loaded_shards()
+            for entry in _MC_MEM.values()
+            if isinstance(entry[1], ShardedKeys)
+        )
+        return dict(_MC_STATS, entries=len(_MC_MEM), shards=shards)
 
 
-def _mc_mem_lookup(es, bucket: str, prefix: str) -> list[str] | None:
-    """Fresh in-memory key list for (bucket, prefix), else None. Unlike
-    ``_metacache_keys`` this never reads the persisted copy or builds —
-    it is the zero-I/O fast path for repeated first-page scans."""
+def _mc_mem_lookup(es, bucket: str, prefix: str) -> "ShardedKeys | None":
+    """Fresh in-memory key stream for (bucket, prefix), else None. Unlike
+    ``_metacache_keys`` this never reads the persisted index or builds —
+    it is the zero-walk fast path for repeated first-page scans (an
+    adopted entry may still fault individual shards in from the
+    persisted tier on first touch)."""
     from ..cache import core as cache_core
 
     ttl = _mc_ttl()
@@ -158,7 +281,7 @@ def _mc_mem_lookup(es, bucket: str, prefix: str) -> list[str] | None:
 def _mc_mem_store(es, bucket: str, prefix: str, keys: list[str],
                   seq0: int) -> None:
     """Memoize a fully-consumed walk so the NEXT scan of this prefix is
-    zero-I/O (in-memory only; the persisted tier stays owned by the
+    zero-walk (in-memory only; the persisted tier stays owned by the
     pagination builder in ``_metacache_keys``). ``seq0`` is the bucket's
     invalidation sequence at WALK START: a mutation that landed mid-walk
     rejects the store — the walk may predate the new key, and memoizing
@@ -171,26 +294,136 @@ def _mc_mem_store(es, bucket: str, prefix: str, keys: list[str],
     if len(keys) > _mc_max_keys():
         return
     now = time.time()
+    sk = ShardedKeys.build(list(keys), _mc_shard_keys())
     with _MC_LOCK:
         if _MC_BSEQ.get(bucket, 0) != seq0:
             return  # invalidated while walking: not trustworthy
         _mc_evict(now, ttl)
-        _MC_MEM[(id(es), bucket, prefix)] = (now, list(keys), weakref.ref(es))
+        _MC_MEM[(id(es), bucket, prefix)] = (now, sk, weakref.ref(es))
         _MC_STATS["stores"] += 1
 
 
 def _mc_evict(now: float, ttl: float) -> None:
     """Caller holds _MC_LOCK: drop expired entries + cap total count."""
-    for ck in [k for k, entry in _MC_MEM.items() if now - entry[0] >= ttl]:
+    victims = [k for k, entry in _MC_MEM.items() if now - entry[0] >= ttl]
+    for ck in victims:
         del _MC_MEM[ck]
+    _MC_STATS["evictions"] += len(victims)
     while len(_MC_MEM) > _MC_MAX_ENTRIES:
         _MC_MEM.pop(next(iter(_MC_MEM)))
+        _MC_STATS["evictions"] += 1
 
 
-def _metacache_keys(es, bucket: str, prefix: str) -> list[str] | None:
-    """Sorted raw keys for (bucket, prefix) from the metacache, building
-    and persisting it on first paginated access. None = stream the walk
-    (cache disabled, stale path, or namespace too big to cache)."""
+def _mc_drop(es, bucket: str, prefix: str) -> None:
+    """Drop one unusable entry (failed shard fault-in)."""
+    with _MC_LOCK:
+        _MC_MEM.pop((id(es), bucket, prefix), None)
+        _MC_STATS["evictions"] += 1
+
+
+def _mc_doc_base(bucket: str, prefix: str) -> str:
+    h = hashlib.sha1(prefix.encode()).hexdigest()
+    return f"buckets/{bucket}/.metacache/{h}"
+
+
+def _mc_persist(es, bucket: str, prefix: str, sk: ShardedKeys,
+                created: float, seq0: int) -> None:
+    """Write the shard docs then the index (index last: an adopter never
+    sees an index whose shards aren't durable yet; each shard doc echoes
+    the index's created stamp so a torn overwrite is detected at
+    fault-in time and falls back to a walk)."""
+    if not _mc_persist_enabled():
+        return
+    base = _mc_doc_base(bucket, prefix)
+    try:
+        for i, s in enumerate(sk.shards):
+            es.put_object(
+                SYSTEM_BUCKET, f"{base}.s{i:05d}.json",
+                json.dumps({"created": created, "keys": s}).encode(),
+            )
+        es.put_object(
+            SYSTEM_BUCKET, f"{base}.idx.json",
+            json.dumps({
+                "created": created,
+                "seq": seq0,
+                "counts": [len(s) for s in sk.shards],
+                "bounds": sk.bounds,
+            }).encode(),
+        )
+    except (ErasureError, StorageError, OSError):
+        return  # persistence is an optimization; memory cache serves
+    with _MC_LOCK:
+        _MC_STATS["persisted"] += len(sk.shards) + 1
+
+
+def _mc_persist_adopt(
+    es, bucket: str, prefix: str, now: float, ttl: float, bseq: int
+) -> tuple[float, ShardedKeys] | None:
+    """Adopt a persisted index (another node, or this node before a
+    restart, built it): shards stay unloaded until a page touches them.
+    Accepted only while TTL-fresh AND the stamped invalidation sequence
+    matches this node's — bseq 0 means no mutation seen since boot, so
+    the TTL alone bounds staleness (cross-node trust)."""
+    if not _mc_persist_enabled():
+        return None
+    base = _mc_doc_base(bucket, prefix)
+    try:
+        _, it = es.get_object(SYSTEM_BUCKET, f"{base}.idx.json")
+        doc = json.loads(b"".join(it))
+        created = float(doc["created"])
+        counts = [int(c) for c in doc["counts"]]
+        bounds = [str(b) for b in doc["bounds"]]
+        seq = int(doc.get("seq", -1))
+    # miniovet: ignore[error-taint] -- any failure here (absent index,
+    # corrupt doc, quorum loss) is recoverable by design: the caller
+    # rebuilds from the drives, which is the source of truth
+    except Exception:  # noqa: BLE001 — absent/corrupt: rebuild
+        return None
+    if now - created >= ttl:
+        # expired persisted cache: reclaim the space opportunistically
+        try:
+            for i in range(len(counts)):
+                es.delete_object(SYSTEM_BUCKET, f"{base}.s{i:05d}.json")
+            es.delete_object(SYSTEM_BUCKET, f"{base}.idx.json")
+        except (ErasureError, StorageError, OSError):
+            pass  # reclaim is best-effort; the TTL already expired it
+        return None
+    if bseq not in (0, seq):
+        return None  # a local mutation outran this index: stale
+    if len(bounds) != len(counts) or sum(counts) > _mc_max_keys():
+        return None
+
+    def load(i: int) -> list[str]:
+        try:
+            _, sit = es.get_object(SYSTEM_BUCKET, f"{base}.s{i:05d}.json")
+            sdoc = json.loads(b"".join(sit))
+            if float(sdoc["created"]) != created:
+                raise MetacacheGone(f"shard {i} from a different build")
+            keys = [str(k) for k in sdoc["keys"]]
+            if len(keys) != counts[i]:
+                raise MetacacheGone(f"shard {i} truncated")
+        except MetacacheGone:
+            raise
+        # a missing/corrupt shard doc is recoverable by design:
+        # MetacacheGone makes the lister fall back to a fresh drive walk
+        except Exception as e:  # noqa: BLE001 — absent/corrupt: rewalk
+            raise MetacacheGone(f"shard {i}: {e}") from None
+        with _MC_LOCK:
+            _MC_STATS["shard_loads"] += 1
+        return keys
+
+    with _MC_LOCK:
+        _MC_STATS["persist_adopts"] += 1
+    return created, ShardedKeys(
+        [None] * len(counts), bounds, sum(counts), loader=load
+    )
+
+
+def _metacache_keys(es, bucket: str, prefix: str) -> "ShardedKeys | None":
+    """Sharded key stream for (bucket, prefix) from the metacache,
+    building and persisting it on first paginated access. None = stream
+    the walk (cache disabled, stale path, or namespace too big to
+    cache)."""
     ttl = _mc_ttl()
     if ttl <= 0 or bucket.startswith(SYSTEM_BUCKET):
         return None
@@ -206,47 +439,72 @@ def _metacache_keys(es, bucket: str, prefix: str) -> list[str] | None:
         return hit[1]
     with _MC_LOCK:
         _MC_STATS["misses"] += 1
-    obj_key = (
-        f"buckets/{bucket}/.metacache/"
-        f"{hashlib.sha1(prefix.encode()).hexdigest()}.json"
-    )
-    # another node of the cluster may have persisted this listing already
-    try:
-        _, it = es.get_object(SYSTEM_BUCKET, obj_key)
-        doc = json.loads(b"".join(it))
-        if now - float(doc.get("created", 0)) < ttl:
-            keys = list(doc.get("keys", []))
+    # singleflight the build: if a sibling request is already walking
+    # this (store, bucket, prefix), wait for its verdict and re-check
+    # the memory cache instead of starting a redundant full walk
+    while True:
+        with _MC_LOCK:
+            ev = _MC_BUILDING.get(ck)
+            if ev is None:
+                _MC_BUILDING[ck] = threading.Event()
+                break
+            _MC_STATS["build_waits"] += 1
+        ev.wait()
+        now = time.time()
+        with _MC_LOCK:
+            hit = _MC_MEM.get(ck)
+        if hit and now - hit[0] < ttl and hit[2]() is es:
             with _MC_LOCK:
-                _MC_MEM[ck] = (float(doc["created"]), keys, weakref.ref(es))
-            return keys
-        # expired persisted cache: reclaim the space opportunistically
-        try:
-            es.delete_object(SYSTEM_BUCKET, obj_key)
-        except (ErasureError, StorageError, OSError):
-            pass  # reclaim is best-effort; the TTL already expired it
-    # miniovet: ignore[error-taint] -- any failure here (absent object,
-    # corrupt doc, quorum loss) is recoverable by design: the walk below
-    # rebuilds the listing from the drives, which is the source of truth
-    except Exception:  # noqa: BLE001 — absent/corrupt: rebuild
-        pass
-    keys: list[str] | None = []
-    cap = _mc_max_keys()
-    for raw in _merged_keys(es, bucket, prefix):
-        keys.append(raw)
-        if len(keys) > cap:
-            keys = None  # memoize the verdict: pages stream the walk
-            break
-    with _MC_LOCK:
-        _MC_MEM[ck] = (now, keys, weakref.ref(es))
-    if keys is not None:
-        try:
-            es.put_object(
-                SYSTEM_BUCKET, obj_key,
-                json.dumps({"created": now, "keys": keys}).encode(),
-            )
-        except (ErasureError, StorageError, OSError):
-            pass  # persistence is an optimization; memory cache serves
-    return keys
+                _MC_STATS["hits"] += 1
+            return hit[1]
+        # builder's store was rejected (mutation mid-walk) or expired:
+        # loop to claim the build slot ourselves
+    try:
+        seq0 = _mc_bucket_seq(bucket)
+        # another node of the cluster (or this node before a restart)
+        # may have persisted this listing already — adopt its index,
+        # fault shards in per page
+        adopted = _mc_persist_adopt(es, bucket, prefix, now, ttl, seq0)
+        if adopted is not None:
+            created, sk = adopted
+            with _MC_LOCK:
+                _MC_MEM[ck] = (created, sk, weakref.ref(es))
+            return sk
+        keys: list[str] | None = []
+        cap = _mc_max_keys()
+        for raw in _merged_keys(es, bucket, prefix):
+            keys.append(raw)
+            if len(keys) > cap:
+                keys = None  # memoize the verdict: pages stream the walk
+                break
+        if keys is None:
+            with _MC_LOCK:
+                _MC_MEM[ck] = (now, None, weakref.ref(es))
+            return None
+        sk = ShardedKeys.build(keys, _mc_shard_keys())
+        # stamp at build END, not walk start: the seq check below proves
+        # no mutation landed during the walk, so the key list equals the
+        # listing as of NOW — and a walk that itself takes a sizable
+        # fraction of the TTL (10^5+ keys on a loaded box) must not be
+        # born half-expired
+        done = time.time()
+        with _MC_LOCK:
+            if _MC_BSEQ.get(bucket, 0) != seq0:
+                # a mutation landed mid-walk: serve THIS page from the
+                # walk we just did (point-in-time listing) but neither
+                # memoize nor persist it — stamping it fresh would hide
+                # the new key for a whole TTL (PR 5's first-page rule,
+                # applied to the pagination builder)
+                return sk
+            _MC_MEM[ck] = (done, sk, weakref.ref(es))
+            _MC_STATS["stores"] += 1
+        _mc_persist(es, bucket, prefix, sk, done, seq0)
+        return sk
+    finally:
+        with _MC_LOCK:
+            ev = _MC_BUILDING.pop(ck, None)
+        if ev is not None:
+            ev.set()
 
 
 def list_objects(
@@ -264,95 +522,104 @@ def list_objects(
         from .quorum import BucketNotFound
 
         raise BucketNotFound(bucket)
-    out = ListObjectsResult()
-    seen_prefixes: set[str] = set()
     max_keys = max(0, min(max_keys, 100000))
-    last_emitted = ""  # next_marker must point at the LAST RETURNED entry
-    last_vid = ""
 
-    def full() -> bool:
-        return len(out.objects) + len(out.prefixes) >= max_keys
+    def _run(key_source: Iterator[str], capture: list[str] | None,
+             cap_seq0: int) -> ListObjectsResult:
+        out = ListObjectsResult()
+        seen_prefixes: set[str] = set()
+        last_emitted = ""  # next_marker points at the LAST RETURNED entry
+        last_vid = ""
 
-    key_source: Iterator[str] | list[str] | None = None
-    capture: list[str] | None = None
-    if marker:
-        # continuation page: reuse (or build once) the cached key stream
-        # instead of re-walking every drive per page
-        key_source = _metacache_keys(es, bucket, prefix)
-    else:
-        # repeated first-page scan: a fresh prior walk serves in-memory
-        key_source = _mc_mem_lookup(es, bucket, prefix)
-    cap_seq0 = 0
-    if key_source is None:
-        key_source = _merged_keys(es, bucket, prefix)
-        if not marker:
-            # capture the walk; if this page consumes it COMPLETELY (no
-            # truncation) the keys are the full prefix listing — cache
-            # them for free so the next scan is zero-I/O
-            capture = []
-            cap_seq0 = _mc_bucket_seq(bucket)
+        def full() -> bool:
+            return len(out.objects) + len(out.prefixes) >= max_keys
 
-    cap_max = _mc_max_keys()
-    for raw_key in key_source:
-        if capture is not None:
-            capture.append(raw_key)
-            if len(capture) > cap_max:
-                capture = None
-        key = decode_dir_object(raw_key)
-        if delimiter:
-            rest = key[len(prefix) :]
-            di = rest.find(delimiter)
-            if di >= 0:
-                cp = prefix + rest[: di + len(delimiter)]
-                if cp in seen_prefixes or cp <= marker:
+        cap_max = _mc_max_keys()
+        for raw_key in key_source:
+            if capture is not None:
+                capture.append(raw_key)
+                if len(capture) > cap_max:
+                    capture = None
+            key = decode_dir_object(raw_key)
+            if delimiter:
+                rest = key[len(prefix) :]
+                di = rest.find(delimiter)
+                if di >= 0:
+                    cp = prefix + rest[: di + len(delimiter)]
+                    if cp in seen_prefixes or cp <= marker:
+                        continue
+                    if full():
+                        out.is_truncated = True
+                        out.next_marker = last_emitted
+                        return out
+                    seen_prefixes.add(cp)
+                    out.prefixes.append(cp)
+                    last_emitted = cp
                     continue
-                if full():
-                    out.is_truncated = True
-                    out.next_marker = last_emitted
-                    return out
-                seen_prefixes.add(cp)
-                out.prefixes.append(cp)
-                last_emitted = cp
+            if include_versions:
+                if key < marker:
+                    continue
+                try:
+                    versions = es.list_object_versions(bucket, key)
+                except (ObjectNotFound, QuorumError, VersionNotFound):
+                    continue
+                resume_skip = key == marker and bool(version_marker)
+                for oi in versions:
+                    if resume_skip:
+                        # resume strictly after the version-id marker
+                        if oi.version_id == version_marker:
+                            resume_skip = False
+                        continue
+                    if key == marker and not version_marker:
+                        continue  # whole key returned on a prior page
+                    oi.name = key
+                    if len(out.objects) >= max_keys:
+                        out.is_truncated = True
+                        out.next_marker = last_emitted
+                        out.next_version_marker = last_vid
+                        return out
+                    out.objects.append(oi)
+                    last_emitted = key
+                    last_vid = oi.version_id
                 continue
-        if include_versions:
-            if key < marker:
+            if key <= marker:
                 continue
             try:
-                versions = es.list_object_versions(bucket, key)
+                oi = es.get_object_info(bucket, raw_key)
             except (ObjectNotFound, QuorumError, VersionNotFound):
-                continue
-            resume_skip = key == marker and bool(version_marker)
-            for oi in versions:
-                if resume_skip:
-                    # resume strictly after the version-id marker
-                    if oi.version_id == version_marker:
-                        resume_skip = False
-                    continue
-                if key == marker and not version_marker:
-                    continue  # whole key already returned on a prior page
-                oi.name = key
-                if len(out.objects) >= max_keys:
-                    out.is_truncated = True
-                    out.next_marker = last_emitted
-                    out.next_version_marker = last_vid
-                    return out
-                out.objects.append(oi)
-                last_emitted = key
-                last_vid = oi.version_id
-            continue
-        if key <= marker:
-            continue
+                continue  # dangling or delete-marked
+            if full():
+                out.is_truncated = True
+                out.next_marker = last_emitted
+                return out
+            oi.name = key
+            out.objects.append(oi)
+            last_emitted = key
+        if capture is not None:
+            _mc_mem_store(es, bucket, prefix, capture, cap_seq0)
+        return out
+
+    sk: ShardedKeys | None = None
+    if marker:
+        # continuation page: resume the cached sharded key stream at the
+        # marker (a bisect, not a scan) instead of re-walking every drive
+        sk = _metacache_keys(es, bucket, prefix)
+    else:
+        # repeated first-page scan: a fresh prior walk serves in-memory
+        sk = _mc_mem_lookup(es, bucket, prefix)
+    if sk is not None:
         try:
-            oi = es.get_object_info(bucket, raw_key)
-        except (ObjectNotFound, QuorumError, VersionNotFound):
-            continue  # dangling or delete-marked
-        if full():
-            out.is_truncated = True
-            out.next_marker = last_emitted
-            return out
-        oi.name = key
-        out.objects.append(oi)
-        last_emitted = key
-    if capture is not None:
-        _mc_mem_store(es, bucket, prefix, capture, cap_seq0)
-    return out
+            return _run(sk.iter_from(marker), None, 0)
+        except MetacacheGone:
+            # a lazily-persisted shard vanished under us: drop the entry
+            # and serve this page from a fresh walk (source of truth)
+            _mc_drop(es, bucket, prefix)
+    capture: list[str] | None = None
+    cap_seq0 = 0
+    if not marker:
+        # capture the walk; if this page consumes it COMPLETELY (no
+        # truncation) the keys are the full prefix listing — cache
+        # them for free so the next scan is zero-walk
+        capture = []
+        cap_seq0 = _mc_bucket_seq(bucket)
+    return _run(_merged_keys(es, bucket, prefix), capture, cap_seq0)

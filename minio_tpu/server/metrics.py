@@ -921,6 +921,7 @@ def _g_api_cache(server) -> list[str]:
     memory."""
     from .. import cache
     from ..cache import coherence as cache_coherence
+    from ..storage import xlstorage
 
     out: list[str] = []
     if server.store is None:
@@ -998,6 +999,52 @@ def _g_api_cache(server) -> list[str]:
          [({}, co["gen_gaps"])],
          "Generation-sequence gaps observed (lost invalidations healed "
          "via epoch revalidation)")
+    # sharded listing metacache: the metadata-plane scale counters —
+    # pages-per-walk proves O(1) drive-walks per continuation page, the
+    # persisted tier's adopt/fault-in activity proves restart survival
+    mc = st["listing"]
+    _fmt(out, "minio_cache_metacache_requests_total", "counter",
+         [({"result": "hit"}, mc.get("hits", 0)),
+          ({"result": "miss"}, mc.get("misses", 0))],
+         "Listing metacache lookups (hit = page served without a walk)")
+    _fmt(out, "minio_cache_metacache_stores_total", "counter",
+         [({}, mc.get("stores", 0))])
+    _fmt(out, "minio_cache_metacache_evictions_total", "counter",
+         [({}, mc.get("evictions", 0))],
+         "Entries dropped by TTL expiry, capacity, or failed fault-in")
+    _fmt(out, "minio_cache_metacache_invalidations_total", "counter",
+         [({}, mc.get("invalidations", 0))],
+         "Entries dropped through the mutation choke point")
+    _fmt(out, "minio_cache_metacache_walks_total", "counter",
+         [({}, mc.get("walks", 0))],
+         "Full merged drive walks started (listing pages that could "
+         "not be served from the sharded cache)")
+    _fmt(out, "minio_cache_metacache_entries", "gauge",
+         [({}, mc.get("entries", 0))])
+    _fmt(out, "minio_cache_metacache_shards", "gauge",
+         [({}, mc.get("shards", 0))],
+         "Loaded key-range shards across in-memory listing entries")
+    _fmt(out, "minio_cache_metacache_persisted_total", "counter",
+         [({}, mc.get("persisted", 0))],
+         "Shard + index docs written under .minio.sys")
+    _fmt(out, "minio_cache_metacache_persist_adopts_total", "counter",
+         [({}, mc.get("persist_adopts", 0))],
+         "Persisted indexes adopted (restarted node or cluster peer)")
+    _fmt(out, "minio_cache_metacache_shard_loads_total", "counter",
+         [({}, mc.get("shard_loads", 0))],
+         "Individual shard docs faulted in on demand")
+    # shard-file fan-out: the inline small-object fast path's proof
+    # counters — inline PUT/GET/HEAD must leave the user plane flat
+    fo = xlstorage.fanout_stats()
+    _fmt(out, "minio_storage_shard_io_total", "counter",
+         [({"op": "read", "plane": "user"}, fo["shard_reads_user"]),
+          ({"op": "read", "plane": "sys"}, fo["shard_reads_sys"]),
+          ({"op": "write", "plane": "user"}, fo["shard_writes_user"]),
+          ({"op": "write", "plane": "sys"}, fo["shard_writes_sys"]),
+          ({"op": "commit", "plane": "user"}, fo["shard_commits_user"]),
+          ({"op": "commit", "plane": "sys"}, fo["shard_commits_sys"])],
+         "Shard-file opens/commits by plane (user buckets vs "
+         ".minio.sys); metadata-only ops never move these")
     return out
 
 

@@ -156,7 +156,8 @@ class HealthCheckedDisk(StorageAPI):
             self._probe_inflight = True
             return True
 
-    def _ok(self, dt: float, op: str | None = None) -> None:
+    def _ok(self, dt: float, op: str | None = None,
+            ewma: bool = True) -> None:
         tripped = False
         with self._mu:
             self._consecutive_faults = 0
@@ -169,8 +170,9 @@ class HealthCheckedDisk(StorageAPI):
             if self._probe_inflight:
                 self._open_until = 0.0
             self._probe_inflight = False
-            self._latencies.append(dt)
-            self._ewma_locked(dt)
+            if ewma:
+                self._latencies.append(dt)
+                self._ewma_locked(dt)
             if op is not None:
                 self._account_locked(op, dt)
             # latency breaker: a drive that ANSWERS but has become
@@ -258,19 +260,24 @@ class HealthCheckedDisk(StorageAPI):
         return self._inner.local_path(volume, path)
 
     def walk_dir(self, volume, base=""):
-        # generator: account the iteration, not just construction
+        # generator: account the iteration, not just construction. The
+        # walk's wall time measures NAMESPACE SIZE (one call enumerates
+        # every key under the prefix — tens of seconds at 10^5+ keys is
+        # healthy), not device health, so it stays out of the latency
+        # EWMA: one big metacache build must not trip the breaker on a
+        # perfectly good drive. Faults still count like any other op.
         if not self._enter():
             raise errors.DiskNotFound(f"{self.endpoint} (circuit open)")
         t0 = time.monotonic()
         try:
             yield from self._inner.walk_dir(volume, base)
         except _LOGICAL:
-            self._ok(time.monotonic() - t0)
+            self._ok(time.monotonic() - t0, op="walk_dir", ewma=False)
             raise
         except Exception:
             self._fault()
             raise
-        self._ok(time.monotonic() - t0)
+        self._ok(time.monotonic() - t0, op="walk_dir", ewma=False)
 
 
 def _make_method(name):

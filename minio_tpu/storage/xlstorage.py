@@ -44,6 +44,39 @@ _ODIRECT = (
 )
 _ODIRECT_MIN = 1 << 20  # small files stay buffered
 
+# ---- shard-file fan-out counters -------------------------------------------
+# Deterministic proof obligations for the inline small-object fast path:
+# raw IOPS on a CPU-shadowed container don't transfer, but "this op opened
+# zero shard files" does. Every shard-file read/write on this drive bumps
+# one counter, split by plane — user volumes vs `.minio.sys` system
+# volumes (metacache persistence, staging, multipart) — so a test or a
+# bench gate can assert that inline PUT/GET/HEAD leave the user-plane
+# counters flat. xl.meta I/O goes through direct open() in _read_meta/
+# _write_meta and is invisible here BY DESIGN: the metadata plane is
+# allowed; shard-file fan-out is what the inline path must never do.
+
+_FANOUT_LOCK = threading.Lock()
+_FANOUT = {
+    "shard_reads_user": 0,
+    "shard_reads_sys": 0,
+    "shard_writes_user": 0,
+    "shard_writes_sys": 0,
+    "shard_commits_user": 0,  # rename_data data-dir moves into place
+    "shard_commits_sys": 0,
+}
+
+
+def _fanout_bump(kind: str, volume: str) -> None:
+    plane = "sys" if volume.startswith(SYS_DIR) else "user"
+    with _FANOUT_LOCK:
+        _FANOUT[f"{kind}_{plane}"] += 1
+
+
+def fanout_stats() -> dict:
+    """Snapshot of the process-wide shard-file I/O counters."""
+    with _FANOUT_LOCK:
+        return dict(_FANOUT)
+
 
 def _clean_rel(path: str) -> str:
     """Reject traversal; normalize an object path to a safe relative path."""
@@ -262,6 +295,7 @@ class XLStorage(StorageAPI):
         dst_dir = self._file_path(dst_volume, dst_path)
         with self._meta_lock:
             if fi.data_dir:
+                _fanout_bump("shard_commits", dst_volume)
                 src_data = os.path.join(src, fi.data_dir)
                 dst_data = os.path.join(dst_dir, fi.data_dir)
                 if not os.path.isdir(src_data):
@@ -281,6 +315,7 @@ class XLStorage(StorageAPI):
             shutil.rmtree(src, ignore_errors=True)
 
     def create_file(self, volume: str, path: str, data: bytes | BinaryIO) -> None:
+        _fanout_bump("shard_writes", volume)
         full = self._file_path(volume, path)
         os.makedirs(os.path.dirname(full), exist_ok=True)
         if (
@@ -355,12 +390,14 @@ class XLStorage(StorageAPI):
         return True
 
     def append_file(self, volume: str, path: str, data: bytes) -> None:
+        _fanout_bump("shard_writes", volume)
         full = self._file_path(volume, path)
         os.makedirs(os.path.dirname(full), exist_ok=True)
         with open(full, "ab") as f:
             f.write(data)
 
     def read_file(self, volume: str, path: str, offset: int = 0, length: int = -1) -> bytes:
+        _fanout_bump("shard_reads", volume)
         full = self._file_path(volume, path)
         try:
             with open(full, "rb") as f:
@@ -374,6 +411,7 @@ class XLStorage(StorageAPI):
             raise errors.IsNotRegular(path) from None
 
     def read_file_stream(self, volume: str, path: str, offset: int, length: int) -> BinaryIO:
+        _fanout_bump("shard_reads", volume)
         full = self._file_path(volume, path)
         try:
             f = open(full, "rb")

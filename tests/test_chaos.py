@@ -277,6 +277,42 @@ def test_latency_breaker_trips_chronically_slow_drive(tmp_path):
     assert disk.online
 
 
+def test_slow_walk_does_not_trip_latency_breaker():
+    """walk_dir's wall time is namespace size, not drive health: a big
+    metacache build (tens of seconds per walk at 10^5+ keys) must not
+    poison the latency EWMA and take a healthy drive offline. Found by
+    the small-object-storm profile at 100k keys: every listing walk
+    tripped the breaker, then ~half of all requests failed DiskNotFound
+    until cooldown."""
+
+    class _SlowWalkDisk:
+        endpoint = "slowwalk"
+        disk_id = ""
+
+        def walk_dir(self, volume, base=""):
+            time.sleep(0.05)  # >> latency_trip_s below
+            yield from (f"k{i:04d}/xl.meta" for i in range(16))
+
+        def stat_vol(self, volume):
+            return {"name": volume}
+
+    disk = HealthCheckedDisk(
+        _SlowWalkDisk(), fail_threshold=4, cooldown=5.0,
+        latency_trip_s=0.02,
+    )
+    for _ in range(12):  # past _EWMA_MIN_SAMPLES with room to spare
+        assert len(list(disk.walk_dir("v"))) == 16
+        assert disk.online, "slow walk tripped the latency breaker"
+    assert disk.latency_trips == 0
+    assert disk.ewma_latency() == 0.0, "walks leaked into the EWMA"
+    # walks still show up in per-op accounting (/system/drive/latency)
+    calls, secs = disk.op_stats_snapshot()["walk_dir"]
+    assert calls == 12 and secs > 0.5
+    # and small-op latency still drives the breaker exactly as before
+    disk.stat_vol("v")
+    assert disk.online
+
+
 # ---------------------------------------------------------------------------
 # TPU boundary: backend degradation ladder
 # ---------------------------------------------------------------------------

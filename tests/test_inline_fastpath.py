@@ -1,0 +1,204 @@
+"""Inline small-object fast path under a 2-worker pool.
+
+Objects at or under INLINE_DATA_THRESHOLD live entirely in xl.meta:
+PUT is a metadata write, GET/HEAD never open a shard file. This module
+pins both halves of that claim under concurrency:
+
+- **Coherence**: an inline object overwritten (and finally deleted)
+  through either worker while both workers serve cached GET/HEAD on it
+  — zero stale bytes, every ETag matches the served body, and the
+  delete is visible on BOTH workers the moment it returns (synchronous
+  choke-point broadcast).
+- **Determinism**: the pool-aggregated ``minio_storage_shard_io_total``
+  fan-out counters prove the whole churn did ZERO user-plane shard-file
+  reads/writes/commits — the hit path (and the inline write path) never
+  touched a shard file, it didn't just happen to win races.
+"""
+
+import hashlib
+import os
+
+os.environ.setdefault("MINIO_TPU_BACKEND", "numpy")
+
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from minio_tpu.client import S3Client
+
+from test_workers import _free_port_block, _wait_ready
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BUCKET = "inlbkt"
+KEY = "hot/inline-obj"
+
+
+def _body(gen: int) -> bytes:
+    return (b"gen-%06d " % gen) * 512  # ~5 KiB: comfortably inline
+
+
+@pytest.fixture(scope="module")
+def pool(tmp_path_factory):
+    base = tmp_path_factory.mktemp("inlpool")
+    port = _free_port_block(3)
+    ctrl_base = port + 1
+    env = dict(os.environ)
+    env["MINIO_TPU_BACKEND"] = "numpy"
+    env["MINIO_TPU_WORKERS"] = "2"
+    env["MINIO_TPU_WORKER_PORT_BASE"] = str(ctrl_base)
+    env["MINIO_TPU_SCAN_INTERVAL"] = "0"
+    env["MINIO_COMPRESSION_ENABLE"] = "off"  # etag == md5(body) below
+    env["PYTHONPATH"] = REPO
+    env.pop("JAX_PLATFORMS", None)
+    log_fh = open(base / "pool.log", "wb")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "minio_tpu.server", "--address",
+         f"127.0.0.1:{port}", *[str(base / f"d{i}") for i in range(4)]],
+        env=env, stdout=log_fh, stderr=subprocess.STDOUT,
+    )
+    w0 = S3Client(f"127.0.0.1:{ctrl_base}")
+    w1 = S3Client(f"127.0.0.1:{ctrl_base + 1}")
+    try:
+        _wait_ready([w0, w1])
+    except TimeoutError:
+        proc.kill()
+        log_fh.close()
+        print((base / "pool.log").read_bytes().decode(errors="replace")[-4000:])
+        raise
+    assert w0.make_bucket(BUCKET).status == 200
+    yield {"proc": proc, "shared": S3Client(f"127.0.0.1:{port}"),
+           "w0": w0, "w1": w1}
+    if proc.poll() is None:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(20)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+    log_fh.close()
+
+
+def _shard_io_user(cli) -> dict[str, float]:
+    r = cli.request("GET", "/minio/metrics/v3/api/cache")
+    assert r.status == 200
+    out: dict[str, float] = {}
+    for line in r.body.decode().splitlines():
+        if (line.startswith("minio_storage_shard_io_total")
+                and 'plane="user"' in line):
+            name, val = line.rsplit(" ", 1)
+            out[name] = out.get(name, 0.0) + float(val)
+    assert out, "shard_io series absent from pool scrape"
+    return out
+
+
+def test_inline_overwrite_delete_under_cached_readers(pool):
+    w0, w1, shared = pool["w0"], pool["w1"], pool["shared"]
+    io_before = _shard_io_user(shared)
+
+    bodies = {1: _body(1)}
+    assert w0.put_object(BUCKET, KEY, bodies[1]).status == 200
+    for cli in (w0, w1):  # admission wants repeat reads: both cache gen 1
+        for _ in range(4):
+            assert cli.get_object(BUCKET, KEY).body == bodies[1]
+
+    committed = {"gen": 1}
+    stop = threading.Event()
+    failures: list[str] = []
+    reads = {"n": 0}
+
+    def reader(cli, rid: int) -> None:
+        while not stop.is_set():
+            floor = committed["gen"]
+            r = (cli.head_object(BUCKET, KEY) if rid % 2
+                 else cli.get_object(BUCKET, KEY))
+            if r.status != 200:
+                failures.append(f"reader {rid}: HTTP {r.status}")
+                continue
+            reads["n"] += 1
+            etag = r.headers.get("etag", "").strip('"')
+            if rid % 2:  # HEAD: etag must name SOME gen >= floor
+                ok = any(etag == hashlib.md5(_body(g)).hexdigest()
+                         for g in range(floor, committed["gen"] + 2))
+                if not ok:
+                    failures.append(
+                        f"reader {rid}: HEAD etag {etag} matches no "
+                        f"gen >= {floor}")
+            else:
+                for g in range(floor, committed["gen"] + 2):
+                    if r.body == _body(g):
+                        break
+                else:
+                    failures.append(
+                        f"reader {rid}: stale bytes (floor gen {floor})")
+                    continue
+                if etag != hashlib.md5(r.body).hexdigest():
+                    failures.append(
+                        f"reader {rid}: etag {etag} != md5(served bytes)")
+
+    threads = [
+        threading.Thread(target=reader, args=(cli, rid), daemon=True)
+        for rid, cli in enumerate((w0, w1, w0, w1))
+    ]
+    for t in threads:
+        t.start()
+
+    # overwrite through BOTH workers: each PUT must invalidate the
+    # sibling's cached copy before it returns
+    deadline = time.time() + 2.5
+    gen = 1
+    while time.time() < deadline:
+        gen += 1
+        bodies[gen] = _body(gen)
+        cli = w0 if gen % 2 else w1
+        assert cli.put_object(BUCKET, KEY, bodies[gen]).status == 200
+        committed["gen"] = gen
+        time.sleep(0.01)
+
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    assert not failures, failures[:5]
+    assert reads["n"] >= 50, f"too few verified reads: {reads['n']}"
+    assert gen >= 20, f"too few overwrites: {gen}"
+
+    # delete through one worker: the OTHER worker must 404 immediately
+    # (no TTL grace, no stale cached 200)
+    assert w0.delete_object(BUCKET, KEY).status in (200, 204)
+    for cli in (w0, w1):
+        assert cli.get_object(BUCKET, KEY).status == 404
+        assert cli.head_object(BUCKET, KEY).status == 404
+
+    # the deterministic pin: the whole churn — every PUT, cached and
+    # uncached GET/HEAD, and the delete — did zero user-plane shard I/O
+    io_after = _shard_io_user(shared)
+    delta = {k: io_after.get(k, 0) - io_before.get(k, 0) for k in io_after}
+    assert all(v == 0 for v in delta.values()), delta
+
+
+def test_inline_boundary_object_stays_inline(pool):
+    """An object exactly at INLINE_DATA_THRESHOLD still takes the
+    inline path; one byte more spills to shard files (counters move)."""
+    from minio_tpu.storage.format import INLINE_DATA_THRESHOLD
+
+    w0, shared = pool["w0"], pool["shared"]
+    io0 = _shard_io_user(shared)
+    at = os.urandom(INLINE_DATA_THRESHOLD)
+    assert w0.put_object(BUCKET, "edge-at", at).status == 200
+    assert w0.get_object(BUCKET, "edge-at").body == at
+    io1 = _shard_io_user(shared)
+    assert io1 == io0, "threshold-sized object left the inline path"
+
+    over = os.urandom(INLINE_DATA_THRESHOLD + 1)
+    assert w0.put_object(BUCKET, "edge-over", over).status == 200
+    assert w0.get_object(BUCKET, "edge-over").body == over
+    io2 = _shard_io_user(shared)
+    # the spilled object's shard WRITES stage under .minio.sys/tmp (sys
+    # plane); what marks the user plane is the rename_data commit into
+    # the bucket — exactly the counter an inline-path regression would
+    # move, since inline PUT never calls rename_data at all
+    commits = sum(v for k, v in io2.items() if 'op="commit"' in k) - sum(
+        v for k, v in io1.items() if 'op="commit"' in k)
+    assert commits > 0, "over-threshold object never committed shard files"

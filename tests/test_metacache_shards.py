@@ -1,0 +1,215 @@
+"""Sharded persistent metacache at depth (>=10^4 keys, many key-range
+shards): continuation pages resume with a bisect instead of a scan and
+stay at O(1) drive-walks per page; a mutation landing mid-walk rejects
+the memoization (PR 5's first-page rule, now applied to the pagination
+builder too); a restarted node adopts the persisted shard docs lazily
+— only the shards its pages touch are faulted in."""
+
+import os
+
+os.environ.setdefault("MINIO_TPU_BACKEND", "numpy")
+
+import pytest
+
+from minio_tpu.erasure import listing
+from minio_tpu.erasure.set import ErasureSet
+from minio_tpu.storage.xlstorage import XLStorage
+
+N = 10_000
+BUCKET = "deep"
+
+
+@pytest.fixture(scope="module")
+def roots(tmp_path_factory):
+    base = tmp_path_factory.mktemp("mcshard")
+    rs = [str(base / f"d{i}") for i in range(2)]
+    s = ErasureSet([XLStorage(r) for r in rs])
+    s.make_bucket(BUCKET)
+    for i in range(N):
+        s.put_object(BUCKET, f"k/{i:06d}", b"x")
+    return rs
+
+
+@pytest.fixture
+def es(roots, monkeypatch):
+    monkeypatch.setenv("MINIO_TPU_METACACHE_TTL", "60")
+    monkeypatch.setenv("MINIO_TPU_METACACHE_SHARD_KEYS", "512")
+    listing._MC_MEM.clear()
+    return ErasureSet([XLStorage(r) for r in roots])
+
+
+def _expected():
+    return [f"k/{i:06d}" for i in range(N)]
+
+
+def _page_all(es, page, start_marker=""):
+    keys, marker = [], start_marker
+    for _ in range(N // page + 2):
+        res = listing.list_objects(es, BUCKET, prefix="k/", marker=marker,
+                                   max_keys=page)
+        keys += [o.name for o in res.objects]
+        if not res.is_truncated:
+            return keys
+        marker = res.next_marker
+    raise AssertionError("did not terminate")
+
+
+def _counting_walks(monkeypatch):
+    walks = {"n": 0}
+    orig = XLStorage.walk_dir
+
+    def counting(self, bucket, base):
+        walks["n"] += 1
+        return orig(self, bucket, base)
+
+    monkeypatch.setattr(XLStorage, "walk_dir", counting)
+    return walks
+
+
+def test_depth_pagination_o1_walks_per_page(es, monkeypatch):
+    walks = _counting_walks(monkeypatch)
+    keys = _page_all(es, page=997)
+    assert keys == _expected()
+    # page 1 partially consumes a fresh walk; the FIRST continuation
+    # builds the sharded cache with one more full walk; every remaining
+    # page (~9) resumes by bisect — zero walks
+    assert walks["n"] <= 2 * 2, walks["n"]
+    entry = next(v for k, v in listing._MC_MEM.items() if k[1] == BUCKET)
+    sk = entry[1]
+    assert isinstance(sk, listing.ShardedKeys)
+    assert len(sk.shards) == (N + 511) // 512  # spans many shards
+    st = listing.metacache_stats()
+    assert st["shards"] >= len(sk.shards)
+    assert st["persisted"] >= len(sk.shards) + 1  # shard docs + index
+
+
+def test_mutation_between_pages_rejects_dirty_walk(es, monkeypatch):
+    # persistence off: this test pins the BUILDER's seq rule, not the
+    # persisted tier (which carries its own seq stamp)
+    monkeypatch.setenv("MINIO_TPU_METACACHE_PERSIST", "0")
+    res = listing.list_objects(es, BUCKET, prefix="k/", max_keys=100)
+    marker = res.next_marker
+
+    orig = XLStorage.walk_dir
+
+    def dirty(self, bucket, base):
+        for j, k in enumerate(orig(self, bucket, base)):
+            if j == 50:  # a PUT lands while the builder is mid-walk
+                listing.invalidate_bucket(BUCKET)
+            yield k
+
+    monkeypatch.setattr(XLStorage, "walk_dir", dirty)
+    res = listing.list_objects(es, BUCKET, prefix="k/", marker=marker,
+                               max_keys=100)
+    # the page itself is still served (point-in-time walk) ...
+    assert [o.name for o in res.objects] == _expected()[100:200]
+    # ... but the dirty walk must NOT be memoized: stamping it fresh
+    # would hide the concurrent key for a whole TTL
+    assert not any(k[1] == BUCKET for k in listing._MC_MEM)
+
+
+def test_mutation_between_pages_visible_on_next_page(es):
+    keys_before = _page_all(es, page=900)
+    assert keys_before == _expected()
+    # a key sorting past the 3rd page lands between page reads
+    res = listing.list_objects(es, BUCKET, prefix="k/", max_keys=900)
+    marker = res.next_marker
+    es2_key = "k/004000a"
+    es.put_object(BUCKET, es2_key, b"new")
+    try:
+        # the choke-point invalidation dropped the cached stream
+        assert not any(k[1] == BUCKET for k in listing._MC_MEM)
+        rest = _page_all(es, page=900, start_marker=marker)
+        assert es2_key in rest
+    finally:
+        es.delete_object(BUCKET, es2_key)
+
+
+def test_restart_adopts_persisted_shards_lazily(roots, es, monkeypatch):
+    _page_all(es, page=997)  # builds + persists index and shard docs
+
+    # a fresh store over the same drives, no in-memory state, bucket
+    # seq reset — the restart shape
+    listing._MC_MEM.clear()
+    listing._MC_BSEQ.pop(BUCKET, None)
+    es2 = ErasureSet([XLStorage(r) for r in roots])
+
+    walks = _counting_walks(monkeypatch)
+    st0 = listing.metacache_stats()
+    res = listing.list_objects(es2, BUCKET, prefix="k/",
+                               marker="k/005000", max_keys=200)
+    assert [o.name for o in res.objects] == _expected()[5001:5201]
+    st1 = listing.metacache_stats()
+    assert walks["n"] == 0  # served entirely from the persisted tier
+    assert st1["persist_adopts"] == st0["persist_adopts"] + 1
+    # one 200-key page at shard size 512 touches at most 2 shards
+    assert 1 <= st1["shard_loads"] - st0["shard_loads"] <= 2
+    entry = next(v for k, v in listing._MC_MEM.items() if k[1] == BUCKET)
+    assert entry[1].loaded_shards() <= 2
+
+    # coherence after adoption: a mutation drops the entry and the next
+    # page re-walks (the persisted index is now seq-stale and rejected)
+    es2.put_object(BUCKET, "k/009999z", b"new")
+    try:
+        res = listing.list_objects(es2, BUCKET, prefix="k/",
+                                   marker="k/009990", max_keys=200)
+        assert walks["n"] > 0
+        assert "k/009999z" in [o.name for o in res.objects]
+    finally:
+        es2.delete_object(BUCKET, "k/009999z")
+
+
+def test_concurrent_misses_share_one_build(es, monkeypatch):
+    """Build singleflight: N concurrent paginated misses on a cold cache
+    do ONE merged drive walk between them (the thundering herd at 10^5+
+    keys is minutes of redundant I/O), and every waiter still serves its
+    page correctly from the shared build."""
+    import threading
+
+    walks = {"n": 0}
+    # parties: the in-flight walk + the release lister (the waiters are
+    # parked inside the singleflight event, not at the barrier)
+    gate = threading.Barrier(2, timeout=30)
+    orig = XLStorage.walk_dir
+
+    def slow_walk(self, bucket, base):
+        walks["n"] += 1
+        if walks["n"] == 1:
+            # first drive of the first build: hold until the release
+            # lister arrives, so all misses overlap the same build
+            gate.wait()
+        return orig(self, bucket, base)
+
+    monkeypatch.setattr(XLStorage, "walk_dir", slow_walk)
+
+    results: dict[int, list[str]] = {}
+    errors: list[BaseException] = []
+
+    def lister(i: int) -> None:
+        try:
+            if i == 0:
+                gate.wait()  # release the walk once everyone is queued
+            res = listing.list_objects(
+                es, BUCKET, prefix="k/", marker=f"k/{i:06d}", max_keys=50)
+            results[i] = [o.name for o in res.objects]
+        except BaseException as e:  # noqa: BLE001 — surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=lister, args=(i,)) for i in range(8)]
+    # non-owner listers first so they queue behind the build, then the
+    # gate-releasing one
+    for t in threads[1:]:
+        t.start()
+    import time as _time
+
+    _time.sleep(0.3)  # let the herd reach the singleflight wait
+    threads[0].start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors, errors[:3]
+    assert len(results) == 8
+    for i, names in results.items():
+        assert names == _expected()[i + 1:i + 51], f"lister {i} bad page"
+    # one build = one walk per drive (2 drives here), not 8 of them
+    assert walks["n"] <= 2, f"herd walked {walks['n']} times"
+    assert listing.metacache_stats()["build_waits"] >= 1

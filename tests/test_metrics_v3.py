@@ -236,3 +236,64 @@ def test_v3_sanitizer_group_and_admin_status(cli):
     )
     assert "violations" in st and "witnessedAttrs" in st
     assert "stallEpisodes" in st
+
+
+def _series_val(text, line_prefix):
+    for line in text.splitlines():
+        if line.startswith(line_prefix):
+            return float(line.rsplit(" ", 1)[1])
+    raise AssertionError(f"{line_prefix} absent from exposition")
+
+
+def test_v3_metacache_group(cli):
+    """Sharded listing metacache series group on /api/cache."""
+    # drive a paginated listing so the builder + hit counters move
+    for i in range(30):
+        cli.put_object("metbkt", f"mc/{i:03d}", b"y")
+    q = {"prefix": "mc/", "max-keys": "7"}
+    assert cli.request("GET", "/metbkt", query=q).status == 200
+    for m in ("mc/006", "mc/013", "mc/020"):
+        r = cli.request("GET", "/metbkt", query=dict(q, marker=m))
+        assert r.status == 200
+    text = _get(cli, "/api/cache").body.decode()
+    for series in (
+        'minio_cache_metacache_requests_total{result="hit"}',
+        'minio_cache_metacache_requests_total{result="miss"}',
+        "minio_cache_metacache_stores_total",
+        "minio_cache_metacache_evictions_total",
+        "minio_cache_metacache_invalidations_total",
+        "minio_cache_metacache_walks_total",
+        "minio_cache_metacache_entries",
+        "minio_cache_metacache_shards",
+        "minio_cache_metacache_persisted_total",
+        "minio_cache_metacache_persist_adopts_total",
+        "minio_cache_metacache_shard_loads_total",
+    ):
+        assert series in text, series
+    assert _series_val(text, "minio_cache_metacache_walks_total") >= 1
+
+
+def test_v3_shard_io_fanout_inline_flat(cli):
+    """minio_storage_shard_io_total exposes the fan-out counters, and an
+    inline PUT/GET/HEAD round-trip leaves the user plane flat — the
+    deterministic zero-shard-file-I/O pin at the exposition level."""
+    text = _get(cli, "/api/cache").body.decode()
+
+    def plane(t):
+        return {
+            (op, pl): _series_val(
+                t, f'minio_storage_shard_io_total{{op="{op}",plane="{pl}"}}'
+            )
+            for op in ("read", "write", "commit") for pl in ("user", "sys")
+        }
+
+    before = plane(text)
+    cli.put_object("metbkt", "inline-pin", b"z" * 4096)  # <= 128 KiB
+    cli.get_object("metbkt", "inline-pin")
+    cli.get_object("metbkt", "inline-pin")  # cached hit path
+    cli.head_object("metbkt", "inline-pin")
+    cli.delete_object("metbkt", "inline-pin")
+    after = plane(_get(cli, "/api/cache").body.decode())
+    for op in ("read", "write", "commit"):
+        assert after[(op, "user")] == before[(op, "user")], (
+            op, before, after)
