@@ -24,6 +24,7 @@ docs:
 	$(PY) -m minio_tpu.analysis minio_tpu/ --cache --gen-lock-order docs/LOCK_ORDER.md
 	$(PY) -m minio_tpu.analysis minio_tpu/ --cache --gen-concurrency docs/CONCURRENCY.md
 	$(PY) -m minio_tpu.analysis minio_tpu/ --cache --gen-resources docs/RESOURCES.md
+	$(PY) -m minio_tpu.analysis minio_tpu/ --cache --gen-surface docs/SURFACE.md
 
 # harness-stays-runnable gate: the closed-loop load harness end to end
 # (worker pool, mixed zipf traffic, heal flood, QoS guard metrics) in

@@ -7,6 +7,7 @@
     python -m minio_tpu.analysis --gen-lock-order [PATH]
     python -m minio_tpu.analysis --gen-concurrency [PATH]
     python -m minio_tpu.analysis --gen-resources [PATH]
+    python -m minio_tpu.analysis --gen-surface [PATH]
     python -m minio_tpu.analysis --list-rules
 
 Findings print as ``file:line: rule: message`` (clickable); exit status
@@ -14,8 +15,8 @@ is non-zero when anything is found. ``--strict`` additionally fails on
 unused ``# miniovet: ignore[...]`` pragmas. With no paths, the installed
 ``minio_tpu`` package is analyzed — per-file rules plus the
 interprocedural passes (blocking-reachable, lock-order, coherence-path,
-cancellation-reachable, races, resources, error-taint, dead-knob) over
-the whole program.
+cancellation-reachable, races, resources, error-taint, dead-knob,
+surface) over the whole program.
 
 ``--cache`` keeps per-file summaries in a content-hash-keyed JSON file
 (default ``.miniovet-cache.json`` next to the package) so warm runs
@@ -99,6 +100,13 @@ def main(argv: list[str] | None = None) -> int:
              "resources pass (the runtime leak witness cross-validates "
              "it) and exit ('-' prints to stdout)",
     )
+    ap.add_argument(
+        "--gen-surface", nargs="?", const="docs/SURFACE.md",
+        default=None, metavar="PATH",
+        help="write the observable-surface inventory extracted by the "
+             "surface pass (metrics, routes, traces, fault boundaries) "
+             "and exit ('-' prints to stdout)",
+    )
     args = ap.parse_args(argv)
 
     if args.list_rules:
@@ -129,6 +137,9 @@ def main(argv: list[str] | None = None) -> int:
         if args.gen_resources is not None and "resources" not in rules:
             # and for the ownership table
             rules.append("resources")
+        if args.gen_surface is not None and "surface" not in rules:
+            # and for the observable-surface inventory
+            rules.append("surface")
 
     cache_path = None
     if (args.cache or args.cache_file) and not args.no_cache:
@@ -147,7 +158,8 @@ def main(argv: list[str] | None = None) -> int:
         if not args.paths and cache_path is None \
                 and args.gen_lock_order is None \
                 and args.gen_concurrency is None \
-                and args.gen_resources is None:
+                and args.gen_resources is None \
+                and args.gen_surface is None:
             return 0
 
     result = analyze_project(
@@ -155,7 +167,7 @@ def main(argv: list[str] | None = None) -> int:
     )
 
     if args.gen_lock_order is not None or args.gen_concurrency is not None \
-            or args.gen_resources is not None:
+            or args.gen_resources is not None or args.gen_surface is not None:
         gate = result.findings
         if not args.strict:  # same pragma filtering as the normal path
             gate = [f for f in gate if f.rule != "pragma"]
@@ -188,6 +200,13 @@ def main(argv: list[str] | None = None) -> int:
             rc = _write_doc(
                 args.gen_resources,
                 generate_resources_md(result.resource_table),
+            )
+        if args.gen_surface is not None and rc == 0:
+            from .rules_surface import generate_surface_md
+
+            rc = _write_doc(
+                args.gen_surface,
+                generate_surface_md(result.surface),
             )
         return rc
 

@@ -65,6 +65,9 @@ class IPResult:
     lock_edges: dict[str, list[str]] = field(default_factory=dict)
     guard_table: list[dict] = field(default_factory=list)
     resource_table: list[dict] = field(default_factory=list)
+    # observable-surface record from the `surface` pass:
+    # {"manifest": ..., "parity": ...} (empty on subset runs)
+    surface: dict = field(default_factory=dict)
 
 
 def run_passes(index: ProjectIndex, passes, suppressed=None,
@@ -118,6 +121,12 @@ def run_passes(index: ProjectIndex, passes, suppressed=None,
         res.findings.extend(
             dead_knob_findings(index, native_knob_reads, suppressed)
         )
+    if "surface" in passes:
+        from . import rules_surface
+
+        findings, record = rules_surface.run(index, suppressed)
+        res.findings.extend(findings)
+        res.surface = record
     res.findings.sort()
     return res
 

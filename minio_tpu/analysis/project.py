@@ -45,7 +45,7 @@ from .core import (
 )
 
 # bump to invalidate every cache entry on engine-format changes
-ENGINE_VERSION = "miniovet-ip-3"
+ENGINE_VERSION = "miniovet-ip-4"
 
 # interprocedural pass ids (per-file rule ids live in core.ALL_RULES)
 INTERPROC_PASSES = (
@@ -57,6 +57,7 @@ INTERPROC_PASSES = (
     "resources",
     "error-taint",
     "dead-knob",
+    "surface",
 )
 
 # blocking primitives for reachability (names matched on the dotted call
@@ -1454,6 +1455,7 @@ class ProjectResult:
     lock_edges: dict[str, list[str]] = field(default_factory=dict)
     guard_table: list[dict] = field(default_factory=list)
     resource_table: list[dict] = field(default_factory=list)
+    surface: dict = field(default_factory=dict)
     stats: dict = field(default_factory=dict)
 
 
@@ -1467,7 +1469,9 @@ def _engine_digest() -> str:
     here = os.path.dirname(__file__)
     h = hashlib.sha1(ENGINE_VERSION.encode())
     for name in sorted(os.listdir(here)):
-        if name.endswith(".py"):
+        # .json covers vendored rule data (reference_surface.json):
+        # editing the parity pins must bust the interproc cache too
+        if name.endswith((".py", ".json")):
             with open(os.path.join(here, name), "rb") as fh:
                 h.update(_sha1(fh.read()).encode())
     return h.hexdigest()
@@ -1691,6 +1695,7 @@ def analyze_project(
             },
             guard_table=list(ip_stored.get("guard_table", ())),
             resource_table=list(ip_stored.get("resource_table", ())),
+            surface=dict(ip_stored.get("surface", {})),
         )
         for rp, lines in ip_stored.get("used", {}).items():
             used_by_file.setdefault(rp, set()).update(lines)
@@ -1730,6 +1735,7 @@ def analyze_project(
                 "lock_edges": ip.lock_edges,
                 "guard_table": ip.guard_table,
                 "resource_table": ip.resource_table,
+                "surface": ip.surface,
             }
             cache_dirty = True
 
@@ -1778,6 +1784,7 @@ def analyze_project(
         lock_edges=ip.lock_edges,
         guard_table=ip.guard_table,
         resource_table=ip.resource_table,
+        surface=ip.surface,
         stats={
             "files": len(py_files),
             "parsed": parsed,

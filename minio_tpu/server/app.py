@@ -322,6 +322,12 @@ class S3Server(
         return self.iam.is_allowed(ak, "admin:Prometheus", "")
 
     def _err_response(self, request, err: s3err.APIError) -> web.Response:
+        # rejection split the status-code classifier in Metrics.observe
+        # can't see: malformed auth headers vs clock skew (both 4xx)
+        if err.code == "RequestTimeTooSkewed":
+            self.metrics.rejected_timestamp += 1
+        elif err.code == "AuthorizationHeaderMalformed":
+            self.metrics.rejected_header += 1
         headers = {}
         size = request.get("_range_object_size")
         if err.http_status == 416 and size is not None:
@@ -453,6 +459,11 @@ class S3Server(
                 qos_class = cls  # acquired: release in finally
             resp = await self._entry_inner(request)
             return resp
+        except asyncio.CancelledError:
+            # client went away: count it (metrics-v3 canceled_total) and
+            # propagate so aiohttp abandons the request
+            self.metrics.canceled += 1
+            raise
         finally:
             obs.trace.reset_request(obs_token)
             if qos_class is not None:

@@ -44,6 +44,9 @@ class Metrics:
         self.errors_5xx: int = 0
         self.rejected_auth: int = 0  # 401/403: failed authentication/authz
         self.rejected_invalid: int = 0  # 400: malformed requests
+        self.rejected_header: int = 0  # malformed Authorization header
+        self.rejected_timestamp: int = 0  # x-amz-date outside the skew window
+        self.canceled: int = 0  # client went away mid-request
         self.rx_bytes = 0
         self.tx_bytes = 0
         self.request_seconds: dict[str, float] = defaultdict(float)
@@ -183,9 +186,10 @@ class Metrics:
                 "# TYPE minio_bucket_usage_total_bytes gauge",
             ]
             for b, u in sorted(bg.usage.buckets.items()):
-                lines.append(f'minio_bucket_usage_total_bytes{{bucket="{b}"}} {u["size"]}')
+                eb = _esc_label(b)
+                lines.append(f'minio_bucket_usage_total_bytes{{bucket="{eb}"}} {u["size"]}')
                 lines.append(
-                    f'minio_bucket_usage_object_total{{bucket="{b}"}} {u["objects"]}'
+                    f'minio_bucket_usage_object_total{{bucket="{eb}"}} {u["objects"]}'
                 )
         lines += [
             "# TYPE minio_node_uptime_seconds gauge",
@@ -320,13 +324,25 @@ def dump_json(obj) -> bytes:
 # group, /minio/metrics/v3/cluster/... serves one subtree, etc.
 
 
+def _esc_label(v) -> str:
+    """Prometheus text-format label-value escaping (backslash, double
+    quote, newline). Bucket/drive/rule labels carry user-chosen names —
+    a bucket called `a"b` must not produce an unparseable line."""
+    s = str(v)
+    if "\\" in s or '"' in s or "\n" in s:
+        s = s.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    return s
+
+
 def _fmt(lines: list[str], name: str, mtype: str, values, help_: str = "") -> None:
     if help_:
         lines.append(f"# HELP {name} {help_}")
     lines.append(f"# TYPE {name} {mtype}")
     for labels, v in values:
         if labels:
-            lab = ",".join(f'{k}="{v2}"' for k, v2 in labels.items())
+            lab = ",".join(
+                f'{k}="{_esc_label(v2)}"' for k, v2 in labels.items()
+            )
             lines.append(f"{name}{{{lab}}} {v}")
         else:
             lines.append(f"{name} {v}")
@@ -354,9 +370,28 @@ def _g_api_requests(server) -> list[str]:
              [({}, m.rejected_auth)])
         _fmt(out, "minio_api_requests_rejected_invalid_total", "counter",
              [({}, m.rejected_invalid)])
+        _fmt(out, "minio_api_requests_rejected_header_total", "counter",
+             [({}, m.rejected_header)],
+             "Requests rejected for a malformed Authorization header")
+        _fmt(out, "minio_api_requests_rejected_timestamp_total", "counter",
+             [({}, m.rejected_timestamp)],
+             "Requests rejected for a skewed x-amz-date")
+        _fmt(out, "minio_api_requests_canceled_total", "counter",
+             [({}, m.canceled)],
+             "Requests abandoned by the client before the response")
         _fmt(out, "minio_api_requests_ttfb_seconds_distribution", "counter",
              [({"name": a, "le": le}, cum)
               for a, le, cum in ttfb_distribution_rows(m.ttfb_hist)])
+    # QoS admission waits live outside the metrics mutex (qos/admission
+    # keeps its own): the reference's waiting_total is the deadline queue
+    qos = getattr(server, "qos", None)
+    waiting = 0
+    if qos is not None:
+        waiting = sum(
+            s["waiting"] for s in qos.admission.snapshot().values()
+        )
+    _fmt(out, "minio_api_requests_waiting_total", "gauge", [({}, waiting)],
+         "Requests parked on QoS admission across classes")
     return out
 
 
@@ -409,17 +444,28 @@ def _probe_drives(server) -> dict:
         path = getattr(d, "path", getattr(d, "endpoint", "?"))
         try:
             di = d.disk_info()
-            per_drive.append((str(path), di.total, di.free, 1))
+            per_drive.append({
+                "drive": str(path), "total": di.total, "free": di.free,
+                "used": di.used or max(di.total - di.free, 0),
+                "used_inodes": di.used_inodes,
+                "free_inodes": di.free_inodes,
+                "healing": 1 if di.healing else 0, "online": 1,
+            })
             by_id[id(d)] = True
         except Exception:  # noqa: BLE001
-            per_drive.append((str(path), 0, 0, 0))
+            per_drive.append({
+                "drive": str(path), "total": 0, "free": 0, "used": 0,
+                "used_inodes": 0, "free_inodes": 0, "healing": 0,
+                "online": 0,
+            })
             by_id[id(d)] = False
     res = {
         "per_drive": per_drive,
-        "online": sum(1 for r in per_drive if r[3]),
-        "offline": sum(1 for r in per_drive if not r[3]),
-        "total_bytes": sum(r[1] for r in per_drive),
-        "free_bytes": sum(r[2] for r in per_drive),
+        "online": sum(r["online"] for r in per_drive),
+        "offline": sum(1 for r in per_drive if not r["online"]),
+        "healing": sum(r["healing"] for r in per_drive),
+        "total_bytes": sum(r["total"] for r in per_drive),
+        "free_bytes": sum(r["free"] for r in per_drive),
         "by_id": by_id,
     }
     m._drive_probe = (now, res)
@@ -427,19 +473,49 @@ def _probe_drives(server) -> dict:
 
 
 def _g_system_drive(server) -> list[str]:
+    from ..storage.health import HealthCheckedDisk
+
     out: list[str] = []
     pr = _probe_drives(server)
     per_drive = pr["per_drive"]
     _fmt(out, "minio_system_drive_total_bytes", "gauge",
-         [({"drive": p}, t) for p, t, _, _ in per_drive])
+         [({"drive": r["drive"]}, r["total"]) for r in per_drive])
+    _fmt(out, "minio_system_drive_used_bytes", "gauge",
+         [({"drive": r["drive"]}, r["used"]) for r in per_drive])
     _fmt(out, "minio_system_drive_free_bytes", "gauge",
-         [({"drive": p}, f) for p, _, f, _ in per_drive])
+         [({"drive": r["drive"]}, r["free"]) for r in per_drive])
+    _fmt(out, "minio_system_drive_used_inodes", "gauge",
+         [({"drive": r["drive"]}, r["used_inodes"]) for r in per_drive])
+    _fmt(out, "minio_system_drive_free_inodes", "gauge",
+         [({"drive": r["drive"]}, r["free_inodes"]) for r in per_drive])
+    _fmt(out, "minio_system_drive_total_inodes", "gauge",
+         [({"drive": r["drive"]},
+           r["used_inodes"] + r["free_inodes"]) for r in per_drive])
     _fmt(out, "minio_system_drive_online", "gauge",
-         [({"drive": p}, o) for p, _, _, o in per_drive])
+         [({"drive": r["drive"]}, r["online"]) for r in per_drive])
+    _fmt(out, "minio_system_drive_health", "gauge",
+         [({"drive": r["drive"]}, r["online"]) for r in per_drive],
+         "1 when the drive answers storage calls (breaker closed)")
     _fmt(out, "minio_system_drive_count", "gauge",
          [({"state": "online"}, pr["online"]), ({"state": "offline"}, pr["offline"])])
+    _fmt(out, "minio_system_drive_online_count", "gauge", [({}, pr["online"])])
+    _fmt(out, "minio_system_drive_offline_count", "gauge", [({}, pr["offline"])])
+    _fmt(out, "minio_system_drive_healing_count", "gauge", [({}, pr["healing"])])
     _fmt(out, "minio_system_drive_raw_total_bytes", "gauge", [({}, pr["total_bytes"])])
     _fmt(out, "minio_system_drive_raw_free_bytes", "gauge", [({}, pr["free_bytes"])])
+    # breaker-classified error counters (HealthCheckedDisk): timeouts vs
+    # any availability fault — the reference's drive error split
+    t_rows, a_rows = [], []
+    for d in server.store.disks:
+        if not isinstance(d, HealthCheckedDisk):
+            continue
+        ep = str(getattr(d, "endpoint", "?"))
+        t_rows.append(({"drive": ep}, d.timeout_faults))
+        a_rows.append(({"drive": ep}, d.total_faults))
+    _fmt(out, "minio_system_drive_timeout_errors_total", "counter", t_rows,
+         "Storage calls that failed with a timeout, per drive")
+    _fmt(out, "minio_system_drive_availability_errors_total", "counter",
+         a_rows, "Storage calls that failed for any transport reason")
     return out
 
 
@@ -464,6 +540,20 @@ def _proc_stat() -> dict:
         out["fds"] = len(os.listdir("/proc/self/fd"))
     except OSError:
         pass
+    try:
+        import resource
+
+        out["fd_limit"] = resource.getrlimit(resource.RLIMIT_NOFILE)[0]
+    except (ImportError, OSError, ValueError):
+        pass
+    try:
+        with open("/proc/self/io") as f:
+            for line in f:
+                k, _, v = line.partition(":")
+                if k in ("rchar", "wchar"):
+                    out[k] = int(v)
+    except (OSError, ValueError):
+        pass
     return out
 
 
@@ -480,6 +570,12 @@ def _g_system_process(server) -> list[str]:
          [({}, st.get("vsize", 0))])
     _fmt(out, "minio_system_process_file_descriptor_open_total", "gauge",
          [({}, st.get("fds", 0))])
+    _fmt(out, "minio_system_process_file_descriptor_limit_total", "gauge",
+         [({}, st.get("fd_limit", 0))])
+    _fmt(out, "minio_system_process_io_rchar_bytes", "counter",
+         [({}, st.get("rchar", 0))])
+    _fmt(out, "minio_system_process_io_wchar_bytes", "counter",
+         [({}, st.get("wchar", 0))])
     _fmt(out, "minio_system_process_threads_total", "gauge",
          [({}, st.get("threads", 0))])
     return out
@@ -501,6 +597,13 @@ def _g_system_memory(server) -> list[str]:
     _fmt(out, "minio_system_memory_free_bytes", "gauge", [({}, info.get("MemFree", 0))])
     _fmt(out, "minio_system_memory_buffers_bytes", "gauge", [({}, info.get("Buffers", 0))])
     _fmt(out, "minio_system_memory_cache_bytes", "gauge", [({}, info.get("Cached", 0))])
+    _fmt(out, "minio_system_memory_shared_bytes", "gauge",
+         [({}, info.get("Shmem", 0))])
+    total = info.get("MemTotal", 0)
+    used = max(total - info.get("MemAvailable", 0), 0)
+    _fmt(out, "minio_system_memory_used_bytes", "gauge", [({}, used)])
+    _fmt(out, "minio_system_memory_used_perc", "gauge",
+         [({}, f"{100.0 * used / total:.2f}" if total else 0)])
     return out
 
 
@@ -515,6 +618,28 @@ def _g_system_cpu(server) -> list[str]:
         ({"interval": "5m"}, f"{load5:.2f}"),
         ({"interval": "15m"}, f"{load15:.2f}"),
     ])
+    _fmt(out, "minio_system_cpu_load", "gauge", [({}, f"{load1:.2f}")])
+    # host CPU time split since boot (/proc/stat first line, jiffies)
+    jif: dict[str, int] = {}
+    try:
+        with open("/proc/stat") as f:
+            first = f.readline().split()
+        names = ("user", "nice", "system", "idle", "iowait", "irq",
+                 "softirq", "steal")
+        jif = dict(zip(names, (int(x) for x in first[1:])))
+    except (OSError, ValueError, IndexError):
+        pass
+    tck = float(os.sysconf("SC_CLK_TCK") or 100)
+
+    def j(field: str) -> str:
+        return f"{jif.get(field, 0) / tck:.2f}"
+
+    _fmt(out, "minio_system_cpu_user", "counter", [({}, j("user"))])
+    _fmt(out, "minio_system_cpu_system", "counter", [({}, j("system"))])
+    _fmt(out, "minio_system_cpu_idle", "counter", [({}, j("idle"))])
+    _fmt(out, "minio_system_cpu_iowait", "counter", [({}, j("iowait"))])
+    _fmt(out, "minio_system_cpu_nice", "counter", [({}, j("nice"))])
+    _fmt(out, "minio_system_cpu_steal", "counter", [({}, j("steal"))])
     _fmt(out, "minio_system_cpu_count", "gauge", [({}, os.cpu_count() or 1)])
     return out
 
@@ -536,6 +661,35 @@ def _g_cluster_health(server) -> list[str]:
     pr = _probe_drives(server)
     _fmt(out, "minio_cluster_health_drives_online_count", "gauge", [({}, pr["online"])])
     _fmt(out, "minio_cluster_health_drives_offline_count", "gauge", [({}, pr["offline"])])
+    _fmt(out, "minio_cluster_health_drives_count", "gauge",
+         [({}, pr["online"] + pr["offline"])])
+    # node view: one "node" per distinct drive host (local paths collapse
+    # to the local node); a node is online while ANY of its drives is
+    nodes: dict[str, int] = {}
+    for r in pr["per_drive"]:
+        p = r["drive"]
+        host = p.split("://", 1)[1].split("/", 1)[0] if "://" in p else "local"
+        nodes[host] = max(nodes.get(host, 0), r["online"])
+    n_on = sum(nodes.values())
+    _fmt(out, "minio_cluster_health_nodes_online_count", "gauge", [({}, n_on)])
+    _fmt(out, "minio_cluster_health_nodes_offline_count", "gauge",
+         [({}, len(nodes) - n_on)])
+    # usable capacity = raw scaled by the erasure data fraction (parity
+    # shards store no user bytes)
+    n_tot = d_tot = 0
+    for pool in server.store.pools:
+        for es in pool.sets:
+            n_tot += es.n
+            d_tot += es.n - es.default_parity
+    frac = d_tot / n_tot if n_tot else 1.0
+    _fmt(out, "minio_cluster_health_capacity_raw_total_bytes", "gauge",
+         [({}, pr["total_bytes"])])
+    _fmt(out, "minio_cluster_health_capacity_raw_free_bytes", "gauge",
+         [({}, pr["free_bytes"])])
+    _fmt(out, "minio_cluster_health_capacity_usable_total_bytes", "gauge",
+         [({}, int(pr["total_bytes"] * frac))])
+    _fmt(out, "minio_cluster_health_capacity_usable_free_bytes", "gauge",
+         [({}, int(pr["free_bytes"] * frac))])
     _fmt(out, "minio_cluster_health_status", "gauge",
          [({}, 1 if pr["offline"] == 0 else 0)], "1 when every drive is online")
     return out
@@ -575,9 +729,28 @@ def _g_cluster_erasure_set(server) -> list[str]:
     _fmt(out, "minio_cluster_erasure_set_online_drives_count", "gauge",
          [({"pool": str(p), "set": str(s)}, ok) for p, s, _, ok, _ in rows])
     # writeQuorum = data, +1 when data == parity (cmd/erasure-object.go)
+    wq = {(p, s): (d + 1 if n == 2 * d else d) for p, s, n, _, d in rows}
     _fmt(out, "minio_cluster_erasure_set_overall_write_quorum", "gauge",
-         [({"pool": str(p), "set": str(s)}, d + 1 if n == 2 * d else d)
-          for p, s, n, _, d in rows])
+         [({"pool": str(p), "set": str(s)}, wq[(p, s)])
+          for p, s, _, _, _ in rows])
+    _fmt(out, "minio_cluster_erasure_set_read_quorum", "gauge",
+         [({"pool": str(p), "set": str(s)}, d) for p, s, _, _, d in rows])
+    _fmt(out, "minio_cluster_erasure_set_write_quorum", "gauge",
+         [({"pool": str(p), "set": str(s)}, wq[(p, s)])
+          for p, s, _, _, _ in rows])
+    # tolerance: drives this set can still lose before losing quorum
+    _fmt(out, "minio_cluster_erasure_set_read_tolerance", "gauge",
+         [({"pool": str(p), "set": str(s)}, max(ok - d, 0))
+          for p, s, _, ok, d in rows])
+    _fmt(out, "minio_cluster_erasure_set_write_tolerance", "gauge",
+         [({"pool": str(p), "set": str(s)}, max(ok - wq[(p, s)], 0))
+          for p, s, _, ok, _ in rows])
+    _fmt(out, "minio_cluster_erasure_set_read_health", "gauge",
+         [({"pool": str(p), "set": str(s)}, 1 if ok >= d else 0)
+          for p, s, _, ok, d in rows])
+    _fmt(out, "minio_cluster_erasure_set_write_health", "gauge",
+         [({"pool": str(p), "set": str(s)}, 1 if ok >= wq[(p, s)] else 0)
+          for p, s, _, ok, _ in rows])
     _fmt(out, "minio_cluster_erasure_set_healing_drives_count", "gauge",
          [({"pool": str(p), "set": str(s)}, 0) for p, s, _, _, _ in rows])
     return out

@@ -200,6 +200,7 @@ class DiskInfo:
     free: int = 0
     used: int = 0
     used_inodes: int = 0
+    free_inodes: int = 0
     fs_type: str = ""
     root_disk: bool = False
     healing: bool = False

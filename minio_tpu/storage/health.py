@@ -97,6 +97,7 @@ class HealthCheckedDisk(StorageAPI):
         self._probe_inflight = False
         self._latencies: collections.deque = collections.deque(maxlen=64)
         self.total_faults = 0
+        self.timeout_faults = 0  # subset of total_faults: TimeoutError
         self.latency_trips = 0
         self._ewma = 0.0
         self._ewma_n = 0
@@ -130,6 +131,7 @@ class HealthCheckedDisk(StorageAPI):
             "endpoint": self.endpoint,
             "online": self.online,
             "totalFaults": self.total_faults,
+            "timeoutErrors": self.timeout_faults,
             "latencyTrips": self.latency_trips,
             "avgLatencyMs": round(sum(lat) / len(lat) * 1e3, 3) if lat else 0.0,
             "ewmaLatencyMs": round(ewma * 1e3, 3),
@@ -199,10 +201,13 @@ class HealthCheckedDisk(StorageAPI):
                 ewmaMs=round(tripped_ewma * 1e3, 3),
             )
 
-    def _fault(self, op: str | None = None, dt: float = 0.0) -> None:
+    def _fault(self, op: str | None = None, dt: float = 0.0,
+               timeout: bool = False) -> None:
         with self._mu:
             self._consecutive_faults += 1
             self.total_faults += 1
+            if timeout:
+                self.timeout_faults += 1
             if dt > 0.0:
                 self._ewma_locked(dt)
             if self._probe_inflight:
@@ -248,6 +253,11 @@ class HealthCheckedDisk(StorageAPI):
                 out = getattr(self._inner, name)(*a, **kw)
             except _LOGICAL:
                 self._ok(time.monotonic() - t0, op=name)  # drive answered
+                raise
+            except TimeoutError:
+                # socket.timeout/asyncio aliases land here too (3.11+):
+                # classified separately for the drive timeout counter
+                self._fault(op=name, dt=time.monotonic() - t0, timeout=True)
                 raise
             except Exception:
                 self._fault(op=name, dt=time.monotonic() - t0)

@@ -129,6 +129,8 @@ class XLStorage(StorageAPI):
             total=total,
             free=free,
             used=total - free,
+            used_inodes=max(st.f_files - st.f_ffree, 0),
+            free_inodes=st.f_favail,
             fs_type="posix",
             endpoint=self.endpoint,
             mount_path=self.root,

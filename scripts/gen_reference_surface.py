@@ -1,0 +1,101 @@
+"""Regenerate minio_tpu/analysis/reference_surface.json from the
+reference tree's metrics-v3 sources.
+
+Usage::
+
+    python scripts/gen_reference_surface.py [REFERENCE_ROOT]
+
+REFERENCE_ROOT defaults to /root/reference. The script greps the
+``cmd/metrics-v3-*.go`` descriptor files for series-name constants
+(``"<name>"`` passed to NewCounterMD/NewGaugeMD, or assembled from the
+``minio_<subsystem>_`` prefix conventions), buckets them into the four
+pinned parity groups (api / cluster / system / drive), and rewrites the
+vendored JSON in place — preserving the pin and the comment header.
+
+When the reference tree is not mounted (the normal case in CI) it exits
+0 without touching anything: the vendored JSON stays the hand-curated
+pin set, and editing it by hand remains legitimate — the surface pass
+hashes it into the engine digest, so any edit busts the analysis cache.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+
+VENDORED = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "minio_tpu", "analysis", "reference_surface.json",
+)
+
+# descriptor files -> parity group. drive series live in the system-*
+# descriptor but carry the minio_system_drive_ prefix, split below.
+_GROUP_BY_FILE = (
+    ("metrics-v3-api-", "api"),
+    ("metrics-v3-cluster-", "cluster"),
+    ("metrics-v3-system-", "system"),
+)
+
+# `xxxMD = NewCounterMD(xxx, ...)` name constants: the series name is a
+# quoted snake_case string in the same file
+_NAME_RE = re.compile(r'"((?:[a-z0-9]+_)+[a-z0-9]+)"')
+
+
+def harvest(reference_root: str) -> dict[str, set[str]] | None:
+    cmd = os.path.join(reference_root, "cmd")
+    if not os.path.isdir(cmd):
+        return None
+    groups: dict[str, set[str]] = {
+        "api": set(), "cluster": set(), "system": set(), "drive": set(),
+    }
+    for fn in sorted(os.listdir(cmd)):
+        if not (fn.startswith("metrics-v3-") and fn.endswith(".go")):
+            continue
+        group = next(
+            (g for pre, g in _GROUP_BY_FILE if fn.startswith(pre)), None
+        )
+        if group is None:
+            continue
+        with open(os.path.join(cmd, fn), "r", encoding="utf-8",
+                  errors="replace") as fh:
+            src = fh.read()
+        # v3 exposition prefixes every series with minio_<group-path>;
+        # descriptor constants carry the tail only
+        for m in _NAME_RE.finditer(src):
+            tail = m.group(1)
+            if tail.startswith("minio_"):
+                name = tail
+            else:
+                continue  # tails are resolved via the full-name form only
+            g = group
+            if name.startswith("minio_system_drive_"):
+                g = "drive"
+            groups[g].add(name)
+    return groups
+
+
+def main() -> int:
+    root = sys.argv[1] if len(sys.argv) > 1 else "/root/reference"
+    harvested = harvest(root)
+    if harvested is None:
+        print(
+            f"gen_reference_surface: {root} not mounted — vendored "
+            "reference_surface.json left untouched", file=sys.stderr,
+        )
+        return 0
+    with open(VENDORED, "r", encoding="utf-8") as fh:
+        doc = json.load(fh)
+    for g, names in harvested.items():
+        if names:
+            doc["groups"][g] = sorted(names)
+    with open(VENDORED, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=2)
+        fh.write("\n")
+    print(f"wrote {VENDORED}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -115,6 +115,9 @@ def test_warm_check_stays_under_perf_budget(tmp_path):
     assert warm.stats["cached"] == warm.stats["files"]
     assert warm.stats["interproc_cached"] is True
     assert warm.stats["total_s"] < 0.5, warm.stats
+    # the surface pass rides the same digest-keyed replay: its record
+    # must come back from the cache, not from re-extraction
+    assert warm.surface.get("manifest"), "surface record lost in warm replay"
 
 
 def test_lock_order_covers_cross_subsystem_edges(project_result):

@@ -1,0 +1,411 @@
+"""Observable-surface pass (analysis/surface.py + rules_surface.py):
+static extraction, reference parity pins, and cross-validation of the
+static manifest against a live scrape — single server and 2-worker pool.
+
+The parity tests ARE the tier-1 gate the issue pins: every one of the
+api/cluster/system/drive reference groups must stay >= 0.80 covered,
+with each miss enumerated by name in the assertion message.
+"""
+
+import json
+import os
+import re
+
+os.environ.setdefault("MINIO_TPU_BACKEND", "numpy")
+os.environ.setdefault("MINIO_PROMETHEUS_AUTH_TYPE", "public")
+
+import pytest
+
+from minio_tpu.analysis import rules_surface, surface
+from minio_tpu.client import S3Client
+
+from test_s3_api import ServerThread
+from test_workers import pool  # noqa: F401 — module-scoped 2-worker pool
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "minio_tpu")
+
+_TYPE_LINE = re.compile(r"^# TYPE (minio_[a-z0-9_]+) (\w+)$", re.M)
+
+
+class _PathsIndex:
+    """surface.extract only consults .paths — a full ProjectIndex build
+    (summaries, call graph) is not needed to drive the extractor."""
+
+    def __init__(self, root):
+        self.paths = {}
+        for dp, dns, fns in os.walk(root):
+            dns[:] = [d for d in dns if d != "__pycache__"]
+            for fn in fns:
+                if fn.endswith(".py"):
+                    p = os.path.join(dp, fn)
+                    rel = os.path.relpath(p, root).replace(os.sep, "/")
+                    self.paths[rel] = p
+
+
+@pytest.fixture(scope="module")
+def manifest():
+    return surface.extract(_PathsIndex(PKG))
+
+
+@pytest.fixture(scope="module")
+def surface_run():
+    return rules_surface.run(_PathsIndex(PKG), lambda rp, line, tag: False)
+
+
+# ---- extractor ------------------------------------------------------------
+
+
+def test_extracts_known_series_with_groups_and_labels(manifest):
+    by_name = {}
+    for s in manifest["metrics"]:
+        by_name.setdefault(s["name"], s)
+    total = manifest["metrics"]
+    assert len(total) >= 200, len(total)
+    assert len(manifest["groups"]) >= 25
+
+    s = by_name["minio_api_requests_total"]
+    assert s["group"] == "/api/requests"
+    assert "name" in s["labels"]
+    assert s["type"] == "counter"
+    assert by_name["minio_system_drive_total_bytes"]["group"] == "/system/drive"
+    assert "drive" in by_name["minio_system_drive_total_bytes"]["labels"]
+    # legacy v2 exposition is part of the surface too
+    assert by_name["minio_s3_requests_total"]["group"] == "/v2"
+    # pool fan-out extras are conditional (only exist under workers)
+    assert by_name["minio_workers_total"]["group"] == "/pool"
+    assert by_name["minio_workers_total"]["conditional"]
+
+
+def test_conditional_marking_tracks_guarded_renderers(manifest):
+    by_name = {s["name"]: s for s in manifest["metrics"]}
+    # QoS group early-returns when the scheduler is off -> conditional
+    assert by_name["minio_api_qos_admitted_total"]["conditional"]
+    # process stats render unconditionally (missing /proc keys -> 0)
+    assert not by_name["minio_system_process_uptime_seconds"]["conditional"]
+
+
+def test_extracts_routes_and_sts(manifest):
+    assert {r["path"] for r in manifest["s3_routes"]} == {
+        "/", "/{bucket}", "/{bucket}/{key:.*}",
+    }
+    ops = {r["op"] for r in manifest["admin_routes"]}
+    for op in ("info", "storageinfo", "fault/inject", "trace",
+               "pools/decommission", "add-user", "set-config-kv"):
+        assert op in ops, op
+    assert len(ops) >= 60
+    assert {r["op"] for r in manifest["sts_actions"]} == {
+        "AssumeRole", "AssumeRoleWithWebIdentity",
+        "AssumeRoleWithLDAPIdentity", "AssumeRoleWithCertificate",
+    }
+
+
+def test_extracts_fault_surface(manifest):
+    fault = manifest["fault"]
+    assert fault["boundaries"] == ["storage", "network", "tpu", "topology"]
+    assert "bitrot" in fault["modes"]["storage"]
+    assert "device-lost" in fault["modes"]["tpu"]
+    by_boundary = {}
+    for c in fault["checks"]:
+        by_boundary.setdefault(c["boundary"], []).append(c)
+    # every declared boundary is consulted somewhere
+    for b in fault["boundaries"]:
+        assert by_boundary.get(b), f"boundary {b} never check()ed"
+    assert any(c["file"] == "parallel/dispatcher.py"
+               for c in by_boundary["tpu"])
+    # a computed modes argument must not leak strings into the manifest
+    walk = [c for c in by_boundary["storage"]
+            if c["file"] == "fault/storage.py" and c["op"] == "walk_dir"]
+    assert walk and walk[0]["modes"] == []
+
+
+def test_extracts_trace_types_with_publish_evidence(manifest):
+    from minio_tpu.obs import trace
+
+    assert set(manifest["trace_types"]) == set(trace.TRACE_TYPES)
+    for value, t in manifest["trace_types"].items():
+        assert t["published"], f"trace type {value} has no publish site"
+
+
+def test_extracts_error_codes_and_knobs(manifest):
+    codes = {e["code"]: e["status"] for e in manifest["error_codes"]}
+    assert codes["NoSuchBucket"] == 404
+    assert codes["AuthorizationHeaderMalformed"] == 400
+    assert len(codes) >= 40
+    assert "MINIO_TPU_BACKEND" in manifest["knobs"]
+
+
+def test_extractor_noop_on_subset_trees(tmp_path):
+    # analyze_project on a subset (no server/metrics.py) must not fail
+    # the parity gate vacuously — the pass returns nothing at all
+    class Ix:
+        paths = {"cache/core.py": str(tmp_path / "x.py")}
+
+    findings, record = rules_surface.run(Ix(), lambda rp, line, tag: False)
+    assert findings == [] and record == {}
+
+
+# ---- reference parity (the pinned tier-1 gate) ----------------------------
+
+
+def test_reference_parity_pinned_groups(surface_run):
+    _, record = surface_run
+    parity = record["parity"]
+    pin = parity["pin"]
+    assert pin >= 0.8
+    for g in ("api", "cluster", "system", "drive"):
+        st = parity["groups"][g]
+        assert st["total"] > 0, f"reference group '{g}' is empty (vacuous)"
+        assert st["ratio"] >= pin, (
+            f"parity group '{g}' fell below the pin: "
+            f"{st['hits']}/{st['total']} = {st['ratio']:.2f}; "
+            f"missing series: {', '.join(st['misses'])}"
+        )
+
+
+def test_surface_pass_is_clean(surface_run):
+    findings, _ = surface_run
+    assert findings == [], [str(f) for f in findings]
+
+
+def test_empty_reference_group_is_a_finding(monkeypatch):
+    monkeypatch.setattr(
+        rules_surface, "load_reference",
+        lambda: {"pin": 0.8, "groups": {"api": []}},
+    )
+    findings, _ = rules_surface.run(
+        _PathsIndex(PKG), lambda rp, line, tag: False
+    )
+    assert any("vacuously" in f.message for f in findings)
+
+
+def test_parity_miss_enumerated_by_name(monkeypatch):
+    monkeypatch.setattr(
+        rules_surface, "load_reference",
+        lambda: {"pin": 0.8, "groups": {
+            "api": ["minio_api_requests_total",
+                    "minio_api_requests_nonexistent_series_total"],
+        }},
+    )
+    findings, _ = rules_surface.run(
+        _PathsIndex(PKG), lambda rp, line, tag: False
+    )
+    msgs = [f.message for f in findings if "parity" in f.message]
+    assert msgs and "minio_api_requests_nonexistent_series_total" in msgs[0]
+
+
+def test_engine_digest_covers_vendored_reference(tmp_path):
+    # editing reference_surface.json must bust the interproc cache —
+    # the engine digest hashes .json files in the analysis package
+    from minio_tpu.analysis import project
+
+    before = project._engine_digest()
+    probe = os.path.join(os.path.dirname(project.__file__),
+                         "zz_digest_probe.json")
+    with open(probe, "w", encoding="utf-8") as fh:
+        fh.write("{}")
+    try:
+        assert project._engine_digest() != before
+    finally:
+        os.unlink(probe)
+
+
+def test_every_boundary_is_injected_somewhere_in_tests(manifest):
+    # the dead-surface sweep's test-side half: a fault boundary nobody
+    # ever injects in the suite is unproven chaos tooling
+    tests_dir = os.path.dirname(os.path.abspath(__file__))
+    corpus = ""
+    for fn in os.listdir(tests_dir):
+        if fn.endswith(".py"):
+            with open(os.path.join(tests_dir, fn), encoding="utf-8") as fh:
+                corpus += fh.read()
+    for b in manifest["fault"]["boundaries"]:
+        assert f'"boundary": "{b}"' in corpus, (
+            f"fault boundary '{b}' is never injected by any test"
+        )
+
+
+# ---- label-value escaping (satellite regression) --------------------------
+
+
+def test_fmt_escapes_hostile_label_values():
+    from minio_tpu.server.metrics import _esc_label, _fmt
+
+    assert _esc_label('a"b') == 'a\\"b'
+    assert _esc_label("a\\b") == "a\\\\b"
+    assert _esc_label("a\nb") == "a\\nb"
+    out = []
+    _fmt(out, "minio_test_series", "counter",
+         [({"bucket": 'evil"bkt\\with\nnewline'}, 7)])
+    body = "\n".join(out)
+    sample = [ln for ln in out if ln.startswith("minio_test_series{")][0]
+    # the rendered line stays one line and parses under the Prometheus
+    # text-format grammar (escaped quote/backslash/newline inside the
+    # label value)
+    assert "\n" not in sample
+    m = re.match(
+        r'minio_test_series\{bucket="((?:[^"\\\n]|\\.)*)"\} 7$', sample
+    )
+    assert m, sample
+    unescaped = m.group(1).replace("\\\\", "\0").replace('\\"', '"')
+    unescaped = unescaped.replace("\\n", "\n").replace("\0", "\\")
+    assert unescaped == 'evil"bkt\\with\nnewline'
+    assert "# TYPE minio_test_series counter" in body
+
+
+def test_v2_render_escapes_hostile_bucket_names():
+    from minio_tpu.server.metrics import Metrics
+
+    m = Metrics()
+
+    class Usage:
+        buckets = {'evil"bkt\\x': {"size": 10, "objects": 2}}
+
+    class BG:
+        stats = {"heals_done": 0, "heals_queued": 0, "heals_failed": 0,
+                 "objects_scanned": 0}
+        usage = Usage()
+
+    class Srv:
+        started_at = 0.0
+        store = None
+        background = BG()
+
+    text = m.render(Srv())
+    assert 'bucket="evil\\"bkt\\\\x"' in text
+    for ln in text.splitlines():
+        if ln.startswith("minio_bucket_usage"):
+            assert re.match(
+                r'^[a-z0-9_]+\{(?:[a-z0-9_]+="(?:[^"\\\n]|\\.)*",?)+\} ', ln
+            ), ln
+
+
+# ---- runtime cross-validation: live scrape vs static manifest -------------
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    base = tmp_path_factory.mktemp("surfdrives")
+    st = ServerThread([str(base / f"d{i}") for i in range(4)])
+    yield st
+    st.stop()
+
+
+@pytest.fixture(scope="module")
+def cli(server):
+    c = S3Client(f"127.0.0.1:{server.port}")
+    c.make_bucket("surfbkt")
+    c.put_object("surfbkt", "obj", b"x" * 512)
+    c.get_object("surfbkt", "obj")
+    return c
+
+
+def _scraped_names(text: str) -> set[str]:
+    return {m.group(1) for m in _TYPE_LINE.finditer(text)}
+
+
+def _static_v3(manifest, include_conditional: bool):
+    names = set()
+    for s in manifest["metrics"]:
+        if s["group"] in ("/v2", "/pool"):
+            continue  # different endpoints than /minio/metrics/v3
+        if not include_conditional and s["conditional"]:
+            continue
+        names.add(s["name"])
+    return names
+
+
+def test_live_scrape_agrees_with_static_manifest(cli, manifest):
+    text = cli.request("GET", "/minio/metrics/v3").body.decode()
+    # bucket collector paths are only rendered per-bucket
+    for bpath, info in manifest["groups"].items():
+        if info.get("bucket"):
+            r = cli.request("GET", f"/minio/metrics/v3{bpath}/surfbkt")
+            assert r.status == 200, bpath
+            text += "\n" + r.body.decode()
+    runtime = _scraped_names(text)
+
+    # direction 1 (strict): everything the live server exposes is in
+    # the static manifest — no unextracted/undocumented series
+    unknown = runtime - _static_v3(manifest, include_conditional=True)
+    assert not unknown, f"live series missing from static manifest: {sorted(unknown)}"
+
+    # direction 2: every unconditional static series shows up live —
+    # no phantom inventory ("# TYPE" renders even with zero samples)
+    missing = _static_v3(manifest, include_conditional=False) - runtime
+    assert not missing, f"static series absent from live scrape: {sorted(missing)}"
+
+
+def test_admin_routes_static_vs_live_probe(cli, manifest):
+    # a GET op from the static route table answers (the dispatcher
+    # knows it); an op absent from the table draws the dispatcher's
+    # unknown-op rejection — the static route inventory matches the
+    # dispatcher both ways
+    ops = {r["op"] for r in manifest["admin_routes"]}
+    for op in ("info", "storageinfo", "datausageinfo", "fault/status",
+               "scanner/status", "cache/status"):
+        assert op in ops, op
+        r = cli.request("GET", f"/minio/admin/v3/{op}")
+        assert r.status not in (404, 501), (op, r.status)
+    r = cli.request("GET", "/minio/admin/v3/definitely-not-a-route")
+    assert r.status in (404, 501)
+
+
+def test_pool_scrape_matches_manifest_modulo_worker_label(pool, manifest):  # noqa: F811
+    # 2-worker pool: the merged render_v3_pool output equals the static
+    # manifest modulo the stamped worker label + the /pool extras
+    r = pool["w0"].request("GET", "/minio/metrics/v3")
+    assert r.status == 200
+    text = r.body.decode()
+    from test_workers import BUCKET
+
+    for bpath, info in manifest["groups"].items():
+        if info.get("bucket"):
+            rb = pool["w0"].request("GET", f"/minio/metrics/v3{bpath}/{BUCKET}")
+            assert rb.status == 200, bpath
+            text += "\n" + rb.body.decode()
+    runtime = _scraped_names(text)
+
+    pool_extras = {"minio_workers_total", "minio_worker_up"}
+    assert pool_extras <= runtime
+    unknown = runtime - _static_v3(manifest, include_conditional=True) \
+        - pool_extras
+    assert not unknown, f"pool series missing from static manifest: {sorted(unknown)}"
+    missing = _static_v3(manifest, include_conditional=False) - runtime
+    assert not missing, f"static series absent from pool scrape: {sorted(missing)}"
+
+    # the merge stamps per-worker provenance and sees both workers
+    workers = set(re.findall(r'worker="(\d+)"', text))
+    assert workers == {"0", "1"}, workers
+    m = re.search(r"^minio_workers_total (\d+)$", text, re.M)
+    assert m and m.group(1) == "2"
+
+
+# ---- docs + CLI -----------------------------------------------------------
+
+
+def test_generated_surface_doc_is_deterministic_and_in_sync(surface_run):
+    _, record = surface_run
+    md = rules_surface.generate_surface_md(record)
+    assert md == rules_surface.generate_surface_md(record)
+    with open(os.path.join(REPO, "docs", "SURFACE.md"), encoding="utf-8") as fh:
+        on_disk = fh.read()
+    assert on_disk == md, (
+        "docs/SURFACE.md is stale — run `make docs` (or `python -m "
+        "minio_tpu.analysis --gen-surface`)"
+    )
+
+
+def test_surface_record_survives_interproc_cache_replay(tmp_path):
+    from minio_tpu.analysis.project import analyze_project
+
+    cache = str(tmp_path / "cache.json")
+    cold = analyze_project([PKG], cache_path=cache)
+    warm = analyze_project([PKG], cache_path=cache)
+    assert warm.stats["interproc_cached"] is True
+    assert warm.surface.get("manifest"), "surface record lost in replay"
+    assert warm.surface["parity"] == cold.surface["parity"]
+    # and the cache file itself round-trips it as JSON
+    with open(cache, encoding="utf-8") as fh:
+        stored = json.load(fh)
+    assert stored["interproc"]["surface"]["parity"] == cold.surface["parity"]
