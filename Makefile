@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: check check-clean test docs bench-smoke
+.PHONY: check check-clean test docs bench-smoke diag-smoke
 
 # whole-program static analysis (per-file rules + interprocedural
 # passes) with the content-hash incremental cache: warm runs re-parse
@@ -34,3 +34,10 @@ docs:
 bench-smoke:
 	MINIO_TPU_BACKEND=numpy $(PY) benchmarks/bench_load.py --quick
 	MINIO_TPU_BACKEND=numpy $(PY) -m benchmarks.scenarios --all --quick
+
+# self-measurement plane end to end vs a live 2-worker pool: quick
+# object/drive/net speedtests + healthinfo (json & zip) with zero
+# request errors, and every /api/diag series the static surface
+# manifest declares present in the live scrape.
+diag-smoke:
+	MINIO_TPU_BACKEND=numpy $(PY) scripts/diag_smoke.py

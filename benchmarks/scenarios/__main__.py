@@ -61,10 +61,17 @@ def main(argv: list[str] | None = None) -> int:
         results[name] = res
         ok = ok and res.get("gates_passed", False)
 
+    # every profile stamped the same machine's fingerprint; surface the
+    # first at the top level so one-line BENCH consumers see it too
+    fingerprint = next(
+        (r["fingerprint"] for r in results.values() if "fingerprint" in r),
+        None,
+    )
     result = {
         "metric": "scenario_zoo",
         "quick": bool(args.quick),
         "nproc": os.cpu_count(),
+        "fingerprint": fingerprint,
         "profiles": results,
         "gates_passed": ok,
     }

@@ -620,6 +620,31 @@ def scrape_cache_series(port: int) -> dict:
     }
 
 
+def selftest_fingerprint(port: int) -> dict:
+    """The machine fingerprint every BENCH json carries: run a quick
+    drive speedtest + netperf through the admin plane, then read the
+    /system/selftest gauges. Raises loudly if any expected series is
+    absent — a fingerprint with silently-missing fields would make BENCH
+    numbers from different machines indistinguishable."""
+    r = admin(port, "POST", "speedtest/drive",
+              query={"sizeMiB": "1", "randCount": "4"}, timeout=120)
+    assert r.status == 200, f"drive speedtest failed: HTTP {r.status}"
+    r = admin(port, "POST", "speedtest/net",
+              query={"size": str(256 * 1024), "count": "2", "pings": "4"},
+              timeout=120)
+    assert r.status == 200, f"netperf failed: HTTP {r.status}"
+    series = scrape_series(port, "/system/selftest", "minio_system_selftest_")
+    wanted = ("cpu_cores", "workers", "drive_write_mibps",
+              "drive_read_mibps", "loopback_mibps", "complete")
+    out: dict = {}
+    for tail in wanted:
+        name = f"minio_system_selftest_{tail}"
+        hits = [v for k, v in series.items() if k.split("{", 1)[0] == name]
+        assert hits, f"fingerprint series {name} absent from /system/selftest"
+        out[tail] = hits[0]
+    return out
+
+
 def require_gate_series(port: int, wanted: list[tuple[str, str]]) -> dict:
     """The no-vacuous-pass primitive: every (metrics path, series name)
     a profile's gates are computed from must be PRESENT in the scrape,

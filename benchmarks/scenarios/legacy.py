@@ -78,6 +78,7 @@ from .engine import (  # noqa: F401 — re-exported for compatibility
     s3_session,
     scrape_cache_series,
     scrape_counter,
+    selftest_fingerprint,
     tbody as _tbody,
 )
 
@@ -391,6 +392,9 @@ def bench_one_worker_count(workers: int, cfg: argparse.Namespace) -> dict:
         assert cli.make_bucket(BUCKET).status == 200
         out = asyncio.run(run_round(cfg.port, cfg))
         out["workers"] = workers
+        # machine fingerprint via the diag plane — raises on any missing
+        # selftest series, so a BENCH json can never ship without one
+        out["fingerprint"] = selftest_fingerprint(cfg.port)
         return out
     finally:
         srv.stop()
@@ -494,6 +498,7 @@ def main(argv: list[str] | None = None) -> int:
         "drives": args.drives,
         "ec": "8+8" if args.drives >= 16 else "default",
         "quick": bool(args.quick),
+        "fingerprint": runs[0].get("fingerprint") if runs else None,
         "runs": runs,
         "ranged": ranged,
         "topology": topology,

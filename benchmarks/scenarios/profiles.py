@@ -58,6 +58,7 @@ from .engine import (
     run_get_loop,
     s3_session,
     scrape_series,
+    selftest_fingerprint,
     tbody,
 )
 
@@ -798,6 +799,10 @@ def run_profile(name: str, quick: bool, port: int) -> dict:
                   quick=quick)
         t0 = time.monotonic()
         out = asyncio.run(prof.phase(ctx))
+        # machine fingerprint (cores, drive MiB/s, grid loopback MiB/s)
+        # via the diag plane — raises if any selftest series is missing,
+        # so a BENCH json can never ship without one
+        fingerprint = selftest_fingerprint(port)
         out.update({
             "profile": prof.name,
             "quick": quick,
@@ -806,6 +811,7 @@ def run_profile(name: str, quick: bool, port: int) -> dict:
             "nproc": os.cpu_count(),
             "wall_s": round(time.monotonic() - t0, 1),
             "gate_series_checked": sorted(presence),
+            "fingerprint": fingerprint,
         })
         if out["gate_failures"]:
             print(f"PROFILE {prof.name} GATES FAILED: "

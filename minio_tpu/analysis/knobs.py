@@ -83,6 +83,19 @@ _ALL: list[Knob] = [
        "Consecutive forward-contiguous ranged reads of one object "
        "before read-ahead engages (floor 2 — a single ranged read is "
        "not yet a sequential pattern)."),
+    # -- diag / self-measurement ------------------------------------------
+    _k("MINIO_TPU_DIAG_MAX_CONCURRENCY", "32", "diag",
+       "Ceiling for the object-speedtest autotune ramp (concurrency "
+       "doubles until throughput stops improving or this cap)."),
+    _k("MINIO_TPU_DIAG_NETPERF_SIZE_KB", "1024", "diag",
+       "Default netperf echo-burst payload size in KiB when the admin "
+       "op does not pass an explicit size."),
+    _k("MINIO_TPU_PROFILE_CONTINUOUS", "1", "diag",
+       "Always-on wall-time attribution sampler (~19 Hz, publishes the "
+       "/api/diag attribution series); 0 disables."),
+    _k("MINIO_TPU_PROFILE_CONTINUOUS_HZ", "19", "diag",
+       "Continuous profiler sample rate in Hz (clamped to [1, 250]); "
+       "prime-ish default avoids phase-locking with periodic work."),
     # -- erasure / object layer ------------------------------------------
     _k("MINIO_TPU_BACKEND", "jax", "erasure",
        "Erasure codec backend: `jax` (TPU/XLA bit-plane kernels) or "

@@ -46,6 +46,7 @@ SUBSYSTEMS = (
     ("network", "internal", "minio_system_network_internode_"),
     ("tpu", "tpu", "minio_tpu_"),
     ("topology", "rebalance", "minio_topology_"),
+    ("diag", "diag", "minio_diag_"),
 )
 
 
@@ -75,6 +76,20 @@ def compute_parity(manifest: dict, reference: dict) -> dict:
             "hits": len(hits),
             "total": len(ref),
             "misses": sorted(ref - ours),
+        }
+    # admin-op parity rides the same gate: the reference's admin_groups
+    # pin op NAMES (from the reference admin router) against our
+    # extracted admin_routes — a diagnostics op we drop is a miss
+    # exactly like a dropped metrics series
+    our_ops = {r["op"] for r in manifest.get("admin_routes", ())}
+    for g, names in sorted(reference.get("admin_groups", {}).items()):
+        ref = set(names)
+        hits = ref & our_ops
+        groups[f"admin-{g}"] = {
+            "ratio": round(len(hits) / len(ref), 4) if ref else 0.0,
+            "hits": len(hits),
+            "total": len(ref),
+            "misses": sorted(ref - our_ops),
         }
     return {"pin": pin, "groups": groups}
 

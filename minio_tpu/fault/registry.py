@@ -35,7 +35,7 @@ import random
 import threading
 import time
 
-BOUNDARIES = ("storage", "network", "tpu", "topology")
+BOUNDARIES = ("storage", "network", "tpu", "topology", "diag")
 MODES = {
     "storage": frozenset({"error", "latency", "bitrot", "torn-write", "enospc"}),
     "network": frozenset({"delay", "drop", "disconnect", "partition"}),
@@ -46,6 +46,11 @@ MODES = {
     # partition isolating the pool being drained; latency applies
     # latency_ms per move via sleep_latency)
     "topology": frozenset({"fail-move", "partition", "latency"}),
+    # diag: the self-measurement plane (minio_tpu/diag). slow-drive
+    # stalls one drive's speedtest I/O, slow-peer stalls one peer's
+    # netperf burst — the chaos test asserts the perf matrices localize
+    # the injected fault by name.
+    "diag": frozenset({"slow-drive", "slow-peer"}),
 }
 
 # fast-path flag: check() returns immediately while no rules exist; only
@@ -59,7 +64,7 @@ _ids = itertools.count(1)
 # robustness-plane counters (metrics v3 /api/fault): injection hits per
 # boundary plus the hedged-read outcome counters fed by erasure/set.py
 COUNTERS = {
-    "storage": 0, "network": 0, "tpu": 0, "topology": 0,
+    "storage": 0, "network": 0, "tpu": 0, "topology": 0, "diag": 0,
     "hedge_reads": 0, "hedge_wins": 0, "hedge_losses": 0,
     "latency_trips": 0,
 }

@@ -204,7 +204,8 @@ CANNED_POLICIES: dict[str, Policy] = {
         _allow(
             ["admin:ServerInfo", "admin:Profiling", "admin:ServerTrace",
              "admin:ConsoleLog", "admin:OBDInfo", "admin:TopLocksInfo",
-             "admin:BandwidthMonitor", "admin:Prometheus"],
+             "admin:BandwidthMonitor", "admin:Prometheus",
+             "admin:Health", "admin:InspectData"],
             ["arn:aws:s3:::*"],
         )
     ]),

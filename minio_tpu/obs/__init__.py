@@ -22,6 +22,7 @@ Spans are opened ONLY via the context-manager API::
 from .trace import (  # noqa: F401
     NOOP_SPAN,
     TRACE_TYPES,
+    TYPE_DIAG,
     TYPE_FAULT,
     TYPE_HEAL,
     TYPE_INTERNAL,

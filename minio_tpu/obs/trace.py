@@ -31,10 +31,11 @@ TYPE_FAULT = "fault"
 TYPE_SANITIZER = "sanitizer"
 TYPE_PLACEMENT = "placement"
 TYPE_REBALANCE = "rebalance"
+TYPE_DIAG = "diag"
 TRACE_TYPES = frozenset(
     {TYPE_S3, TYPE_INTERNAL, TYPE_STORAGE, TYPE_TPU, TYPE_HEAL,
      TYPE_SCANNER, TYPE_FAULT, TYPE_SANITIZER, TYPE_PLACEMENT,
-     TYPE_REBALANCE}
+     TYPE_REBALANCE, TYPE_DIAG}
 )
 
 # (request_id, parent_span_id); spans nest by swapping the second slot

@@ -872,15 +872,91 @@ async def handle_admin(server, request: web.Request, access_key: str, subpath: s
             return _json({"error": str(e)}, 400)
         return web.Response(status=200)
 
-    # -- speedtest ---------------------------------------------------------
+    # -- self-measurement plane (diag/: speedtests, netperf, healthinfo) ---
+    if op == "speedtest" and m == "POST":
+        # autotuning object speedtest through the real erasure path on
+        # the QoS background lane (reference cmd/perf-tests.go); the
+        # coordinator merges per-node rows, `local=true` leaves measure
+        authz("admin:Health")
+        from .. import diag
+
+        size = _int_q(q, "size", 1 << 20, lo=4096, hi=64 << 20)
+        ops_n = _int_q(q, "ops", 4, lo=1, hi=64)
+        concurrency = _int_q(q, "concurrency", 0, lo=0, hi=256)
+        if q.get("local") == "true":
+            row = await server._run(
+                diag.object_speedtest, server, size, ops_n, concurrency
+            )
+            return _json({"nodes": {"local": row}})
+        return _json(await server._run(
+            diag.run_cluster, server, "object", "speedtest",
+            {"size": str(size), "ops": str(ops_n),
+             "concurrency": str(concurrency)},
+            lambda: diag.object_speedtest(server, size, ops_n, concurrency),
+        ))
     if op == "speedtest/drive" and m == "POST":
         authz("admin:Health")
-        return _json(await server._run(_drive_speedtest, server))
+        from .. import diag
+
+        size_mb = _int_q(q, "sizeMiB", 4, lo=1, hi=64)
+        rand_count = _int_q(q, "randCount", 16, lo=1, hi=256)
+        if q.get("local") == "true":
+            return _json({"nodes": {"local": await server._run(
+                diag.drive_speedtest, server, size_mb, rand_count)}})
+        return _json(await server._run(
+            diag.run_cluster, server, "drive", "speedtest/drive",
+            {"sizeMiB": str(size_mb), "randCount": str(rand_count)},
+            lambda: diag.drive_speedtest(server, size_mb, rand_count),
+        ))
+    if op == "speedtest/net" and m == "POST":
+        authz("admin:Health")
+        from .. import diag
+
+        size = _int_q(q, "size", 0, lo=0, hi=64 << 20)
+        count = _int_q(q, "count", 4, lo=1, hi=64)
+        pings = _int_q(q, "pings", 8, lo=1, hi=256)
+        if q.get("local") == "true":
+            return _json({"nodes": {"local": await server._run(
+                diag.run_netperf, server, size, count, pings)}})
+        return _json(await server._run(
+            diag.run_cluster, server, "net", "speedtest/net",
+            {"size": str(size), "count": str(count), "pings": str(pings)},
+            lambda: diag.run_netperf(server, size, count, pings),
+        ))
     if op == "speedtest/object" and m == "POST":
+        # legacy fixed-concurrency form, kept for compatibility — the
+        # autotuning `speedtest` op above supersedes it
         authz("admin:Health")
         size = _int_q(q, "size", 1 << 20, lo=4096, hi=64 << 20)
         count = _int_q(q, "count", 8, lo=1, hi=32)
         return _json(await server._run(_object_speedtest, server, size, count))
+    if op == "healthinfo" and m == "GET":
+        authz("admin:OBDInfo")
+        from ..diag import healthinfo as hinfo
+
+        info = await server._run(hinfo.build_healthinfo, server)
+        if q.get("format") == "zip":
+            blob = await server._run(hinfo.healthinfo_zip, info)
+            return web.Response(
+                status=200, body=blob, content_type="application/zip",
+                headers={"Content-Disposition":
+                         'attachment; filename="healthinfo.zip"'},
+            )
+        return _json(info)
+    if op == "inspect-data" and m == "GET":
+        authz("admin:InspectData")
+        from ..diag import healthinfo as hinfo
+
+        bucket = q.get("bucket", "")
+        obj = q.get("object", "")
+        if not bucket or not obj:
+            raise s3err.InvalidArgument
+        blob = await server._run(hinfo.inspect_data, server, bucket, obj)
+        return web.Response(
+            status=200, body=blob, content_type="application/zip",
+            headers={"Content-Disposition":
+                     'attachment; filename="inspect-data.zip"'},
+        )
 
     # -- info / heal ------------------------------------------------------
     if op == "info" and m == "GET":
@@ -1113,39 +1189,6 @@ async def _stream_trace(server, request: web.Request) -> web.StreamResponse:
         except Exception:  # noqa: BLE001 — client already gone
             pass
     return resp
-
-
-def _drive_speedtest(server) -> dict:
-    """Sequential write/read throughput per drive (reference
-    cmd/speedtest.go driveSpeedTest)."""
-    import os as _os
-    import time as _time
-
-    import uuid as _uuid
-
-    run_id = str(_uuid.uuid4())[:8]
-    payload = _os.urandom(4 << 20)
-    out = []
-    for i, d in enumerate(server.store.disks):
-        path = f"speedtest/{run_id}-{i}.bin"
-        try:
-            t0 = _time.perf_counter()
-            d.create_file(".minio.sys", path, payload)
-            wdt = _time.perf_counter() - t0
-            t0 = _time.perf_counter()
-            got = d.read_file(".minio.sys", path)
-            rdt = _time.perf_counter() - t0
-            d.delete(".minio.sys", path)
-            out.append(
-                {
-                    "endpoint": d.endpoint,
-                    "writeMiBps": round(len(payload) / 2**20 / wdt, 1),
-                    "readMiBps": round(len(got) / 2**20 / rdt, 1),
-                }
-            )
-        except Exception as e:  # noqa: BLE001
-            out.append({"endpoint": d.endpoint, "error": str(e)})
-    return {"drives": out}
 
 
 def _object_speedtest(server, size: int, count: int) -> dict:

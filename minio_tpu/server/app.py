@@ -101,6 +101,9 @@ class S3Server(
         # SlowDown on overflow) + last-minute per-API latency ring
         self.qos = QoS()
         self.background = None
+        # continuous wall-time profiler (server/profiling.py): main()
+        # starts it knob-gated; in-process test servers leave it off
+        self.cprofiler = None
         self.root_user = os.environ.get("MINIO_ROOT_USER", "minioadmin")
         self.root_pass = os.environ.get("MINIO_ROOT_PASSWORD", "minioadmin")
         self.app = web.Application(client_max_size=1 << 30)
@@ -1189,6 +1192,11 @@ def main(argv: list[str] | None = None) -> None:
     srv.worker_count = worker_count
     srv.worker_peers = worker_siblings
     srv.worker_port_base = worker_port_base
+    # continuous wall-time attribution (knob-gated, ~19 Hz): scraped as
+    # the /api/diag attribution series; None when MINIO_TPU_PROFILE_CONTINUOUS=0
+    from . import profiling as _profiling
+
+    srv.cprofiler = _profiling.start_continuous_from_env()
     from ..cluster.grid import GridServer
 
     storage_srv = StorageRESTServer(registry, token)
@@ -1211,6 +1219,15 @@ def main(argv: list[str] | None = None) -> None:
     # every mutation the cross-node timeout while it restarts)
     cache_coherence.configure(
         worker_siblings + peers, token, worker_peers=worker_siblings
+    )
+    # netperf echoes ride the same muxed storage plane; the loopback row
+    # (this node calling itself over the grid) is the stack floor every
+    # peer row is read against
+    from ..diag import netperf as diag_netperf
+
+    diag_netperf.register_grid(grid)
+    diag_netperf.configure(
+        worker_siblings + peers, token, self_addr=f"127.0.0.1:{my_port}"
     )
     grid.register(srv.app)
     from ..cluster import bootstrap as bootmod

@@ -1050,7 +1050,7 @@ def _g_api_fault(server) -> list[str]:
          "Armed fault-injection rules on this node")
     _fmt(out, "minio_fault_injected_total", "counter",
          [({"boundary": b}, c.get(b, 0))
-          for b in ("storage", "network", "tpu", "topology")],
+          for b in ("storage", "network", "tpu", "topology", "diag")],
          "Injected fault hits per boundary")
     _fmt(out, "minio_fault_hedge_reads_total", "counter",
          [({}, c.get("hedge_reads", 0))],
@@ -1376,6 +1376,102 @@ def _g_system_drive_latency(server) -> list[str]:
     return out
 
 
+def _g_api_diag(server) -> list[str]:
+    """Self-measurement plane (diag/): run counters, the last
+    speedtest/netperf results as gauges (the per-drive and per-peer
+    matrices a chaos-injected slow drive or slow peer must stand out
+    in), and the continuous profiler's wall-time attribution — where the
+    process actually spends its time, by subsystem, without anyone
+    having run a profile."""
+    from .. import diag
+
+    out: list[str] = []
+    st = diag.stats()
+    last = diag.last_results()
+    _fmt(out, "minio_diag_runs_total", "counter",
+         [({"kind": k}, n) for k, n in sorted(st["runs"].items())],
+         "Completed self-measurement runs by kind (object/drive/net)")
+    _fmt(out, "minio_diag_errors_total", "counter", [({}, st["errors"])])
+
+    obj = last.get("object", {})
+    knee = obj.get("knee", {})
+    _fmt(out, "minio_diag_speedtest_put_mibps", "gauge",
+         [({}, knee["putMiBps"])] if knee else [],
+         "Knee-point PUT throughput of the last object speedtest")
+    _fmt(out, "minio_diag_speedtest_get_mibps", "gauge",
+         [({}, knee["getMiBps"])] if knee else [])
+    _fmt(out, "minio_diag_speedtest_knee_concurrency", "gauge",
+         [({}, knee["concurrency"])] if knee else [],
+         "Concurrency at which the autotune ramp stopped paying")
+
+    drv = last.get("drive", {})
+    rows = [d for d in drv.get("drives", ()) if "error" not in d]
+    _fmt(out, "minio_diag_drive_write_mibps", "gauge",
+         [({"drive": d["endpoint"]}, d["writeMiBps"]) for d in rows],
+         "Sequential write MiB/s per drive, last drive speedtest")
+    _fmt(out, "minio_diag_drive_read_mibps", "gauge",
+         [({"drive": d["endpoint"]}, d["readMiBps"]) for d in rows])
+    _fmt(out, "minio_diag_drive_rand_read_p99_ms", "gauge",
+         [({"drive": d["endpoint"]}, d["randRead"]["p99Ms"]) for d in rows],
+         "Random 4KiB read p99 per drive, last drive speedtest")
+
+    net = last.get("net", {})
+    prow = [(p, r) for p, r in sorted(net.get("peers", {}).items())
+            if "error" not in r]
+    _fmt(out, "minio_diag_net_mibps", "gauge",
+         [({"peer": p}, r["throughputMiBps"]) for p, r in prow],
+         "Grid echo throughput per peer, last netperf")
+    _fmt(out, "minio_diag_net_rtt_p99_ms", "gauge",
+         [({"peer": p}, r["rttP99Ms"]) for p, r in prow])
+
+    cp = getattr(server, "cprofiler", None)
+    snap = cp.snapshot() if cp is not None else {"samples": 0, "counts": {}}
+    _fmt(out, "minio_diag_profile_enabled", "gauge",
+         [({}, int(cp is not None))],
+         "1 when the continuous ~19Hz profiler is sampling")
+    _fmt(out, "minio_diag_profile_samples_total", "counter",
+         [({}, snap["samples"])])
+    _fmt(out, "minio_diag_profile_thread_samples_total", "counter",
+         [({"subsystem": sub, "state": state}, n)
+          for (sub, state), n in sorted(snap["counts"].items())],
+         "Wall-time attribution: sampled thread stacks by owning "
+         "subsystem and running/waiting state")
+    return out
+
+
+def _g_system_selftest(server) -> list[str]:
+    """Hardware fingerprint from the last self-measurement runs — the
+    series the scenario engine scrapes to stamp every BENCH json, so a
+    CPU-shadowed number is self-describing."""
+    from .. import diag
+
+    out: list[str] = []
+    last = diag.last_results()
+    _fmt(out, "minio_system_selftest_cpu_cores", "gauge",
+         [({}, os.cpu_count() or 1)],
+         "Cores visible to this process")
+    _fmt(out, "minio_system_selftest_workers", "gauge",
+         [({}, getattr(server, "worker_count", 1))])
+
+    drv = [d for d in last.get("drive", {}).get("drives", ())
+           if "error" not in d]
+    _fmt(out, "minio_system_selftest_drive_write_mibps", "gauge",
+         [({}, max(d["writeMiBps"] for d in drv))] if drv else [],
+         "Best sequential drive write MiB/s, last drive speedtest")
+    _fmt(out, "minio_system_selftest_drive_read_mibps", "gauge",
+         [({}, max(d["readMiBps"] for d in drv))] if drv else [])
+
+    net = [r for r in last.get("net", {}).get("peers", {}).values()
+           if "error" not in r]
+    _fmt(out, "minio_system_selftest_loopback_mibps", "gauge",
+         [({}, max(r["throughputMiBps"] for r in net))] if net else [],
+         "Best grid echo throughput (loopback/peer), last netperf")
+    _fmt(out, "minio_system_selftest_complete", "gauge",
+         [({}, int(bool(drv) and bool(net)))],
+         "1 when drive + net selftests have both completed")
+    return out
+
+
 # collector path -> renderer; bucket paths live in V3_BUCKET_GROUPS
 V3_GROUPS = {
     "/api/requests": _g_api_requests,
@@ -1386,7 +1482,9 @@ V3_GROUPS = {
     "/api/cache": _g_api_cache,
     "/api/sanitizer": _g_api_sanitizer,
     "/api/topology": _g_api_topology,
+    "/api/diag": _g_api_diag,
     "/system/drive/latency": _g_system_drive_latency,
+    "/system/selftest": _g_system_selftest,
     "/system/network/internode": _g_system_network,
     "/system/drive": _g_system_drive,
     "/system/memory": _g_system_memory,

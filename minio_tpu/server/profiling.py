@@ -14,10 +14,23 @@ returns the bundle. The Python equivalents here:
 The admin handler runs the local profile and fans out to every cluster
 peer in parallel, exactly like the reference's notification-system
 fan-out.
+
+On top of the on-demand profilers sits the CONTINUOUS profiler: an
+always-on (knob-gated, MINIO_TPU_PROFILE_CONTINUOUS) ~19 Hz sampler
+that classifies every thread's stack by owning subsystem and publishes
+the counts as the metrics-v3 wall-time-attribution series under
+``/api/diag`` — a scrape answers "where does this process actually
+spend its time" without anyone having run a profile. 19 Hz (a prime-ish
+rate, same idea as Linux perf's default 99 Hz) avoids phase-locking
+with 10/20/100 Hz periodic work; at ~50 ms per sample over a handful of
+threads the overhead is far below one percent. Counts are mutated and
+snapshotted under one lock (the dispatcher-stats snapshot idiom) — the
+runtime sanitizer sees no unguarded shared state.
 """
 
 from __future__ import annotations
 
+import os
 import sys
 import threading
 import time
@@ -138,3 +151,109 @@ def run_cluster(server, profiler_type: str, duration: float) -> dict:
                 results[peer] = {"error": str(e)}
         results["local"] = {profiler_type: local.result()}
     return {"nodes": results}
+
+
+# -- continuous wall-time attribution ---------------------------------------
+
+CONTINUOUS_KNOB = "MINIO_TPU_PROFILE_CONTINUOUS"
+CONTINUOUS_HZ_KNOB = "MINIO_TPU_PROFILE_CONTINUOUS_HZ"
+
+# first path fragment matched walking a stack innermost-out wins; order
+# matters (dispatcher before the generic erasure bucket, listing before
+# erasure — listing.py lives inside erasure/)
+_SUBSYSTEM_PATTERNS = (
+    ("minio_tpu/parallel/", "dispatcher"),
+    ("minio_tpu/erasure/listing", "listing"),
+    ("minio_tpu/erasure/", "erasure"),
+    ("minio_tpu/storage/", "erasure"),
+    ("minio_tpu/cache/", "cache"),
+    ("minio_tpu/cluster/", "grid"),
+    ("minio_tpu/server/admin", "admin"),
+    ("minio_tpu/server/", "server"),
+    ("minio_tpu/diag/", "diag"),
+)
+
+# innermost frames that mean the thread is PARKED, not working — samples
+# there get state="waiting" so attribution separates owning-subsystem
+# wall time from actual execution
+_WAIT_FUNCS = frozenset(
+    {"wait", "get", "select", "poll", "accept", "recv", "recv_into",
+     "read", "sleep", "acquire", "epoll", "_recv_loop"}
+)
+
+
+def classify_stack(frame) -> tuple[str, str]:
+    """(subsystem, state) for one thread's innermost frame."""
+    state = "running"
+    fn = frame.f_code.co_filename
+    if frame.f_code.co_name in _WAIT_FUNCS and "minio_tpu" not in fn:
+        state = "waiting"
+    f = frame
+    while f is not None:
+        path = f.f_code.co_filename
+        for pat, subsystem in _SUBSYSTEM_PATTERNS:
+            if pat in path:
+                return subsystem, state
+        f = f.f_back
+    return "other", state
+
+
+class ContinuousProfiler:
+    """The always-on sampler thread. ``snapshot()`` is the ONLY reader
+    and the sampler loop the only writer, both under ``_mu`` — the
+    dispatcher-stats snapshot idiom, no unguarded shared Counter."""
+
+    def __init__(self, hz: float = 19.0):
+        self.hz = max(1.0, min(hz, 250.0))
+        self._mu = threading.Lock()
+        self._counts: Counter[tuple[str, str]] = Counter()
+        self._samples = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "ContinuousProfiler":
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="cont-profiler"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+    def snapshot(self) -> dict:
+        with self._mu:
+            return {
+                "samples": self._samples,
+                "counts": dict(self._counts),
+                "hz": self.hz,
+            }
+
+    def _loop(self) -> None:
+        me = threading.get_ident()
+        interval = 1.0 / self.hz
+        # Event.wait doubles as the pacing sleep and the stop signal;
+        # the dedicated daemon sampler thread never serves requests
+        while not self._stop.wait(interval):
+            tick: Counter[tuple[str, str]] = Counter()
+            for tid, frame in sys._current_frames().items():
+                if tid == me:
+                    continue
+                tick[classify_stack(frame)] += 1
+            with self._mu:
+                self._counts.update(tick)
+                self._samples += 1
+
+
+def start_continuous_from_env() -> ContinuousProfiler | None:
+    """The knob-gated boot hook (server/app.py main): returns a started
+    profiler, or None when MINIO_TPU_PROFILE_CONTINUOUS=0."""
+    if os.environ.get(CONTINUOUS_KNOB, "1") == "0":
+        return None
+    try:
+        hz = float(os.environ.get(CONTINUOUS_HZ_KNOB, "19") or 19.0)
+    except ValueError:
+        hz = 19.0
+    return ContinuousProfiler(hz).start()

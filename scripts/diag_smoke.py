@@ -1,0 +1,177 @@
+"""diag-smoke: the self-measurement plane end to end, in seconds.
+
+Brings up a 2-worker SO_REUSEPORT pool the way `make bench-smoke` does,
+then drives the whole diag surface over real HTTP:
+
+* quick object speedtest (autotune ramp) + drive speedtest + netperf —
+  every request must be a 200 and every node row error-free;
+* healthinfo as JSON and as zip (the zip must contain healthinfo.json);
+* every series the static surface manifest declares under ``/api/diag``
+  must be present in the live scrape (the continuous profiler's
+  attribution series included) — a diag series we document but don't
+  serve fails the smoke, never passes it.
+
+Exit status 0 only when all of that holds. Wired as `make diag-smoke`
+and a check.yml step.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import shutil
+import sys
+import tempfile
+import zipfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("MINIO_TPU_BACKEND", "numpy")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from benchmarks.scenarios.engine import Server, admin  # noqa: E402
+from minio_tpu.client import S3Client  # noqa: E402
+
+PORT = 19831
+
+
+def fail(msg: str) -> None:
+    print(f"diag-smoke: FAIL: {msg}", file=sys.stderr, flush=True)
+    raise SystemExit(1)
+
+
+def node_rows(payload: bytes, what: str) -> dict:
+    doc = json.loads(payload)
+    nodes = doc.get("nodes", {})
+    if not nodes:
+        fail(f"{what}: no node rows in {doc}")
+    for node, row in nodes.items():
+        if isinstance(row, dict) and "error" in row:
+            fail(f"{what}: node {node} errored: {row['error']}")
+    return doc
+
+
+def declared_diag_series() -> set[str]:
+    """Series names the static surface manifest declares under the
+    /api/diag collector path."""
+    from minio_tpu.analysis import surface
+
+    class _PathsIndex:
+        def __init__(self, root: str):
+            self.root = root
+            self.paths = {}
+            for dirpath, _, files in os.walk(root):
+                for fn in files:
+                    if fn.endswith(".py"):
+                        full = os.path.join(dirpath, fn)
+                        self.paths[os.path.relpath(full, root)] = full
+
+    pkg = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "minio_tpu")
+    manifest = surface.extract(_PathsIndex(pkg))
+    return {s["name"] for s in manifest["metrics"]
+            if s["group"] == "/api/diag"}
+
+
+def main() -> int:
+    base = tempfile.mkdtemp(prefix="diag-smoke-")
+    srv = Server(base, PORT, drives=4, workers=2, scan_interval=30.0)
+    try:
+        cli = S3Client(f"127.0.0.1:{PORT}")
+        assert cli.make_bucket("diag-smoke").status == 200
+
+        # -- object speedtest (quick autotune) ---------------------------
+        r = admin(PORT, "POST", "speedtest",
+                  query={"size": str(64 * 1024), "ops": "2"}, timeout=180)
+        if r.status != 200:
+            fail(f"speedtest HTTP {r.status}: {r.body[:200]}")
+        doc = node_rows(r.body, "speedtest")
+        for node, row in doc["nodes"].items():
+            knee = row.get("knee", {})
+            if not knee.get("putMiBps", 0) > 0:
+                fail(f"speedtest: node {node} knee has no PUT throughput: "
+                     f"{knee}")
+        print(f"diag-smoke: speedtest ok ({len(doc['nodes'])} nodes)")
+
+        # -- drive speedtest ---------------------------------------------
+        r = admin(PORT, "POST", "speedtest/drive",
+                  query={"sizeMiB": "1", "randCount": "4"}, timeout=120)
+        if r.status != 200:
+            fail(f"speedtest/drive HTTP {r.status}: {r.body[:200]}")
+        doc = node_rows(r.body, "speedtest/drive")
+        drives = sum(len(row.get("drives", ()))
+                     for row in doc["nodes"].values())
+        if drives == 0:
+            fail("speedtest/drive: no drive rows")
+        for row in doc["nodes"].values():
+            for d in row.get("drives", ()):
+                if "error" in d:
+                    fail(f"speedtest/drive: drive {d.get('drive')} errored: "
+                         f"{d['error']}")
+        print(f"diag-smoke: drive speedtest ok ({drives} drive rows)")
+
+        # -- netperf matrix ----------------------------------------------
+        r = admin(PORT, "POST", "speedtest/net",
+                  query={"size": str(256 * 1024), "count": "2", "pings": "4"},
+                  timeout=120)
+        if r.status != 200:
+            fail(f"speedtest/net HTTP {r.status}: {r.body[:200]}")
+        doc = node_rows(r.body, "speedtest/net")
+        for node, row in doc["nodes"].items():
+            peers = row.get("peers", {})
+            if "loopback" not in peers:
+                fail(f"netperf: node {node} has no loopback row: {peers}")
+            for peer, cell in peers.items():
+                if "error" in cell:
+                    fail(f"netperf: {node} -> {peer} errored: "
+                         f"{cell['error']}")
+        print(f"diag-smoke: netperf ok ({len(doc['nodes'])} matrix rows)")
+
+        # -- healthinfo: JSON + zip --------------------------------------
+        r = admin(PORT, "GET", "healthinfo", timeout=60)
+        if r.status != 200:
+            fail(f"healthinfo HTTP {r.status}: {r.body[:200]}")
+        info = json.loads(r.body)
+        for key in ("version", "hardware", "topology", "breakers",
+                    "sanitizer", "selftest"):
+            if key not in info:
+                fail(f"healthinfo: missing section {key!r}")
+        if not info["selftest"]["last"]:
+            fail("healthinfo: selftest.last empty after three speedtests")
+        r = admin(PORT, "GET", "healthinfo", query={"format": "zip"},
+                  timeout=60)
+        if r.status != 200:
+            fail(f"healthinfo zip HTTP {r.status}")
+        with zipfile.ZipFile(io.BytesIO(r.body)) as z:
+            if "healthinfo.json" not in z.namelist():
+                fail(f"healthinfo zip entries: {z.namelist()}")
+        print("diag-smoke: healthinfo ok (json + zip)")
+
+        # -- every declared /api/diag series present in the live scrape --
+        declared = declared_diag_series()
+        if not declared:
+            fail("static manifest declares no /api/diag series")
+        r = cli.request("GET", "/minio/metrics/v3/api/diag")
+        if r.status != 200:
+            fail(f"/api/diag scrape HTTP {r.status}")
+        live = set()
+        for line in r.body.decode().splitlines():
+            if line.startswith("# TYPE "):
+                live.add(line.split()[2])
+            elif line and not line.startswith("#") and " " in line:
+                live.add(line.rsplit(" ", 1)[0].split("{", 1)[0])
+        missing = declared - live
+        if missing:
+            fail(f"declared /api/diag series absent from live scrape: "
+                 f"{sorted(missing)}")
+        print(f"diag-smoke: /api/diag scrape ok "
+              f"({len(declared)} declared series all present)")
+        print("diag-smoke: PASS")
+        return 0
+    finally:
+        srv.stop()
+        shutil.rmtree(base, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

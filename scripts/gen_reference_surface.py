@@ -9,8 +9,10 @@ REFERENCE_ROOT defaults to /root/reference. The script greps the
 ``cmd/metrics-v3-*.go`` descriptor files for series-name constants
 (``"<name>"`` passed to NewCounterMD/NewGaugeMD, or assembled from the
 ``minio_<subsystem>_`` prefix conventions), buckets them into the four
-pinned parity groups (api / cluster / system / drive), and rewrites the
-vendored JSON in place — preserving the pin and the comment header.
+pinned parity groups (api / cluster / system / drive), harvests the
+diagnostic admin-op names from ``cmd/admin-router.go`` into the
+``admin_groups`` pin set, and rewrites the vendored JSON in place —
+preserving the pin and the comment header.
 
 When the reference tree is not mounted (the normal case in CI) it exits
 0 without touching anything: the vendored JSON stays the hand-curated
@@ -41,6 +43,36 @@ _GROUP_BY_FILE = (
 # `xxxMD = NewCounterMD(xxx, ...)` name constants: the series name is a
 # quoted snake_case string in the same file
 _NAME_RE = re.compile(r'"((?:[a-z0-9]+_)+[a-z0-9]+)"')
+
+# admin-router registrations: adminRouter.Methods(...).Path(adminVersion +
+# "/speedtest/drive") — harvest the op path tails
+_ADMIN_OP_RE = re.compile(r'adminAPIVersionPrefix\s*\+\s*"/([a-z][a-z0-9/_-]*)"'
+                          r'|adminVersion\s*\+\s*"/([a-z][a-z0-9/_-]*)"')
+
+# the curated diagnostics subset: the reference router registers ~100
+# ops; parity pins only the self-measurement plane this tree mirrors
+_DIAG_OPS = frozenset({
+    "speedtest", "speedtest/drive", "speedtest/net", "speedtest/object",
+    "healthinfo", "inspect-data", "profile", "trace", "top/locks",
+})
+
+
+def harvest_admin_ops(reference_root: str) -> set[str]:
+    """Diagnostic admin-op names from the reference admin router,
+    intersected with the curated allowlist (the reference registers far
+    more ops than this tree pins parity on)."""
+    path = os.path.join(reference_root, "cmd", "admin-router.go")
+    try:
+        with open(path, "r", encoding="utf-8", errors="replace") as fh:
+            src = fh.read()
+    except OSError:
+        return set()
+    ops = set()
+    for m in _ADMIN_OP_RE.finditer(src):
+        op = (m.group(1) or m.group(2)).strip("/")
+        if op in _DIAG_OPS:
+            ops.add(op)
+    return ops
 
 
 def harvest(reference_root: str) -> dict[str, set[str]] | None:
@@ -90,6 +122,9 @@ def main() -> int:
     for g, names in harvested.items():
         if names:
             doc["groups"][g] = sorted(names)
+    admin_ops = harvest_admin_ops(root)
+    if admin_ops:
+        doc.setdefault("admin_groups", {})["diagnostics"] = sorted(admin_ops)
     with open(VENDORED, "w", encoding="utf-8") as fh:
         json.dump(doc, fh, indent=2)
         fh.write("\n")

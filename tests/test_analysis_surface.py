@@ -102,7 +102,9 @@ def test_extracts_routes_and_sts(manifest):
 
 def test_extracts_fault_surface(manifest):
     fault = manifest["fault"]
-    assert fault["boundaries"] == ["storage", "network", "tpu", "topology"]
+    assert fault["boundaries"] == [
+        "storage", "network", "tpu", "topology", "diag",
+    ]
     assert "bitrot" in fault["modes"]["storage"]
     assert "device-lost" in fault["modes"]["tpu"]
     by_boundary = {}
@@ -153,7 +155,7 @@ def test_reference_parity_pinned_groups(surface_run):
     parity = record["parity"]
     pin = parity["pin"]
     assert pin >= 0.8
-    for g in ("api", "cluster", "system", "drive"):
+    for g in ("api", "cluster", "system", "drive", "admin-diagnostics"):
         st = parity["groups"][g]
         assert st["total"] > 0, f"reference group '{g}' is empty (vacuous)"
         assert st["ratio"] >= pin, (
