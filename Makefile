@@ -30,7 +30,10 @@ docs:
 # (worker pool, mixed zipf traffic, heal flood, QoS guard metrics) in
 # seconds — full runs write BENCH json, this just proves it still works.
 # Then every named workload profile at toy scale, each with its real
-# gates armed (a missing gate series fails the run, never passes it).
+# gates armed (a missing gate series fails the run, never passes it) —
+# --all includes repair-degraded-storm, the seeded drive-failure +
+# straggler storm with verifying traffic and the windowed-vs-serial
+# repair A/B.
 bench-smoke:
 	MINIO_TPU_BACKEND=numpy $(PY) benchmarks/bench_load.py --quick
 	MINIO_TPU_BACKEND=numpy $(PY) -m benchmarks.scenarios --all --quick

@@ -187,6 +187,18 @@ class AsyncS3:
         self.host = host
         self.port = port
 
+    @staticmethod
+    def _canon_path(path: str) -> str:
+        """Percent-encode the path exactly as sign_request canonicalizes
+        it. yarl would otherwise re-decode sub-delims ('=' in hive-style
+        keys) on the wire, so the request must carry this form verbatim
+        (sent with ``yarl.URL(..., encoded=True)``) or the server-side
+        canonical request disagrees with the signed one → 403."""
+        import urllib.parse
+
+        return urllib.parse.quote(
+            urllib.parse.unquote(path), safe="/-_.~")
+
     def _signed(self, method: str, path: str, query: str) -> dict:
         url = f"{self.base}{path}" + (f"?{query}" if query else "")
         return sign_request(
@@ -207,10 +219,16 @@ class AsyncS3:
                            headers: dict | None = None):
         """Like request() but also returns the response headers (the
         topology phase cross-checks ETag against the served bytes)."""
+        import yarl
+
+        path = self._canon_path(path)
         hdrs = self._signed(method, path, query)
         if headers:
             hdrs.update(headers)  # unsigned extras (Range) are S3-legal
-        url = f"{self.base}{path}" + (f"?{query}" if query else "")
+        url = yarl.URL(
+            f"{self.base}{path}" + (f"?{query}" if query else ""),
+            encoded=True,
+        )
         async with self.session.request(
             method, url, data=body if body else None, headers=hdrs
         ) as resp:
@@ -292,6 +310,45 @@ def zipf_cdf(n: int, alpha: float = ZIPF_ALPHA) -> list[float]:
     for x in w:
         acc += x / total
         out.append(acc)
+    return out
+
+
+def hive_keys(n: int, days: int = 4, hours: int = 6) -> list[str]:
+    """Hive-partitioned key shape: ``dt=.../hour=.../part-NNNNN.parquet``.
+
+    A lakehouse layout — deep shared prefixes with many siblings per
+    leaf directory, the shape that stresses metacache shard splits and
+    per-prefix listing far harder than a flat ``oNNNNNN`` space. Keys
+    are deterministic in ``n`` so a verifying reader can regenerate the
+    expected content for any index. Returned in partition order (also
+    lexicographic), so ``keys[zipf_idx]`` concentrates heat on the
+    newest-first partitions when the caller reverses, or the oldest
+    when not."""
+    leaves = days * hours
+    per_leaf = -(-n // leaves)
+    out: list[str] = []
+    for i in range(n):
+        leaf, part = divmod(i, per_leaf)
+        d, h = divmod(leaf, hours)
+        out.append(f"dt=2026-07-{d + 1:02d}/hour={h:02d}/"
+                   f"part-{part:05d}.parquet")
+    return out
+
+
+def timestamp_run_keys(n: int, runs: int = 8) -> list[str]:
+    """Timestamp-sorted key shape: ``events/<epoch>-<seq>.log`` in
+    monotonically increasing runs.
+
+    A log-shipper layout — every new key sorts after every existing
+    one inside its run, so inserts always land on the tail of the same
+    metacache shard (the pathological append pattern for sorted
+    indexes). ``runs`` independent streams interleave, each strictly
+    increasing. Deterministic in ``n``."""
+    base = 1753920000  # fixed epoch anchor; content keys, not clocks
+    out: list[str] = []
+    for i in range(n):
+        run, seq = i % runs, i // runs
+        out.append(f"events/run{run:02d}/{base + seq * 60}-{seq:06d}.log")
     return out
 
 

@@ -26,11 +26,19 @@ The four profiles:
   B expands the cluster live, floods big PUTs + cross-tenant LISTs with
   a heal flood behind it. Gated on ``fg_deferred_behind_bg`` staying
   flat and bounded cross-tenant p99 skew.
+- ``repair-degraded-storm``: seeded drive-failure + straggler/error
+  fault schedule under verifying zipf traffic over a hive-partitioned
+  keyspace while a heal flood runs. Gated on degraded-GET p99 within a
+  declared band of healthy p99, zero wrong bytes anywhere, the
+  BENCH_r09 cauchy-ingress bound (<= 0.75x rs, controlled synthetic),
+  and windowed repair beating the block-serial baseline wall-clock
+  under a seeded per-read straggler.
 """
 
 from __future__ import annotations
 
 import asyncio
+import bisect
 import dataclasses
 import hashlib
 import json
@@ -52,6 +60,7 @@ from .engine import (
     Server,
     Stats,
     admin,
+    hive_keys,
     median,
     multipart_put,
     require_gate_series,
@@ -60,6 +69,7 @@ from .engine import (
     scrape_series,
     selftest_fingerprint,
     tbody,
+    zipf_cdf,
 )
 
 from minio_tpu.client import S3Client
@@ -668,6 +678,303 @@ async def burst_phase(ctx: Ctx) -> dict:
     return out
 
 
+# ==================================================== repair-degraded-storm
+
+
+REPAIR_GATE_SERIES: list[tuple[str, str]] = [
+    ("/api/tpu", "minio_tpu_repair_partial_blocks_total"),
+    ("/api/tpu", "minio_heal_ingress_bytes_total"),
+    ("/api/tpu", "minio_tpu_degraded_ingress_bytes_total"),
+    ("/api/tpu", "minio_tpu_decode_matrix_cache_total"),
+    ("/api/fault", "minio_fault_repair_hedge_reads_total"),
+    ("/api/fault", "minio_fault_repair_fallback_blocks_total"),
+]
+
+
+async def _verified_get_loop(cli: AsyncS3, keys: list[str], clients: int,
+                             duration: float, size: int,
+                             cls: str) -> tuple[Stats, int]:
+    """Closed-loop zipf GETs over `keys`, every response byte-compared
+    against tbody — a wrong byte anywhere (healthy or degraded) is a
+    counted failure, never a silent one. Returns (stats, wrong_bytes)."""
+    stats = Stats()
+    wrong = 0
+    cdf = zipf_cdf(len(keys))
+    stop_at = time.monotonic() + duration
+
+    async def one(cid: int) -> None:
+        nonlocal wrong
+        rng = random.Random(8191 * cid + 3)
+        while time.monotonic() < stop_at:
+            key = keys[bisect.bisect_left(cdf, rng.random())]
+            t0 = time.perf_counter()
+            try:
+                st, data = await cli.request("GET", f"/{BUCKET}/{key}")
+                stats.add(cls, time.perf_counter() - t0, len(data), st)
+                if st == 200 and data != tbody(key, 0, size):
+                    wrong += 1
+                if st == 503:
+                    await asyncio.sleep(0.5)
+            except Exception:  # noqa: BLE001 — count, keep looping
+                stats.add("ERR", time.perf_counter() - t0, 0, 599)
+
+    t0 = time.monotonic()
+    await asyncio.gather(*(one(i) for i in range(clients)))
+    stats.wall = time.monotonic() - t0
+    return stats, wrong
+
+
+def _wipe_drive_bucket(base: str, idx: int) -> int:
+    """The seeded drive failure: drop every object's shard data under
+    one drive's bucket dir (the drive stays mounted — reads return
+    FileNotFound, the degraded plane's bread-and-butter). Returns how
+    many object dirs were dropped."""
+    root = os.path.join(base, f"d{idx}", BUCKET)
+    dropped = 0
+    for ent in os.listdir(root):
+        shutil.rmtree(os.path.join(root, ent), ignore_errors=True)
+        dropped += 1
+    return dropped
+
+
+def _synthetic_repair_ab(spec: dict) -> dict:
+    """In-process SYNTHETIC measurement (no server, labelled as such in
+    the output): the controlled single-lost-DATA-shard case the BENCH_r09
+    ingress bound is defined over, plus the windowed-vs-block-serial
+    repair wall-clock A/B under a seeded +straggler-per-shard-read
+    schedule. In-process because both need per-object control the wire
+    API doesn't expose: choosing WHICH shard is lost (data shard 0, the
+    apples-to-apples repair-plan case — a whole-drive wipe mixes parity
+    losses in, which repair_schedule correctly refuses) and flipping
+    MINIO_TPU_REPAIR_WINDOWED between otherwise-identical reads."""
+    from minio_tpu import fault
+    from minio_tpu.erasure.set import ErasureSet
+    from minio_tpu.fault.storage import FaultInjectedDisk
+    from minio_tpu.storage.health import HealthCheckedDisk
+    from minio_tpu.storage.xlstorage import XLStorage
+
+    def rig(base: str, tag: str) -> ErasureSet:
+        # production wrap order: faults inject UNDER the breaker, so the
+        # straggler schedule feeds the same EWMA the hedge budget reads
+        es = ErasureSet(
+            [HealthCheckedDisk(FaultInjectedDisk(
+                XLStorage(os.path.join(base, tag, f"d{i}"))))
+             for i in range(16)],
+            default_parity=8,
+        )
+        es.make_bucket("fam")
+        return es
+
+    def drain(it) -> bytes:
+        return b"".join(bytes(c) for c in it)
+
+    def lose_data_shard0(base: str, tag: str, es: ErasureSet) -> None:
+        fi, _ = es._cached_fileinfo("fam", "o", "")
+        lost = fi.erasure.distribution.index(1)  # data shard 0's drive
+        shutil.rmtree(os.path.join(base, tag, f"d{lost}", "fam", "o"))
+        es.cache.clear()
+
+    saved = {k: os.environ.get(k) for k in (
+        "MINIO_TPU_EC_FAMILY", "MINIO_TPU_NATIVE_PLANE",
+        "MINIO_TPU_REPAIR_WINDOWED")}
+    base = tempfile.mkdtemp(prefix="repair-ab-")
+    try:
+        os.environ["MINIO_TPU_NATIVE_PLANE"] = "0"
+        body = tbody("ab", 0, spec["ab_mib"] * MIB)
+
+        # -- ingress bound: single lost data shard, heal per family -----
+        ingress: dict[str, int] = {}
+        for fam in ("reedsolomon", "cauchy"):
+            os.environ["MINIO_TPU_EC_FAMILY"] = fam
+            es = rig(base, fam)
+            es.put_object("fam", "o", body)
+            lose_data_shard0(base, fam, es)
+            res = es.heal_object("fam", "o")
+            assert res["healed"], f"{fam} heal failed: {res}"
+            ingress[fam] = res["ingressBytes"]
+
+        # -- wall clock: windowed vs block-serial degraded GET ----------
+        os.environ["MINIO_TPU_EC_FAMILY"] = "cauchy"
+        es = rig(base, "ab")
+        es.put_object("fam", "o", body)
+        lose_data_shard0(base, "ab", es)
+        fault.inject({
+            "boundary": "storage", "mode": "latency", "op": "read_file",
+            "latency_ms": spec["ab_straggler_ms"], "seed": 42,
+        })
+        walls: dict[str, list[float]] = {"windowed": [], "serial": []}
+        modes = (("windowed", "1"), ("serial", "0"))
+        for mode, env in modes:  # warm decode matrices etc., unmeasured
+            os.environ["MINIO_TPU_REPAIR_WINDOWED"] = env
+            es.cache.clear()
+            _, it = es.get_object("fam", "o")
+            assert drain(it) == body, f"warmup {mode}: wrong bytes"
+        for _ in range(spec["ab_trials"]):
+            for mode, env in modes:  # interleaved: drift washes out
+                os.environ["MINIO_TPU_REPAIR_WINDOWED"] = env
+                es.cache.clear()  # every trial re-reads the drives
+                t0 = time.perf_counter()
+                _, it = es.get_object("fam", "o")
+                got = drain(it)
+                walls[mode].append(time.perf_counter() - t0)
+                assert got == body, f"{mode} repair served wrong bytes"
+        return {
+            "label": "synthetic-in-process",
+            "object_mib": spec["ab_mib"],
+            "heal_ingress_bytes": ingress,
+            "cauchy_over_rs_ingress": round(
+                ingress["cauchy"] / max(ingress["reedsolomon"], 1), 4),
+            "ab_trials": spec["ab_trials"],
+            "ab_straggler_ms_per_read": spec["ab_straggler_ms"],
+            "degraded_get_wall_ms": {
+                m: round(median(w) * 1e3, 2) for m, w in walls.items()},
+        }
+    finally:
+        fault.clear()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        shutil.rmtree(base, ignore_errors=True)
+
+
+async def repair_storm_phase(ctx: Ctx) -> dict:
+    spec = ctx.spec
+    n, size = spec["objects"], spec["object_kb"] * 1024
+    keys = hive_keys(n)
+    rrs = {"x-amz-storage-class": "REDUCED_REDUNDANCY"}
+
+    async with s3_session(ctx.port) as cli:
+        c0 = await asyncio.to_thread(
+            require_gate_series, ctx.port, REPAIR_GATE_SERIES)
+
+        # populate: hive-partitioned keyspace, even keys cauchy
+        # (STANDARD), odd keys reedsolomon (RRS pinned to the same EC:8
+        # via the profile env) — the per-family comparison is over
+        # identical shapes
+        sem = asyncio.Semaphore(32)
+
+        async def put_one(i: int, key: str) -> None:
+            async with sem:
+                st, _ = await cli.request(
+                    "PUT", f"/{BUCKET}/{key}", body=tbody(key, 0, size),
+                    read=False, headers=(rrs if i % 2 else None))
+                assert st == 200, f"populate {key}: HTTP {st}"
+
+        await asyncio.gather(*(put_one(i, k) for i, k in enumerate(keys)))
+
+        healthy, wrong_h = await _verified_get_loop(
+            cli, keys, spec["clients"], spec["healthy_s"], size, "HGET")
+
+        # seeded failure schedule: one drive's data gone, one drive a
+        # straggler, one drive throwing transient read errors
+        dropped = await asyncio.to_thread(
+            _wipe_drive_bucket, ctx.base, spec["wipe_drive"])
+        for rule in (
+            {"boundary": "storage", "mode": "latency", "op": "read_file",
+             "target": os.path.join(ctx.base, f"d{spec['straggler_drive']}"),
+             "latency_ms": spec["straggler_ms"],
+             "prob": spec["straggler_prob"], "seed": 1207},
+            {"boundary": "storage", "mode": "error", "op": "read_file",
+             "target": os.path.join(ctx.base, f"d{spec['error_drive']}"),
+             "prob": spec["error_prob"], "seed": 4311},
+        ):
+            r = await asyncio.to_thread(
+                admin, ctx.port, "POST", "fault/inject",
+                json.dumps(rule).encode())
+            assert r.status == 200, (
+                f"fault/inject: {r.status} {r.body[:200]}")
+
+        with HealFlood(ctx.port) as flood:
+            storm, wrong_s = await _verified_get_loop(
+                cli, keys, spec["clients"], spec["storm_s"], size, "DGET")
+            sweeps = flood.sweeps
+
+        r = await asyncio.to_thread(admin, ctx.port, "POST", "fault/clear")
+        assert r.status == 200, f"fault/clear: {r.status}"
+        r = await asyncio.to_thread(
+            admin, ctx.port, "POST", f"heal/{BUCKET}", b"", None, 300)
+        assert r.status == 200, f"final heal: {r.status} {r.body[:200]}"
+
+        # post-heal: every key byte-exact, sequentially (no sampling)
+        wrong_f = errs_f = 0
+        for key in keys:
+            st, data = await cli.request("GET", f"/{BUCKET}/{key}")
+            if st != 200:
+                errs_f += 1
+            elif data != tbody(key, 0, size):
+                wrong_f += 1
+
+        c1 = await asyncio.to_thread(
+            require_gate_series, ctx.port, REPAIR_GATE_SERIES)
+        heal_fam = await asyncio.to_thread(
+            scrape_series, ctx.port, "/api/tpu",
+            "minio_heal_ingress_bytes_total")
+
+    synth = await asyncio.to_thread(_synthetic_repair_ab, spec)
+
+    healthy_s = healthy.summary(healthy.wall)
+    storm_sum = storm.summary(storm.wall)
+    p99_h = healthy_s["per_class"].get("HGET", {}).get("p99_ms", 0.0)
+    p99_d = storm_sum["per_class"].get("DGET", {}).get("p99_ms", 0.0)
+    deltas = {s: c1[s] - c0[s] for _, s in REPAIR_GATE_SERIES}
+    walls = synth["degraded_get_wall_ms"]
+
+    out = {
+        "objects": n,
+        "object_kb": spec["object_kb"],
+        "keyspace": "hive-partitioned",
+        "objects_dropped_on_failed_drive": dropped,
+        "healthy": healthy_s,
+        "storm": storm_sum,
+        "post_heal_verified": n - wrong_f - errs_f,
+        "heal_sweeps": sweeps,
+        "healthy_get_p99_ms": p99_h,
+        "degraded_get_p99_ms": p99_d,
+        "p99_band_mult": spec["p99_band_mult"],
+        "repair_series_delta": deltas,
+        "heal_ingress_by_family_server": heal_fam,
+        "synthetic": synth,
+    }
+
+    failures = []
+    if wrong_h or wrong_s or wrong_f:
+        failures.append(
+            f"wrong bytes served: healthy {wrong_h}, storm {wrong_s}, "
+            f"post-heal {wrong_f}")
+    if healthy_s["errors"] or storm_sum["errors"] or errs_f:
+        failures.append(
+            f"GET errors: healthy {healthy_s['errors']}, storm "
+            f"{storm_sum['errors']}, post-heal {errs_f} (the degraded "
+            "plane must mask 2 bad drives at EC 8+8)")
+    allowed = max(spec["p99_band_mult"] * p99_h, spec["p99_floor_ms"])
+    if not p99_d or p99_d > allowed:
+        failures.append(
+            f"degraded GET p99 {p99_d}ms outside (0, {allowed:.0f}] "
+            f"(healthy {p99_h}ms, band {spec['p99_band_mult']}x)")
+    if deltas["minio_tpu_repair_partial_blocks_total"] <= 0:
+        failures.append("sub-chunk partial repair never engaged "
+                        "(repair_partial_blocks flat across the storm)")
+    if deltas["minio_tpu_decode_matrix_cache_total"] <= 0:
+        failures.append("decode-matrix cache never consulted")
+    ratio = synth["cauchy_over_rs_ingress"]
+    if ratio > spec["ingress_ratio_max"]:
+        failures.append(
+            f"cauchy heal ingress {ratio:.3f}x rs > "
+            f"{spec['ingress_ratio_max']} (BENCH_r09 bound regressed)")
+    if walls["windowed"] >= walls["serial"]:
+        failures.append(
+            f"windowed repair {walls['windowed']}ms did not beat "
+            f"block-serial {walls['serial']}ms under "
+            f"+{spec['ab_straggler_ms']}ms/shard-read straggler")
+    if sweeps == 0:
+        failures.append("heal flood swept nothing (vacuous storm)")
+    out["gates_passed"] = not failures
+    out["gate_failures"] = failures
+    return out
+
+
 # =============================================================== registry
 
 
@@ -776,6 +1083,44 @@ PROFILES: dict[str, Profile] = {p.name: p for p in [
             "skew_max": 25.0, "p99_floor_ms": 400.0,
         },
         phase=burst_phase,
+    ),
+    Profile(
+        name="repair-degraded-storm",
+        summary="seeded drive failure + stragglers under verifying "
+                "traffic + heal flood; p99 band, zero wrong bytes, "
+                "cauchy ingress bound, windowed beats serial repair",
+        drives=16,  # EC 8+8: every object stripes across all drives
+        workers=1,  # fault registry + counters live per-process
+        scan_interval=300.0,
+        env={
+            # both families at the same EC 8+8 geometry: storage class
+            # selects the family, not the parity
+            "MINIO_TPU_EC_FAMILY_STANDARD": "cauchy",
+            "MINIO_TPU_EC_FAMILY_RRS": "reedsolomon",
+            "MINIO_STORAGE_CLASS_RRS": "EC:8",
+        },
+        gate_series=REPAIR_GATE_SERIES,
+        quick_spec={
+            "objects": 24, "object_kb": 256, "clients": 8,
+            "healthy_s": 2.5, "storm_s": 4.0,
+            "wipe_drive": 3, "straggler_drive": 5, "error_drive": 7,
+            "straggler_ms": 80.0, "straggler_prob": 0.3,
+            "error_prob": 0.08,
+            "p99_band_mult": 30.0, "p99_floor_ms": 600.0,
+            "ingress_ratio_max": 0.75,
+            "ab_trials": 5, "ab_mib": 2, "ab_straggler_ms": 1.5,
+        },
+        full_spec={
+            "objects": 96, "object_kb": 256, "clients": 24,
+            "healthy_s": 6.0, "storm_s": 15.0,
+            "wipe_drive": 3, "straggler_drive": 5, "error_drive": 7,
+            "straggler_ms": 120.0, "straggler_prob": 0.3,
+            "error_prob": 0.08,
+            "p99_band_mult": 12.0, "p99_floor_ms": 500.0,
+            "ingress_ratio_max": 0.75,
+            "ab_trials": 5, "ab_mib": 8, "ab_straggler_ms": 1.5,
+        },
+        phase=repair_storm_phase,
     ),
 ]}
 

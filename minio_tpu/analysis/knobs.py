@@ -127,6 +127,18 @@ _ALL: list[Knob] = [
        "repair schedule's sub-chunk frames instead of full survivor "
        "shards. 0 forces full-shard reads (correctness never depends "
        "on this — it is purely the repair-bandwidth optimization)."),
+    _k("MINIO_TPU_REPAIR_WINDOWED", "1", "erasure",
+       "Windowed + hedged execution of partial-repair plans (degraded "
+       "GET and heal): a window of blocks' sub-chunk reads issues "
+       "concurrently with next-window readahead, and straggling or "
+       "failed helpers degrade per BLOCK to the generic gather. 0 "
+       "falls back to the block-serial baseline (the A/B lever the "
+       "repair-degraded-storm wall-clock gate measures against)."),
+    _k("MINIO_TPU_DECODE_MATRIX_CACHE", "256", "erasure",
+       "Entries in the decode-matrix LRU shared by the code families "
+       "(ops/decode_cache.py): GF inverses keyed by (family, d, p, "
+       "failure pattern), hit/miss series on /api/tpu. 0 disables the "
+       "cache so A/B runs can price it."),
     _k("MINIO_TPU_DISK_MONITOR_INTERVAL", "10", "erasure",
        "Seconds between background disk health probes (offline-disk "
        "detection and auto-heal triggering)."),
@@ -238,7 +250,10 @@ _ALL: list[Knob] = [
     _k("MINIO_TPU_HEDGE", "1", "fault",
        "Hedged shard reads on the GET window path: when a drive blows "
        "the latency budget, parity reads race the straggler and the GET "
-       "decodes around it; 0 disables."),
+       "decodes around it. The same budget covers the repair plane "
+       "(degraded GET / heal partial-repair plans), where the hedge is "
+       "the generic full gather racing the sub-chunk plan per block; "
+       "0 disables both."),
     _k("MINIO_TPU_HEDGE_MIN_MS", "50", "fault",
        "Floor of the hedged-read straggler budget (a cold or fast "
        "cluster must not hedge on noise)."),

@@ -91,6 +91,15 @@ def family_stats_snapshot() -> dict[str, dict[str, int]]:
     return out
 
 
+def decode_matrix_cache_snapshot() -> dict:
+    """Per-family decode-matrix LRU hit/miss counters + entry count
+    (ops/decode_cache) — the /api/tpu series that make pattern-churn
+    storms diagnosable from a scrape."""
+    from ..ops import decode_cache
+
+    return decode_cache.snapshot()
+
+
 def encode_blocks_numpy(
     np_codec, blocks: np.ndarray, family: str = FAMILY_RS
 ) -> tuple[np.ndarray, np.ndarray]:

@@ -14,7 +14,7 @@ import hashlib
 import os
 import threading
 import uuid
-from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor
+from concurrent.futures import ALL_COMPLETED, FIRST_COMPLETED, ThreadPoolExecutor
 from concurrent.futures import wait as _fut_wait
 from typing import Callable, Iterator
 
@@ -120,6 +120,14 @@ def _native_plane_enabled(device_active: bool = False) -> bool:
     from .. import native
 
     return native.dataplane_available()
+
+def _repair_windowed_enabled() -> bool:
+    """MINIO_TPU_REPAIR_WINDOWED gates the windowed + hedged execution of
+    partial-repair plans (degraded GET and heal). "0" keeps the original
+    block-serial executor — the A/B baseline the BENCH_r12 wall-clock
+    gate measures against; correctness is identical either way."""
+    return os.environ.get("MINIO_TPU_REPAIR_WINDOWED", "1") != "0"
+
 
 # shared shard-read pool: per-block shard reads of ALL in-flight GETs fan
 # out here (the reference spawns per-shard goroutines; a bounded pool is
@@ -1253,7 +1261,8 @@ class ErasureSet:
                         if fired:
                             fault_registry.stats_add("hedge_reads")
                             fault_registry.emit(
-                                "hedge.fire", bucket=bucket, object=obj,
+                                "hedge.fire", plane="read",
+                                bucket=bucket, object=obj,
                                 budgetMs=round((hedge_budget or 0.0) * 1e3, 1),
                                 reads=fired,
                             )
@@ -1329,8 +1338,12 @@ class ErasureSet:
                     out[bi] = b"".join(shards[i] for i in range(d))
             return out  # type: ignore[return-value]
 
-        # ---- repair-plan execution: sub-chunk reads, block by block ----
-        if repair_sched is not None:
+        # ---- repair-plan execution: block-serial baseline --------------
+        # (MINIO_TPU_REPAIR_WINDOWED=0: one block's sub-chunk reads at a
+        # time, any failure abandons the rest of the plan to the generic
+        # gather — kept as the A/B lever the windowed executor's
+        # wall-clock gate measures against)
+        if repair_sched is not None and not _repair_windowed_enabled():
             rest = None
             for k, (pnum, per, f_off, lo, hi) in enumerate(plan):
                 try:
@@ -1349,6 +1362,301 @@ class ErasureSet:
             if rest is None:
                 return
             plan = rest
+            repair_sched = None
+
+        # ---- repair-plan execution: windowed sub-chunk pipeline --------
+        # The same shape as the healthy path below: a window's sub-chunk
+        # frame reads issue concurrently, the next window's reads start
+        # as readahead while the current one decodes, and the hedged-read
+        # policy covers the plan — except that for sub-chunk reads the
+        # hedged alternative is the generic full-frame gather for that
+        # block. A blown budget races it; a mid-read breaker trip
+        # (DiskNotFound/DiskFull), bitrot, or second fault degrades to it
+        # outright — for that block ONLY. The plan is never abandoned,
+        # and every fallback byte re-verifies its frame digest like any
+        # generic read, so wrong bytes cannot be served.
+        if repair_sched is not None:
+            i_m = repair_sched.missing
+            SPILL = (errors.FileCorrupt, errors.FileNotFound,
+                     errors.DiskNotFound, errors.DiskFull,
+                     errors.VolumeNotFound, OSError)
+
+            def repair_frames(per, lo, hi):
+                """One block's plan read set: (full-frame shard indices,
+                sub-chunk rows, data rows the range needs)."""
+                lo_sh, hi_sh = lo // per, (hi - 1) // per
+                needed = list(range(lo_sh, min(hi_sh, d - 1) + 1))
+                full_idx = set(i for i in needed if i != i_m)
+                subs: list[int] = []
+                if i_m in needed:
+                    # mates need BOTH sub-chunks: one contiguous frame-
+                    # group read each (same bytes, half the round-trips)
+                    full_idx.update(repair_sched.mates)
+                    subs = [r for r in repair_sched.b_helpers
+                            if r not in full_idx]
+                    subs.append(repair_sched.pb_parity)
+                return full_idx, subs, needed
+
+            def start_repair_window(win):
+                """Submit every block's plan reads for the window."""
+                futs = {}
+                for bi, (pnum, per, f_off, lo, hi) in enumerate(win):
+                    full_idx, subs, _needed = repair_frames(per, lo, hi)
+                    for idx in full_idx:
+                        futs[(bi, "full", idx)] = pool.submit(
+                            read_shard_block, pnum, idx, per, f_off
+                        )
+                    for r in subs:
+                        futs[(bi, "sub", r)] = pool.submit(
+                            read_sub_chunk, pnum, r, per, f_off, 1
+                        )
+                return futs
+
+            def assemble_repair(entry, full, subs) -> bytes:
+                """Plan-complete block -> its [lo, hi) bytes (the compute
+                half of repair_read_block; reads already resolved)."""
+                pnum, per, f_off, lo, hi = entry
+                _full_idx, _subs, needed = repair_frames(per, lo, hi)
+                got = {i: np.frombuffer(v, dtype=np.uint8)
+                       for i, v in full.items()}
+                if i_m in needed:
+                    ingress = len(got) * (fdig + per)
+                    h1, h2 = bitrot_io.sub_lens(per)
+                    sub2 = {}
+                    for r in repair_sched.b_helpers:
+                        if r in got:
+                            sub2[r] = got[r][h1:]
+                        else:
+                            sub2[r] = subs[r]
+                            ingress += DIGEST + h2
+                    pb = subs[repair_sched.pb_parity]
+                    ingress += DIGEST + h2
+                    sub1 = {r: got[r][:h1] for r in repair_sched.mates}
+                    got[i_m] = coder.repair_data_shard(
+                        repair_sched, per, sub2, pb, sub1
+                    )
+                    family_stats_add(family, "degraded_ingress_bytes", ingress)
+                out = b"".join(got[i].tobytes() for i in needed)
+                lo_sh = lo // per
+                return out[lo - lo_sh * per : hi - lo_sh * per]
+
+            def gather_repair_window(win, futs):
+                """Resolve a window of plan blocks. Each block is its own
+                race: the sub-chunk read set vs (once hedged or failed)
+                the generic d-shard full gather — whichever completes
+                first serves the block. Returns (pieces, full, subs):
+                pieces[bi] is fallback-decoded bytes, or None meaning the
+                plan reads landed and assembly is deferred (it runs under
+                the next window's readahead)."""
+                nwin = len(win)
+                full = [dict() for _ in range(nwin)]    # bi -> idx: bytes
+                subs = [dict() for _ in range(nwin)]    # bi -> row: array
+                fb_got = [dict() for _ in range(nwin)]  # fallback frames
+                fb_mode = [False] * nwin
+                fb_hedge = [False] * nwin
+                plan_done = [False] * nwin
+                pieces: list[bytes | None] = [None] * nwin
+                pending: dict[tuple, object] = dict(futs)
+                rev = {f: k for k, f in pending.items()}
+                plan_keys: list[set] = [set() for _ in range(nwin)]
+                for k in futs:
+                    plan_keys[k[0]].add(k)
+                hedge_fired = False
+                import time as _time
+
+                deadline = (
+                    _time.monotonic() + hedge_budget
+                    if hedge_budget is not None else None
+                )
+
+                def unserved(bi):
+                    return pieces[bi] is None and not plan_done[bi]
+
+                def drop_plan_reads(bi):
+                    for k in list(plan_keys[bi]):
+                        f = pending.pop(k, None)
+                        if f is not None:
+                            rev.pop(f, None)
+                            f.cancel()
+                    plan_keys[bi].clear()
+
+                def drop_fb_reads(bi):
+                    for k in [k for k in pending
+                              if k[0] == bi and k[1] == "fb"]:
+                        f = pending.pop(k)
+                        rev.pop(f, None)
+                        f.cancel()
+
+                def fb_submit(bi) -> int:
+                    """Keep fallback block bi able to reach d shards."""
+                    pnum, per, f_off, _lo, _hi = win[bi]
+                    inflight = [k[2] for k in pending
+                                if k[0] == bi and k[1] == "fb"]
+                    have = len(fb_got[bi]) + len(inflight)
+                    tried = set(fb_got[bi]) | bad | set(inflight)
+                    cands = [i for i in range(self.n)
+                             if i in sources and i not in tried]
+                    n_sub = 0
+                    for idx in cands[: max(d - have, 0)]:
+                        f = pool.submit(read_shard_block, pnum, idx, per, f_off)
+                        pending[(bi, "fb", idx)] = f
+                        rev[f] = (bi, "fb", idx)
+                        n_sub += 1
+                    return n_sub
+
+                def enter_fallback(bi, racing) -> int:
+                    """Degrade block bi to the generic gather. ``racing``
+                    (hedge) leaves the plan reads inflight to race; a
+                    failed plan read drops them instead."""
+                    if fb_mode[bi]:
+                        return 0
+                    fb_mode[bi] = True
+                    fb_hedge[bi] = racing
+                    if not racing:
+                        drop_plan_reads(bi)
+                    return fb_submit(bi)
+
+                def finish_plan(bi):
+                    """All plan reads landed: settle the race; assembly
+                    is deferred to the caller (under readahead)."""
+                    plan_done[bi] = True
+                    if fb_mode[bi]:
+                        if fb_hedge[bi]:
+                            fault_registry.stats_add("repair_hedge_losses")
+                        drop_fb_reads(bi)
+
+                def finish_fallback(bi):
+                    if not unserved(bi) or len(fb_got[bi]) < d:
+                        return
+                    block = decode_window([win[bi]], [fb_got[bi]])[0]
+                    _pnum, _per, _f_off, lo, hi = win[bi]
+                    pieces[bi] = block[lo:hi]
+                    fault_registry.stats_add("repair_fallback_blocks")
+                    if fb_hedge[bi]:
+                        fault_registry.stats_add("repair_hedge_wins")
+                    drop_plan_reads(bi)
+
+                try:
+                    while any(unserved(bi) for bi in range(nwin)):
+                        # fallback blocks must stay able to reach d
+                        for bi in range(nwin):
+                            if not (unserved(bi) and fb_mode[bi]):
+                                continue
+                            inflight = sum(
+                                1 for k in pending
+                                if k[0] == bi and k[1] == "fb"
+                            )
+                            if len(fb_got[bi]) + inflight < d:
+                                if (fb_submit(bi) == 0 and inflight == 0
+                                        and not plan_keys[bi]):
+                                    pnum, _per, f_off, _lo, _hi = win[bi]
+                                    raise QuorumError(
+                                        f"cannot read part {pnum} shard "
+                                        f"offset {f_off}: only "
+                                        f"{len(fb_got[bi])} of {d} shards"
+                                    )
+                        if not pending:
+                            continue  # spills just submitted; re-check
+                        timeout = None
+                        if deadline is not None and not hedge_fired:
+                            timeout = max(deadline - _time.monotonic(), 0.0)
+                        # plan-only mode needs every read anyway: one
+                        # ALL_COMPLETED wait registers each future once.
+                        # Once any block races its fallback, settle per
+                        # completion (FIRST_COMPLETED) — whichever side
+                        # lands first serves without waiting on the loser.
+                        racing = hedge_fired or any(fb_mode)
+                        done, _ = _fut_wait(
+                            set(pending.values()), timeout=timeout,
+                            return_when=(
+                                FIRST_COMPLETED if racing else ALL_COMPLETED
+                            ),
+                        )
+                        if not done:
+                            # plan reads blew the hedge budget: race the
+                            # generic full gather for every unserved block
+                            hedge_fired = True
+                            fired = sum(
+                                enter_fallback(bi, True)
+                                for bi in range(nwin) if unserved(bi)
+                            )
+                            if fired:
+                                fault_registry.stats_add("repair_hedge_reads")
+                                fault_registry.emit(
+                                    "hedge.fire", plane="repair",
+                                    bucket=bucket, object=obj,
+                                    budgetMs=round(
+                                        (hedge_budget or 0.0) * 1e3, 1
+                                    ),
+                                    reads=fired,
+                                )
+                            else:
+                                deadline = None  # nothing left to hedge
+                            continue
+                        for f in done:
+                            key = rev.pop(f, None)
+                            if key is None:
+                                continue  # read dropped after its race
+                            pending.pop(key, None)
+                            bi, kind = key[0], key[1]
+                            if kind == "fb":
+                                try:
+                                    fb_got[bi][key[2]] = f.result()
+                                except SPILL:
+                                    bad.add(key[2])
+                                    report_degraded()
+                                else:
+                                    finish_fallback(bi)
+                                continue
+                            plan_keys[bi].discard(key)
+                            try:
+                                if kind == "full":
+                                    full[bi][key[2]] = f.result()
+                                else:
+                                    subs[bi][key[2]] = f.result()
+                            except SPILL:
+                                # mid-plan breaker trip / bitrot / second
+                                # fault: THIS block degrades to the
+                                # generic gather; sibling blocks keep
+                                # their plan reads
+                                if not unserved(bi):
+                                    continue
+                                if fb_mode[bi]:
+                                    # already racing: the plan just lost
+                                    # its own race; the gather carries on
+                                    drop_plan_reads(bi)
+                                else:
+                                    enter_fallback(bi, False)
+                            else:
+                                if unserved(bi) and not plan_keys[bi]:
+                                    finish_plan(bi)
+                finally:
+                    for f in pending.values():
+                        f.cancel()
+                return pieces, full, subs
+
+            r_windows = [
+                plan[i : i + window] for i in range(0, len(plan), window)
+            ]
+            r_futs = start_repair_window(r_windows[0]) if r_windows else {}
+            try:
+                for wi, win in enumerate(r_windows):
+                    pieces, r_full, r_subs = gather_repair_window(win, r_futs)
+                    r_futs = {}
+                    if wi + 1 < len(r_windows):
+                        r_futs = start_repair_window(r_windows[wi + 1])
+                    for bi in range(len(win)):
+                        if pieces[bi] is None:
+                            # plan-complete blocks decode here, under the
+                            # next window's readahead
+                            pieces[bi] = assemble_repair(
+                                win[bi], r_full[bi], r_subs[bi]
+                            )
+                        yield pieces[bi]
+            finally:
+                for f in r_futs.values():
+                    f.cancel()
+            return
 
         # ---- pipelined execution: window k+1 reads under window k decode ----
         windows = [plan[i : i + window] for i in range(0, len(plan), window)]
@@ -1723,24 +2031,37 @@ class ErasureSet:
         missing_idx = tuple(sorted(idx for idx, _ in stale))
 
         heal_whole_cache: dict[tuple[int, int], bytes] = {}
+        heal_whole_mu = threading.Lock()
         # survivor bytes moved into this heal (the repair-bandwidth
-        # number: metrics minio_heal_ingress_bytes_total, heal span)
+        # number: metrics minio_heal_ingress_bytes_total, heal span).
+        # The windowed repair executor fans reads onto the shared pool,
+        # so the accumulator takes a lock.
         ingress = 0
+        ingress_mu = threading.Lock()
+
+        def ingress_add(n: int) -> None:
+            nonlocal ingress
+            with ingress_mu:
+                ingress += n
 
         def read_block(part, idx, f_off, per):
-            nonlocal ingress
             disk, m = good[idx]
             wf = _whole_file_hash(m, part.number)
             if wf is not None:  # legacy whole-file survivor
                 k = (idx, part.number)
-                if k not in heal_whole_cache:  # heal reads single-threaded
-                    raw = m.inline_data if m.inline_data else disk.read_file(
-                        bucket, f"{obj}/{fi.data_dir}/part.{part.number}", 0, -1
-                    )
-                    ingress += len(raw)
-                    heal_whole_cache[k] = bitrot_io.verify_whole_file(
-                        bytes(raw), *wf
-                    )
+                # coarse lock: legacy survivors are rare and the whole-
+                # file read+verify must happen once, not once per racing
+                # windowed block
+                with heal_whole_mu:
+                    if k not in heal_whole_cache:
+                        raw = m.inline_data if m.inline_data else disk.read_file(
+                            bucket, f"{obj}/{fi.data_dir}/part.{part.number}",
+                            0, -1,
+                        )
+                        ingress_add(len(raw))
+                        heal_whole_cache[k] = bitrot_io.verify_whole_file(
+                            bytes(raw), *wf
+                        )
                 block_i = f_off // (fdig + coder.shard_size)
                 blk = heal_whole_cache[k][block_i * coder.shard_size:][:per]
                 if len(blk) != per:
@@ -1753,12 +2074,11 @@ class ErasureSet:
                     bucket, f"{obj}/{fi.data_dir}/part.{part.number}",
                     f_off, fdig + per,
                 )
-            ingress += len(buf)
+            ingress_add(len(buf))
             return bitrot_io.verify_block(buf, per, family=family)
 
         def read_sub(part, idx, f_off, per, which):
             """Sub-chunk frame read from a survivor (partial repair)."""
-            nonlocal ingress
             disk, m = good[idx]
             rel, dlen = bitrot_io.sub_chunk_in_block(per, which)
             off = f_off + rel
@@ -1769,7 +2089,7 @@ class ErasureSet:
                     bucket, f"{obj}/{fi.data_dir}/part.{part.number}",
                     off, DIGEST + dlen,
                 )
-            ingress += len(buf)
+            ingress_add(len(buf))
             return np.frombuffer(
                 bitrot_io.verify_sub_chunk(bytes(buf), dlen), dtype=np.uint8
             )
@@ -1794,6 +2114,271 @@ class ErasureSet:
             if sched is not None and all(h in good for h in sched.helpers):
                 repair_sched = sched
 
+        def repair_part_windowed(part, geometry) -> bytearray:
+            """Windowed + hedged partial repair of one part's lost shard
+            (the heal twin of the degraded-GET plan executor): a window
+            of blocks' sub-chunk reads issues concurrently on the shard-
+            read pool, the next window starts as readahead while the
+            current one frames (hash + emit), and a straggling or failed
+            helper degrades THAT block to a generic survivor rebuild —
+            racing it as the hedge when the EWMA budget blows. Raises
+            only when a block can neither repair nor rebuild from the
+            verified survivor set (the caller then falls back to the
+            generic whole-part path). Returns the lost shard's framed
+            bytes for the whole part, in block order."""
+            sched = repair_sched
+            s_idx = sched.missing
+            pool = _read_pool()
+            window = max(1, int(os.environ.get("MINIO_TPU_READ_WINDOW", "8")))
+            hedge_budget = self._hedge_budget_s()
+            SPILL = (StorageError, OSError)
+
+            def start_win(blocks):
+                """Submit one window's plan reads: mates as full frame
+                groups (they need both sub-chunks), the remaining
+                b_helpers + piggyback parity as sub-chunk-2 frames."""
+                futs = {}
+                for bi, (block_i, per) in enumerate(blocks):
+                    f_off = bitrot_io.block_offset(
+                        coder.shard_size, block_i, family
+                    )
+                    for r in sched.mates:
+                        futs[(bi, "full", r)] = pool.submit(
+                            read_block, part, r, f_off, per
+                        )
+                    for r in sched.b_helpers:
+                        if r not in sched.mates:
+                            futs[(bi, "sub", r)] = pool.submit(
+                                read_sub, part, r, f_off, per, 1
+                            )
+                    futs[(bi, "sub", sched.pb_parity)] = pool.submit(
+                        read_sub, part, sched.pb_parity, f_off, per, 1
+                    )
+                return futs
+
+            def assemble(blocks, bi, fullm, subm) -> np.ndarray:
+                _block_i, per = blocks[bi]
+                h1m, _h2m = bitrot_io.sub_lens(per)
+                mate_full = {
+                    r: np.frombuffer(fullm[bi][r], dtype=np.uint8)
+                    for r in sched.mates
+                }
+                sub2 = {
+                    r: (mate_full[r][h1m:] if r in mate_full else subm[bi][r])
+                    for r in sched.b_helpers
+                }
+                pb = subm[bi][sched.pb_parity]
+                sub1 = {r: v[:h1m] for r, v in mate_full.items()}
+                return coder.repair_data_shard(sched, per, sub2, pb, sub1)
+
+            def gather_win(blocks, futs):
+                """Resolve one window; every block races its plan reads
+                against (once hedged or failed) a generic survivor
+                rebuild. Returns the rebuilt shard per block."""
+                nb = len(blocks)
+                fullm = [dict() for _ in range(nb)]
+                subm = [dict() for _ in range(nb)]
+                fb_got = [dict() for _ in range(nb)]
+                fb_bad: set[int] = set()  # shards whose fb read failed
+                fb_mode = [False] * nb
+                fb_hedge = [False] * nb
+                shards: list[np.ndarray | None] = [None] * nb
+                plan_keys: list[set] = [set() for _ in range(nb)]
+                pending: dict[tuple, object] = dict(futs)
+                rev = {f: k for k, f in pending.items()}
+                for k in futs:
+                    plan_keys[k[0]].add(k)
+                last_err: BaseException | None = None
+                hedge_fired = False
+                import time as _time
+
+                deadline = (
+                    _time.monotonic() + hedge_budget
+                    if hedge_budget is not None else None
+                )
+
+                def drop_plan(bi):
+                    for k in list(plan_keys[bi]):
+                        f = pending.pop(k, None)
+                        if f is not None:
+                            rev.pop(f, None)
+                            f.cancel()
+                    plan_keys[bi].clear()
+
+                def drop_fb(bi):
+                    for k in [k for k in pending
+                              if k[0] == bi and k[1] == "fb"]:
+                        f = pending.pop(k)
+                        rev.pop(f, None)
+                        f.cancel()
+
+                def fb_submit(bi) -> int:
+                    block_i, per = blocks[bi]
+                    f_off = bitrot_io.block_offset(
+                        coder.shard_size, block_i, family
+                    )
+                    inflight = [k[2] for k in pending
+                                if k[0] == bi and k[1] == "fb"]
+                    have = len(fb_got[bi]) + len(inflight)
+                    tried = set(fb_got[bi]) | set(inflight) | fb_bad
+                    cands = [i for i in sorted(good) if i not in tried]
+                    n_sub = 0
+                    for idx in cands[: max(d - have, 0)]:
+                        f = pool.submit(read_block, part, idx, f_off, per)
+                        pending[(bi, "fb", idx)] = f
+                        rev[f] = (bi, "fb", idx)
+                        n_sub += 1
+                    return n_sub
+
+                def enter_fb(bi, racing) -> int:
+                    if fb_mode[bi]:
+                        return 0
+                    fb_mode[bi] = True
+                    fb_hedge[bi] = racing
+                    if not racing:
+                        drop_plan(bi)
+                    return fb_submit(bi)
+
+                def finish_plan(bi):
+                    shards[bi] = assemble(blocks, bi, fullm, subm)
+                    if fb_mode[bi]:
+                        if fb_hedge[bi]:
+                            fault_registry.stats_add("repair_hedge_losses")
+                        drop_fb(bi)
+
+                def finish_fb(bi):
+                    if shards[bi] is not None or len(fb_got[bi]) < d:
+                        return
+                    got = {
+                        i: np.frombuffer(v, dtype=np.uint8)
+                        for i, v in fb_got[bi].items()
+                    }
+                    rec = coder.reconstruct_block(got, blocks[bi][1])
+                    shards[bi] = rec[s_idx]
+                    fault_registry.stats_add("repair_fallback_blocks")
+                    if fb_hedge[bi]:
+                        fault_registry.stats_add("repair_hedge_wins")
+                    drop_plan(bi)
+
+                try:
+                    while any(s is None for s in shards):
+                        for bi in range(nb):
+                            if shards[bi] is not None or not fb_mode[bi]:
+                                continue
+                            inflight = sum(
+                                1 for k in pending
+                                if k[0] == bi and k[1] == "fb"
+                            )
+                            if len(fb_got[bi]) + inflight < d:
+                                if (fb_submit(bi) == 0 and inflight == 0
+                                        and not plan_keys[bi]):
+                                    # neither path can complete: the
+                                    # caller rebuilds this part the
+                                    # generic way
+                                    raise last_err or errors.FileCorrupt(
+                                        "repair fallback lost quorum"
+                                    )
+                        if not pending:
+                            continue
+                        timeout = None
+                        if deadline is not None and not hedge_fired:
+                            timeout = max(deadline - _time.monotonic(), 0.0)
+                        # plan-only mode needs every read anyway: one
+                        # ALL_COMPLETED wait registers each future once.
+                        # Once any block races its fallback, settle per
+                        # completion (FIRST_COMPLETED) — whichever side
+                        # lands first serves without waiting on the loser.
+                        racing = hedge_fired or any(fb_mode)
+                        done, _ = _fut_wait(
+                            set(pending.values()), timeout=timeout,
+                            return_when=(
+                                FIRST_COMPLETED if racing else ALL_COMPLETED
+                            ),
+                        )
+                        if not done:
+                            hedge_fired = True
+                            fired = sum(
+                                enter_fb(bi, True)
+                                for bi in range(nb) if shards[bi] is None
+                            )
+                            if fired:
+                                fault_registry.stats_add("repair_hedge_reads")
+                                fault_registry.emit(
+                                    "hedge.fire", plane="repair", op="heal",
+                                    bucket=bucket, object=obj,
+                                    budgetMs=round(
+                                        (hedge_budget or 0.0) * 1e3, 1
+                                    ),
+                                    reads=fired,
+                                )
+                            else:
+                                deadline = None
+                            continue
+                        for f in done:
+                            key = rev.pop(f, None)
+                            if key is None:
+                                continue
+                            pending.pop(key, None)
+                            bi, kind = key[0], key[1]
+                            if kind == "fb":
+                                try:
+                                    fb_got[bi][key[2]] = f.result()
+                                except SPILL as e:
+                                    # a failed fallback shard must never
+                                    # be re-picked (a persistently
+                                    # corrupt helper would loop forever)
+                                    last_err = e
+                                    fb_bad.add(key[2])
+                                else:
+                                    finish_fb(bi)
+                                continue
+                            plan_keys[bi].discard(key)
+                            try:
+                                if kind == "full":
+                                    fullm[bi][key[2]] = f.result()
+                                else:
+                                    subm[bi][key[2]] = f.result()
+                            except SPILL as e:
+                                last_err = e
+                                if shards[bi] is not None:
+                                    continue
+                                if fb_mode[bi]:
+                                    drop_plan(bi)  # plan lost its race
+                                else:
+                                    enter_fb(bi, False)
+                            else:
+                                if shards[bi] is None and not plan_keys[bi]:
+                                    finish_plan(bi)
+                finally:
+                    for f in pending.values():
+                        f.cancel()
+                return shards
+
+            out = bytearray()
+            blocks_all = [
+                (block_i, per)
+                for block_i, (_data_len, per) in enumerate(geometry)
+            ]
+            wins = [
+                blocks_all[i : i + window]
+                for i in range(0, len(blocks_all), window)
+            ]
+            futs = start_win(wins[0]) if wins else {}
+            try:
+                for wi, blocks in enumerate(wins):
+                    shards = gather_win(blocks, futs)
+                    futs = {}
+                    if wi + 1 < len(wins):
+                        futs = start_win(wins[wi + 1])  # readahead
+                    for blk in shards:
+                        # framing (bitrot hash + emit) runs under the
+                        # next window's readahead
+                        out += bitrot_io.frame_block(blk.tobytes(), family)
+            finally:
+                for f in futs.values():
+                    f.cancel()
+            return out
+
         for part in fi.parts:
             geometry = coder.shard_sizes_for(part.size)
             rebuilt: dict[int, bytearray] = {idx: bytearray() for idx, _ in stale}
@@ -1815,44 +2400,57 @@ class ErasureSet:
             if repair_sched is not None:
                 s_idx = repair_sched.missing
                 try:
-                    for block_i, (data_len, per) in enumerate(geometry):
-                        f_off = bitrot_io.block_offset(
-                            coder.shard_size, block_i, family
+                    if _repair_windowed_enabled():
+                        # windowed + hedged executor: straggling/failed
+                        # helpers degrade per BLOCK to a generic survivor
+                        # rebuild inside repair_part_windowed; only a
+                        # block that can do neither lands here
+                        rebuilt[s_idx] += repair_part_windowed(
+                            part, geometry
                         )
-                        # group mates need BOTH sub-chunks (every mate is
-                        # a b_helper): one full frame-group read each —
-                        # same bytes as two sub-chunk reads, half the ops
-                        h1m, _h2m = bitrot_io.sub_lens(per)
-                        mate_full = {
-                            r: np.frombuffer(
-                                read_block(part, r, f_off, per),
-                                dtype=np.uint8,
+                    else:
+                        # block-serial baseline
+                        # (MINIO_TPU_REPAIR_WINDOWED=0)
+                        for block_i, (data_len, per) in enumerate(geometry):
+                            f_off = bitrot_io.block_offset(
+                                coder.shard_size, block_i, family
                             )
-                            for r in repair_sched.mates
-                        }
-                        sub2 = {
-                            r: (
-                                mate_full[r][h1m:] if r in mate_full
-                                else read_sub(part, r, f_off, per, 1)
+                            # group mates need BOTH sub-chunks (every
+                            # mate is a b_helper): one full frame-group
+                            # read each — same bytes as two sub-chunk
+                            # reads, half the ops
+                            h1m, _h2m = bitrot_io.sub_lens(per)
+                            mate_full = {
+                                r: np.frombuffer(
+                                    read_block(part, r, f_off, per),
+                                    dtype=np.uint8,
+                                )
+                                for r in repair_sched.mates
+                            }
+                            sub2 = {
+                                r: (
+                                    mate_full[r][h1m:] if r in mate_full
+                                    else read_sub(part, r, f_off, per, 1)
+                                )
+                                for r in repair_sched.b_helpers
+                            }
+                            pb = read_sub(
+                                part, repair_sched.pb_parity, f_off, per, 1
                             )
-                            for r in repair_sched.b_helpers
-                        }
-                        pb = read_sub(
-                            part, repair_sched.pb_parity, f_off, per, 1
-                        )
-                        sub1 = {r: v[:h1m] for r, v in mate_full.items()}
-                        blk = coder.repair_data_shard(
-                            repair_sched, per, sub2, pb, sub1
-                        )
-                        rebuilt[s_idx] += bitrot_io.frame_block(
-                            blk.tobytes(), family
-                        )
+                            sub1 = {r: v[:h1m] for r, v in mate_full.items()}
+                            blk = coder.repair_data_shard(
+                                repair_sched, per, sub2, pb, sub1
+                            )
+                            rebuilt[s_idx] += bitrot_io.frame_block(
+                                blk.tobytes(), family
+                            )
                     per_part_rebuilt[part.number] = rebuilt
                     continue
                 except (StorageError, OSError):
-                    # helper failed mid-repair: rebuild THIS part the
-                    # generic way (and stop trying the shortcut — the
-                    # helper set just proved unreliable)
+                    # helper failed mid-repair AND the per-block fallback
+                    # lost quorum: rebuild THIS part the generic way (and
+                    # stop trying the shortcut — the survivor set just
+                    # proved unreliable)
                     repair_sched = None
                     rebuilt = {idx: bytearray() for idx, _ in stale}
             if use_device:

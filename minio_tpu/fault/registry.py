@@ -62,10 +62,16 @@ _rules: dict[int, "FaultRule"] = {}
 _ids = itertools.count(1)
 
 # robustness-plane counters (metrics v3 /api/fault): injection hits per
-# boundary plus the hedged-read outcome counters fed by erasure/set.py
+# boundary plus the hedged-read outcome counters fed by erasure/set.py.
+# The plain hedge_* triple is the healthy GET window path; the repair_*
+# variants are the partial-repair plane (degraded GET + heal), where the
+# hedge is the generic full-frame gather racing the sub-chunk plan and
+# repair_fallback_blocks counts blocks ultimately served by that gather.
 COUNTERS = {
     "storage": 0, "network": 0, "tpu": 0, "topology": 0, "diag": 0,
     "hedge_reads": 0, "hedge_wins": 0, "hedge_losses": 0,
+    "repair_hedge_reads": 0, "repair_hedge_wins": 0,
+    "repair_hedge_losses": 0, "repair_fallback_blocks": 0,
     "latency_trips": 0,
 }
 

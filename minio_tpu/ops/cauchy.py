@@ -49,7 +49,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
-from . import gf
+from . import decode_cache, gf
 
 FAMILY = "cauchy"
 SUB_CHUNKS = 2  # sub-packetization: sub-chunks per shard block
@@ -235,10 +235,17 @@ class CauchyPiggyback:
 
     def _decode_matrix(self, rows: list[int]) -> np.ndarray:
         """[d, d] inverse mapping the survivor values at ``rows`` (pure,
-        per instance) back to the d data values."""
+        per instance) back to the d data values. Per-pattern inverses go
+        through the shared decode-matrix LRU (ops/decode_cache) so a
+        failure storm with churning patterns pays `gf_mat_inv` once per
+        pattern, not once per block. Read-only by contract."""
         if len(rows) < self.data_shards:
             raise ValueError("need at least data_shards surviving shards")
-        return gf.gf_mat_inv(self.matrix[rows[: self.data_shards], :])
+        key = tuple(rows[: self.data_shards])
+        return decode_cache.get(
+            "cauchy", self.data_shards, self.parity_shards, key,
+            lambda: gf.gf_mat_inv(self.matrix[list(key), :]),
+        )
 
     def _pure_b(self, rows: list[int], bvals: np.ndarray, a: np.ndarray) -> np.ndarray:
         """Subtract the piggyback pollution from survivor b-instance rows.

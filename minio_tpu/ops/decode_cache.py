@@ -1,0 +1,99 @@
+"""Decode-matrix LRU shared by the erasure code families.
+
+Reconstructing a block from a given survivor pattern needs a GF(2^8)
+matrix inverse (and, for RS parity rebuilds, a composition on top of
+it). The inverse depends only on (family, d, p, survivor/missing
+pattern) — not on the data — yet a drive-failure storm with churning
+patterns was paying `gf_mat_inv` per *pattern switch* on every decode
+call site (ops/cauchy `_decode_matrix`, ops/rs `decode_matrix_for` /
+`reconstruct_rows_for`). The efficient-decoding line (arXiv:0901.1886,
+arXiv:1312.5155) treats decode-matrix setup as amortizable state; this
+module is the amortization: a bounded LRU keyed by the full pattern
+tuple, with per-family hit/miss counters surfaced on ``/api/tpu``
+(``minio_tpu_decode_matrix_cache_total{family,result}``).
+
+Capacity: ``MINIO_TPU_DECODE_MATRIX_CACHE`` entries (default 256; at
+EC 8+8 a single-failure churn needs 16, a double-failure storm ~120 —
+256 holds both with headroom). ``0`` disables caching entirely (every
+lookup builds, nothing is counted) so A/B runs can price the cache.
+
+Cached matrices are handed out by reference and MUST be treated as
+read-only by callers — every consumer feeds them straight into
+``gf_matvec_blocks``/``gf_apply``, which do not mutate their inputs.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+from typing import Callable
+
+import numpy as np
+
+_LOCK = threading.Lock()
+_CACHE: "OrderedDict[tuple, np.ndarray]" = OrderedDict()
+# family -> {"hits": n, "misses": n}; families appear on first lookup
+_STATS: dict[str, dict[str, int]] = {}
+
+
+def capacity() -> int:
+    try:
+        return int(os.environ.get("MINIO_TPU_DECODE_MATRIX_CACHE", "256"))
+    except ValueError:
+        return 256
+
+
+def get(
+    family: str,
+    d: int,
+    p: int,
+    pattern: tuple,
+    build: Callable[[], np.ndarray],
+) -> np.ndarray:
+    """The matrix for ``(family, d, p, pattern)``, building on miss.
+
+    ``pattern`` is any hashable encoding of the failure pattern the
+    matrix depends on (survivor rows, or (present, missing) for the
+    composed RS rows). ``build`` runs outside the lock: two threads
+    racing the same cold pattern may both build, last write wins —
+    harmless, the matrices are identical.
+    """
+    cap = capacity()
+    if cap <= 0:
+        return build()
+    key = (family, d, p, pattern)
+    with _LOCK:
+        st = _STATS.setdefault(family, {"hits": 0, "misses": 0})
+        mat = _CACHE.get(key)
+        if mat is not None:
+            st["hits"] += 1
+            _CACHE.move_to_end(key)
+            return mat
+        st["misses"] += 1
+    mat = build()
+    with _LOCK:
+        _CACHE[key] = mat
+        _CACHE.move_to_end(key)
+        while len(_CACHE) > cap:
+            _CACHE.popitem(last=False)
+    return mat
+
+
+def snapshot() -> dict:
+    """{"entries": n, "families": {family: {"hits", "misses"}}} — the
+    /api/tpu scrape shape. Families that never decoded report zeros so
+    the series exist from boot (gate harnesses reject vacuous scrapes)."""
+    with _LOCK:
+        fams = {f: dict(st) for f, st in _STATS.items()}
+        entries = len(_CACHE)
+    for f in ("reedsolomon", "cauchy"):
+        fams.setdefault(f, {"hits": 0, "misses": 0})
+    return {"entries": entries, "families": fams}
+
+
+def clear() -> None:
+    """Drop entries and counters (tests)."""
+    with _LOCK:
+        _CACHE.clear()
+        _STATS.clear()

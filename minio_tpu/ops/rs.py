@@ -17,7 +17,7 @@ import functools
 
 import numpy as np
 
-from . import gf
+from . import decode_cache, gf
 
 
 def build_matrix(data_shards: int, total_shards: int) -> np.ndarray:
@@ -105,8 +105,11 @@ class ReedSolomon:
         rows = present[: self.data_shards]
         if len(rows) < self.data_shards:
             raise ValueError("need at least data_shards surviving shards")
-        sub = self.matrix[rows, :]
-        return gf.gf_mat_inv(sub)
+        key = tuple(rows)
+        return decode_cache.get(
+            "reedsolomon", self.data_shards, self.parity_shards, key,
+            lambda: gf.gf_mat_inv(self.matrix[list(key), :]),
+        )
 
     def reconstruct_rows_for(
         self, present: list[int], missing: list[int]
@@ -115,20 +118,30 @@ class ReedSolomon:
 
         Missing data shard i uses row i of the decode inverse; missing
         parity shard i composes its parity row with the inverse. Shared by
-        the numpy, native, and bit-plane (rs_jax) reconstruct paths.
+        the numpy, native, and bit-plane (rs_jax) reconstruct paths. The
+        composed rows are per-(present, missing)-pattern constants, so
+        they ride the decode-matrix LRU alongside the inverse itself.
         """
         from . import gf
 
-        dec = self.decode_matrix_for(present)
-        rows = []
-        for i in missing:
-            if i < self.data_shards:
-                rows.append(dec[i])
-            else:
-                rows.append(
-                    gf.gf_matmul(self.parity_matrix[i - self.data_shards][None], dec)[0]
-                )
-        return np.stack(rows)
+        def build() -> np.ndarray:
+            dec = self.decode_matrix_for(present)
+            rows = []
+            for i in missing:
+                if i < self.data_shards:
+                    rows.append(dec[i])
+                else:
+                    rows.append(
+                        gf.gf_matmul(
+                            self.parity_matrix[i - self.data_shards][None], dec
+                        )[0]
+                    )
+            return np.stack(rows)
+
+        key = (tuple(present[: self.data_shards]), tuple(missing))
+        return decode_cache.get(
+            "reedsolomon", self.data_shards, self.parity_shards, key, build
+        )
 
     def reconstruct(
         self, shards: list[np.ndarray | None], data_only: bool = False

@@ -1011,6 +1011,18 @@ def _g_api_tpu(server) -> list[str]:
          [({"family": f}, fs[f].get("repair_partial_blocks", 0))
           for f in fams],
          "Stripe blocks rebuilt via sub-chunk partial repair")
+    from ..erasure.coder import decode_matrix_cache_snapshot
+
+    dc = decode_matrix_cache_snapshot()
+    _fmt(out, "minio_tpu_decode_matrix_cache_total", "counter",
+         [({"family": f, "result": r}, dc["families"][f][k])
+          for f in sorted(dc["families"])
+          for r, k in (("hit", "hits"), ("miss", "misses"))],
+         "Decode-matrix LRU lookups per family (per-failure-pattern "
+         "inverses; ops/decode_cache)")
+    _fmt(out, "minio_tpu_decode_matrix_cache_entries", "gauge",
+         [({}, dc["entries"])],
+         "Decode matrices resident in the LRU")
     return out
 
 
@@ -1060,6 +1072,20 @@ def _g_api_fault(server) -> list[str]:
          "Hedged windows where the parity decode beat the straggler")
     _fmt(out, "minio_fault_hedge_losses_total", "counter",
          [({}, c.get("hedge_losses", 0))])
+    _fmt(out, "minio_fault_repair_hedge_reads_total", "counter",
+         [({}, c.get("repair_hedge_reads", 0))],
+         "Repair-plan windows whose sub-chunk reads blew the hedge "
+         "budget and fired the generic full-frame gather as the hedge")
+    _fmt(out, "minio_fault_repair_hedge_wins_total", "counter",
+         [({}, c.get("repair_hedge_wins", 0))],
+         "Hedged repair blocks where the full gather beat the plan")
+    _fmt(out, "minio_fault_repair_hedge_losses_total", "counter",
+         [({}, c.get("repair_hedge_losses", 0))])
+    _fmt(out, "minio_fault_repair_fallback_blocks_total", "counter",
+         [({}, c.get("repair_fallback_blocks", 0))],
+         "Repair-plan blocks served by the generic full gather "
+         "(hedge wins + mid-plan read failures); the plan itself "
+         "is never abandoned")
     trips = 0
     for d in getattr(server.store, "disks", []):
         if isinstance(d, HealthCheckedDisk):
