@@ -320,3 +320,4 @@ from . import rules_obs     # noqa: E402,F401
 from . import rules_retry   # noqa: E402,F401
 from . import rules_cache   # noqa: E402,F401
 from . import rules_native  # noqa: E402,F401
+from . import rules_copy    # noqa: E402,F401

@@ -172,9 +172,16 @@ _ALL: list[Knob] = [
        "Read-ahead window (spans) for streaming GETs."),
     _k("MINIO_TPU_READ_WORKERS", "32", "erasure",
        "Worker threads per erasure set for parallel shard reads."),
+    _k("MINIO_TPU_POOL_MB", "256", "erasure",
+       "Stripe-arena buffer-pool budget (MiB) shared by ingest and GET "
+       "gather; arenas beyond the budget are freed, not recycled."),
     _k("MINIO_TPU_STREAM_BATCH_MB", "64", "erasure",
        "Stripe bytes accumulated before a streaming PUT flushes a "
        "batched device encode."),
+    _k("MINIO_TPU_ZEROCOPY", "1", "erasure",
+       "Zero-copy data plane: pooled ingest arenas feeding the "
+       "dispatcher, view-based GET gather. `0` restores the legacy "
+       "copying path (A/B lever for the BENCH_r13 ingest phase)."),
     # -- events / notifications ------------------------------------------
     _k("MINIO_NOTIFY_ELASTICSEARCH_ENABLE_", None, "events",
        "Enable the Elasticsearch notify target with this id "

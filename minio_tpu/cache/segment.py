@@ -466,7 +466,13 @@ class SegmentCache:
                 return [], orphans
             if d.segs.get((pnum, bi)) is not None:
                 return [], orphans  # already cached (racing fills)
-            seg = _Seg((dk, pnum, bi), length, bytes(data[:length]))
+            # admission snapshot: the cache owns its copy (the serving
+            # plane may hand us a view of a buffer it keeps reusing) —
+            # one counted copy via memoryview, never slice-then-bytes
+            from ..erasure import bufpool
+
+            bufpool.count_copy("cache-fill")
+            seg = _Seg((dk, pnum, bi), length, bytes(memoryview(data)[:length]))
             d.segs[(pnum, bi)] = seg
             self._mem_lru[seg.key] = seg
             _bytes_add(length)

@@ -488,7 +488,15 @@ class StorageRESTClient(StorageAPI):
             data = data.read()
         self._rpc("createfile", {"volume": volume, "path": path, "data": bytes(data)})
 
-    def append_file(self, volume: str, path: str, data: bytes) -> None:
+    def append_file(self, volume: str, path: str, data) -> None:
+        if not isinstance(data, (bytes, bytearray, memoryview)):
+            # writev vectors serialize at the RPC boundary — the one
+            # legitimate copy on a remote-drive append, counted so the
+            # zero-copy claim stays enumerable
+            from ..erasure import bufpool
+
+            bufpool.count_copy("append-rpc")
+            data = b"".join(data)
         self._rpc("appendfile", {"volume": volume, "path": path, "data": data})
 
     def read_file(self, volume: str, path: str, offset: int = 0, length: int = -1) -> bytes:

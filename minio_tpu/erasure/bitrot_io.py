@@ -132,28 +132,40 @@ def frame_block(
 
 def verify_block(
     buf: bytes, expect_len: int, algo: BitrotAlgorithm = DEFAULT_BITROT_ALGO,
-    family: str = FAMILY_RS,
-) -> bytes:
+    family: str = FAMILY_RS, view: bool = False,
+):
     """Split one shard-block frame group and verify it; returns the block.
 
     Raises FileCorrupt on short reads or digest mismatch — the bitrot
     detection that triggers healing in the read path. Single source of
     truth for the record layout (used by reads, inline verify, heal).
     For the cauchy family the buffer holds TWO digest||sub-chunk frames;
-    both verify and the sub-chunks concatenate back into the block."""
+    both verify and the sub-chunks concatenate back into the block.
+
+    ``view=True`` returns a zero-copy memoryview of the payload where
+    the frame layout allows (reedsolomon: the payload is one contiguous
+    span of ``buf``, which must stay alive while the view is used). The
+    cauchy frame interleaves digests between its sub-chunks, so a
+    contiguous block always assembles once into a fresh buffer —
+    regardless of ``view``, that one copy is inherent to the format."""
     if check_family(family) == FAMILY_CAUCHY:
-        h1, h2 = _sub_lens(expect_len)
         if len(buf) != 2 * DIGEST_SIZE + expect_len:
             raise errors.FileCorrupt("short shard block")
-        sub1 = verify_sub_chunk(buf[: DIGEST_SIZE + h1], h1, algo)
-        sub2 = verify_sub_chunk(buf[DIGEST_SIZE + h1 :], h2, algo)
-        return sub1 + sub2
+        h1, h2 = _sub_lens(expect_len)
+        mv = memoryview(buf)
+        sub1 = verify_sub_chunk(mv[: DIGEST_SIZE + h1], h1, algo)
+        sub2 = verify_sub_chunk(mv[DIGEST_SIZE + h1 :], h2, algo)
+        out = bytearray(expect_len)
+        out[:h1] = sub1
+        out[h1:] = sub2
+        return out
     if len(buf) != DIGEST_SIZE + expect_len:
         raise errors.FileCorrupt("short shard block")
-    digest, block = buf[:DIGEST_SIZE], buf[DIGEST_SIZE:]
+    mv = memoryview(buf)
+    digest, block = mv[:DIGEST_SIZE], mv[DIGEST_SIZE:]
     if _digest(block, algo) != digest:
         raise errors.FileCorrupt("bitrot detected")
-    return block
+    return block if view else bytes(block)
 
 
 def verify_sub_chunk(

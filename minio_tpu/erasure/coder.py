@@ -32,7 +32,7 @@ import numpy as np
 
 from ..ops import rs
 from ..ops.highwayhash import hash256_batch_numpy
-from . import bitrot_io
+from . import bitrot_io, bufpool
 from .bitrot_io import FAMILY_CAUCHY, FAMILY_RS
 
 # max shards per device dispatch (HBM headroom: the hash lane arrays
@@ -146,6 +146,32 @@ class EncodedPart:
     size: int  # input size
 
 
+class EncodedBatch:
+    """One streaming-encode batch on the zero-copy plane.
+
+    ``shard_vecs[i]`` is the writev-style buffer sequence for erasure
+    index i — alternating digest-row / shard-row views into the encode
+    output, framed exactly like the legacy bytearray chunks. ``raw`` is
+    the input slice this batch encoded (md5/size folding); on the pooled
+    path it is a memoryview into the ingest arena, so the caller MUST
+    finish both the md5 fold and every ``append_file(shard_vecs[i])``
+    before calling :meth:`release` — the release returns the arena to
+    the pool (docs/ERASURE.md buffer-ownership contract)."""
+
+    __slots__ = ("shard_vecs", "raw", "_lease")
+
+    def __init__(self, shard_vecs, raw, lease=None):
+        self.shard_vecs: list[list] = shard_vecs
+        self.raw = raw
+        self._lease = lease
+
+    def release(self) -> None:
+        """Return the backing ingest arena (if pooled). Idempotent."""
+        lease, self._lease = self._lease, None
+        if lease is not None:
+            lease.release()
+
+
 class ErasureCoder:
     def __init__(
         self, data_blocks: int, parity_blocks: int,
@@ -238,6 +264,7 @@ class ErasureCoder:
         full = len(data) // self.block_size
         per = self.shard_size
         padded_block = self.d * per  # >= block_size; zero padding at tail
+        bufpool.count_copy("staging")  # bytes -> numpy staging materialization
         arr = np.zeros((full, self.d, per), dtype=np.uint8)
         flat = np.frombuffer(data, dtype=np.uint8)
         if padded_block == self.block_size:
@@ -264,10 +291,12 @@ class ErasureCoder:
                     else:
                         files[i] += digests[b, i].tobytes()
                         files[i] += shards[b, i].tobytes()
+        bufpool.count_copy("frame-tobytes", full * self.t)
         return files
 
     def _encode_tail_buffer(self, data: bytes) -> list[bytearray]:
         """Partial final block (numpy codec, byte-identical)."""
+        bufpool.count_copy("tail-block", self.t)
         if self.family == FAMILY_CAUCHY:
             shards = self._np.encode_data(data)
             family_stats_add(self.family, "encode_blocks", 1)
@@ -305,17 +334,127 @@ class ErasureCoder:
                 continue
             buf += chunk
             while len(buf) >= batch_bytes:
+                bufpool.count_copy("staging")
                 piece = bytes(buf[:batch_bytes])
                 del buf[:batch_bytes]
                 yield self._encode_full_buffer(memoryview(piece)), piece
         full = (len(buf) // self.block_size) * self.block_size
         if full:
+            bufpool.count_copy("staging")
             piece = bytes(buf[:full])
             del buf[:full]
             yield self._encode_full_buffer(memoryview(piece)), piece
         if buf:
             piece = bytes(buf)
             yield self._encode_tail_buffer(piece), piece
+
+    def _frame_into(
+        self, vecs: list[list], shards: np.ndarray, digests: np.ndarray
+    ) -> None:
+        """Append digest/shard ROW VIEWS to the per-shard writev vectors
+        — same on-disk frame interleave as _encode_full_buffer, zero
+        materialization. The views pin the encode-output arrays alive
+        until the disk layer consumes them."""
+        cauchy = self.family == FAMILY_CAUCHY
+        h1 = shards.shape[2] // 2
+        for b in range(shards.shape[0]):
+            for i in range(self.t):
+                v = vecs[i]
+                if cauchy:
+                    v.append(digests[b, i, 0].data)
+                    v.append(shards[b, i, :h1].data)
+                    v.append(digests[b, i, 1].data)
+                    v.append(shards[b, i, h1:].data)
+                else:
+                    v.append(digests[b, i].data)
+                    v.append(shards[b, i].data)
+
+    def _emit_zc(self, lease, nbytes: int) -> EncodedBatch:
+        """Encode the first nbytes (whole stripe blocks) of a pooled
+        ingest arena. The arena IS the dispatch geometry — reshape, no
+        copy — and the batch takes over the lease (released by the
+        caller once md5 + shard appends are done)."""
+        full = nbytes // self.block_size
+        arr = lease.array[:nbytes].reshape(full, self.d, self.shard_size)
+        vecs: list[list] = [[] for _ in range(self.t)]
+        max_blocks = max(1, MAX_DEVICE_SHARDS // self.t)
+        for start in range(0, full, max_blocks):
+            shards, digests = self._encode_full_blocks(arr[start : start + max_blocks])
+            self._frame_into(vecs, shards, digests)
+        return EncodedBatch(vecs, lease.view(nbytes), lease)
+
+    def iter_encode_zc(
+        self, reader, max_batch_bytes: int | None = None
+    ) -> "Iterator[EncodedBatch]":
+        """Zero-copy streaming encode: reader chunks land DIRECTLY in a
+        pooled arena laid out in dispatcher geometry [B, d, shard_size],
+        the device consumes the arena view, and framing yields shard-row
+        views for writev-style appends — no staging copy anywhere on the
+        full-block path (site "staging" stays 0; the partial tail block
+        is the one inherent copy, counted as "tail-block").
+
+        Falls back to the counting legacy path when MINIO_TPU_ZEROCOPY=0
+        (the A/B lever) or when d does not divide block_size (the flat
+        stream cannot alias as [B, d, per] — shard padding interleaves).
+        Every yielded batch must be release()d by the caller; abandoning
+        the generator releases the in-fill arena via close().
+        """
+        per = self.shard_size
+        if self.d * per != self.block_size or not bufpool.zerocopy_enabled():
+            for chunks, raw in self.iter_encode(reader, max_batch_bytes):
+                yield EncodedBatch([[bytes(c)] for c in chunks], raw)
+            return
+        batch_blocks = max(1, MAX_DEVICE_SHARDS // self.t)
+        if max_batch_bytes is not None:
+            batch_blocks = max(1, min(batch_blocks, max_batch_bytes // self.block_size))
+        # round DOWN to a power of two: the dispatcher buckets batch
+        # sizes to powers of two, so an exact-fit arena dispatches as-is
+        # (no bucket copy, no pad) instead of padding 192 -> 256
+        p2 = 1
+        while p2 * 2 <= batch_blocks:
+            p2 <<= 1
+        batch_blocks = p2
+        batch_bytes = batch_blocks * self.block_size
+        pool = bufpool.get_pool()
+        lease = None
+        mv: memoryview | None = None
+        pos = 0
+        try:
+            for chunk in reader:
+                if not chunk:
+                    continue
+                cmv = memoryview(chunk)
+                off = 0
+                while off < len(cmv):
+                    if lease is None:
+                        lease = pool.acquire(batch_bytes)
+                        mv = lease.view(batch_bytes)
+                        pos = 0
+                    n = min(len(cmv) - off, batch_bytes - pos)
+                    mv[pos : pos + n] = cmv[off : off + n]
+                    pos += n
+                    off += n
+                    if pos == batch_bytes:
+                        batch, lease, mv = self._emit_zc(lease, pos), None, None
+                        yield batch
+            if lease is not None:
+                full = (pos // self.block_size) * self.block_size
+                # the tail residue is copied OUT of the arena before the
+                # full-block batch hands the lease to the caller
+                tail = bytes(mv[full:pos]) if pos > full else b""
+                if full:
+                    batch, lease, mv = self._emit_zc(lease, full), None, None
+                    yield batch
+                else:
+                    lease.release()
+                    lease = mv = None
+                if tail:
+                    yield EncodedBatch(
+                        [[bytes(c)] for c in self._encode_tail_buffer(tail)], tail
+                    )
+        finally:
+            if lease is not None:
+                lease.release()
 
     def encode_part(self, data: bytes) -> EncodedPart:
         """Erasure-code one in-memory part into per-drive shard files.

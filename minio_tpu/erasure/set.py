@@ -35,7 +35,7 @@ from ..storage.datatypes import (
 from ..storage.format import INLINE_DATA_THRESHOLD
 from ..storage.interface import StorageAPI
 from ..utils.hashing import hash_order
-from . import bitrot_io
+from . import bitrot_io, bufpool
 from .coder import (
     BLOCK_SIZE,
     ErasureCoder,
@@ -612,25 +612,62 @@ class ErasureSet:
                     bucket, obj,
                 )
             else:
-                for chunks, raw in coder.iter_encode(reader, max_batch_bytes=stream_cap):
-                    if lock is not None and lock.lost:
-                        raise QuorumError(
-                            f"write lock on {bucket}/{obj} lost mid-stream; aborting"
-                        )
-                    md5.update(raw)
-                    size += len(raw)
-                    futs = []
-                    for i, disk in enumerate(self.disks):
-                        shard_idx = fi.erasure.distribution[i] - 1
-                        futs.append(self._pool.submit(
-                            drive_op, i, disk.append_file, TMP_VOLUME, stage,
-                            bytes(chunks[shard_idx]),
-                        ))
-                    for f in futs:
-                        f.result()
-                    if sum(e is None for e in errs) < write_q:
-                        raise QuorumError("write quorum lost mid-stream")
+                # zero-copy plane: reader chunks accumulate straight into
+                # pooled arenas in dispatcher geometry; shard appends are
+                # writev vectors of encode-output views. Each batch's
+                # arena is released only after md5 + every drive append
+                # completed (drive_op futures joined) — a mid-PUT drive
+                # failure can therefore never recycle a referenced arena.
+                # process-global site counters: the delta is this PUT's
+                # copies plus any concurrent traffic — an attribution
+                # signal for the obs stream, not an exact per-request bill
+                copies0 = bufpool.copies_snapshot() if obs.active() else None
+                batch = None
+                try:
+                    for batch in coder.iter_encode_zc(
+                        reader, max_batch_bytes=stream_cap
+                    ):
+                        if lock is not None and lock.lost:
+                            raise QuorumError(
+                                f"write lock on {bucket}/{obj} lost mid-stream;"
+                                " aborting"
+                            )
+                        md5.update(batch.raw)
+                        size += len(batch.raw)
+                        futs = []
+                        for i, disk in enumerate(self.disks):
+                            shard_idx = fi.erasure.distribution[i] - 1
+                            futs.append(self._pool.submit(
+                                drive_op, i, disk.append_file, TMP_VOLUME, stage,
+                                batch.shard_vecs[shard_idx],
+                            ))
+                        for f in futs:
+                            f.result()
+                        batch.release()
+                        batch = None
+                        if sum(e is None for e in errs) < write_q:
+                            raise QuorumError("write quorum lost mid-stream")
+                finally:
+                    if batch is not None:
+                        batch.release()
                 etag = md5.hexdigest()
+                if copies0 is not None:
+                    import time as _time
+
+                    copies1 = bufpool.copies_snapshot()
+                    obs.publish({
+                        "time": _time.time(),
+                        "type": obs.TYPE_TPU,
+                        "name": "copy.site",
+                        "node": obs.trace.NODE,
+                        "bytes": size,
+                        "zerocopy": bufpool.zerocopy_enabled(),
+                        "sites": {
+                            s: copies1[s] - copies0.get(s, 0)
+                            for s in copies1
+                            if copies1[s] - copies0.get(s, 0)
+                        },
+                    })
 
             fi.size = size
             fi.metadata.setdefault("etag", etag)
@@ -941,7 +978,18 @@ class ErasureSet:
                     fut.set_exception(e)
             return fut.result()
 
-        def read_shard_block(part_num: int, idx: int, per: int, f_off: int) -> bytes:
+        # zero-copy gather: verified shard payloads flow as views of the
+        # read buffer (reedsolomon frames; cauchy's interleaved digests
+        # make its one assembly copy inherent), and blocks assemble ONCE
+        # into a pre-sized buffer served as a memoryview slice
+        zc = bufpool.zerocopy_enabled()
+
+        def serve_slice(buf: bytearray, a: int, b: int):
+            """Slice an assembled (GC-owned, never recycled) block for
+            the response: a view when zero-copy, bytes on the A/B path."""
+            return memoryview(buf)[a:b] if zc else bytes(memoryview(buf)[a:b])
+
+        def read_shard_block(part_num: int, idx: int, per: int, f_off: int):
             disk, m = sources[idx]
             wf = _whole_file_hash(m, part_num)
             if wf is not None:
@@ -957,7 +1005,7 @@ class ErasureSet:
                 buf = disk.read_file(
                     bucket, f"{obj}/{fi.data_dir}/part.{part_num}", f_off, fdig + per
                 )
-            return bitrot_io.verify_block(buf, per, family=family)
+            return bitrot_io.verify_block(buf, per, family=family, view=zc)
 
         def read_sub_chunk(
             part_num: int, idx: int, per: int, f_off: int, which: int
@@ -997,7 +1045,7 @@ class ErasureSet:
 
         def repair_read_block(
             pnum: int, per: int, f_off: int, lo: int, hi: int
-        ) -> bytes:
+        ):
             """Serve [lo, hi) of one stripe block under the repair plan:
             full frames only for the data shards the range needs, the
             schedule's sub-chunk frames to rebuild the lost one."""
@@ -1059,8 +1107,14 @@ class ErasureSet:
                     repair_sched, per, sub2, pb, sub1
                 )
                 family_stats_add(family, "degraded_ingress_bytes", ingress)
-            out = b"".join(got_full[idx].tobytes() for idx in needed)
-            return out[lo - lo_sh * per : hi - lo_sh * per]
+            # single pre-sized assembly (was .tobytes() per shard +
+            # b"".join — two full copies of every block)
+            out = bytearray(len(needed) * per)
+            mv = memoryview(out)
+            for j, idx in enumerate(needed):
+                mv[j * per : (j + 1) * per] = got_full[idx]
+            bufpool.count_copy("gather-join")
+            return serve_slice(out, lo - lo_sh * per, hi - lo_sh * per)
 
         # ---- plan: every stripe block overlapping [offset, offset+length) ----
         plan: list[tuple[int, int, int, int, int]] = []  # (part#, per, f_off, lo, hi)
@@ -1303,14 +1357,25 @@ class ErasureSet:
                 )
             return got
 
-        def decode_window(win, got) -> list[bytes]:
-            """Per-block data bytes; same-pattern degraded blocks batch."""
-            out: list[bytes | None] = [None] * len(win)
+        def decode_window(win, got) -> list:
+            """Per-block data buffers; same-pattern degraded blocks batch.
+
+            Every block assembles exactly ONCE into a pre-sized buffer
+            (shard payload views copy in directly — the old .tobytes()
+            per shard + b"".join double copy is gone; the single copy is
+            site "gather-join")."""
+            out: list = [None] * len(win)
             groups: dict[tuple[tuple[int, ...], int], list[int]] = {}
             for bi in range(len(win)):
                 present = tuple(sorted(got[bi].keys())[:d])
                 if present == tuple(range(d)):
-                    out[bi] = b"".join(got[bi][i] for i in range(d))
+                    per = win[bi][1]
+                    buf = bytearray(d * per)
+                    mv = memoryview(buf)
+                    for i in range(d):
+                        mv[i * per : (i + 1) * per] = got[bi][i]
+                    bufpool.count_copy("gather-join")
+                    out[bi] = buf
                 else:
                     # survivor ingress: every frame fetched for a block
                     # that needs reconstruction (the full-shard cost the
@@ -1325,18 +1390,40 @@ class ErasureSet:
             for (present, per), bis in groups.items():
                 missing = tuple(i for i in range(d) if i not in present)
                 # build [d, W', per] directly: the contiguous layout the
-                # native GF apply consumes, no post-stack transpose copies
-                survivors = np.empty((d, len(bis), per), dtype=np.uint8)
-                for k, i in enumerate(present):
-                    for w, bi in enumerate(bis):
-                        survivors[k, w] = np.frombuffer(got[bi][i], dtype=np.uint8)
-                rec = coder.reconstruct_data_flat(survivors, present, missing, pool)
+                # native GF apply consumes, no post-stack transpose
+                # copies. The stack is POOLED scratch — recycled the
+                # moment reconstruction returns (its outputs are fresh
+                # arrays, never views of the stack)
+                nb = d * len(bis) * per
+                stack_lease = bufpool.get_pool().acquire(nb) if zc else None
+                try:
+                    if stack_lease is not None:
+                        survivors = stack_lease.array[:nb].reshape(
+                            d, len(bis), per
+                        )
+                    else:
+                        survivors = np.empty((d, len(bis), per), dtype=np.uint8)
+                    for k, i in enumerate(present):
+                        for w, bi in enumerate(bis):
+                            survivors[k, w] = np.frombuffer(
+                                got[bi][i], dtype=np.uint8
+                            )
+                    rec = coder.reconstruct_data_flat(
+                        survivors, present, missing, pool
+                    )
+                finally:
+                    if stack_lease is not None:
+                        stack_lease.release()
+                mj = {i: j for j, i in enumerate(missing)}
                 for w, bi in enumerate(bis):
-                    shards = {i: got[bi][i] for i in present if i < d}
-                    for mj, i in enumerate(missing):
-                        shards[i] = rec[mj, w].tobytes()
-                    out[bi] = b"".join(shards[i] for i in range(d))
-            return out  # type: ignore[return-value]
+                    buf = bytearray(d * per)
+                    mv = memoryview(buf)
+                    for i in range(d):
+                        src = rec[mj[i], w] if i in mj else got[bi][i]
+                        mv[i * per : (i + 1) * per] = src
+                    bufpool.count_copy("gather-join")
+                    out[bi] = buf
+            return out
 
         # ---- repair-plan execution: block-serial baseline --------------
         # (MINIO_TPU_REPAIR_WINDOWED=0: one block's sub-chunk reads at a
@@ -1412,7 +1499,7 @@ class ErasureSet:
                         )
                 return futs
 
-            def assemble_repair(entry, full, subs) -> bytes:
+            def assemble_repair(entry, full, subs):
                 """Plan-complete block -> its [lo, hi) bytes (the compute
                 half of repair_read_block; reads already resolved)."""
                 pnum, per, f_off, lo, hi = entry
@@ -1436,9 +1523,14 @@ class ErasureSet:
                         repair_sched, per, sub2, pb, sub1
                     )
                     family_stats_add(family, "degraded_ingress_bytes", ingress)
-                out = b"".join(got[i].tobytes() for i in needed)
+                # single pre-sized assembly (was .tobytes() + b"".join)
+                out = bytearray(len(needed) * per)
+                mv = memoryview(out)
+                for j, i in enumerate(needed):
+                    mv[j * per : (j + 1) * per] = got[i]
+                bufpool.count_copy("gather-join")
                 lo_sh = lo // per
-                return out[lo - lo_sh * per : hi - lo_sh * per]
+                return serve_slice(out, lo - lo_sh * per, hi - lo_sh * per)
 
             def gather_repair_window(win, futs):
                 """Resolve a window of plan blocks. Each block is its own
@@ -1530,7 +1622,7 @@ class ErasureSet:
                         return
                     block = decode_window([win[bi]], [fb_got[bi]])[0]
                     _pnum, _per, _f_off, lo, hi = win[bi]
-                    pieces[bi] = block[lo:hi]
+                    pieces[bi] = serve_slice(block, lo, hi)
                     fault_registry.stats_add("repair_fallback_blocks")
                     if fb_hedge[bi]:
                         fault_registry.stats_add("repair_hedge_wins")
@@ -1673,12 +1765,13 @@ class ErasureSet:
                         # the decode always materializes the FULL stripe
                         # block (ranged reads only slice at yield time),
                         # so even a partial-range request fills whole
-                        # verified segments
+                        # verified segments (the cache copies on admit —
+                        # site "cache-fill" — so serving views is safe)
                         seg_sink(
                             pnum, f_off // (fdig + coder.shard_size),
                             block,
                         )
-                    yield block[lo:hi]
+                    yield serve_slice(block, lo, hi)
         finally:
             # abandoned iterator (client hung up) or error: don't let
             # readahead reads+verifies hog the shared pool
@@ -2674,6 +2767,9 @@ class ObjectHandle:
                         self._mutex.refresh()
                         last_refresh = now
                     if collected is not None:
+                        # data-cache fill owns its copy (chunks may be
+                        # views of per-window assembly buffers)
+                        bufpool.count_copy("cache-fill")
                         collected.append(bytes(chunk))
                     yield chunk
                 if collected is not None:

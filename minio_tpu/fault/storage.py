@@ -80,10 +80,16 @@ class FaultInjectedDisk(StorageAPI):
         if rule.mode == "enospc":
             raise errors.DiskFull(f"{self.endpoint}: injected ENOSPC")
         if rule.mode == "torn-write":
-            if name in _WRITE_OPS and len(a) >= 3 and isinstance(
-                a[2], (bytes, bytearray, memoryview)
+            if name in _WRITE_OPS and len(a) >= 3 and (
+                isinstance(a[2], (bytes, bytearray, memoryview))
+                or isinstance(a[2], (list, tuple))
             ):
-                data = bytes(a[2])
+                # writev vectors (zero-copy shard frames) tear the same
+                # way a flat payload does: half the joined bytes land
+                payload = a[2]
+                if isinstance(payload, (list, tuple)):
+                    payload = b"".join(bytes(p) for p in payload)
+                data = bytes(payload)
                 try:
                     # half the payload lands, then the drive "dies":
                     # the staged shard file is torn, not merely absent

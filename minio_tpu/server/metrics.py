@@ -1023,6 +1023,45 @@ def _g_api_tpu(server) -> list[str]:
     _fmt(out, "minio_tpu_decode_matrix_cache_entries", "gauge",
          [({}, dc["entries"])],
          "Decode matrices resident in the LRU")
+    # zero-copy data plane (erasure/bufpool.py): counted hot-path copies
+    # per named site plus stripe-arena pool behaviour — the A/B surface
+    # for the MINIO_TPU_ZEROCOPY lever (BENCH_r13 gates staging==0 on
+    # aligned streaming PUTs against these exact series)
+    from ..erasure import bufpool
+
+    cs = bufpool.copies_snapshot()
+    _fmt(out, "minio_tpu_ingest_copies_total", "counter",
+         [({"site": s}, cs[s]) for s in sorted(cs)],
+         "Full-buffer copies at named data-plane sites (zero at "
+         "'staging' under the zero-copy plane on aligned streaming PUTs)")
+    ps = bufpool.pool_stats_snapshot()
+    _fmt(out, "minio_tpu_pool_acquires_total", "counter",
+         [({"result": "hit"}, ps.get("hits", 0)),
+          ({"result": "miss"}, ps.get("misses", 0)),
+          ({"result": "unpooled"}, ps.get("unpooled", 0))],
+         "Stripe-arena pool acquisitions by outcome (unpooled = size "
+         "outside the pooled classes, plain allocation)")
+    _fmt(out, "minio_tpu_pool_recycled_bytes_total", "counter",
+         [({}, ps.get("recycled_bytes", 0))])
+    _fmt(out, "minio_tpu_pool_resident_bytes", "gauge",
+         [({}, ps.get("resident_bytes", 0))],
+         "Recycled arena bytes resident in the pool free lists")
+    _fmt(out, "minio_tpu_pool_live_leases", "gauge",
+         [({}, ps.get("live_leases", 0))])
+    _fmt(out, "minio_tpu_pool_lease_violations_total", "counter",
+         [({}, ps.get("violations", 0))],
+         "Lease-discipline violations (double-release / retain-dead); "
+         "always 0 in a healthy process, sanitizer-witnessed otherwise")
+    _fmt(out, "minio_tpu_dispatch_pad_blocks_total", "counter",
+         [({}, ds.get("pad_blocks", 0))],
+         "Zero-filled pad blocks appended to round batches up to buckets")
+    _fmt(out, "minio_tpu_dispatch_arena_direct_total", "counter",
+         [({}, ds.get("arena_direct", 0))],
+         "Dispatches fed straight from a caller arena (exact bucket fit, "
+         "no assembly copy)")
+    _fmt(out, "minio_tpu_dispatch_bucket_blocks_distribution", "counter",
+         _hist_rows(dmod.BUCKET_BLOCK_BUCKETS, ds.get("bucket_hist", [])),
+         "Padded bucket size (blocks) per dispatch")
     return out
 
 

@@ -69,7 +69,10 @@ class StorageAPI(ABC):
     def create_file(self, volume: str, path: str, data: bytes | BinaryIO) -> None: ...
 
     @abstractmethod
-    def append_file(self, volume: str, path: str, data: bytes) -> None: ...
+    def append_file(self, volume: str, path: str, data) -> None:
+        """data: bytes-like, or a writev-style sequence of buffers
+        (appended in order — the zero-copy shard-frame contract)."""
+        ...
 
     @abstractmethod
     def read_file(self, volume: str, path: str, offset: int = 0, length: int = -1) -> bytes: ...

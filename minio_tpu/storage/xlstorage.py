@@ -391,12 +391,18 @@ class XLStorage(StorageAPI):
                 os.close(fd2)
         return True
 
-    def append_file(self, volume: str, path: str, data: bytes) -> None:
+    def append_file(self, volume: str, path: str, data) -> None:
+        """Append bytes-like data OR a writev-style sequence of buffers
+        (the zero-copy shard-frame vectors: digest/shard views appended
+        in one pass, never joined into an intermediate bytes)."""
         _fanout_bump("shard_writes", volume)
         full = self._file_path(volume, path)
         os.makedirs(os.path.dirname(full), exist_ok=True)
         with open(full, "ab") as f:
-            f.write(data)
+            if isinstance(data, (bytes, bytearray, memoryview)):
+                f.write(data)
+            else:
+                f.writelines(data)
 
     def read_file(self, volume: str, path: str, offset: int = 0, length: int = -1) -> bytes:
         _fanout_bump("shard_reads", volume)
